@@ -1,0 +1,14 @@
+"""code2vec_tpu: a TPU-native (JAX/XLA/Flax/pjit) framework for learning
+distributed representations of code from bags of AST path-contexts.
+
+Capability parity target: km-Poonacha/code2vec (see /root/repo/SURVEY.md).
+The architecture is TPU-first — host-side integer data pipeline, a single
+Flax model (instead of the reference's dual TF1/Keras backends,
+reference: code2vec.py:7-13), pjit/shard_map sharding over a
+``jax.sharding.Mesh`` for data/model/context parallelism, Optax Adam,
+Orbax checkpoints — not a translation of the reference's TF graphs.
+"""
+
+__version__ = "0.1.0"
+
+from code2vec_tpu.config import Config  # noqa: F401

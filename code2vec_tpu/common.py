@@ -1,0 +1,103 @@
+"""String/number helpers shared across the framework.
+
+Behavioral spec comes from the reference's ``common.py`` (normalization
+common.py:12-18, legality filter common.py:122-129, subtoken split
+common.py:131-133, first-match search common.py:180-187, word2vec text
+format common.py:82-91, fast line count common.py:166-170). No TF here —
+these are pure-Python/numpy utilities usable from the host data pipeline.
+"""
+
+from __future__ import annotations
+
+import re
+from itertools import repeat, takewhile
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+_NON_ALPHA_RE = re.compile(r"[^a-zA-Z]")
+_LEGAL_NAME_RE = re.compile(r"^[a-zA-Z|]+$")
+
+
+def normalize_word(word: str) -> str:
+    """Strip non-alphabetic chars and lowercase; fall back to plain lower.
+
+    reference: common.py:12-18.
+    """
+    stripped = _NON_ALPHA_RE.sub("", word)
+    return word.lower() if not stripped else stripped.lower()
+
+
+def is_legal_method_name(name: str, oov_word: str) -> bool:
+    """A prediction is 'legal' iff it is not OOV and matches ^[a-zA-Z|]+$.
+
+    reference: common.py:122-124.
+    """
+    return name != oov_word and bool(_LEGAL_NAME_RE.match(name))
+
+
+def filter_impossible_names(top_words: Iterable[str], oov_word: str) -> List[str]:
+    # reference: common.py:126-129
+    return [w for w in top_words if is_legal_method_name(w, oov_word)]
+
+
+def get_subtokens(name: str) -> List[str]:
+    # reference: common.py:131-133 — subtokens are '|'-separated.
+    return name.split("|")
+
+
+def get_first_match_word_from_top_predictions(
+    original_name: str, top_predicted_words: Iterable[str], oov_word: str
+) -> Optional[Tuple[int, str]]:
+    """Index (within the legality-filtered list) + word of the first
+    prediction whose normalized form equals the normalized original name.
+
+    reference: common.py:180-187.
+    """
+    normalized_original = normalize_word(original_name)
+    for idx, predicted in enumerate(filter_impossible_names(top_predicted_words, oov_word)):
+        if normalize_word(predicted) == normalized_original:
+            return idx, predicted
+    return None
+
+
+def save_word2vec_file(output_file, index_to_word: Dict[int, str],
+                       embedding_matrix: np.ndarray) -> None:
+    """Plain-text word2vec format: header 'vocab dim', then 'word v0 v1 ...'.
+
+    reference: common.py:82-91.
+    """
+    assert embedding_matrix.ndim == 2
+    vocab_size, dim = embedding_matrix.shape
+    output_file.write("%d %d\n" % (vocab_size, dim))
+    for word_idx in range(vocab_size):
+        assert word_idx in index_to_word
+        output_file.write(index_to_word[word_idx] + " ")
+        output_file.write(" ".join(map(str, embedding_matrix[word_idx])) + "\n")
+
+
+def count_lines_in_file(file_path: str) -> int:
+    # reference: common.py:166-170 — buffered newline counting.
+    with open(file_path, "rb") as f:
+        bufgen = takewhile(lambda x: x, (f.raw.read(1024 * 1024) for _ in repeat(None)))
+        return sum(buf.count(b"\n") for buf in bufgen)
+
+
+def java_string_hashcode(s: str) -> int:
+    """Java's ``String#hashCode`` in Python; used to map hashed path strings
+    back to readable ones for the attention display.
+
+    reference: extractor.py:40-49; JavaExtractor ProgramRelation.java:18-34.
+    """
+    h = 0
+    for ch in s:
+        h = (31 * h + ord(ch)) & 0xFFFFFFFF
+    if h > 0x7FFFFFFF:
+        h -= 0x100000000
+    return h
+
+
+def split_to_batches(items, batch_size: int):
+    # reference: common.py:117-120
+    for i in range(0, len(items), batch_size):
+        yield items[i:i + batch_size]

@@ -1,0 +1,213 @@
+"""Configuration for the TPU-native code2vec framework.
+
+Mirrors the knob surface of the reference ``Config`` class
+(reference: config.py:46-70 for defaults, config.py:10-44 for CLI flags,
+config.py:143-230 for derived path conventions) as a frozen-free dataclass,
+and adds TPU-specific knobs (mesh shape, compute dtype, packed-data paths)
+that have no reference equivalent (the reference is single-device,
+reference: SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+import sys
+from typing import Optional
+
+
+_LOGGER_NAME = "code2vec_tpu"
+
+
+@dataclasses.dataclass
+class Config:
+    # -- training schedule (reference: config.py:46-57) --
+    num_train_epochs: int = 20
+    save_every_epochs: int = 1
+    train_batch_size: int = 1024
+    test_batch_size: int = 1024
+    top_k_words_considered_during_prediction: int = 10
+    num_batches_to_log_progress: int = 100
+    num_train_batches_to_evaluate: int = 1800
+    reader_num_workers: int = 6
+    shuffle_buffer_size: int = 10000
+    csv_buffer_size: int = 100 * 1024 * 1024
+    max_to_keep: int = 10
+
+    # -- model hyper-params (reference: config.py:59-70) --
+    max_contexts: int = 200
+    max_token_vocab_size: int = 1301136
+    max_target_vocab_size: int = 261245
+    max_path_vocab_size: int = 911417
+    default_embeddings_size: int = 128
+    token_embeddings_size: int = 128
+    path_embeddings_size: int = 128
+    dropout_keep_rate: float = 0.75
+    separate_oov_and_pad: bool = False
+
+    # -- CLI-filled run mode (reference: config.py:72-87) --
+    predict: bool = False
+    model_save_path: Optional[str] = None
+    model_load_path: Optional[str] = None
+    train_data_path_prefix: Optional[str] = None
+    test_data_path: str = ""
+    release: bool = False
+    export_code_vectors: bool = False
+    save_w2v: Optional[str] = None
+    save_t2v: Optional[str] = None
+    verbose_mode: int = 1
+    logs_path: Optional[str] = None
+    use_tensorboard: bool = False
+
+    # -- TPU-native knobs (no reference equivalent) --
+    # Mesh axis sizes: data parallel, tensor/model parallel (row-sharded
+    # embedding tables + target softmax), context/sequence parallel
+    # (shards the MAX_CONTEXTS axis; SURVEY.md §5 long-context plan).
+    dp: int = 1
+    tp: int = 1
+    cp: int = 1
+    # Computation dtype for matmuls (params stay float32). bfloat16 maps
+    # onto the MXU natively; accumulation is forced to float32.
+    compute_dtype: str = "bfloat16"
+    # Adam hyper-params (reference uses tf.compat.v1.train.AdamOptimizer()
+    # defaults, tensorflow_model.py:231).
+    learning_rate: float = 0.001
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    # Use the hand-written shard_map tensor-parallel kernels instead of
+    # relying purely on GSPMD sharding propagation (only matters if tp>1).
+    use_manual_tp_kernels: bool = True
+    # Prefer the packed int32 binary sidecar (.c2vb) when present.
+    use_packed_data: bool = True
+    # Number of batches the host pipeline keeps in flight ahead of device.
+    prefetch_batches: int = 4
+    # Random seed for params/dropout.
+    seed: int = 42
+
+    # -- filled at runtime (reference: config.py:130-132) --
+    num_train_examples: int = 0
+    num_test_examples: int = 0
+
+    # ---------------------------------------------------------------- derived
+
+    @property
+    def context_vector_size(self) -> int:
+        # concat of source-token, path and target-token embeddings
+        # (reference: config.py:143-147).
+        return self.path_embeddings_size + 2 * self.token_embeddings_size
+
+    @property
+    def code_vector_size(self) -> int:
+        return self.context_vector_size
+
+    @property
+    def target_embeddings_size(self) -> int:
+        return self.code_vector_size
+
+    @property
+    def is_training(self) -> bool:
+        return bool(self.train_data_path_prefix)
+
+    @property
+    def is_loading(self) -> bool:
+        return bool(self.model_load_path)
+
+    @property
+    def is_saving(self) -> bool:
+        return bool(self.model_save_path)
+
+    @property
+    def is_testing(self) -> bool:
+        return bool(self.test_data_path)
+
+    @property
+    def train_steps_per_epoch(self) -> int:
+        # reference: config.py:165-167
+        if not self.train_batch_size:
+            return 0
+        return math.ceil(self.num_train_examples / self.train_batch_size)
+
+    @property
+    def test_steps(self) -> int:
+        if not self.test_batch_size:
+            return 0
+        return math.ceil(self.num_test_examples / self.test_batch_size)
+
+    @property
+    def train_data_path(self) -> Optional[str]:
+        # reference: config.py:179-183 — `<prefix>.train.c2v`
+        if not self.is_training:
+            return None
+        return f"{self.train_data_path_prefix}.train.c2v"
+
+    @property
+    def word_freq_dict_path(self) -> Optional[str]:
+        # reference: config.py:185-189 — `<prefix>.dict.c2v`
+        if not self.is_training:
+            return None
+        return f"{self.train_data_path_prefix}.dict.c2v"
+
+    def data_path(self, is_evaluating: bool = False) -> Optional[str]:
+        return self.test_data_path if is_evaluating else self.train_data_path
+
+    def batch_size(self, is_evaluating: bool = False) -> int:
+        return self.test_batch_size if is_evaluating else self.train_batch_size
+
+    @staticmethod
+    def get_vocabularies_path_from_model_path(model_file_path: str) -> str:
+        # reference: config.py:191-194 — vocabs live next to the model as
+        # `dictionaries.bin`.
+        return os.path.join(os.path.dirname(model_file_path), "dictionaries.bin")
+
+    @property
+    def model_load_dir(self) -> str:
+        return os.path.dirname(self.model_load_path or "")
+
+    @property
+    def mesh_size(self) -> int:
+        return self.dp * self.tp * self.cp
+
+    # ---------------------------------------------------------------- checks
+
+    def verify(self) -> None:
+        # reference: config.py:232-239, plus mesh-shape checks.
+        if not self.is_training and not self.is_loading:
+            raise ValueError("Must train or load a model.")
+        if self.is_loading and not os.path.isdir(self.model_load_dir):
+            raise ValueError(
+                f"Model load dir `{self.model_load_dir}` does not exist.")
+        if self.dp < 1 or self.tp < 1 or self.cp < 1:
+            raise ValueError("Mesh axis sizes dp/tp/cp must be >= 1.")
+        if self.max_contexts % self.cp != 0:
+            raise ValueError(
+                f"max_contexts ({self.max_contexts}) must be divisible by the "
+                f"context-parallel degree cp ({self.cp}).")
+        if self.compute_dtype not in ("bfloat16", "float32"):
+            raise ValueError("compute_dtype must be bfloat16 or float32.")
+
+    # ---------------------------------------------------------------- logging
+
+    def get_logger(self) -> logging.Logger:
+        logger = logging.getLogger(_LOGGER_NAME)
+        if not logger.handlers:
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+            formatter = logging.Formatter("%(asctime)s %(levelname)-8s %(message)s")
+            if self.verbose_mode >= 1:
+                ch = logging.StreamHandler(sys.stdout)
+                ch.setFormatter(formatter)
+                logger.addHandler(ch)
+            if self.logs_path:
+                fh = logging.FileHandler(self.logs_path)
+                fh.setFormatter(formatter)
+                logger.addHandler(fh)
+        return logger
+
+    def log(self, msg: str) -> None:
+        self.get_logger().info(msg)
+
+    def items(self):
+        return dataclasses.asdict(self).items()

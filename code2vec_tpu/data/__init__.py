@@ -1,0 +1,7 @@
+from code2vec_tpu.data.reader import (  # noqa: F401
+    EstimatorAction,
+    RowBatch,
+    PathContextReader,
+    parse_context_lines,
+)
+from code2vec_tpu.data.packed import pack_c2v, PackedDataset  # noqa: F401

@@ -1,0 +1,213 @@
+"""Packed binary `.c2vb` datasets: `.c2v` text compiled to int32 memmaps.
+
+The reference parses 201-field CSV rows and does string hash-table lookups
+inside the input graph on every epoch (reference:
+path_context_reader.py:122-125, 184-228). At the TPU north-star rate
+(>=47K examples/sec, BASELINE.md) text parsing is the bottleneck, so —
+like the reference's own offline preprocess stage — we compile the text
+once into integer arrays and train from a zero-copy memmap. Layout:
+
+    [ 16-byte header: magic 'C2VB', uint32 version, uint32 N, uint32 M ]
+    [ target_index  int32 (N,)   ]
+    [ source_tokens int32 (N, M) ]
+    [ paths         int32 (N, M) ]
+    [ target_tokens int32 (N, M) ]
+
+An optional `<path>.targets` sidecar holds one raw target string per row
+(needed by evaluation, which scores OOV targets too). Vocab identity is
+guarded by a content hash in the sidecar meta.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from code2vec_tpu.data import reader as reader_mod
+from code2vec_tpu.data.reader import EstimatorAction, RowBatch
+from code2vec_tpu.vocab import Code2VecVocabs
+
+_MAGIC = b"C2VB"
+_VERSION = 1
+_HEADER = struct.Struct("<4sIII")
+
+
+def vocabs_fingerprint(vocabs: Code2VecVocabs) -> str:
+    """Cheap content hash to detect vocab/packed-data mismatch."""
+    h = hashlib.sha256()
+    for vocab in (vocabs.token_vocab, vocabs.path_vocab, vocabs.target_vocab):
+        h.update(str(vocab.size).encode())
+        for idx in (0, 1, vocab.size // 2, vocab.size - 1):
+            h.update(vocab.index_to_word.get(idx, "").encode())
+    return h.hexdigest()[:16]
+
+
+def pack_c2v(c2v_path: str, vocabs: Code2VecVocabs, max_contexts: int,
+             out_path: Optional[str] = None, chunk_lines: int = 8192,
+             write_targets_sidecar: bool = True) -> str:
+    """Compile a `.c2v` text file into a `.c2vb` memmap (returns its path)."""
+    out_path = out_path or (c2v_path + "b")  # data.train.c2v -> data.train.c2vb
+    tmp_path = out_path + ".tmp"
+    n_rows = 0
+    targets_sidecar = out_path + ".targets" if write_targets_sidecar else None
+
+    with open(tmp_path, "wb") as out:
+        out.write(_HEADER.pack(_MAGIC, _VERSION, 0, max_contexts))
+        tgt_file = open(targets_sidecar, "w") if targets_sidecar else None
+        try:
+            chunk: List[str] = []
+            with open(c2v_path, "r", buffering=16 * 1024 * 1024) as f:
+                for line in f:
+                    chunk.append(line)
+                    if len(chunk) >= chunk_lines:
+                        n_rows += _write_chunk(out, tgt_file, chunk, vocabs,
+                                               max_contexts)
+                        chunk = []
+            if chunk:
+                n_rows += _write_chunk(out, tgt_file, chunk, vocabs, max_contexts)
+        finally:
+            if tgt_file:
+                tgt_file.close()
+        out.seek(0)
+        out.write(_HEADER.pack(_MAGIC, _VERSION, n_rows, max_contexts))
+    os.replace(tmp_path, out_path)
+    meta = {"rows": n_rows, "max_contexts": max_contexts,
+            "vocab_fingerprint": vocabs_fingerprint(vocabs),
+            "source": os.path.basename(c2v_path)}
+    with open(out_path + ".meta.json", "w") as f:
+        json.dump(meta, f)
+    return out_path
+
+
+def _write_chunk(out, tgt_file, chunk, vocabs, max_contexts) -> int:
+    batch = reader_mod.parse_context_lines(
+        chunk, vocabs, max_contexts, EstimatorAction.Evaluate)
+    # Row-major per-chunk blocks would complicate the memmap; instead we
+    # buffer whole columns per chunk and interleave chunk-by-chunk, then fix
+    # layout at read time? Simpler: single pass writes rows interleaved as
+    # [target, src, path, tgt] per row so the file is appendable.
+    n, m = batch.source_token_indices.shape
+    rec = np.empty((n, 1 + 3 * m), dtype=np.int32)
+    rec[:, 0] = batch.target_index
+    rec[:, 1:1 + m] = batch.source_token_indices
+    rec[:, 1 + m:1 + 2 * m] = batch.path_indices
+    rec[:, 1 + 2 * m:] = batch.target_token_indices
+    out.write(rec.tobytes())
+    if tgt_file and batch.target_strings:
+        tgt_file.write("\n".join(batch.target_strings) + "\n")
+    return n
+
+
+class PackedDataset:
+    """Zero-copy view over a `.c2vb` file with batched iteration.
+
+    Training iteration uses a full random permutation per epoch (strictly
+    better shuffling than the reference's 10K-element buffer,
+    path_context_reader.py:139) and yields fixed-size batches.
+    """
+
+    def __init__(self, path: str, vocabs: Code2VecVocabs,
+                 shard_index: int = 0, num_shards: int = 1):
+        self.path = path
+        self.vocabs = vocabs
+        with open(path, "rb") as f:
+            magic, version, n, m = _HEADER.unpack(f.read(_HEADER.size))
+        if magic != _MAGIC:
+            raise ValueError(f"{path} is not a .c2vb file")
+        if version != _VERSION:
+            raise ValueError(f"{path}: unsupported .c2vb version {version}")
+        self.num_rows_total = n
+        self.max_contexts = m
+        self._rec = np.memmap(path, dtype=np.int32, mode="r",
+                              offset=_HEADER.size,
+                              shape=(n, 1 + 3 * m))
+        meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            fp = vocabs_fingerprint(vocabs)
+            if meta.get("vocab_fingerprint") not in (None, fp):
+                raise ValueError(
+                    f"{path} was packed with different vocabularies "
+                    f"(fingerprint {meta.get('vocab_fingerprint')} != {fp}); re-pack it.")
+        # Host shard: disjoint strided row subset.
+        self.row_ids = np.arange(shard_index, n, num_shards)
+        self._target_strings: Optional[List[str]] = None
+
+    def __len__(self) -> int:
+        return len(self.row_ids)
+
+    @property
+    def target_strings(self) -> Optional[List[str]]:
+        sidecar = self.path + ".targets"
+        if self._target_strings is None and os.path.exists(sidecar):
+            with open(sidecar, "r") as f:
+                self._target_strings = f.read().splitlines()
+        return self._target_strings
+
+    def gather(self, rows: np.ndarray, estimator_action: EstimatorAction,
+               with_target_strings: bool = False) -> RowBatch:
+        m = self.max_contexts
+        rec = np.asarray(self._rec[rows])  # copy out of the memmap
+        src = rec[:, 1:1 + m]
+        pth = rec[:, 1 + m:1 + 2 * m]
+        tgt = rec[:, 1 + 2 * m:]
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        mask = ((src != token_pad) | (tgt != token_pad) | (pth != path_pad))
+        strings = None
+        if with_target_strings and self.target_strings is not None:
+            strings = [self.target_strings[r] for r in rows]
+        return RowBatch(
+            source_token_indices=src,
+            path_indices=pth,
+            target_token_indices=tgt,
+            context_valid_mask=mask.astype(np.float32),
+            target_index=rec[:, 0],
+            example_valid=np.ones((len(rows),), dtype=bool),
+            target_strings=strings,
+        )
+
+    def _filtered_row_ids(self, estimator_action: EstimatorAction) -> np.ndarray:
+        """Apply the reference row filter once, vectorized over the memmap."""
+        m = self.max_contexts
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        keep_chunks = []
+        for start in range(0, len(self.row_ids), 1 << 18):
+            rows = self.row_ids[start:start + (1 << 18)]
+            rec = self._rec[rows]
+            src = rec[:, 1:1 + m]
+            pth = rec[:, 1 + m:1 + 2 * m]
+            tgt = rec[:, 1 + 2 * m:]
+            any_valid = ((src != token_pad) | (tgt != token_pad)
+                         | (pth != path_pad)).any(axis=1)
+            if estimator_action.is_train:
+                any_valid &= rec[:, 0] > self.vocabs.target_vocab.oov_index
+            keep_chunks.append(rows[any_valid])
+        return np.concatenate(keep_chunks) if keep_chunks else np.empty((0,), np.int64)
+
+    def iter_batches(self, batch_size: int, estimator_action: EstimatorAction,
+                     num_epochs: int = 1, seed: int = 0,
+                     repeat_endlessly: bool = False,
+                     with_target_strings: bool = False) -> Iterator[RowBatch]:
+        rows = self._filtered_row_ids(estimator_action)
+        rng = np.random.default_rng(seed)
+        epoch = 0
+        while repeat_endlessly or epoch < num_epochs:
+            order = rng.permutation(rows) if estimator_action.is_train else rows
+            n_full = (len(order) // batch_size) * batch_size
+            for start in range(0, n_full, batch_size):
+                yield self.gather(order[start:start + batch_size],
+                                  estimator_action, with_target_strings)
+            tail = len(order) - n_full
+            if tail and not estimator_action.is_train:
+                batch = self.gather(order[n_full:], estimator_action,
+                                    with_target_strings)
+                yield reader_mod._pad_rows(batch, batch_size)
+            epoch += 1

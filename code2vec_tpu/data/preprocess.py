@@ -1,0 +1,165 @@
+"""Offline preprocessing: raw extractor output -> `.c2v` + `.dict.c2v`.
+
+Combines the reference's awk histogram step (reference: preprocess.sh:56-58
+— targets from field 1, tokens from context fields 1 and 3, paths from
+field 2) and `preprocess.py` (context sampling with in-vocab preference,
+space padding, dict pickling; reference: preprocess.py:23-74, 12-20) into
+one Python module. Run-once and I/O-bound, so Python is the right tool
+(SURVEY.md §7 step 8); the hot training-time path uses the packed reader.
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+from collections import Counter
+from typing import Dict, Iterable, Optional, Tuple
+
+from code2vec_tpu.common import count_lines_in_file
+
+
+def build_histograms(raw_path: str) -> Tuple[Counter, Counter, Counter]:
+    """Frequency histograms over a raw extractor-output file.
+
+    Equivalent of the reference's three awk passes (preprocess.sh:56-58):
+    every occurrence counts, including duplicates within a line.
+    """
+    targets: Counter = Counter()
+    tokens: Counter = Counter()
+    paths: Counter = Counter()
+    with open(raw_path, "r", buffering=16 * 1024 * 1024) as f:
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if not parts or not parts[0]:
+                continue
+            targets[parts[0]] += 1
+            for ctx in parts[1:]:
+                if not ctx:
+                    continue
+                pieces = ctx.split(",")
+                if len(pieces) != 3:
+                    continue
+                tokens[pieces[0]] += 1
+                paths[pieces[1]] += 1
+                tokens[pieces[2]] += 1
+    return tokens, paths, targets
+
+
+def truncate_histogram(histogram: Dict[str, int], max_size: Optional[int]) -> Dict[str, int]:
+    """Keep words whose count is >= one plus the max_size'th largest count
+    when the histogram exceeds max_size (reference: common.py:47-58 —
+    min-count thresholding, which may keep slightly fewer than max_size).
+    """
+    if max_size is None or len(histogram) <= max_size:
+        return dict(histogram)
+    min_count = sorted(histogram.values(), reverse=True)[max_size] + 1
+    return {w: c for w, c in histogram.items() if c >= min_count}
+
+
+def _context_full_found(parts, word_to_count, path_to_count) -> bool:
+    # reference: preprocess.py:77-79
+    return (parts[0] in word_to_count and parts[1] in path_to_count
+            and parts[2] in word_to_count)
+
+
+def _context_partial_found(parts, word_to_count, path_to_count) -> bool:
+    # reference: preprocess.py:82-84
+    return (parts[0] in word_to_count or parts[1] in path_to_count
+            or parts[2] in word_to_count)
+
+
+def process_file(file_path: str, data_file_role: str, dataset_name: str,
+                 word_to_count: Dict[str, int], path_to_count: Dict[str, int],
+                 max_contexts: int, rng: Optional[random.Random] = None,
+                 log=print) -> int:
+    """Sample/truncate each method's contexts to `max_contexts`, preferring
+    fully-in-vocab then partially-in-vocab contexts, pad with spaces, write
+    `<dataset>.<role>.c2v`. Returns the number of non-empty examples.
+
+    reference: preprocess.py:23-74.
+    """
+    rng = rng or random.Random(0)
+    sum_total = sum_sampled = total = empty = max_unfiltered = 0
+    output_path = f"{dataset_name}.{data_file_role}.c2v"
+    with open(output_path, "w") as outfile, open(file_path, "r") as file:
+        for line in file:
+            parts = line.rstrip("\n").split(" ")
+            target_name = parts[0]
+            contexts = parts[1:]
+            max_unfiltered = max(max_unfiltered, len(contexts))
+            sum_total += len(contexts)
+
+            if len(contexts) > max_contexts:
+                context_parts = [c.split(",") for c in contexts]
+                full = [c for i, c in enumerate(contexts)
+                        if _context_full_found(context_parts[i], word_to_count,
+                                               path_to_count)]
+                partial = [c for i, c in enumerate(contexts)
+                           if _context_partial_found(context_parts[i], word_to_count,
+                                                     path_to_count)
+                           and not _context_full_found(context_parts[i],
+                                                       word_to_count, path_to_count)]
+                if len(full) > max_contexts:
+                    contexts = rng.sample(full, max_contexts)
+                elif len(full) + len(partial) > max_contexts:
+                    contexts = full + rng.sample(partial, max_contexts - len(full))
+                else:
+                    contexts = full + partial
+
+            if len(contexts) == 0:
+                empty += 1
+                continue
+            sum_sampled += len(contexts)
+            padding = " " * (max_contexts - len(contexts))
+            outfile.write(target_name + " " + " ".join(contexts) + padding + "\n")
+            total += 1
+
+    log(f"File: {file_path}")
+    log(f"Average total contexts: {float(sum_total) / max(total, 1)}")
+    log(f"Average final (after sampling) contexts: {float(sum_sampled) / max(total, 1)}")
+    log(f"Total examples: {total}")
+    log(f"Empty examples: {empty}")
+    log(f"Max number of contexts per word: {max_unfiltered}")
+    return total
+
+
+def save_dictionaries(dataset_name: str, word_to_count: Dict[str, int],
+                      path_to_count: Dict[str, int], target_to_count: Dict[str, int],
+                      num_training_examples: int, log=print) -> str:
+    """Pickle the freq dicts + train count to `<dataset>.dict.c2v`
+    (reference: preprocess.py:12-20)."""
+    path = f"{dataset_name}.dict.c2v"
+    with open(path, "wb") as f:
+        pickle.dump(word_to_count, f)
+        pickle.dump(path_to_count, f)
+        pickle.dump(target_to_count, f)
+        pickle.dump(num_training_examples, f)
+    log(f"Dictionaries saved to: {path}")
+    return path
+
+
+def preprocess(train_raw: str, val_raw: str, test_raw: str, output_name: str,
+               max_contexts: int = 200, word_vocab_size: int = 1301136,
+               path_vocab_size: int = 911417, target_vocab_size: int = 261245,
+               seed: int = 0, log=print) -> str:
+    """Full offline pipeline: histograms from the raw train split, vocab
+    truncation, context sampling for all three splits, dict pickling.
+
+    Mirrors preprocess.sh:42-63 + preprocess.py:87-141 end-to-end.
+    """
+    tokens, paths, targets = build_histograms(train_raw)
+    word_to_count = truncate_histogram(tokens, word_vocab_size)
+    path_to_count = truncate_histogram(paths, path_vocab_size)
+    target_to_count = truncate_histogram(targets, target_vocab_size)
+
+    rng = random.Random(seed)
+    num_training_examples = 0
+    for file_path, role in zip([test_raw, val_raw, train_raw],
+                               ["test", "val", "train"]):
+        n = process_file(file_path, role, output_name, word_to_count,
+                         path_to_count, max_contexts, rng=rng, log=log)
+        if role == "train":
+            num_training_examples = n
+    save_dictionaries(output_name, word_to_count, path_to_count,
+                      target_to_count, num_training_examples, log=log)
+    return output_name

@@ -1,0 +1,345 @@
+"""Host-side streaming reader for `.c2v` path-context files.
+
+TPU-first redesign of the reference's in-graph tf.data pipeline
+(reference: path_context_reader.py:119-228): strings never reach the
+device. The host tokenizes, looks up vocab ids, pads and masks into fixed
+`(B, MAX_CONTEXTS)` int32 arrays; XLA only ever sees integers. Row
+semantics are reproduced exactly:
+
+- a context is valid iff any of its three parts is not PAD
+  (reference: path_context_reader.py:209-214);
+- training rows are dropped when the target is OOV/PAD or no context is
+  valid; eval rows only when no context is valid; predict rows never
+  (reference: path_context_reader.py:153-177, 100);
+- missing trailing fields behave like padding contexts (the reference's
+  CsvDataset record_defaults, path_context_reader.py:82-83).
+
+Shuffling uses a bounded reservoir-style buffer like tf.data's
+`shuffle(buffer_size)` (reference: path_context_reader.py:139), and the
+file can be sharded across hosts (`shard_index`/`num_shards`) for
+multi-host TPU pods — each host reads a disjoint subset of rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import random
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from code2vec_tpu.vocab import Code2VecVocabs
+
+
+class EstimatorAction(enum.Enum):
+    Train = "train"
+    Evaluate = "evaluate"
+    Predict = "predict"
+
+    @property
+    def is_train(self) -> bool:
+        return self is EstimatorAction.Train
+
+    @property
+    def is_evaluate(self) -> bool:
+        return self is EstimatorAction.Evaluate
+
+    @property
+    def is_predict(self) -> bool:
+        return self is EstimatorAction.Predict
+
+
+@dataclasses.dataclass
+class RowBatch:
+    """One batch of model inputs (host numpy; device transfer elsewhere).
+
+    `example_valid` marks rows that are real examples (the final batch of an
+    eval epoch is padded up to the fixed batch size so shapes stay static
+    under jit; metrics must ignore padded rows).
+    """
+    source_token_indices: np.ndarray   # (B, M) int32
+    path_indices: np.ndarray           # (B, M) int32
+    target_token_indices: np.ndarray   # (B, M) int32
+    context_valid_mask: np.ndarray     # (B, M) float32
+    target_index: np.ndarray           # (B,) int32
+    example_valid: np.ndarray          # (B,) bool
+    target_strings: Optional[List[str]] = None      # (B,) for eval/predict
+    # Raw string triples, only materialized for predict (attention display).
+    source_strings: Optional[np.ndarray] = None     # (B, M) object
+    path_strings: Optional[np.ndarray] = None       # (B, M) object
+    target_token_strings: Optional[np.ndarray] = None  # (B, M) object
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.example_valid.sum())
+
+    def model_inputs(self):
+        return (self.source_token_indices, self.path_indices,
+                self.target_token_indices, self.context_valid_mask)
+
+
+def parse_context_lines(
+    lines: Sequence[str],
+    vocabs: Code2VecVocabs,
+    max_contexts: int,
+    estimator_action: EstimatorAction,
+    keep_strings: bool = False,
+) -> RowBatch:
+    """Parse raw `.c2v` lines into a RowBatch (unfiltered).
+
+    Reference row parse: path_context_reader.py:184-228.
+    """
+    n = len(lines)
+    m = max_contexts
+    token_w2i = vocabs.token_vocab.word_to_index
+    path_w2i = vocabs.path_vocab.word_to_index
+    token_oov = vocabs.token_vocab.oov_index
+    path_oov = vocabs.path_vocab.oov_index
+    token_pad = vocabs.token_vocab.pad_index
+    path_pad = vocabs.path_vocab.pad_index
+
+    src = np.full((n, m), token_pad, dtype=np.int32)
+    pth = np.full((n, m), path_pad, dtype=np.int32)
+    tgt = np.full((n, m), token_pad, dtype=np.int32)
+    target_index = np.empty((n,), dtype=np.int32)
+    target_strings: List[str] = []
+    keep = keep_strings or estimator_action.is_predict
+    if keep:
+        src_s = np.full((n, m), "", dtype=object)
+        pth_s = np.full((n, m), "", dtype=object)
+        tgt_s = np.full((n, m), "", dtype=object)
+
+    target_lookup = vocabs.target_vocab.lookup_index
+    for i, line in enumerate(lines):
+        parts = line.rstrip("\n").split(" ")
+        target_str = parts[0] if parts else ""
+        target_strings.append(target_str)
+        target_index[i] = target_lookup(target_str)
+        row_contexts = parts[1:m + 1]
+        for j, ctx in enumerate(row_contexts):
+            if not ctx:
+                continue
+            pieces = ctx.split(",")
+            # Malformed contexts (< 3 fields) behave like the reference's
+            # sparse->dense fill: missing parts are PAD
+            # (path_context_reader.py:190-196).
+            a = pieces[0] if len(pieces) > 0 else ""
+            b = pieces[1] if len(pieces) > 1 else ""
+            c = pieces[2] if len(pieces) > 2 else ""
+            src[i, j] = token_w2i.get(a, token_pad if a == "" else token_oov)
+            pth[i, j] = path_w2i.get(b, path_pad if b == "" else path_oov)
+            tgt[i, j] = token_w2i.get(c, token_pad if c == "" else token_oov)
+            if keep:
+                src_s[i, j], pth_s[i, j], tgt_s[i, j] = a, b, c
+
+    # Context valid iff any part is not PAD (reference:
+    # path_context_reader.py:209-214). Note that in the joined PAD/OOV
+    # scheme an all-OOV context is treated as invalid — intentionally
+    # identical to the reference.
+    mask = ((src != token_pad) | (tgt != token_pad) | (pth != path_pad))
+    context_valid_mask = mask.astype(np.float32)
+
+    return RowBatch(
+        source_token_indices=src,
+        path_indices=pth,
+        target_token_indices=tgt,
+        context_valid_mask=context_valid_mask,
+        target_index=target_index,
+        example_valid=np.ones((n,), dtype=bool),
+        target_strings=target_strings,
+        source_strings=src_s if keep else None,
+        path_strings=pth_s if keep else None,
+        target_token_strings=tgt_s if keep else None,
+    )
+
+
+def row_filter_mask(batch: RowBatch, vocabs: Code2VecVocabs,
+                    estimator_action: EstimatorAction) -> np.ndarray:
+    """Vectorized reference row filter (path_context_reader.py:153-177)."""
+    any_valid = batch.context_valid_mask.any(axis=1)
+    if estimator_action.is_train:
+        target_known = batch.target_index > vocabs.target_vocab.oov_index
+        return any_valid & target_known
+    return any_valid
+
+
+def _select_rows(batch: RowBatch, idx: np.ndarray) -> RowBatch:
+    def sel(x):
+        if x is None:
+            return None
+        if isinstance(x, list):
+            return [x[i] for i in idx]
+        return x[idx]
+    return RowBatch(**{f.name: sel(getattr(batch, f.name))
+                       for f in dataclasses.fields(RowBatch)})
+
+
+def _pad_rows(batch: RowBatch, batch_size: int) -> RowBatch:
+    """Pad with invalid rows up to `batch_size` (static shapes under jit)."""
+    n = batch.target_index.shape[0]
+    if n == batch_size:
+        return batch
+    pad = batch_size - n
+
+    def pad_arr(x, fill=0):
+        if x is None:
+            return None
+        if isinstance(x, list):
+            return x + [""] * pad
+        shape = (pad,) + x.shape[1:]
+        return np.concatenate([x, np.full(shape, fill, dtype=x.dtype)], axis=0)
+
+    out = RowBatch(
+        source_token_indices=pad_arr(batch.source_token_indices),
+        path_indices=pad_arr(batch.path_indices),
+        target_token_indices=pad_arr(batch.target_token_indices),
+        context_valid_mask=pad_arr(batch.context_valid_mask),
+        target_index=pad_arr(batch.target_index),
+        example_valid=np.concatenate([batch.example_valid,
+                                      np.zeros((pad,), dtype=bool)]),
+        target_strings=pad_arr(batch.target_strings),
+        source_strings=pad_arr(batch.source_strings, fill=""),
+        path_strings=pad_arr(batch.path_strings, fill=""),
+        target_token_strings=pad_arr(batch.target_token_strings, fill=""),
+    )
+    return out
+
+
+def _iter_file_lines(path: str, shard_index: int, num_shards: int) -> Iterator[str]:
+    with open(path, "r", buffering=16 * 1024 * 1024) as f:
+        for i, line in enumerate(f):
+            if num_shards > 1 and i % num_shards != shard_index:
+                continue
+            yield line
+
+
+class PathContextReader:
+    """Streaming batched reader with reference-equivalent semantics.
+
+    Yields `RowBatch`es of exactly `batch_size` rows. In training the final
+    partial batch (across all epochs) is dropped — static shapes are worth
+    far more on TPU than the reference's single ragged tail batch
+    (path_context_reader.py:148 allows a ragged final batch; the deviation
+    is at most one batch per run). In evaluation the tail is padded and
+    marked invalid instead so every example is scored.
+    """
+
+    def __init__(self, vocabs: Code2VecVocabs, config,
+                 estimator_action: EstimatorAction,
+                 data_path: Optional[str] = None,
+                 shard_index: int = 0, num_shards: int = 1,
+                 repeat_endlessly: bool = False,
+                 parse_chunk_lines: int = 4096):
+        self.vocabs = vocabs
+        self.config = config
+        self.estimator_action = estimator_action
+        self.data_path = data_path if data_path is not None else \
+            config.data_path(is_evaluating=estimator_action.is_evaluate)
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self.repeat_endlessly = repeat_endlessly
+        self.parse_chunk_lines = parse_chunk_lines
+        self._rng = random.Random(config.seed)
+
+    # ------------------------------------------------------------------
+
+    def process_input_rows(self, lines: Sequence[str]) -> RowBatch:
+        """Single-shot parse used by predict (no filtering; reference:
+        path_context_reader.py:96-107)."""
+        return parse_context_lines(
+            lines, self.vocabs, self.config.max_contexts,
+            self.estimator_action, keep_strings=True)
+
+    def __iter__(self) -> Iterator[RowBatch]:
+        batch_size = self.config.batch_size(
+            is_evaluating=self.estimator_action.is_evaluate)
+        if self.estimator_action.is_train:
+            epochs = None if self.repeat_endlessly else self.config.num_train_epochs
+            line_iter = self._shuffled_lines(epochs)
+        else:
+            line_iter = _iter_file_lines(self.data_path, self.shard_index,
+                                         self.num_shards)
+        yield from self._batched(line_iter, batch_size)
+
+    # ------------------------------------------------------------------
+
+    def _shuffled_lines(self, epochs: Optional[int]) -> Iterator[str]:
+        """Repeat + bounded shuffle buffer (reference semantics of
+        `.repeat(epochs).shuffle(buffer)`, path_context_reader.py:134-139)."""
+        buf: List[str] = []
+        buf_size = self.config.shuffle_buffer_size
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            for line in _iter_file_lines(self.data_path, self.shard_index,
+                                         self.num_shards):
+                if len(buf) < buf_size:
+                    buf.append(line)
+                    continue
+                j = self._rng.randrange(buf_size)
+                out, buf[j] = buf[j], line
+                yield out
+            epoch += 1
+        self._rng.shuffle(buf)
+        yield from buf
+
+    def _batched(self, line_iter: Iterator[str], batch_size: int) -> Iterator[RowBatch]:
+        pending: List[RowBatch] = []
+        pending_rows = 0
+        chunk: List[str] = []
+
+        def flush_chunk():
+            nonlocal pending_rows
+            if not chunk:
+                return
+            raw = parse_context_lines(chunk, self.vocabs, self.config.max_contexts,
+                                      self.estimator_action)
+            keep = row_filter_mask(raw, self.vocabs, self.estimator_action)
+            filtered = _select_rows(raw, np.nonzero(keep)[0])
+            if filtered.target_index.shape[0]:
+                pending.append(filtered)
+                pending_rows += filtered.target_index.shape[0]
+            chunk.clear()
+
+        def pop_batches() -> Iterator[RowBatch]:
+            nonlocal pending, pending_rows
+            while pending_rows >= batch_size:
+                merged = _concat_batches(pending)
+                pending = []
+                pending_rows = 0
+                n = merged.target_index.shape[0]
+                for start in range(0, n - batch_size + 1, batch_size):
+                    yield _select_rows(merged, np.arange(start, start + batch_size))
+                tail = n % batch_size
+                if tail:
+                    pending = [_select_rows(merged, np.arange(n - tail, n))]
+                    pending_rows = tail
+
+        for line in line_iter:
+            chunk.append(line)
+            if len(chunk) >= self.parse_chunk_lines:
+                flush_chunk()
+                yield from pop_batches()
+        flush_chunk()
+        yield from pop_batches()
+        if pending_rows:
+            merged = _concat_batches(pending)
+            if self.estimator_action.is_train:
+                return  # drop ragged tail (see class docstring)
+            yield _pad_rows(merged, batch_size)
+
+
+def _concat_batches(batches: List[RowBatch]) -> RowBatch:
+    if len(batches) == 1:
+        return batches[0]
+
+    def cat(name):
+        vals = [getattr(b, name) for b in batches]
+        if vals[0] is None:
+            return None
+        if isinstance(vals[0], list):
+            return [x for v in vals for x in v]
+        return np.concatenate(vals, axis=0)
+
+    return RowBatch(**{f.name: cat(f.name) for f in dataclasses.fields(RowBatch)})

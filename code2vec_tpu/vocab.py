@@ -1,0 +1,243 @@
+"""Vocabularies: word<->index maps for tokens, AST paths and target names.
+
+Reproduces the reference semantics exactly (they determine filtering and
+padding behavior, hence accuracy parity):
+
+- special words come first; the default scheme joins PAD and OOV into a
+  single ``<PAD_OR_OOV>`` at index 0 (reference: vocabularies.py:22-35,
+  Code2VecVocabs._get_special_words_by_vocab_type vocabularies.py:204-209 —
+  with ``separate_oov_and_pad`` the target vocab gets only ``<OOV>`` while
+  token/path vocabs get ``<PAD>``/``<OOV>``).
+- construction from a frequency dict keeps the top-N words by count
+  (reference: vocabularies.py:99-106).
+- the on-disk model-sidecar format ``dictionaries.bin`` stores the three
+  vocabs WITHOUT special words, in token/target/path order (reference:
+  vocabularies.py:57-97, 211-218) — we keep that format bit-compatible so
+  models can be audited against reference tooling.
+- the training-time source is the ``.dict.c2v`` pickle written by
+  preprocessing: token/path/target freq dicts + train example count
+  (reference: preprocess.py:12-20, vocabularies.py:220-230).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+from typing import Dict, Iterable, List, NamedTuple, Optional
+
+PAD_OR_OOV = "<PAD_OR_OOV>"
+PAD = "<PAD>"
+OOV = "<OOV>"
+
+
+class VocabType(enum.Enum):
+    Token = 1
+    Target = 2
+    Path = 3
+
+
+class SpecialWords(NamedTuple):
+    """Resolved special-word scheme for one vocab."""
+    pad: str
+    oov: str
+
+    @property
+    def unique(self) -> List[str]:
+        # preserves order, dedups joined PAD/OOV (reference: common.py:199-201)
+        out: List[str] = []
+        for w in (self.pad, self.oov):
+            if w not in out:
+                out.append(w)
+        return out
+
+
+def special_words_for(vocab_type: VocabType, separate_oov_and_pad: bool) -> SpecialWords:
+    # reference: vocabularies.py:204-209
+    if not separate_oov_and_pad:
+        return SpecialWords(pad=PAD_OR_OOV, oov=PAD_OR_OOV)
+    if vocab_type == VocabType.Target:
+        # Target rows are never padded, only OOV; PAD aliases OOV here so the
+        # reader can treat all vocabs uniformly.
+        return SpecialWords(pad=OOV, oov=OOV)
+    return SpecialWords(pad=PAD, oov=OOV)
+
+
+class Vocab:
+    """One word<->index vocabulary with its special words at the front."""
+
+    def __init__(self, vocab_type: VocabType, words: Iterable[str],
+                 special_words: SpecialWords):
+        self.vocab_type = vocab_type
+        self.special_words = special_words
+        self.word_to_index: Dict[str, int] = {}
+        self.index_to_word: Dict[int, str] = {}
+        for index, word in enumerate(list(special_words.unique) + list(words)):
+            self.word_to_index[word] = index
+            self.index_to_word[index] = word
+        self.size = len(self.word_to_index)
+
+    # -- indices used all over the data pipeline / model ------------------
+
+    @property
+    def pad_index(self) -> int:
+        return self.word_to_index[self.special_words.pad]
+
+    @property
+    def oov_index(self) -> int:
+        return self.word_to_index[self.special_words.oov]
+
+    def lookup_index(self, word: str) -> int:
+        return self.word_to_index.get(word, self.oov_index)
+
+    def lookup_word(self, index: int) -> str:
+        return self.index_to_word.get(index, self.special_words.oov)
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def create_from_freq_dict(cls, vocab_type: VocabType, word_to_count: Dict[str, int],
+                              max_size: int, special_words: SpecialWords) -> "Vocab":
+        # Top-N by count; ties broken by dict insertion order, matching the
+        # reference's stable sort (reference: vocabularies.py:99-106).
+        words = sorted(word_to_count, key=word_to_count.get, reverse=True)[:max_size]
+        return cls(vocab_type, words, special_words)
+
+    # -- reference-compatible binary format (dictionaries.bin) ------------
+
+    def save_to_file(self, file) -> None:
+        # Stored WITHOUT special words (reference: vocabularies.py:57-66).
+        nr_special = len(self.special_words.unique)
+        w2i = {w: i for w, i in self.word_to_index.items() if i >= nr_special}
+        i2w = {i: w for i, w in self.index_to_word.items() if i >= nr_special}
+        pickle.dump(w2i, file)
+        pickle.dump(i2w, file)
+        pickle.dump(self.size - nr_special, file)
+
+    @classmethod
+    def load_from_file(cls, vocab_type: VocabType, file,
+                       special_words: SpecialWords) -> "Vocab":
+        # reference: vocabularies.py:68-97
+        w2i = pickle.load(file)
+        i2w = pickle.load(file)
+        size_wo_specials = pickle.load(file)
+        assert len(i2w) == len(w2i) == size_wo_specials
+        specials = special_words.unique
+        min_idx = min(i2w.keys())
+        if min_idx != len(specials):
+            raise ValueError(
+                f"Stored vocabulary {vocab_type} has minimum word index {min_idx}, "
+                f"expected {len(specials)} (number of special words {specials}). "
+                f"Check `separate_oov_and_pad`.")
+        vocab = cls(vocab_type, [], special_words)
+        vocab.word_to_index = {**w2i, **{w: i for i, w in enumerate(specials)}}
+        vocab.index_to_word = {**i2w, **{i: w for i, w in enumerate(specials)}}
+        vocab.size = size_wo_specials + len(specials)
+        return vocab
+
+
+class WordFreqDicts(NamedTuple):
+    token_to_count: Dict[str, int]
+    path_to_count: Dict[str, int]
+    target_to_count: Dict[str, int]
+    num_train_examples: int
+
+
+def load_word_freq_dicts(dict_c2v_path: str) -> WordFreqDicts:
+    """Load the `.dict.c2v` pickle produced by preprocessing.
+
+    Pickle order: token, path, target freq dicts then train example count
+    (reference: preprocess.py:12-20).
+    """
+    with open(dict_c2v_path, "rb") as f:
+        token_to_count = pickle.load(f)
+        path_to_count = pickle.load(f)
+        target_to_count = pickle.load(f)
+        try:
+            num_train_examples = pickle.load(f)
+        except EOFError:
+            num_train_examples = 0
+    return WordFreqDicts(token_to_count, path_to_count, target_to_count,
+                         num_train_examples)
+
+
+class Code2VecVocabs:
+    """The three vocabularies, created from freq dicts or loaded from a
+    saved model's ``dictionaries.bin`` (reference: vocabularies.py:151-230).
+    """
+
+    def __init__(self, token_vocab: Vocab, path_vocab: Vocab, target_vocab: Vocab):
+        self.token_vocab = token_vocab
+        self.path_vocab = path_vocab
+        self.target_vocab = target_vocab
+        self._already_saved_in_paths = set()
+
+    @classmethod
+    def create_from_freq_dicts(cls, freq: WordFreqDicts, *,
+                               max_token_vocab_size: int,
+                               max_path_vocab_size: int,
+                               max_target_vocab_size: int,
+                               separate_oov_and_pad: bool = False) -> "Code2VecVocabs":
+        token_vocab = Vocab.create_from_freq_dict(
+            VocabType.Token, freq.token_to_count, max_token_vocab_size,
+            special_words_for(VocabType.Token, separate_oov_and_pad))
+        path_vocab = Vocab.create_from_freq_dict(
+            VocabType.Path, freq.path_to_count, max_path_vocab_size,
+            special_words_for(VocabType.Path, separate_oov_and_pad))
+        target_vocab = Vocab.create_from_freq_dict(
+            VocabType.Target, freq.target_to_count, max_target_vocab_size,
+            special_words_for(VocabType.Target, separate_oov_and_pad))
+        return cls(token_vocab, path_vocab, target_vocab)
+
+    @classmethod
+    def load_or_create(cls, config) -> "Code2VecVocabs":
+        # reference: vocabularies.py:163-173
+        assert config.is_training or config.is_loading
+        if config.is_loading:
+            path = config.get_vocabularies_path_from_model_path(config.model_load_path)
+            if not os.path.isfile(path):
+                raise ValueError(
+                    f"Model dictionaries file is not found in model load dir. "
+                    f"Expecting file `{path}`.")
+            return cls.load(path, separate_oov_and_pad=config.separate_oov_and_pad)
+        freq = load_word_freq_dicts(config.word_freq_dict_path)
+        return cls.create_from_freq_dicts(
+            freq,
+            max_token_vocab_size=config.max_token_vocab_size,
+            max_path_vocab_size=config.max_path_vocab_size,
+            max_target_vocab_size=config.max_target_vocab_size,
+            separate_oov_and_pad=config.separate_oov_and_pad)
+
+    @classmethod
+    def load(cls, path: str, separate_oov_and_pad: bool = False) -> "Code2VecVocabs":
+        # Stored order is token, target, path (reference: vocabularies.py:175-185).
+        with open(path, "rb") as f:
+            token_vocab = Vocab.load_from_file(
+                VocabType.Token, f,
+                special_words_for(VocabType.Token, separate_oov_and_pad))
+            target_vocab = Vocab.load_from_file(
+                VocabType.Target, f,
+                special_words_for(VocabType.Target, separate_oov_and_pad))
+            path_vocab = Vocab.load_from_file(
+                VocabType.Path, f,
+                special_words_for(VocabType.Path, separate_oov_and_pad))
+        vocabs = cls(token_vocab, path_vocab, target_vocab)
+        vocabs._already_saved_in_paths.add(path)
+        return vocabs
+
+    def save(self, path: str) -> None:
+        # reference: vocabularies.py:211-218 (token, target, path order).
+        if path in self._already_saved_in_paths:
+            return
+        with open(path, "wb") as f:
+            self.token_vocab.save_to_file(f)
+            self.target_vocab.save_to_file(f)
+            self.path_vocab.save_to_file(f)
+        self._already_saved_in_paths.add(path)
+
+    def get(self, vocab_type: VocabType) -> Vocab:
+        return {
+            VocabType.Token: self.token_vocab,
+            VocabType.Target: self.target_vocab,
+            VocabType.Path: self.path_vocab,
+        }[vocab_type]

@@ -1,0 +1,51 @@
+"""Test harness: run JAX on CPU with 8 virtual devices so DP/TP/CP sharding
+is exercised without TPU hardware (SURVEY.md §4 implication)."""
+
+import os
+
+# Must happen before the first `import jax` anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+from code2vec_tpu.vocab import (  # noqa: E402
+    Code2VecVocabs, WordFreqDicts,
+)
+
+
+@pytest.fixture
+def tiny_vocabs() -> Code2VecVocabs:
+    """Small deterministic vocabs used across tests."""
+    freq = WordFreqDicts(
+        token_to_count={"foo": 10, "bar": 8, "baz": 5, "qux": 2},
+        path_to_count={"P1": 9, "P2": 7, "P3": 3},
+        target_to_count={"get|name": 6, "set|value": 4, "run": 2},
+        num_train_examples=100,
+    )
+    return Code2VecVocabs.create_from_freq_dicts(
+        freq, max_token_vocab_size=10, max_path_vocab_size=10,
+        max_target_vocab_size=10)
+
+
+@pytest.fixture
+def tiny_config(tmp_path):
+    from code2vec_tpu.config import Config
+    return Config(
+        train_data_path_prefix=str(tmp_path / "data"),
+        max_contexts=4,
+        train_batch_size=2,
+        test_batch_size=2,
+        num_train_epochs=1,
+        shuffle_buffer_size=8,
+        seed=0,
+    )

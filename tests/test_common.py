@@ -1,0 +1,63 @@
+"""Helpers parity tests (reference: common.py)."""
+
+import io
+
+import numpy as np
+
+from code2vec_tpu.common import (
+    filter_impossible_names, get_first_match_word_from_top_predictions,
+    get_subtokens, is_legal_method_name, java_string_hashcode, normalize_word,
+    save_word2vec_file,
+)
+
+
+def test_normalize_word():
+    # reference: common.py:12-18
+    assert normalize_word("getName") == "getname"
+    assert normalize_word("get_name2") == "getname"
+    assert normalize_word("123") == "123"       # all-stripped falls back to lower
+    assert normalize_word("A_B") == "ab"
+
+
+def test_legal_method_names():
+    # reference: common.py:122-124
+    oov = "<PAD_OR_OOV>"
+    assert is_legal_method_name("get|name", oov)
+    assert not is_legal_method_name(oov, oov)
+    assert not is_legal_method_name("get2", oov)
+    assert not is_legal_method_name("", oov)
+    assert filter_impossible_names([oov, "a|b", "x9", "run"], oov) == ["a|b", "run"]
+
+
+def test_first_match():
+    # reference: common.py:180-187 — index is within the FILTERED list.
+    oov = "<PAD_OR_OOV>"
+    res = get_first_match_word_from_top_predictions(
+        "getName", [oov, "bad2", "set|name", "get|name"], oov)
+    assert res == (1, "get|name")
+    assert get_first_match_word_from_top_predictions("getName", ["foo"], oov) is None
+
+
+def test_subtokens():
+    assert get_subtokens("get|name") == ["get", "name"]
+    assert get_subtokens("run") == ["run"]
+
+
+def test_java_string_hashcode():
+    # Known Java values: "".hashCode()==0, "a".hashCode()==97,
+    # "hello".hashCode()==99162322, "polygenelubricants" is famously negative.
+    assert java_string_hashcode("") == 0
+    assert java_string_hashcode("a") == 97
+    assert java_string_hashcode("hello") == 99162322
+    assert java_string_hashcode("polygenelubricants") == -2147483648
+
+
+def test_w2v_format():
+    # reference: common.py:82-91
+    buf = io.StringIO()
+    mat = np.array([[1.0, 2.0], [3.5, 4.25]])
+    save_word2vec_file(buf, {0: "a", 1: "b"}, mat)
+    lines = buf.getvalue().splitlines()
+    assert lines[0] == "2 2"
+    assert lines[1].startswith("a 1.0 2.0")
+    assert lines[2].startswith("b 3.5 4.25")

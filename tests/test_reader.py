@@ -1,0 +1,160 @@
+"""Reader semantics tests vs the reference's row parse/filter
+(path_context_reader.py:153-228)."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.reader import (
+    EstimatorAction, PathContextReader, parse_context_lines, row_filter_mask,
+)
+from code2vec_tpu.data.packed import pack_c2v, PackedDataset
+
+
+def _write_c2v(path, lines):
+    with open(path, "w") as f:
+        for line in lines:
+            f.write(line + "\n")
+
+
+def test_parse_basic(tiny_vocabs):
+    lines = ["get|name foo,P1,bar baz,P2,foo  "]
+    batch = parse_context_lines(lines, tiny_vocabs, max_contexts=4,
+                                estimator_action=EstimatorAction.Train)
+    tv, pv = tiny_vocabs.token_vocab, tiny_vocabs.path_vocab
+    assert batch.target_index[0] == tiny_vocabs.target_vocab.lookup_index("get|name")
+    np.testing.assert_array_equal(
+        batch.source_token_indices[0],
+        [tv.lookup_index("foo"), tv.lookup_index("baz"), 0, 0])
+    np.testing.assert_array_equal(
+        batch.path_indices[0],
+        [pv.lookup_index("P1"), pv.lookup_index("P2"), 0, 0])
+    np.testing.assert_array_equal(batch.context_valid_mask[0], [1, 1, 0, 0])
+
+
+def test_parse_oov_parts_counted_valid_only_if_any_nonpad(tiny_vocabs):
+    # all-OOV context: in the joined PAD/OOV scheme the indices are all 0
+    # == PAD, so the context is INVALID (reference FIXME at
+    # path_context_reader.py:209-214 resolved as 'just no padding').
+    lines = ["run unknown,UNKNOWNPATH,unknown   "]
+    batch = parse_context_lines(lines, tiny_vocabs, 4, EstimatorAction.Train)
+    np.testing.assert_array_equal(batch.context_valid_mask[0], [0, 0, 0, 0])
+    # partially-known context stays valid
+    lines = ["run foo,UNKNOWNPATH,unknown   "]
+    batch = parse_context_lines(lines, tiny_vocabs, 4, EstimatorAction.Train)
+    np.testing.assert_array_equal(batch.context_valid_mask[0], [1, 0, 0, 0])
+
+
+def test_row_filter_train_drops_oov_target_and_invalid_rows(tiny_vocabs):
+    lines = [
+        "get|name foo,P1,bar   ",        # keep
+        "unknowntarget foo,P1,bar   ",   # drop in train (OOV target), keep in eval
+        "run unk,UNK,unk   ",            # drop everywhere (no valid context)
+    ]
+    batch = parse_context_lines(lines, tiny_vocabs, 4, EstimatorAction.Train)
+    train_mask = row_filter_mask(batch, tiny_vocabs, EstimatorAction.Train)
+    eval_mask = row_filter_mask(batch, tiny_vocabs, EstimatorAction.Evaluate)
+    np.testing.assert_array_equal(train_mask, [True, False, False])
+    np.testing.assert_array_equal(eval_mask, [True, True, False])
+
+
+def test_malformed_context_parts_are_pad(tiny_vocabs):
+    lines = ["run foo,P1 bar   "]  # 2-field and 1-field contexts
+    batch = parse_context_lines(lines, tiny_vocabs, 4, EstimatorAction.Train)
+    tv = tiny_vocabs.token_vocab
+    assert batch.source_token_indices[0, 0] == tv.lookup_index("foo")
+    assert batch.target_token_indices[0, 0] == tv.pad_index
+    assert batch.source_token_indices[0, 1] == tv.lookup_index("bar")
+
+
+def test_reader_end_to_end_train_batches(tiny_vocabs, tiny_config, tmp_path):
+    lines = ["get|name foo,P1,bar baz,P2,foo  ",
+             "set|value bar,P3,baz   ",
+             "run foo,P2,qux   ",
+             "unknowntarget foo,P1,bar   ",  # filtered in train
+             "get|name qux,P1,foo   "]
+    _write_c2v(tiny_config.train_data_path, lines)
+    reader = PathContextReader(tiny_vocabs, tiny_config, EstimatorAction.Train)
+    batches = list(reader)
+    # 4 valid rows, batch size 2 -> 2 full batches
+    assert len(batches) == 2
+    for b in batches:
+        assert b.source_token_indices.shape == (2, 4)
+        assert b.num_valid == 2
+
+
+def test_reader_eval_pads_tail(tiny_vocabs, tiny_config, tmp_path):
+    lines = ["get|name foo,P1,bar   ",
+             "unknowntarget bar,P2,foo   ",
+             "run baz,P3,qux   "]
+    test_path = str(tmp_path / "data.val.c2v")
+    _write_c2v(test_path, lines)
+    tiny_config.test_data_path = test_path
+    reader = PathContextReader(tiny_vocabs, tiny_config, EstimatorAction.Evaluate)
+    batches = list(reader)
+    assert len(batches) == 2
+    assert batches[0].num_valid == 2
+    assert batches[1].num_valid == 1          # padded tail
+    assert batches[1].example_valid.tolist() == [True, False]
+    assert batches[1].target_strings[0] == "run"
+
+
+def test_host_sharding_disjoint(tiny_vocabs, tiny_config):
+    lines = ["get|name foo,P1,bar   " for _ in range(10)]
+    _write_c2v(tiny_config.train_data_path, lines)
+    r0 = PathContextReader(tiny_vocabs, tiny_config, EstimatorAction.Train,
+                           shard_index=0, num_shards=2)
+    r1 = PathContextReader(tiny_vocabs, tiny_config, EstimatorAction.Train,
+                           shard_index=1, num_shards=2)
+    n0 = sum(b.num_valid for b in r0)
+    n1 = sum(b.num_valid for b in r1)
+    assert n0 == n1 == 4  # 5 rows each, batch 2, tail dropped
+
+
+def test_packed_roundtrip_matches_text_parse(tiny_vocabs, tiny_config):
+    lines = ["get|name foo,P1,bar baz,P2,foo  ",
+             "set|value bar,P3,baz   ",
+             "unknowntarget foo,P1,bar   ",
+             "run unk,UNK,unk   "]
+    _write_c2v(tiny_config.train_data_path, lines)
+    packed_path = pack_c2v(tiny_config.train_data_path, tiny_vocabs,
+                           tiny_config.max_contexts)
+    ds = PackedDataset(packed_path, tiny_vocabs)
+    assert ds.num_rows_total == 4
+    text = parse_context_lines(lines, tiny_vocabs, 4, EstimatorAction.Evaluate)
+    packed = ds.gather(np.arange(4), EstimatorAction.Evaluate,
+                       with_target_strings=True)
+    np.testing.assert_array_equal(packed.source_token_indices,
+                                  text.source_token_indices)
+    np.testing.assert_array_equal(packed.path_indices, text.path_indices)
+    np.testing.assert_array_equal(packed.target_token_indices,
+                                  text.target_token_indices)
+    np.testing.assert_array_equal(packed.context_valid_mask,
+                                  text.context_valid_mask)
+    np.testing.assert_array_equal(packed.target_index, text.target_index)
+    assert packed.target_strings == text.target_strings
+
+
+def test_packed_iter_filters_and_batches(tiny_vocabs, tiny_config):
+    lines = ["get|name foo,P1,bar   ",
+             "set|value bar,P3,baz   ",
+             "unknowntarget foo,P1,bar   ",  # train-filtered
+             "run unk,UNK,unk   ",           # always filtered
+             "run foo,P2,qux   "]
+    _write_c2v(tiny_config.train_data_path, lines)
+    packed_path = pack_c2v(tiny_config.train_data_path, tiny_vocabs, 4)
+    ds = PackedDataset(packed_path, tiny_vocabs)
+    train_batches = list(ds.iter_batches(2, EstimatorAction.Train, num_epochs=1))
+    assert len(train_batches) == 1  # 3 valid rows -> 1 full batch, tail dropped
+    eval_batches = list(ds.iter_batches(2, EstimatorAction.Evaluate))
+    assert sum(b.num_valid for b in eval_batches) == 4
+
+
+def test_packed_vocab_fingerprint_mismatch(tiny_vocabs, tiny_config):
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+    _write_c2v(tiny_config.train_data_path, ["get|name foo,P1,bar   "])
+    packed_path = pack_c2v(tiny_config.train_data_path, tiny_vocabs, 4)
+    other = Code2VecVocabs.create_from_freq_dicts(
+        WordFreqDicts({"zzz": 1}, {"Q": 1}, {"t": 1}, 1),
+        max_token_vocab_size=5, max_path_vocab_size=5, max_target_vocab_size=5)
+    with pytest.raises(ValueError, match="different vocabularies"):
+        PackedDataset(packed_path, other)

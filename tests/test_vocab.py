@@ -1,0 +1,91 @@
+"""Vocab semantics tests, cross-checked against the reference's documented
+behavior (vocabularies.py:22-106, preprocess.py:12-20)."""
+
+import io
+import pickle
+
+from code2vec_tpu.vocab import (
+    Code2VecVocabs, SpecialWords, Vocab, VocabType, WordFreqDicts,
+    load_word_freq_dicts, special_words_for, PAD_OR_OOV, PAD, OOV,
+)
+
+
+def test_joined_pad_oov_is_index_zero(tiny_vocabs):
+    for vocab in (tiny_vocabs.token_vocab, tiny_vocabs.path_vocab,
+                  tiny_vocabs.target_vocab):
+        assert vocab.pad_index == 0
+        assert vocab.oov_index == 0
+        assert vocab.index_to_word[0] == PAD_OR_OOV
+
+
+def test_separate_pad_oov_scheme():
+    sw_token = special_words_for(VocabType.Token, separate_oov_and_pad=True)
+    assert sw_token.pad == PAD and sw_token.oov == OOV
+    vocab = Vocab(VocabType.Token, ["a", "b"], sw_token)
+    assert vocab.pad_index == 0 and vocab.oov_index == 1
+    assert vocab.lookup_index("a") == 2
+    # Target vocab: only OOV (reference: vocabularies.py:204-209).
+    sw_target = special_words_for(VocabType.Target, separate_oov_and_pad=True)
+    assert sw_target.unique == [OOV]
+
+
+def test_freq_dict_truncation_keeps_top_n():
+    counts = {"w%d" % i: i for i in range(1, 21)}
+    vocab = Vocab.create_from_freq_dict(
+        VocabType.Token, counts, max_size=5,
+        special_words=special_words_for(VocabType.Token, False))
+    # top-5 by count: w20..w16, plus 1 special word
+    assert vocab.size == 6
+    for w in ("w20", "w19", "w18", "w17", "w16"):
+        assert w in vocab.word_to_index
+    assert "w15" not in vocab.word_to_index
+
+
+def test_oov_lookup(tiny_vocabs):
+    assert tiny_vocabs.token_vocab.lookup_index("nonexistent") == 0
+    assert tiny_vocabs.token_vocab.lookup_index("foo") != 0
+
+
+def test_dictionaries_bin_roundtrip(tiny_vocabs, tmp_path):
+    path = str(tmp_path / "dictionaries.bin")
+    tiny_vocabs.save(path)
+    loaded = Code2VecVocabs.load(path)
+    for orig, new in ((tiny_vocabs.token_vocab, loaded.token_vocab),
+                      (tiny_vocabs.path_vocab, loaded.path_vocab),
+                      (tiny_vocabs.target_vocab, loaded.target_vocab)):
+        assert orig.word_to_index == new.word_to_index
+        assert orig.size == new.size
+
+
+def test_dictionaries_bin_format_matches_reference_layout(tiny_vocabs, tmp_path):
+    """The file must be a sequence of raw pickles, specials excluded,
+    token/target/path order (reference: vocabularies.py:57-66, 211-218)."""
+    path = str(tmp_path / "dictionaries.bin")
+    tiny_vocabs.save(path)
+    with open(path, "rb") as f:
+        tok_w2i = pickle.load(f)
+        tok_i2w = pickle.load(f)
+        tok_size = pickle.load(f)
+        tgt_w2i = pickle.load(f)
+        _ = pickle.load(f)
+        _ = pickle.load(f)
+        path_w2i = pickle.load(f)
+    assert "foo" in tok_w2i and PAD_OR_OOV not in tok_w2i
+    assert min(tok_i2w) == 1  # specials stripped -> min index == nr specials
+    assert tok_size == tiny_vocabs.token_vocab.size - 1
+    assert "get|name" in tgt_w2i
+    assert "P1" in path_w2i
+
+
+def test_dict_c2v_pickle_roundtrip(tmp_path):
+    p = tmp_path / "data.dict.c2v"
+    with open(p, "wb") as f:
+        pickle.dump({"tok": 3}, f)
+        pickle.dump({"path": 2}, f)
+        pickle.dump({"tgt": 1}, f)
+        pickle.dump(42, f)
+    freq = load_word_freq_dicts(str(p))
+    assert freq.token_to_count == {"tok": 3}
+    assert freq.path_to_count == {"path": 2}
+    assert freq.target_to_count == {"tgt": 1}
+    assert freq.num_train_examples == 42
