@@ -1,0 +1,15 @@
+#!/usr/bin/env python3
+"""Reference-compatible entry point: `python3 code2vec.py --data D --test V
+--save S` etc. (reference: code2vec.py). Runs the TPU-native framework."""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from code2vec_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

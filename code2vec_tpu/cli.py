@@ -1,0 +1,133 @@
+"""CLI entry point, flag-compatible with the reference
+(reference: config.py:10-44 for the flags, code2vec.py:16-37 for the
+dispatch), plus TPU mesh/precision knobs."""
+
+from __future__ import annotations
+
+import sys
+from argparse import ArgumentParser
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.vocab import VocabType
+
+
+def arguments_parser() -> ArgumentParser:
+    parser = ArgumentParser(prog="code2vec_tpu")
+    # reference flags (config.py:10-44)
+    parser.add_argument("-d", "--data", dest="data_path",
+                        help="path prefix to preprocessed dataset", required=False)
+    parser.add_argument("-te", "--test", dest="test_path", metavar="FILE",
+                        required=False, default="",
+                        help="path to test/validation .c2v file")
+    parser.add_argument("-s", "--save", dest="save_path", metavar="FILE",
+                        required=False, help="path to save the model")
+    parser.add_argument("-l", "--load", dest="load_path", metavar="FILE",
+                        required=False, help="path to load the model from")
+    parser.add_argument("--save_w2v", dest="save_w2v", metavar="FILE",
+                        required=False,
+                        help="save token embeddings in word2vec format")
+    parser.add_argument("--save_t2v", dest="save_t2v", metavar="FILE",
+                        required=False,
+                        help="save target embeddings in word2vec format")
+    parser.add_argument("--export_code_vectors", action="store_true",
+                        help="export code vectors for the given examples")
+    parser.add_argument("--release", action="store_true",
+                        help="release the loaded model (strip optimizer "
+                             "state for a smaller artifact)")
+    parser.add_argument("--predict", action="store_true",
+                        help="run the interactive prediction shell")
+    parser.add_argument("-fw", "--framework", dest="dl_framework",
+                        choices=["jax", "tensorflow", "keras"], default="jax",
+                        help="accepted for reference CLI compatibility; this "
+                             "framework always runs the JAX/TPU backend")
+    parser.add_argument("-v", "--verbose", dest="verbose_mode", type=int,
+                        default=1, help="verbose mode in {0,1,2}")
+    parser.add_argument("-lp", "--logs-path", dest="logs_path", metavar="FILE",
+                        required=False, help="log file path")
+    # TPU-native knobs
+    parser.add_argument("--dp", type=int, default=1,
+                        help="data-parallel mesh axis size")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel (row-sharded tables) axis size")
+    parser.add_argument("--cp", type=int, default=1,
+                        help="context-parallel axis size (shards MAX_CONTEXTS)")
+    parser.add_argument("--compute_dtype", choices=["bfloat16", "float32"],
+                        default="bfloat16")
+    parser.add_argument("--batch_size", type=int, default=None)
+    parser.add_argument("--test_batch_size", type=int, default=None)
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--max_contexts", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--no_packed_data", action="store_true",
+                        help="stream text .c2v instead of packed .c2vb")
+    parser.add_argument("--gspmd", action="store_true",
+                        help="disable the manual shard_map TP kernels and "
+                             "rely on GSPMD sharding propagation")
+    return parser
+
+
+def config_from_args(argv=None) -> Config:
+    args = arguments_parser().parse_args(argv)
+    config = Config(
+        predict=args.predict,
+        model_save_path=args.save_path,
+        model_load_path=args.load_path,
+        train_data_path_prefix=args.data_path,
+        test_data_path=args.test_path,
+        release=args.release,
+        export_code_vectors=args.export_code_vectors,
+        save_w2v=args.save_w2v,
+        save_t2v=args.save_t2v,
+        verbose_mode=args.verbose_mode,
+        logs_path=args.logs_path,
+        dp=args.dp, tp=args.tp, cp=args.cp,
+        compute_dtype=args.compute_dtype,
+        seed=args.seed,
+        use_packed_data=not args.no_packed_data,
+        use_manual_tp_kernels=not args.gspmd,
+    )
+    if args.batch_size:
+        config.train_batch_size = args.batch_size
+        config.test_batch_size = args.batch_size
+    if args.test_batch_size:
+        config.test_batch_size = args.test_batch_size
+    if args.epochs:
+        config.num_train_epochs = args.epochs
+    if args.max_contexts:
+        config.max_contexts = args.max_contexts
+    return config
+
+
+def main(argv=None) -> None:
+    # dispatch mirrors reference code2vec.py:16-37
+    config = config_from_args(argv)
+    config.verify()
+
+    from code2vec_tpu.model_facade import Code2VecModel
+    model = Code2VecModel(config)
+
+    if config.is_training:
+        model.train()
+    if config.save_w2v is not None:
+        model.save_word2vec_format(config.save_w2v, VocabType.Token)
+        config.log(f"Origin word vectors saved in word2vec text format in: "
+                   f"{config.save_w2v}")
+    if config.save_t2v is not None:
+        model.save_word2vec_format(config.save_t2v, VocabType.Target)
+        config.log(f"Target word vectors saved in word2vec text format in: "
+                   f"{config.save_t2v}")
+    if (config.is_testing and not config.is_training) or config.release:
+        eval_results = model.evaluate()
+        if eval_results is not None:
+            config.log(
+                str(eval_results).replace(
+                    "topk",
+                    f"top{config.top_k_words_considered_during_prediction}"))
+    if config.predict:
+        from code2vec_tpu.serving.interactive import InteractivePredictor
+        predictor = InteractivePredictor(config, model)
+        predictor.predict()
+
+
+if __name__ == "__main__":
+    main()

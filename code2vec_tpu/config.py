@@ -158,8 +158,13 @@ class Config:
 
     @staticmethod
     def get_vocabularies_path_from_model_path(model_file_path: str) -> str:
-        # reference: config.py:191-194 — vocabs live next to the model as
-        # `dictionaries.bin`.
+        # Our model artifacts are directories carrying their own
+        # `dictionaries.bin`; the reference instead stores it as a sibling
+        # of the checkpoint file (reference: config.py:191-194). Accept both
+        # so reference-layout model dirs remain loadable.
+        inside = os.path.join(model_file_path, "dictionaries.bin")
+        if os.path.isfile(inside):
+            return inside
         return os.path.join(os.path.dirname(model_file_path), "dictionaries.bin")
 
     @property

@@ -1,0 +1,5 @@
+from code2vec_tpu.evaluation.metrics import (  # noqa: F401
+    ModelEvaluationResults, SubtokensEvaluationMetric,
+    TopKAccuracyEvaluationMetric, TargetWordTables,
+)
+from code2vec_tpu.evaluation.evaluator import Evaluator  # noqa: F401

@@ -1,0 +1,108 @@
+"""Evaluation loop: device top-k + host metrics + per-example audit log.
+
+reference flow: tensorflow_model.py:114-194 — iterate the eval reader,
+fetch (top_words, scores, original_names, code_vectors), update topk/
+subtoken metrics, append per-example outcomes to `log.txt`, optionally
+dump code vectors to `<test>.vectors`.
+
+TPU redesign: the jitted eval step returns top-k *indices* over the
+(possibly row-sharded) logits; strings only exist host-side. Batches are
+padded to fixed size with invalid rows (reader) and masked here.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from code2vec_tpu.evaluation.metrics import (
+    ModelEvaluationResults, SubtokensEvaluationMetric, TargetWordTables,
+    TopKAccuracyEvaluationMetric, first_match_rank,
+)
+from code2vec_tpu.training.step import device_put_batch
+
+
+class Evaluator:
+    def __init__(self, config, vocabs, eval_step: Callable, mesh=None,
+                 log_path: str = "log.txt"):
+        self.config = config
+        self.vocabs = vocabs
+        self.eval_step = eval_step
+        self.mesh = mesh
+        self.log_path = log_path
+        self.tables = TargetWordTables(vocabs.target_vocab)
+
+    def evaluate(self, params, batches: Iterable,
+                 code_vectors_path: Optional[str] = None) -> ModelEvaluationResults:
+        config = self.config
+        topk_metric = TopKAccuracyEvaluationMetric(
+            config.top_k_words_considered_during_prediction, self.tables)
+        subtoken_metric = SubtokensEvaluationMetric(self.tables)
+        loss_sum = 0.0
+        total_predictions = 0
+        total_batches = 0
+        start_time = time.time()
+
+        vectors_file = open(code_vectors_path, "w") if code_vectors_path else None
+        log_file = open(self.log_path, "w") if self.log_path else None
+        try:
+            for batch in batches:
+                arrays = device_put_batch(batch, self.mesh)
+                out = self.eval_step(params, *arrays)
+                topk_indices = np.asarray(out.topk_indices)
+                valid = np.asarray(batch.example_valid)
+                names = batch.target_strings
+                if names is None:
+                    # Fall back to vocab words (train-filtered data only has
+                    # in-vocab targets, so this is lossless there).
+                    names = [self.vocabs.target_vocab.lookup_word(int(i))
+                             for i in batch.target_index]
+                names = [n for n, v in zip(names, valid) if v]
+                rows = topk_indices[valid]
+                topk_metric.update_batch_from_indices(names, rows)
+                subtoken_metric.update_batch_from_indices(names, rows)
+                loss_sum += float(out.loss_sum)
+                total_predictions += len(names)
+                total_batches += 1
+                if log_file is not None:
+                    self._log_predictions(log_file, names, rows)
+                if vectors_file is not None:
+                    code_vectors = np.asarray(out.code_vectors)[valid]
+                    for vec in code_vectors:
+                        vectors_file.write(" ".join(map(str, vec)) + "\n")
+                if total_batches % config.num_batches_to_log_progress == 0:
+                    elapsed = time.time() - start_time
+                    config.log(f"Evaluated {total_predictions} examples... "
+                               f"({total_predictions / max(elapsed, 1e-9):.0f} "
+                               f"samples/sec)")
+            if log_file is not None:
+                log_file.write(str(topk_metric.topk_correct_predictions) + "\n")
+        finally:
+            if vectors_file is not None:
+                vectors_file.close()
+            if log_file is not None:
+                log_file.close()
+
+        return ModelEvaluationResults(
+            topk_acc=topk_metric.topk_correct_predictions,
+            subtoken_precision=subtoken_metric.precision,
+            subtoken_recall=subtoken_metric.recall,
+            subtoken_f1=subtoken_metric.f1,
+            loss=loss_sum / max(total_predictions, 1))
+
+    def _log_predictions(self, log_file, names, topk_rows) -> None:
+        # reference: tensorflow_model.py:410-421
+        for name, row in zip(names, topk_rows):
+            found = first_match_rank(self.tables, name, row)
+            if found is not None:
+                rank, predicted = found
+                if rank == 0:
+                    log_file.write(f"Original: {name}, predicted 1st: "
+                                   f"{predicted}\n")
+                else:
+                    log_file.write("\t\t predicted correctly at rank: "
+                                   f"{rank + 1}\n")
+            else:
+                log_file.write(f"No results for predicting: {name}")

@@ -1,0 +1,182 @@
+"""Evaluation metrics: top-k accuracy and subtoken precision/recall/F1.
+
+The reference has two implementations with subtly different edge cases
+(Python host-side, tensorflow_model.py:449-512, vs in-graph Keras,
+keras_words_subtoken_metrics.py). Per SURVEY.md §7 ("hard parts") the
+Python/eval definition is canonical here:
+
+- a prediction is the first *legal* word among the top-k (legal: not OOV
+  and ^[a-zA-Z|]+$, common.py:122-129);
+- subtoken tp/fp/fn count duplicate occurrences via Counter membership
+  (tensorflow_model.py:457-468);
+- top-k accuracy marks ranks >= the first normalized match's index within
+  the FILTERED list (common.py:180-187, tensorflow_model.py:502-508).
+
+One deliberate robustness fix: the reference crashes when no top-k word is
+legal (`[0]` on an empty list, tensorflow_model.py:459); here that case
+counts all original subtokens as false negatives instead (a strictly more
+conservative score; with k=10 over a real model it virtually never fires).
+
+Device->host flow: the model's eval step emits top-k *indices*; the
+`TargetWordTables` cache maps indices to words/legality/normalized forms
+once per vocab so the per-batch host work is dict lookups, not regex.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.common import (
+    get_subtokens, is_legal_method_name, normalize_word,
+)
+from code2vec_tpu.vocab import Vocab
+
+
+class ModelEvaluationResults(NamedTuple):
+    # reference: model_base.py:11-26
+    topk_acc: np.ndarray
+    subtoken_precision: float
+    subtoken_recall: float
+    subtoken_f1: float
+    loss: Optional[float] = None
+
+    def __str__(self):
+        res = (f"topk_acc: {self.topk_acc}, precision: {self.subtoken_precision}, "
+               f"recall: {self.subtoken_recall}, F1: {self.subtoken_f1}")
+        if self.loss is not None:
+            res = f"loss: {self.loss}, " + res
+        return res
+
+
+class TargetWordTables:
+    """Per-target-vocab-index caches: word, legality, normalized form,
+    subtoken Counter. Built lazily (predictions concentrate on a small set
+    of frequent names)."""
+
+    def __init__(self, target_vocab: Vocab):
+        self.vocab = target_vocab
+        self.oov_word = target_vocab.special_words.oov
+        self._legal: Dict[int, bool] = {}
+        self._normalized: Dict[int, str] = {}
+        self._subtokens: Dict[int, Counter] = {}
+
+    def word(self, index: int) -> str:
+        return self.vocab.lookup_word(index)
+
+    def legal(self, index: int) -> bool:
+        cached = self._legal.get(index)
+        if cached is None:
+            cached = is_legal_method_name(self.word(index), self.oov_word)
+            self._legal[index] = cached
+        return cached
+
+    def normalized(self, index: int) -> str:
+        cached = self._normalized.get(index)
+        if cached is None:
+            cached = normalize_word(self.word(index))
+            self._normalized[index] = cached
+        return cached
+
+    def subtoken_counter(self, index: int) -> Counter:
+        cached = self._subtokens.get(index)
+        if cached is None:
+            cached = Counter(get_subtokens(self.word(index)))
+            self._subtokens[index] = cached
+        return cached
+
+
+class TopKAccuracyEvaluationMetric:
+    """reference: tensorflow_model.py:495-512."""
+
+    def __init__(self, top_k: int, tables: TargetWordTables):
+        self.top_k = top_k
+        self.tables = tables
+        self.nr_correct_predictions = np.zeros(top_k)
+        self.nr_predictions = 0
+
+    def update_batch_from_indices(self, original_names: Sequence[str],
+                                  topk_indices: np.ndarray) -> None:
+        t = self.tables
+        for name, row in zip(original_names, topk_indices):
+            self.nr_predictions += 1
+            normalized_original = normalize_word(name)
+            filtered_rank = 0
+            for idx in row:
+                idx = int(idx)
+                if not t.legal(idx):
+                    continue
+                if t.normalized(idx) == normalized_original:
+                    self.nr_correct_predictions[filtered_rank:self.top_k] += 1
+                    break
+                filtered_rank += 1
+
+    @property
+    def topk_correct_predictions(self) -> np.ndarray:
+        return self.nr_correct_predictions / max(self.nr_predictions, 1)
+
+
+class SubtokensEvaluationMetric:
+    """reference: tensorflow_model.py:449-492 (see module docstring for the
+    no-legal-prediction edge case)."""
+
+    def __init__(self, tables: TargetWordTables):
+        self.tables = tables
+        self.nr_true_positives = 0
+        self.nr_false_positives = 0
+        self.nr_false_negatives = 0
+        self.nr_predictions = 0
+
+    def update_batch_from_indices(self, original_names: Sequence[str],
+                                  topk_indices: np.ndarray) -> None:
+        t = self.tables
+        for name, row in zip(original_names, topk_indices):
+            prediction_counter: Optional[Counter] = None
+            for idx in row:
+                idx = int(idx)
+                if t.legal(idx):
+                    prediction_counter = t.subtoken_counter(idx)
+                    break
+            original = Counter(get_subtokens(name))
+            if prediction_counter is None:
+                prediction_counter = Counter()
+            self.nr_true_positives += sum(
+                c for elem, c in prediction_counter.items() if elem in original)
+            self.nr_false_positives += sum(
+                c for elem, c in prediction_counter.items() if elem not in original)
+            self.nr_false_negatives += sum(
+                c for elem, c in original.items() if elem not in prediction_counter)
+            self.nr_predictions += 1
+
+    @property
+    def precision(self) -> float:
+        denom = self.nr_true_positives + self.nr_false_positives
+        return self.nr_true_positives / denom if denom else 0.0
+
+    @property
+    def recall(self) -> float:
+        denom = self.nr_true_positives + self.nr_false_negatives
+        return self.nr_true_positives / denom if denom else 0.0
+
+    @property
+    def f1(self) -> float:
+        p, r = self.precision, self.recall
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+def first_match_rank(tables: TargetWordTables, original_name: str,
+                     topk_indices: Iterable[int]) -> Optional[Tuple[int, str]]:
+    """(rank within filtered list, predicted word) of the first normalized
+    match, for the per-example eval log (tensorflow_model.py:410-421)."""
+    normalized_original = normalize_word(original_name)
+    filtered_rank = 0
+    for idx in topk_indices:
+        idx = int(idx)
+        if not tables.legal(idx):
+            continue
+        if tables.normalized(idx) == normalized_original:
+            return filtered_rank, tables.word(idx)
+        filtered_rank += 1
+    return None

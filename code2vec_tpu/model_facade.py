@@ -1,0 +1,287 @@
+"""High-level model API: train / evaluate / predict / save / export.
+
+Mirrors the reference's `Code2VecModelBase` lifecycle (model_base.py:37-182)
+with one TPU-native implementation instead of two TF backends: vocabs are
+built or loaded, the Flax module + Optax state are created (sharded over
+the mesh when dp*tp*cp > 1), and the train/evaluate/predict entry points
+drive the jitted steps.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu import common as common_mod
+from code2vec_tpu.common import count_lines_in_file
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.packed import PackedDataset, pack_c2v
+from code2vec_tpu.data.reader import (
+    EstimatorAction, PathContextReader, parse_context_lines,
+)
+from code2vec_tpu.evaluation.evaluator import Evaluator
+from code2vec_tpu.evaluation.metrics import ModelEvaluationResults
+from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+from code2vec_tpu.training import checkpoint as ckpt_mod
+from code2vec_tpu.training.loop import Trainer
+from code2vec_tpu.training.state import (
+    TrainState, create_train_state, make_optimizer, num_params,
+)
+from code2vec_tpu.training.step import TrainStepBuilder, device_put_batch
+from code2vec_tpu.vocab import Code2VecVocabs, VocabType
+
+
+class ModelPredictionResults(NamedTuple):
+    # reference: model_base.py:29-34
+    original_name: str
+    topk_predicted_words: List[str]
+    topk_predicted_words_scores: np.ndarray
+    attention_per_context: Dict[Tuple[str, str, str], float]
+    code_vector: Optional[np.ndarray] = None
+
+
+class Code2VecModel:
+    def __init__(self, config: Config):
+        self.config = config
+        config.verify()
+        self.log = config.log
+        self.log("Creating code2vec TPU model")
+        if not config.release:
+            self._init_num_of_examples()
+        self.vocabs = Code2VecVocabs.load_or_create(config)
+        self.dims = ModelDims.from_config_and_vocabs(config, self.vocabs)
+        self.mesh = (make_mesh(MeshPlan.from_config(config))
+                     if config.mesh_size > 1 else None)
+        self.module = Code2VecModule(
+            dims=self.dims,
+            dropout_keep_rate=config.dropout_keep_rate,
+            compute_dtype=jnp.dtype(config.compute_dtype))
+        self.optimizer = make_optimizer(config)
+        self.state = create_train_state(
+            self.module, self.optimizer, jax.random.PRNGKey(config.seed),
+            mesh=self.mesh)
+        self.builder = TrainStepBuilder(self.module, self.optimizer, config,
+                                        mesh=self.mesh)
+        if config.is_loading:
+            self.state = ckpt_mod.load_model(config.model_load_path, self.state)
+            self.log(f"Loaded model weights from {config.model_load_path}")
+        self._eval_step = None
+        self._predict_step = None
+        self.log(f"Model created: {num_params(self.state):,} parameters "
+                 f"(mesh dp={config.dp} tp={config.tp} cp={config.cp})")
+
+    # ------------------------------------------------------------ data
+
+    def _init_num_of_examples(self):
+        # reference: model_base.py:77-96 (.num_examples sidecar cache)
+        config = self.config
+        if config.is_training:
+            config.num_train_examples = self._count_examples(config.train_data_path)
+            self.log(f"    Number of train examples: {config.num_train_examples}")
+        if config.is_testing:
+            config.num_test_examples = self._count_examples(config.test_data_path)
+            self.log(f"    Number of test examples: {config.num_test_examples}")
+
+    @staticmethod
+    def _count_examples(dataset_path: str) -> int:
+        sidecar = dataset_path + ".num_examples"
+        if os.path.isfile(sidecar):
+            with open(sidecar) as f:
+                return int(f.readline())
+        n = count_lines_in_file(dataset_path)
+        try:
+            with open(sidecar, "w") as f:
+                f.write(str(n))
+        except OSError:
+            pass
+        return n
+
+    def _packed_dataset(self, c2v_path: str) -> PackedDataset:
+        packed_path = c2v_path + "b"
+        if not os.path.exists(packed_path):
+            self.log(f"Packing {c2v_path} -> {packed_path} (one-time)")
+            pack_c2v(c2v_path, self.vocabs, self.config.max_contexts,
+                     out_path=packed_path)
+        return PackedDataset(packed_path, self.vocabs)
+
+    def _train_batches(self) -> Iterable:
+        config = self.config
+        if config.use_packed_data:
+            ds = self._packed_dataset(config.train_data_path)
+            return ds.iter_batches(config.train_batch_size,
+                                   EstimatorAction.Train,
+                                   num_epochs=config.num_train_epochs,
+                                   seed=config.seed)
+        return PathContextReader(self.vocabs, config, EstimatorAction.Train)
+
+    def _eval_batches(self) -> Iterable:
+        config = self.config
+        if config.use_packed_data:
+            ds = self._packed_dataset(config.test_data_path)
+            return ds.iter_batches(config.test_batch_size,
+                                   EstimatorAction.Evaluate,
+                                   with_target_strings=True)
+        return PathContextReader(self.vocabs, config, EstimatorAction.Evaluate)
+
+    # ------------------------------------------------------------ train
+
+    def train(self):
+        config = self.config
+        train_step = self.builder.make_train_step(self.state)
+        save_fn = self._make_save_fn() if config.is_saving else None
+        evaluate_fn = ((lambda state: self._evaluate_with_params(state.params))
+                       if config.is_testing else None)
+        trainer = Trainer(config, train_step, mesh=self.mesh,
+                          evaluate_fn=evaluate_fn, save_fn=save_fn)
+        self.state = trainer.train(self.state, self._train_batches(),
+                                   jax.random.PRNGKey(config.seed + 1))
+        if config.is_saving:
+            self.save()
+            self.log(f"Model saved in: {config.model_save_path}")
+
+    def _make_save_fn(self):
+        config = self.config
+
+        def save_fn(state, epoch):
+            path = f"{config.model_save_path}_iter{epoch}"
+            ckpt_mod.save_model(path, state, self.vocabs, config, epoch=epoch)
+            self.log(f"Saved after {epoch} epochs in: {path}")
+            self._rotate_epoch_checkpoints()
+
+        return save_fn
+
+    def _rotate_epoch_checkpoints(self):
+        # reference keeps MAX_TO_KEEP epoch checkpoints (config.py:57).
+        config = self.config
+        pattern = f"{config.model_save_path}_iter*"
+        def epoch_of(p):
+            try:
+                return int(p.rsplit("_iter", 1)[1])
+            except ValueError:
+                return -1
+        paths = sorted((p for p in glob.glob(pattern) if epoch_of(p) >= 0),
+                       key=epoch_of)
+        for stale in paths[:-config.max_to_keep]:
+            shutil.rmtree(stale, ignore_errors=True)
+
+    # ------------------------------------------------------------ eval
+
+    def _get_eval_step(self):
+        if self._eval_step is None:
+            self._eval_step = self.builder.make_eval_step(self.state)
+        return self._eval_step
+
+    def evaluate(self) -> Optional[ModelEvaluationResults]:
+        config = self.config
+        if config.release:
+            # reference: tensorflow_model.py:131-135 — re-save weights-only.
+            released = ckpt_mod.save_model(
+                config.model_load_path, self.state, self.vocabs, config,
+                released=True)
+            self.log(f"Releasing model, output model: {released}")
+            return None
+        return self._evaluate_with_params(self.state.params)
+
+    def _evaluate_with_params(self, params) -> ModelEvaluationResults:
+        config = self.config
+        evaluator = Evaluator(config, self.vocabs, self._get_eval_step(),
+                              mesh=self.mesh)
+        vectors_path = (config.test_data_path + ".vectors"
+                        if config.export_code_vectors else None)
+        results = evaluator.evaluate(params, self._eval_batches(),
+                                     code_vectors_path=vectors_path)
+        return results
+
+    # ---------------------------------------------------------- predict
+
+    def _get_predict_step(self):
+        if self._predict_step is None:
+            self._predict_step = self.builder.make_eval_step(self.state)
+        return self._predict_step
+
+    def predict(self, predict_data_lines: Iterable[str]) -> List[ModelPredictionResults]:
+        """reference: tensorflow_model.py:310-367 — per-line predictions
+        with top-k words, softmax-normalized scores, attention per context
+        and the code vector."""
+        config = self.config
+        step = self._get_predict_step()
+        results: List[ModelPredictionResults] = []
+        lines = list(predict_data_lines)
+        if not lines:
+            return results
+        batch = parse_context_lines(lines, self.vocabs, config.max_contexts,
+                                    EstimatorAction.Predict, keep_strings=True)
+        # Pad the row count to the jitted batch size to avoid recompiles.
+        from code2vec_tpu.data.reader import _pad_rows
+        bs = config.test_batch_size
+        chunks = [batch] if len(lines) <= bs else None
+        if chunks is None:
+            idxs = [np.arange(i, min(i + bs, len(lines)))
+                    for i in range(0, len(lines), bs)]
+            from code2vec_tpu.data.reader import _select_rows
+            chunks = [_select_rows(batch, ix) for ix in idxs]
+        for chunk in chunks:
+            n = chunk.target_index.shape[0]
+            padded = _pad_rows(chunk, bs)
+            arrays = device_put_batch(padded, self.mesh)
+            out = step(self.state.params, *arrays)
+            topk_idx = np.asarray(out.topk_indices)[:n]
+            topk_val = np.asarray(out.topk_values)[:n]
+            code_vectors = np.asarray(out.code_vectors)[:n]
+            attention = np.asarray(out.attention)[:n]
+            # normalize_scores=True in the reference predict graph
+            # (tensorflow_model.py:321): softmax over the k values.
+            e = np.exp(topk_val - topk_val.max(axis=1, keepdims=True))
+            scores = e / e.sum(axis=1, keepdims=True)
+            for i in range(n):
+                words = [self.vocabs.target_vocab.lookup_word(int(j))
+                         for j in topk_idx[i]]
+                attention_per_context: Dict[Tuple[str, str, str], float] = {}
+                for m in range(config.max_contexts):
+                    s = chunk.source_strings[i, m]
+                    p = chunk.path_strings[i, m]
+                    t = chunk.target_token_strings[i, m]
+                    if s or p or t:
+                        attention_per_context[(s, p, t)] = float(attention[i, m])
+                results.append(ModelPredictionResults(
+                    original_name=(chunk.target_strings[i]
+                                   if chunk.target_strings else ""),
+                    topk_predicted_words=words,
+                    topk_predicted_words_scores=scores[i],
+                    attention_per_context=attention_per_context,
+                    code_vector=(code_vectors[i]
+                                 if config.export_code_vectors else None)))
+        return results
+
+    # ------------------------------------------------------------ save
+
+    def save(self, model_save_path: Optional[str] = None) -> str:
+        path = model_save_path or self.config.model_save_path
+        return ckpt_mod.save_model(path, self.state, self.vocabs, self.config)
+
+    # --------------------------------------------------------- exports
+
+    def _get_vocab_embedding_as_np_array(self, vocab_type: VocabType) -> np.ndarray:
+        name = {VocabType.Token: "token_embedding",
+                VocabType.Path: "path_embedding",
+                VocabType.Target: "target_embedding"}[vocab_type]
+        table = np.asarray(jax.device_get(self.state.params[name]))
+        real_rows = self.vocabs.get(vocab_type).size
+        return table[:real_rows]
+
+    def save_word2vec_format(self, dest_save_path: str, vocab_type: VocabType):
+        # reference: model_base.py:176-182
+        if vocab_type not in VocabType:
+            raise ValueError("`vocab_type` should be a VocabType")
+        matrix = self._get_vocab_embedding_as_np_array(vocab_type)
+        index_to_word = self.vocabs.get(vocab_type).index_to_word
+        with open(dest_save_path, "w") as f:
+            common_mod.save_word2vec_file(f, index_to_word, matrix)
+        self.log(f"Saved {vocab_type} word2vec format to {dest_save_path}")

@@ -1,0 +1,1 @@
+from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims  # noqa: F401

@@ -1,0 +1,186 @@
+"""The code2vec model as a single Flax module.
+
+One TPU-first implementation replaces the reference's two parallel
+backends (TF1 session graphs tensorflow_model.py:196-308 and tf.keras
+keras_model.py:37-95). Architecture (identical math):
+
+  token/path embedding gathers -> concat (B, M, 3d) -> dropout(0.25)
+  -> tanh(. @ TRANSFORM) -> masked single-query attention -> code vector
+  -> logits = code_vector @ TARGET_EMB^T  (~261K-way classifier)
+
+Parameter shapes and initializers follow tensorflow_model.py:204-219 and
+:248-253: embeddings use variance_scaling(1.0, fan_out, uniform);
+TRANSFORM/ATTENTION use TF's get_variable default (glorot_uniform).
+Parameters are float32; matmuls run in `compute_dtype` (bfloat16 on the
+MXU) with float32 accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.ops.attention import masked_single_query_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDims:
+    token_vocab_size: int
+    path_vocab_size: int
+    target_vocab_size: int
+    token_dim: int = 128
+    path_dim: int = 128
+    # Real (unpadded) target vocab size. Table rows may be padded up to a
+    # multiple of the tensor-parallel degree so row shards are equal-sized
+    # under shard_map; padded classifier columns must never win, so logits
+    # for ids >= real_target_vocab_size are masked to -inf.
+    real_target_vocab_size: int = 0
+
+    def __post_init__(self):
+        if self.real_target_vocab_size == 0:
+            object.__setattr__(self, "real_target_vocab_size",
+                               self.target_vocab_size)
+
+    @property
+    def context_dim(self) -> int:
+        return self.path_dim + 2 * self.token_dim
+
+    @property
+    def code_dim(self) -> int:
+        return self.context_dim
+
+    @property
+    def has_padded_targets(self) -> bool:
+        return self.real_target_vocab_size < self.target_vocab_size
+
+    def padded_to(self, tp: int) -> "ModelDims":
+        """Round table row counts up to a multiple of `tp` (equal row
+        shards for the manual tensor-parallel kernels)."""
+        def up(n):
+            return ((n + tp - 1) // tp) * tp
+        return dataclasses.replace(
+            self,
+            token_vocab_size=up(self.token_vocab_size),
+            path_vocab_size=up(self.path_vocab_size),
+            target_vocab_size=up(self.target_vocab_size),
+            real_target_vocab_size=self.real_target_vocab_size,
+        )
+
+    @classmethod
+    def from_config_and_vocabs(cls, config, vocabs) -> "ModelDims":
+        dims = cls(
+            token_vocab_size=vocabs.token_vocab.size,
+            path_vocab_size=vocabs.path_vocab.size,
+            target_vocab_size=vocabs.target_vocab.size,
+            token_dim=config.token_embeddings_size,
+            path_dim=config.path_embeddings_size,
+        )
+        if config.tp > 1:
+            dims = dims.padded_to(config.tp)
+        return dims
+
+
+def _embedding_init():
+    # reference: tensorflow_model.py:208 — variance_scaling(scale=1.0,
+    # mode='fan_out', distribution='uniform').
+    return nn.initializers.variance_scaling(1.0, "fan_out", "uniform")
+
+
+class Code2VecModule(nn.Module):
+    dims: ModelDims
+    dropout_keep_rate: float = 0.75
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    # Mesh axis name the context dimension is sharded over (context/sequence
+    # parallelism); None under plain jit/GSPMD.
+    context_axis_name: Optional[str] = None
+
+    def setup(self):
+        d = self.dims
+        self.token_embedding = self.param(
+            "token_embedding", _embedding_init(),
+            (d.token_vocab_size, d.token_dim), jnp.float32)
+        self.path_embedding = self.param(
+            "path_embedding", _embedding_init(),
+            (d.path_vocab_size, d.path_dim), jnp.float32)
+        self.target_embedding = self.param(
+            "target_embedding", _embedding_init(),
+            (d.target_vocab_size, d.code_dim), jnp.float32)
+        self.transform = self.param(
+            "transform", nn.initializers.glorot_uniform(),
+            (d.context_dim, d.code_dim), jnp.float32)
+        self.attention = self.param(
+            "attention", nn.initializers.glorot_uniform(),
+            (d.code_dim, 1), jnp.float32)
+
+    def transform_contexts(
+        self,
+        source_token_indices: jax.Array,   # (B, M) int32
+        path_indices: jax.Array,           # (B, M) int32
+        target_token_indices: jax.Array,   # (B, M) int32
+        deterministic: bool = True,
+    ) -> jax.Array:
+        """Embed, concat, dropout, tanh-transform: (B, M, code_dim).
+
+        reference: tensorflow_model.py:237-251.
+        """
+        src = jnp.take(self.token_embedding, source_token_indices, axis=0)
+        pth = jnp.take(self.path_embedding, path_indices, axis=0)
+        tgt = jnp.take(self.token_embedding, target_token_indices, axis=0)
+        ctx = jnp.concatenate([src, pth, tgt], axis=-1)      # (B, M, 3d)
+        if not deterministic:
+            # reference keeps 75% (tensorflow_model.py:244-245).
+            keep = self.dropout_keep_rate
+            rng = self.make_rng("dropout")
+            mask = jax.random.bernoulli(rng, p=keep, shape=ctx.shape)
+            ctx = jnp.where(mask, ctx / keep, 0.0)
+        ctx = ctx.astype(self.compute_dtype)
+        transformed = jnp.tanh(
+            jnp.einsum("bmc,cd->bmd", ctx, self.transform.astype(self.compute_dtype),
+                       preferred_element_type=jnp.float32))
+        return transformed.astype(self.compute_dtype)
+
+    def encode(
+        self,
+        source_token_indices: jax.Array,
+        path_indices: jax.Array,
+        target_token_indices: jax.Array,
+        context_valid_mask: jax.Array,     # (B, M) float
+        deterministic: bool = True,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Code vectors (B, code_dim) float32 + attention weights (B, M)."""
+        transformed = self.transform_contexts(
+            source_token_indices, path_indices, target_token_indices,
+            deterministic=deterministic)
+        code_vectors, attention = masked_single_query_attention(
+            transformed, self.attention[:, 0], context_valid_mask,
+            axis_name=self.context_axis_name)
+        return code_vectors.astype(jnp.float32), attention
+
+    def logits_from_code_vectors(self, code_vectors: jax.Array) -> jax.Array:
+        """(B, target_vocab) float32 — the replicated (non-TP) classifier.
+
+        reference: tensorflow_model.py:225, :296. The tensor-parallel
+        variant lives in ops/sharded.py and consumes `target_embedding`
+        row-sharded.
+        """
+        logits = jnp.einsum(
+            "bd,vd->bv", code_vectors.astype(self.compute_dtype),
+            self.target_embedding.astype(self.compute_dtype),
+            preferred_element_type=jnp.float32)
+        if self.dims.has_padded_targets:
+            col = jnp.arange(self.dims.target_vocab_size)
+            logits = jnp.where(col[None, :] < self.dims.real_target_vocab_size,
+                               logits, -jnp.inf)
+        return logits
+
+    def __call__(self, source_token_indices, path_indices, target_token_indices,
+                 context_valid_mask, deterministic: bool = True):
+        code_vectors, attention = self.encode(
+            source_token_indices, path_indices, target_token_indices,
+            context_valid_mask, deterministic=deterministic)
+        logits = self.logits_from_code_vectors(code_vectors)
+        return logits, code_vectors, attention

@@ -1,0 +1,1 @@
+from code2vec_tpu.ops.attention import masked_single_query_attention  # noqa: F401

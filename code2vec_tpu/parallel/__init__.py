@@ -1,0 +1,4 @@
+from code2vec_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_CTX, AXIS_DATA, AXIS_MODEL, MeshPlan, batch_specs, make_mesh,
+    param_specs, replicated_axes_for_spec, tree_param_specs,
+)

@@ -1,0 +1,129 @@
+"""Device mesh + sharding layout for the framework.
+
+The reference is single-process/single-device (SURVEY.md §2.3); this module
+is the TPU-native replacement for "no distribution at all": a 3-axis
+``jax.sharding.Mesh``
+
+  - ``data``  — batch (data parallelism; gradient psum rides ICI),
+  - ``model`` — rows of the three embedding tables and of the ~261K-way
+                target classifier (tensor parallelism for the pod-scale
+                config, BASELINE.json config #5),
+  - ``ctx``   — the MAX_CONTEXTS axis (context parallelism for the
+                MAX_CONTEXTS=500 stress config, BASELINE.json config #4).
+
+Layout policy: put ``data`` outermost so DP gradient all-reduces ride the
+densest ICI dimension; ``model``/``ctx`` collectives are small
+(activations, not tables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_CTX = "ctx"
+MESH_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_CTX)
+
+# PartitionSpec per parameter leaf name (flax param tree of
+# models/code2vec.py). Embedding tables are row-sharded over `model`
+# (vocab dimension); the small dense params are replicated.
+PARAM_SPECS = {
+    "token_embedding": P(AXIS_MODEL, None),
+    "path_embedding": P(AXIS_MODEL, None),
+    "target_embedding": P(AXIS_MODEL, None),
+    "transform": P(),
+    "attention": P(),
+}
+
+# PartitionSpec per batch field of data.reader.RowBatch.
+BATCH_SPECS = {
+    "source_token_indices": P(AXIS_DATA, AXIS_CTX),
+    "path_indices": P(AXIS_DATA, AXIS_CTX),
+    "target_token_indices": P(AXIS_DATA, AXIS_CTX),
+    "context_valid_mask": P(AXIS_DATA, AXIS_CTX),
+    "target_index": P(AXIS_DATA),
+    "example_valid": P(AXIS_DATA),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    tp: int = 1
+    cp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.cp
+
+    @classmethod
+    def from_config(cls, config) -> "MeshPlan":
+        return cls(dp=config.dp, tp=config.tp, cp=config.cp)
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) < plan.size:
+        raise ValueError(
+            f"Mesh plan dp={plan.dp} tp={plan.tp} cp={plan.cp} needs "
+            f"{plan.size} devices, have {len(devices)}.")
+    grid = np.asarray(devices[:plan.size]).reshape(plan.dp, plan.tp, plan.cp)
+    return Mesh(grid, MESH_AXES)
+
+
+def param_specs(params) -> dict:
+    """PartitionSpec tree mirroring a flax param dict ({'token_embedding':
+    arr, ...}); unknown leaves are replicated."""
+    return {name: PARAM_SPECS.get(name, P()) for name in params}
+
+
+def tree_param_specs(tree):
+    """Spec tree for any pytree whose leaf paths contain the param names
+    (params, Adam mu/nu, ...). Leaves on unrecognized paths (e.g. the Adam
+    step counter) are replicated."""
+
+    def spec_for_path(path, leaf):
+        for entry in reversed(path):
+            key = getattr(entry, "key", None) or getattr(entry, "name", None)
+            if key in PARAM_SPECS:
+                # Guard against a named leaf that isn't the full-shape param
+                # (e.g. factored optimizer vectors): fall back to replication
+                # if the spec has more axes than the leaf.
+                spec = PARAM_SPECS[key]
+                if hasattr(leaf, "ndim") and len(spec) > leaf.ndim:
+                    return P()
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for_path, tree)
+
+
+def batch_specs() -> dict:
+    return dict(BATCH_SPECS)
+
+
+def shardings(mesh: Mesh, spec_tree):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def replicated_axes_for_spec(spec: P) -> Tuple[str, ...]:
+    """Mesh axes over which a leaf with this spec is stored replicated —
+    exactly the axes its local gradient must be psum'd over inside
+    shard_map (the storage-replication transpose rule)."""
+    used = set()
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, (tuple, list)):
+            used.update(part)
+        else:
+            used.add(part)
+    return tuple(a for a in MESH_AXES if a not in used)

@@ -1,0 +1,2 @@
+from code2vec_tpu.serving.extractor_bridge import PathExtractor  # noqa: F401
+from code2vec_tpu.serving.interactive import InteractivePredictor  # noqa: F401

@@ -1,0 +1,81 @@
+"""Bridge to an AST path extractor producing raw context lines.
+
+Preference order:
+1. the framework's native C++ extractor (`cpp/` build, `c2v-extract`);
+2. the reference's shipped Java jar (a data producer, not model runtime —
+   SURVEY.md §7 'minimum end-to-end slice').
+
+Reproduces the reference driver semantics (extractor.py:11-38): run with
+`--no_hash` so paths come out readable, truncate to MAX_CONTEXTS, re-hash
+each path string with Java's String#hashCode (the training data stores
+hashed paths), keep hash->string for the attention display.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Tuple
+
+from code2vec_tpu.common import java_string_hashcode
+
+DEFAULT_JAR_PATH = "JavaExtractor/JPredict/target/JavaExtractor-0.0.1-SNAPSHOT.jar"
+NATIVE_EXTRACTOR_ENV = "C2V_NATIVE_EXTRACTOR"
+
+
+def _native_extractor_path() -> str:
+    env = os.environ.get(NATIVE_EXTRACTOR_ENV)
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "cpp", "build", "c2v-extract")
+
+
+class PathExtractor:
+    def __init__(self, config, jar_path: str = DEFAULT_JAR_PATH,
+                 max_path_length: int = 8, max_path_width: int = 2):
+        self.config = config
+        self.jar_path = jar_path
+        self.max_path_length = max_path_length
+        self.max_path_width = max_path_width
+
+    def _build_command(self, path: str) -> List[str]:
+        native = _native_extractor_path()
+        if os.path.exists(native):
+            return [native, "--max_path_length", str(self.max_path_length),
+                    "--max_path_width", str(self.max_path_width),
+                    "--file", path, "--no_hash"]
+        if os.path.exists(self.jar_path) and shutil.which("java"):
+            return ["java", "-cp", self.jar_path, "JavaExtractor.App",
+                    "--max_path_length", str(self.max_path_length),
+                    "--max_path_width", str(self.max_path_width),
+                    "--file", path, "--no_hash"]
+        raise FileNotFoundError(
+            f"No extractor available: native binary `{native}` not built and "
+            f"jar `{self.jar_path}` not present (or no java runtime).")
+
+    def extract_paths(self, path: str) -> Tuple[List[str], Dict[str, str]]:
+        command = self._build_command(path)
+        process = subprocess.Popen(command, stdout=subprocess.PIPE,
+                                   stderr=subprocess.PIPE)
+        out, err = process.communicate()
+        output = out.decode().splitlines()
+        if len(output) == 0:
+            raise ValueError(err.decode())
+        hash_to_string: Dict[str, str] = {}
+        result = []
+        max_contexts = self.config.max_contexts
+        for line in output:
+            parts = line.rstrip().split(" ")
+            line_parts = [parts[0]]
+            contexts = parts[1:]
+            for context in contexts[:max_contexts]:
+                w1, p, w2 = context.split(",")
+                hashed = str(java_string_hashcode(p))
+                hash_to_string[hashed] = p
+                line_parts.append(f"{w1},{hashed},{w2}")
+            padding = " " * (max_contexts - len(contexts))
+            result.append(" ".join(line_parts) + padding)
+        return result, hash_to_string

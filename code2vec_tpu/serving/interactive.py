@@ -1,0 +1,99 @@
+"""Interactive prediction REPL (reference: interactive_predict.py:28-57).
+
+Reads `Input.java`, extracts path-contexts, predicts names, prints top-k
+predictions with per-context attention (paths un-hashed via the
+extractor's hash->string map) and optionally the code vector.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from code2vec_tpu.common import get_subtokens
+from code2vec_tpu.serving.extractor_bridge import PathExtractor
+
+SHOW_TOP_CONTEXTS = 10
+MAX_PATH_LENGTH = 8
+MAX_PATH_WIDTH = 2
+
+
+class MethodPredictionResults:
+    # reference: common.py:204-217
+    def __init__(self, original_name: str):
+        self.original_name = original_name
+        self.predictions: List[dict] = []
+        self.attention_paths: List[dict] = []
+
+    def append_prediction(self, name, probability):
+        self.predictions.append({"name": name, "probability": probability})
+
+    def append_attention_path(self, attention_score, token1, path, token2):
+        self.attention_paths.append({"score": attention_score, "path": path,
+                                     "token1": token1, "token2": token2})
+
+
+def parse_prediction_results(raw_prediction_results, hash_to_string: Dict[str, str],
+                             oov_word: str, topk: int = SHOW_TOP_CONTEXTS
+                             ) -> List[MethodPredictionResults]:
+    # reference: common.py:135-158
+    out = []
+    for raw in raw_prediction_results:
+        res = MethodPredictionResults(raw.original_name)
+        for i, predicted in enumerate(raw.topk_predicted_words):
+            if predicted == oov_word:
+                continue
+            res.append_prediction(
+                get_subtokens(predicted),
+                float(raw.topk_predicted_words_scores[i]))
+        sorted_contexts = sorted(raw.attention_per_context.items(),
+                                 key=lambda kv: kv[1], reverse=True)[:topk]
+        for (token1, hashed_path, token2), weight in sorted_contexts:
+            if hashed_path in hash_to_string:
+                res.append_attention_path(
+                    float(weight), token1=token1,
+                    path=hash_to_string[hashed_path], token2=token2)
+        out.append(res)
+    return out
+
+
+class InteractivePredictor:
+    exit_keywords = ["exit", "quit", "q"]
+
+    def __init__(self, config, model):
+        self.model = model
+        self.config = config
+        self.path_extractor = PathExtractor(
+            config, max_path_length=MAX_PATH_LENGTH,
+            max_path_width=MAX_PATH_WIDTH)
+
+    def predict(self, input_filename: str = "Input.java"):
+        print("Starting interactive prediction...")
+        oov = self.model.vocabs.target_vocab.special_words.oov
+        while True:
+            print(f'Modify the file: "{input_filename}" and press any key '
+                  'when ready, or "q" / "quit" / "exit" to exit')
+            user_input = input()
+            if user_input.lower() in self.exit_keywords:
+                print("Exiting...")
+                return
+            try:
+                predict_lines, hash_to_string = \
+                    self.path_extractor.extract_paths(input_filename)
+            except (ValueError, FileNotFoundError) as e:
+                print(e)
+                continue
+            raw_results = self.model.predict(predict_lines)
+            method_results = parse_prediction_results(
+                raw_results, hash_to_string, oov, topk=SHOW_TOP_CONTEXTS)
+            for raw, method in zip(raw_results, method_results):
+                print("Original name:\t" + method.original_name)
+                for pair in method.predictions:
+                    print("\t(%f) predicted: %s" % (pair["probability"],
+                                                    pair["name"]))
+                print("Attention:")
+                for att in method.attention_paths:
+                    print("%f\tcontext: %s,%s,%s" % (
+                        att["score"], att["token1"], att["path"], att["token2"]))
+                if self.config.export_code_vectors and raw.code_vector is not None:
+                    print("Code vector:")
+                    print(" ".join(map(str, raw.code_vector)))

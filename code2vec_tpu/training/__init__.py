@@ -1,0 +1,4 @@
+from code2vec_tpu.training.state import (  # noqa: F401
+    TrainState, make_optimizer, init_params, create_train_state,
+)
+from code2vec_tpu.training.step import TrainStepBuilder  # noqa: F401

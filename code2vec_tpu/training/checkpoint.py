@@ -1,0 +1,149 @@
+"""Checkpointing via Orbax: trainable vs released artifacts + vocab sidecar.
+
+Reference behavior being reproduced (TPU-natively, not with TF Savers):
+- per-epoch checkpoints `<save>_iter<N>` with `max_to_keep` rotation
+  (tensorflow_model.py:57, 90-94; config.py:57);
+- vocabs stored next to the model as `dictionaries.bin`
+  (model_base.py:102-109, config.py:191-194);
+- `--release` strips optimizer state for a ~3x smaller inference-only
+  artifact (tensorflow_model.py:131-135, keras_model.py:230-234) — here a
+  released checkpoint simply omits `opt_state`;
+- resume-for-training requires the full artifact (keras_model.py:245-262).
+
+Orbax gives async, sharded, multi-host-safe saves (SURVEY.md §5 plan:
+preemption-tolerant checkpointing for TPU pods).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from code2vec_tpu.training.state import TrainState
+
+_STATE_DIR = "state"
+_META_NAME = "code2vec_meta.json"
+RELEASED_SUFFIX = ".release"
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(path)
+
+
+class CheckpointManager:
+    """Epoch-numbered checkpoints for one model path prefix."""
+
+    def __init__(self, directory: str, max_to_keep: int = 10):
+        self.directory = _abs(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=True),
+        )
+
+    def save(self, epoch: int, state: TrainState, released: bool = False) -> None:
+        target = {"params": state.params, "step": state.step}
+        if not released:
+            target["opt_state"] = state.opt_state
+        self._manager.save(epoch, args=ocp.args.StandardSave(target))
+
+    def restore(self, state_like: TrainState, epoch: Optional[int] = None) -> TrainState:
+        epoch = epoch if epoch is not None else self._manager.latest_step()
+        if epoch is None:
+            raise FileNotFoundError(
+                f"No checkpoint found under {self.directory}")
+        template = {"params": state_like.params, "step": state_like.step,
+                    "opt_state": state_like.opt_state}
+        saved_names = set()
+        try:
+            meta = self._manager.item_metadata(epoch)
+            saved_names = set(getattr(meta, "keys", lambda: [])())
+        except Exception:
+            pass
+        if saved_names and "opt_state" not in saved_names:
+            template.pop("opt_state")
+        restored = self._manager.restore(
+            epoch, args=ocp.args.StandardRestore(template))
+        return TrainState(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored.get("opt_state", state_like.opt_state))
+
+    def latest_epoch(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()
+
+
+def save_model(model_save_path: str, state: TrainState, vocabs, config,
+               epoch: int = 0, released: bool = False) -> str:
+    """Save a standalone model artifact at `<model_save_path>` (a directory
+    is created): Orbax state + `dictionaries.bin` + config meta. Mirrors
+    `Code2VecModelBase.save` (model_base.py:102-109)."""
+    base = _abs(model_save_path) + (RELEASED_SUFFIX if released else "")
+    os.makedirs(base, exist_ok=True)
+    vocabs.save(os.path.join(base, "dictionaries.bin"))
+    with open(os.path.join(base, _META_NAME), "w") as f:
+        json.dump({
+            "released": released,
+            "epoch": epoch,
+            "step": int(np.asarray(state.step)),
+            "token_vocab_size": vocabs.token_vocab.size,
+            "path_vocab_size": vocabs.path_vocab.size,
+            "target_vocab_size": vocabs.target_vocab.size,
+            "token_embeddings_size": config.token_embeddings_size,
+            "path_embeddings_size": config.path_embeddings_size,
+            "separate_oov_and_pad": config.separate_oov_and_pad,
+        }, f, indent=2)
+    ckptr = ocp.StandardCheckpointer()
+    target = {"params": state.params, "step": state.step}
+    if not released:
+        target["opt_state"] = state.opt_state
+    state_dir = os.path.join(base, _STATE_DIR)
+    ckptr.save(state_dir, target, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    return base
+
+
+def load_model_meta(model_load_path: str) -> dict:
+    base = _abs(model_load_path)
+    with open(os.path.join(base, _META_NAME)) as f:
+        return json.load(f)
+
+
+def load_model(model_load_path: str, state_like: TrainState) -> TrainState:
+    """Restore a standalone artifact saved by `save_model`. `state_like`
+    provides structure/shardings; released artifacts keep `state_like`'s
+    (fresh) optimizer state."""
+    base = _abs(model_load_path)
+    meta = load_model_meta(base)
+    template = {"params": state_like.params, "step": state_like.step}
+    if not meta.get("released", False):
+        template["opt_state"] = state_like.opt_state
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.join(base, _STATE_DIR), template)
+    ckptr.close()
+    return TrainState(
+        step=restored["step"],
+        params=restored["params"],
+        opt_state=restored.get("opt_state", state_like.opt_state))
+
+
+def release_model(model_load_path: str, model_save_path: Optional[str],
+                  state_like: TrainState, vocabs, config) -> str:
+    """Load a trainable artifact and re-save it weights-only
+    (reference: tensorflow_model.py:131-135 saves `<load>.release`)."""
+    state = load_model(model_load_path, state_like)
+    out = model_save_path or model_load_path
+    return save_model(out, state, vocabs, config, released=True)

@@ -1,0 +1,87 @@
+"""The training loop: jitted steps, throughput tracing, periodic eval+save.
+
+reference: tensorflow_model.py:40-112 — an endless `sess.run` loop with
+per-100-batch throughput logs (:83-89), per-epoch checkpoint + eval
+(:90-101). Here the step is one donated jitted call; the host thread only
+feeds prefetched batches and reads the loss scalar asynchronously
+(fetching it every batch would serialize host and device; we only block on
+it at log boundaries).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+import numpy as np
+
+from code2vec_tpu.training.state import TrainState
+from code2vec_tpu.utils.prefetch import DevicePrefetcher
+
+
+class Trainer:
+    def __init__(self, config, train_step: Callable, mesh=None,
+                 evaluate_fn: Optional[Callable] = None,
+                 save_fn: Optional[Callable] = None,
+                 profile_dir: Optional[str] = None):
+        self.config = config
+        self.train_step = train_step
+        self.mesh = mesh
+        self.evaluate_fn = evaluate_fn
+        self.save_fn = save_fn
+        self.profile_dir = profile_dir
+
+    def train(self, state: TrainState, batches: Iterable,
+              rng: jax.Array) -> TrainState:
+        config = self.config
+        log = config.log
+        log("Starting training")
+        start_time = time.time()
+        steps_per_epoch = config.train_steps_per_epoch
+        batches_per_save_and_eval = max(
+            int(steps_per_epoch * config.save_every_epochs), 1)
+
+        batch_num = 0
+        pending_losses = []
+        multi_batch_start = time.time()
+        prefetcher = DevicePrefetcher(batches, self.mesh,
+                                      depth=config.prefetch_batches)
+        for arrays, _ in prefetcher:
+            batch_num += 1
+            if self.profile_dir and batch_num == 10:
+                jax.profiler.start_trace(self.profile_dir)
+            state, loss = self.train_step(state, *arrays, rng)
+            pending_losses.append(loss)
+            if self.profile_dir and batch_num == 20:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                log(f"Wrote profiler trace to {self.profile_dir}")
+            if batch_num % config.num_batches_to_log_progress == 0:
+                # Blocks on the device only here.
+                avg_loss = float(np.mean(jax.device_get(pending_losses)))
+                elapsed = time.time() - multi_batch_start
+                n = len(pending_losses) * config.train_batch_size
+                throughput = n / max(elapsed, 1e-9)
+                contexts_rate = throughput * config.max_contexts
+                log(f"Average loss at batch {batch_num}: {avg_loss:.6f}, "
+                    f"\tthroughput: {throughput:.0f} samples/sec "
+                    f"({contexts_rate / 1e6:.2f}M path-contexts/sec)")
+                pending_losses = []
+                multi_batch_start = time.time()
+            if batch_num % batches_per_save_and_eval == 0:
+                epoch_num = int(batch_num / batches_per_save_and_eval
+                                * config.save_every_epochs)
+                if self.save_fn is not None:
+                    self.save_fn(state, epoch_num)
+                if self.evaluate_fn is not None:
+                    results = self.evaluate_fn(state)
+                    if results is not None:
+                        log(f"After {epoch_num} epochs -- {results}")
+                multi_batch_start = time.time()
+
+        log("Done training")
+        elapsed = int(time.time() - start_time)
+        log("Training time: %sH:%sM:%sS\n" % (
+            elapsed // 3600, (elapsed // 60) % 60, elapsed % 60))
+        return state

@@ -1,0 +1,80 @@
+"""Training state: params + Adam state, with mesh-sharded initialization.
+
+The reference's trainable state is four TF variables plus Adam slots
+managed by the session (tensorflow_model.py:204-231); here it's an
+explicit pytree initialized directly into its target sharding via
+jit(out_shardings=...) so a pod-scale model never materializes unsharded
+on one host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from code2vec_tpu.models.code2vec import Code2VecModule
+from code2vec_tpu.parallel import mesh as mesh_lib
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array         # scalar int32
+    params: Any             # flax param dict
+    opt_state: Any          # optax state
+
+
+def make_optimizer(config) -> optax.GradientTransformation:
+    # reference uses tf.compat.v1.train.AdamOptimizer() defaults
+    # (tensorflow_model.py:231): lr 1e-3, b1 .9, b2 .999, eps 1e-8.
+    return optax.adam(
+        learning_rate=config.learning_rate,
+        b1=config.adam_beta1, b2=config.adam_beta2, eps=config.adam_eps)
+
+
+def init_params(module: Code2VecModule, rng: jax.Array):
+    """Initialize the param dict with throwaway token shapes (params do not
+    depend on batch shapes)."""
+    dummy = jnp.zeros((1, 1), dtype=jnp.int32)
+    dummy_mask = jnp.zeros((1, 1), dtype=jnp.float32)
+    variables = module.init({"params": rng}, dummy, dummy, dummy, dummy_mask)
+    return variables["params"]
+
+
+def state_spec_tree(state: Any):
+    """PartitionSpec tree for a TrainState (params + optimizer slots follow
+    the same layout; the Adam counter and `step` are replicated)."""
+    return mesh_lib.tree_param_specs(state)
+
+
+def create_train_state(
+    module: Code2VecModule,
+    optimizer: optax.GradientTransformation,
+    rng: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> TrainState:
+    """Build a TrainState; with a mesh, every leaf is created directly into
+    its NamedSharding (no host-side full materialization)."""
+
+    def init_fn(rng):
+        params = init_params(module, rng)
+        return TrainState(
+            step=jnp.zeros((), dtype=jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params))
+
+    if mesh is None:
+        return jax.jit(init_fn)(rng)
+
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = mesh_lib.shardings(mesh, state_spec_tree(abstract))
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def num_params(state: TrainState) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(state.params))

@@ -1,0 +1,1 @@
+from code2vec_tpu.utils.prefetch import DevicePrefetcher  # noqa: F401

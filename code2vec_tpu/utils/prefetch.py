@@ -1,0 +1,50 @@
+"""Host->device double-buffering: overlap input parsing/transfer with step
+execution (the reference gets this from tf.data's internal C++ threads,
+path_context_reader.py:150; here an explicit background thread feeds a
+bounded queue of device-resident, sharding-annotated batches)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, Optional
+
+from code2vec_tpu.training.step import device_put_batch
+
+
+class DevicePrefetcher:
+    """Wraps a RowBatch iterable; yields (device_arrays, host_batch) with up
+    to `depth` batches transferred ahead of consumption."""
+
+    _SENTINEL = object()
+
+    def __init__(self, batches: Iterable, mesh, depth: int = 4,
+                 keep_host_batch: bool = False):
+        self.batches = batches
+        self.mesh = mesh
+        self.depth = max(1, depth)
+        self.keep_host_batch = keep_host_batch
+        self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        try:
+            for batch in self.batches:
+                arrays = device_put_batch(batch, self.mesh)
+                self._queue.put(
+                    (arrays, batch if self.keep_host_batch else None))
+        except BaseException as e:  # propagate to consumer
+            self._error = e
+        finally:
+            self._queue.put(self._SENTINEL)
+
+    def __iter__(self) -> Iterator:
+        self._thread.start()
+        while True:
+            item = self._queue.get()
+            if item is self._SENTINEL:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item

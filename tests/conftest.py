@@ -3,12 +3,21 @@ is exercised without TPU hardware (SURVEY.md §4 implication)."""
 
 import os
 
-# Must happen before the first `import jax` anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Forced (not setdefault): the ambient environment points JAX at the real
+# TPU (JAX_PLATFORMS=axon), but tests exercise sharding on 8 virtual CPU
+# devices. In this image `import pytest` already imports jax, which
+# snapshots env vars into its config at import time — so update the jax
+# config directly as well (safe: the backend itself initializes lazily).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import sys
 

@@ -1,0 +1,75 @@
+"""Metric-definition tests vs hand-computed cases
+(spec: tensorflow_model.py:449-512, common.py:122-187)."""
+
+import numpy as np
+
+from code2vec_tpu.evaluation.metrics import (
+    SubtokensEvaluationMetric, TargetWordTables, TopKAccuracyEvaluationMetric,
+    first_match_rank,
+)
+from code2vec_tpu.vocab import (
+    Vocab, VocabType, special_words_for,
+)
+
+
+def _vocab(words):
+    return Vocab(VocabType.Target, words,
+                 special_words_for(VocabType.Target, False))
+
+
+def test_topk_accuracy_filtered_rank_semantics():
+    # vocab: 0=<PAD_OR_OOV>, 1=get|name, 2=bad2name, 3=set|name, 4=run
+    vocab = _vocab(["get|name", "bad2name", "set|name", "run"])
+    tables = TargetWordTables(vocab)
+    metric = TopKAccuracyEvaluationMetric(3, tables)
+    # top-3 = [OOV, bad2name, get|name]: OOV + illegal are skipped, so
+    # get|name is the FIRST filtered candidate -> correct at rank 1.
+    metric.update_batch_from_indices(["getName"], np.array([[0, 2, 1]]))
+    np.testing.assert_array_equal(metric.topk_correct_predictions, [1, 1, 1])
+    # top-3 = [set|name, get|name, run] vs getName: match at filtered idx 1.
+    metric.update_batch_from_indices(["getName"], np.array([[3, 1, 4]]))
+    np.testing.assert_array_equal(metric.topk_correct_predictions,
+                                  [0.5, 1, 1])
+    # no match anywhere
+    metric.update_batch_from_indices(["zzz"], np.array([[1, 3, 4]]))
+    np.testing.assert_allclose(metric.topk_correct_predictions,
+                               [1 / 3, 2 / 3, 2 / 3])
+
+
+def test_subtoken_metric_counter_semantics():
+    vocab = _vocab(["get|name", "get|get|name", "set|value", "run"])
+    tables = TargetWordTables(vocab)
+    metric = SubtokensEvaluationMetric(tables)
+    # original getName -> subtokens Counter(getname: 1)?? No: original name
+    # comes as the raw target string 'get|name' in .c2v data.
+    # prediction get|get|name: tp counts duplicates (2x 'get' both count
+    # since 'get' in original), fn for nothing, fp for nothing extra.
+    metric.update_batch_from_indices(["get|name"], np.array([[2]]))
+    assert metric.nr_true_positives == 3   # get,get,name all in original
+    assert metric.nr_false_positives == 0
+    assert metric.nr_false_negatives == 0
+
+    metric2 = SubtokensEvaluationMetric(tables)
+    # prediction set|value vs original get|name: 0 tp, 2 fp, 2 fn
+    metric2.update_batch_from_indices(["get|name"], np.array([[3]]))
+    assert (metric2.nr_true_positives, metric2.nr_false_positives,
+            metric2.nr_false_negatives) == (0, 2, 2)
+    assert metric2.precision == 0 and metric2.recall == 0 and metric2.f1 == 0
+
+
+def test_subtoken_metric_no_legal_prediction_counts_fn():
+    vocab = _vocab(["bad2name"])
+    tables = TargetWordTables(vocab)
+    metric = SubtokensEvaluationMetric(tables)
+    # top-k contains only OOV and an illegal name: reference would crash
+    # (tensorflow_model.py:459); we count all original subtokens as FN.
+    metric.update_batch_from_indices(["get|name"], np.array([[0, 1]]))
+    assert (metric.nr_true_positives, metric.nr_false_positives,
+            metric.nr_false_negatives) == (0, 0, 2)
+
+
+def test_first_match_rank():
+    vocab = _vocab(["get|name", "bad2name", "set|name"])
+    tables = TargetWordTables(vocab)
+    assert first_match_rank(tables, "getName", [0, 2, 3, 1]) == (1, "get|name")
+    assert first_match_rank(tables, "nope", [1, 3]) is None

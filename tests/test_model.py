@@ -1,0 +1,122 @@
+"""Model math tests: attention/softmax numerics vs hand-computed numpy
+(the spec is tensorflow_model.py:235-264)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+from code2vec_tpu.ops.attention import masked_single_query_attention
+
+
+def _numpy_reference_forward(params, src, pth, tgt, mask):
+    """Direct numpy transcription of the reference math
+    (tensorflow_model.py:237-262), no dropout."""
+    tok = params["token_embedding"]
+    path = params["path_embedding"]
+    ctx = np.concatenate([tok[src], path[pth], tok[tgt]], axis=-1)
+    transformed = np.tanh(ctx @ params["transform"])
+    scores = transformed @ params["attention"][:, 0]
+    scores = scores + np.log(mask)          # log(0) = -inf on invalid
+    scores = scores - scores.max(axis=1, keepdims=True)
+    e = np.exp(scores)
+    attn = e / e.sum(axis=1, keepdims=True)
+    code = (transformed * attn[..., None]).sum(axis=1)
+    logits = code @ params["target_embedding"].T
+    return code, attn, logits
+
+
+@pytest.fixture
+def small_module_and_params():
+    dims = ModelDims(token_vocab_size=11, path_vocab_size=7,
+                     target_vocab_size=5, token_dim=4, path_dim=4)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, 1), jnp.int32)
+    params = module.init({"params": rng}, dummy, dummy, dummy,
+                         jnp.zeros((1, 1)))["params"]
+    return module, params
+
+
+def test_forward_matches_numpy_reference(small_module_and_params):
+    module, params = small_module_and_params
+    rng = np.random.default_rng(0)
+    B, M = 3, 6
+    src = rng.integers(0, 11, (B, M)).astype(np.int32)
+    pth = rng.integers(0, 7, (B, M)).astype(np.int32)
+    tgt = rng.integers(0, 11, (B, M)).astype(np.int32)
+    mask = (rng.random((B, M)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0  # every row has a valid context
+
+    logits, code, attn = module.apply({"params": params}, src, pth, tgt, mask,
+                                      deterministic=True)
+    np_params = jax.tree.map(np.asarray, params)
+    ref_code, ref_attn, ref_logits = _numpy_reference_forward(
+        np_params, src, pth, tgt, mask)
+
+    np.testing.assert_allclose(np.asarray(code), ref_code, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(attn), ref_attn, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_invalid_contexts_get_zero_weight():
+    B, M, D = 2, 4, 3
+    transformed = jnp.ones((B, M, D))
+    att = jnp.ones((D,))
+    mask = jnp.array([[1, 1, 0, 0], [1, 0, 0, 0]], jnp.float32)
+    code, attn = masked_single_query_attention(transformed, att, mask)
+    np.testing.assert_allclose(np.asarray(attn[0]), [0.5, 0.5, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(attn[1]), [1, 0, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(code), np.ones((B, D)), atol=1e-6)
+
+
+def test_attention_all_invalid_row_is_finite():
+    # Padded eval rows have no valid context; weights must be 0 (not NaN)
+    # so downstream psums stay finite.
+    transformed = jnp.ones((1, 4, 3))
+    mask = jnp.zeros((1, 4), jnp.float32)
+    code, attn = masked_single_query_attention(transformed, jnp.ones((3,)), mask)
+    assert np.isfinite(np.asarray(attn)).all()
+    np.testing.assert_allclose(np.asarray(attn), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(code), 0.0, atol=1e-6)
+
+
+def test_dropout_scales_and_zeroes(small_module_and_params):
+    module, params = small_module_and_params
+    B, M = 2, 5
+    src = np.zeros((B, M), np.int32)
+    pth = np.zeros((B, M), np.int32)
+    tgt = np.zeros((B, M), np.int32)
+    mask = np.ones((B, M), np.float32)
+    out1 = module.apply({"params": params}, src, pth, tgt, mask,
+                        deterministic=False,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+    out2 = module.apply({"params": params}, src, pth, tgt, mask,
+                        deterministic=True)
+    # stochastic forward differs from deterministic one
+    assert not np.allclose(np.asarray(out1[0]), np.asarray(out2[0]))
+
+
+def test_padded_target_dims_mask_logits():
+    dims = ModelDims(token_vocab_size=8, path_vocab_size=8,
+                     target_vocab_size=8, token_dim=4, path_dim=4,
+                     real_target_vocab_size=5)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, 2), jnp.int32)
+    params = module.init({"params": rng}, dummy, dummy, dummy,
+                         jnp.ones((1, 2)))["params"]
+    logits, _, _ = module.apply({"params": params}, dummy, dummy, dummy,
+                                jnp.ones((1, 2)), deterministic=True)
+    assert np.asarray(logits)[:, 5:].max() == -np.inf
+    assert np.isfinite(np.asarray(logits)[:, :5]).all()
+
+
+def test_padded_to_rounds_up():
+    dims = ModelDims(token_vocab_size=10, path_vocab_size=9,
+                     target_vocab_size=7, token_dim=4, path_dim=4)
+    p = dims.padded_to(4)
+    assert (p.token_vocab_size, p.path_vocab_size, p.target_vocab_size) == (12, 12, 8)
+    assert p.real_target_vocab_size == 7
+    assert p.has_padded_targets
