@@ -1,0 +1,114 @@
+"""Headline benchmark: flagship-scale train-step throughput on one chip.
+
+Builds the java14m-scale code2vec model (full reference vocab sizes,
+reference: config.py:61-63 — token 1,301,136 / path 911,417 / target
+261,245; ~385M params) and times the jitted fused
+forward/backward/Adam-update train step at the reference batch size 1024
+with MAX_CONTEXTS=200.
+
+Baseline: the reference trains java14m (~14M examples) at ~50 min/epoch on
+one V100 (reference: README.md:69,127) => ~4,700 examples/sec. BASELINE.json
+asks for >=10x on a v5e-16 pod; this script reports single-chip
+examples/sec, so vs_baseline is the per-chip speedup over one V100.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+V100_EXAMPLES_PER_SEC = 14_000_000 / (50 * 60)  # ~4,667
+
+BATCH = 1024
+CONTEXTS = 200
+WARMUP_STEPS = 3
+TIMED_STEPS = 20
+
+
+def _build(config):
+    import jax
+    import jax.numpy as jnp
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+    from code2vec_tpu.training.step import TrainStepBuilder
+
+    dims = ModelDims(
+        token_vocab_size=config.max_token_vocab_size,
+        path_vocab_size=config.max_path_vocab_size,
+        target_vocab_size=config.max_target_vocab_size,
+        token_dim=config.token_embeddings_size,
+        path_dim=config.path_embeddings_size,
+    )
+    module = Code2VecModule(dims=dims,
+                            compute_dtype=jnp.dtype(config.compute_dtype))
+    optimizer = make_optimizer(config)
+    state = create_train_state(module, optimizer, jax.random.PRNGKey(0),
+                               mesh=None)
+    builder = TrainStepBuilder(module, optimizer, config, mesh=None)
+    return state, builder.make_train_step(state), dims
+
+
+def _synthetic_batch(dims):
+    """Random int batch, device-resident, so timings measure the step."""
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    b, m = BATCH, CONTEXTS
+    src = jax.random.randint(ks[0], (b, m), 0, dims.token_vocab_size, jnp.int32)
+    pth = jax.random.randint(ks[1], (b, m), 0, dims.path_vocab_size, jnp.int32)
+    tgt = jax.random.randint(ks[2], (b, m), 0, dims.token_vocab_size, jnp.int32)
+    mask = jnp.ones((b, m), jnp.float32)
+    labels = jax.random.randint(ks[3], (b,), 1, dims.target_vocab_size,
+                                jnp.int32)
+    valid = jnp.ones((b,), bool)
+    return tuple(jax.block_until_ready(x)
+                 for x in (src, pth, tgt, mask, labels, valid))
+
+
+def main() -> None:
+    import jax
+    from code2vec_tpu.config import Config
+
+    config = Config(train_data_path_prefix="<bench>",
+                    train_batch_size=BATCH, max_contexts=CONTEXTS,
+                    compute_dtype="bfloat16")
+    state, train_step, dims = _build(config)
+    batch = _synthetic_batch(dims)
+    rng = jax.random.PRNGKey(2)
+
+    for _ in range(WARMUP_STEPS):
+        state, loss = train_step(state, *batch, rng)
+    float(loss)  # host fetch: the only reliable completion barrier over the
+    #              axon tunnel, where block_until_ready can return early.
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, loss = train_step(state, *batch, rng)
+    # The final loss transitively depends on every prior donated-state
+    # update, so fetching it forces the full 20-step chain.
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = TIMED_STEPS * BATCH / dt
+    print(json.dumps({
+        "metric": "java14m-scale train throughput, 1 chip "
+                  f"(batch {BATCH}, {CONTEXTS} ctx, 385M params, "
+                  f"{config.compute_dtype})",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(examples_per_sec / V100_EXAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
