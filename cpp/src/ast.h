@@ -1,0 +1,79 @@
+// AST for the native Java path-context extractor.
+//
+// Node `type` strings are JavaParser 3.0.0-alpha.4 simple class names
+// (the reference's parser: JavaExtractor/JPredict/pom.xml) because the
+// extractor embeds them verbatim in path strings
+// (FeatureExtractor.java:161-162). alpha.4 is structurally the 2.x AST:
+// declaration names are NameExpr child nodes (Common.java:61-69 relies on
+// a NameExpr child of MethodDeclaration), operator enum names are
+// lowercase (`plus`, `rSignedShift`, ...), and reference types are wrapped
+// in ReferenceType carrying the array dimension count.
+//
+// Children order matters: it defines childId (LeavesCollectorVisitor
+// .java:57-68 — index of the first sibling with an equal source range),
+// which is printed at path endpoints and under
+// AssignExpr/ArrayAccessExpr/FieldAccessExpr/MethodCallExpr parents
+// (FeatureExtractor.java:26-28,153-188). Orders below follow the alpha.4
+// constructors' setAsParentNodeOf sequence.
+#pragma once
+
+#include <deque>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace c2v {
+
+struct Node {
+  // JavaParser simple class name, e.g. "MethodCallExpr".
+  std::string type;
+  std::vector<Node*> children;
+  Node* parent = nullptr;
+  // Source byte range (Range equality stands in for JavaParser's
+  // line/column Range in getChildId).
+  int begin = 0;
+  int end = 0;
+
+  // Leaf text: what alpha.4 `node.toString()` prints for a childless
+  // node (identifier, literal with quotes, "int", "this", ...).
+  std::string text;
+  // Operator enum name for BinaryExpr/UnaryExpr/AssignExpr (lowercase
+  // alpha.4 spelling); empty otherwise.
+  std::string op;
+  // ClassOrInterfaceType details for the boxed/generic rules
+  // (Property.java:29-31,45-54).
+  std::string name;          // simple name (ClassOrInterfaceType, decls)
+  bool boxed = false;        // Integer/Long/... -> type becomes PrimitiveType
+  std::string unboxed_name;  // "int", "long", ... when boxed
+  bool generic_parent = false;  // has >=1 type argument
+
+  bool is_statement = false;    // Statement subclasses: never leaves
+  bool is_null_literal = false;
+  bool is_int_literal = false;  // IntegerLiteralExpr (for <NUM> masking)
+
+  bool HasChildren() const { return !children.empty(); }
+};
+
+// Owns all nodes of one parse; Nodes use raw pointers into the arena.
+class Arena {
+ public:
+  Node* New(std::string type) {
+    nodes_.emplace_back();
+    nodes_.back().type = std::move(type);
+    return &nodes_.back();
+  }
+  size_t size() const { return nodes_.size(); }
+
+ private:
+  std::deque<Node> nodes_;
+};
+
+// Appends `child` to `parent` (no-op on null child), setting parent link.
+inline void Adopt(Node* parent, Node* child) {
+  if (child == nullptr) return;
+  child->parent = parent;
+  parent->children.push_back(child);
+}
+
+}  // namespace c2v

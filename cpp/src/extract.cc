@@ -1,0 +1,435 @@
+#include "extract.h"
+
+#include <algorithm>
+#include <cctype>
+#include <sstream>
+#include <unordered_map>
+#include <unordered_set>
+
+#include "ast.h"
+#include "parser.h"
+
+namespace c2v {
+
+namespace {
+
+constexpr const char* kMethodNameToken = "METHOD_NAME";  // Common.java:33
+constexpr const char* kBlankWord = "BLANK";              // Common.java:30
+constexpr int kMaxLabelLength = 50;                      // Common.java:32
+
+// FeatureExtractor.java:26-28
+const std::unordered_set<std::string> kParentTypesWithChildId = {
+    "AssignExpr", "ArrayAccessExpr", "FieldAccessExpr", "MethodCallExpr"};
+
+// Note: Property.java:23-24's NumericalKeepValues/<NUM> masking touches
+// only SplitName, which the text output never prints
+// (ProgramRelation.java:31-34) — so it is intentionally absent here.
+
+bool IsPrintableAscii(unsigned char c) { return c >= 0x20 && c <= 0x7E; }
+
+}  // namespace
+
+int32_t JavaStringHashCode(const std::string& s) {
+  int32_t h = 0;
+  for (unsigned char c : s) {
+    h = static_cast<int32_t>(
+        static_cast<uint32_t>(h) * 31u + static_cast<uint32_t>(c));
+  }
+  return h;
+}
+
+std::string NormalizeName(const std::string& original,
+                          const std::string& default_string) {
+  // Common.java:36-41, applied in the reference's exact order:
+  // toLowerCase, remove literal "\n" escapes, remove the (buggy) `//s+`
+  // pattern (literally `//` followed by one or more `s`), remove
+  // quotes/apostrophes/commas, remove non-printables.
+  std::string s;
+  s.reserve(original.size());
+  for (char c : original)
+    s.push_back(static_cast<char>(
+        std::tolower(static_cast<unsigned char>(c))));
+  // remove "\\n" (two source chars: backslash, 'n')
+  std::string t;
+  for (size_t i = 0; i < s.size();) {
+    if (s[i] == '\\' && i + 1 < s.size() && s[i + 1] == 'n') {
+      i += 2;
+    } else {
+      t.push_back(s[i]);
+      ++i;
+    }
+  }
+  // remove `//s+` — the reference regex "//s+" is literal, a typo for
+  // "\\s+"; reproduced bug-for-bug (Common.java:39)
+  std::string u;
+  for (size_t i = 0; i < t.size();) {
+    if (t[i] == '/' && i + 1 < t.size() && t[i + 1] == '/' &&
+        i + 2 < t.size() && t[i + 2] == 's') {
+      i += 2;
+      while (i < t.size() && t[i] == 's') ++i;
+    } else {
+      u.push_back(t[i]);
+      ++i;
+    }
+  }
+  std::string v;
+  for (char c : u) {
+    if (c == '"' || c == '\'' || c == ',') continue;
+    if (!IsPrintableAscii(static_cast<unsigned char>(c))) continue;
+    v.push_back(c);
+  }
+  // Common.java:42-52
+  std::string stripped;
+  for (char c : v)
+    if (std::isalpha(static_cast<unsigned char>(c))) stripped.push_back(c);
+  if (!stripped.empty()) return stripped;
+  std::string careful;
+  for (char c : v) careful.push_back(c == ' ' ? '_' : c);
+  if (careful.empty()) return default_string;
+  return careful;
+}
+
+std::vector<std::string> SplitToSubtokens(const std::string& s) {
+  // Common.java:71-76 — split on case boundaries, and treat '_',
+  // digits, and whitespace as removed delimiters; normalize each part.
+  std::string str = s;
+  // trim
+  size_t b = str.find_first_not_of(" \t\r\n\f");
+  size_t e = str.find_last_not_of(" \t\r\n\f");
+  str = (b == std::string::npos) ? "" : str.substr(b, e - b + 1);
+
+  std::vector<std::string> raw_parts;
+  std::string cur;
+  auto flush = [&]() {
+    raw_parts.push_back(cur);  // keep empties; filtered below like Java's
+    cur.clear();
+  };
+  for (size_t i = 0; i < str.size(); ++i) {
+    char c = str[i];
+    auto lower = [&](size_t k) {
+      return k < str.size() && std::islower(static_cast<unsigned char>(str[k]));
+    };
+    auto upper = [&](size_t k) {
+      return k < str.size() && std::isupper(static_cast<unsigned char>(str[k]));
+    };
+    if (c == '_' || std::isdigit(static_cast<unsigned char>(c)) ||
+        std::isspace(static_cast<unsigned char>(c))) {
+      flush();
+      continue;
+    }
+    cur.push_back(c);
+    // boundary (?<=[a-z])(?=[A-Z]) and (?<=[A-Z])(?=[A-Z][a-z])
+    if ((std::islower(static_cast<unsigned char>(c)) && upper(i + 1)) ||
+        (std::isupper(static_cast<unsigned char>(c)) && upper(i + 1) &&
+         lower(i + 2))) {
+      flush();
+    }
+  }
+  flush();
+
+  std::vector<std::string> out;
+  for (const std::string& part : raw_parts) {
+    if (part.empty()) continue;
+    std::string norm = NormalizeName(part, "");
+    if (!norm.empty()) out.push_back(norm);
+  }
+  return out;
+}
+
+namespace {
+
+// ------------------------------------------------------------ Property
+// Per-node attributes computed exactly as Property.java:26-77.
+struct NodeProps {
+  std::string raw_type;  // class simple name
+  std::string type;      // + boxed rewrite, GenericClass, :operator
+  std::string name;      // normalized printable token
+  int child_id = 0;
+};
+
+int ComputeChildId(const Node* node) {
+  // LeavesCollectorVisitor.java:57-68: index of the first sibling whose
+  // Range equals this node's.
+  const Node* parent = node->parent;
+  if (parent == nullptr) return 0;
+  int child_id = 0;
+  for (const Node* child : parent->children) {
+    if (child->begin == node->begin && child->end == node->end)
+      return child_id;
+    ++child_id;
+  }
+  return child_id;
+}
+
+NodeProps ComputeProps(const Node* node, bool is_leaf) {
+  NodeProps p;
+  p.raw_type = node->type;
+  p.type = node->type;
+  if (node->type == "ClassOrInterfaceType" && node->boxed) {
+    p.type = "PrimitiveType";  // Property.java:29-31
+  }
+  if (!node->op.empty()) p.type += ":" + node->op;  // Property.java:32-42
+
+  bool generic_parent =
+      node->type == "ClassOrInterfaceType" && node->generic_parent;
+  if (generic_parent && is_leaf) p.type = "GenericClass";  // Property.java:47-53
+
+  // Name: normalizeName(node.toString()) for leaves; for internal
+  // nodes the reference computes it from the full pretty-print, but it
+  // is only ever printed for leaves (ProgramRelation.java:31-34), so
+  // non-leaf names are left empty here.
+  if (is_leaf) {
+    p.name = NormalizeName(node->text, kBlankWord);
+    if (p.name.size() > static_cast<size_t>(kMaxLabelLength)) {
+      p.name = p.name.substr(0, kMaxLabelLength);  // Property.java:60-61
+    } else if (node->type == "ClassOrInterfaceType" && node->boxed) {
+      p.name = node->unboxed_name;  // Property.java:62-64
+    }
+    // METHOD_NAME masking (Property.java:66-68, Common.java:61-69)
+    if (p.type == "NameExpr" && node->parent != nullptr &&
+        node->parent->type == "MethodDeclaration") {
+      p.name = kMethodNameToken;
+    }
+  }
+  p.child_id = ComputeChildId(node);
+  return p;
+}
+
+// ------------------------------------------------------- leaf gathering
+void CollectLeaves(Node* node, std::vector<Node*>* leaves) {
+  // LeavesCollectorVisitor.java:20-37 (pre-order). Comments never exist
+  // in this AST; Statements are not leaves.
+  if (!node->HasChildren() && !node->is_statement) {
+    const std::string& text = node->text;
+    if (!text.empty() && (text != "null" || node->is_null_literal)) {
+      leaves->push_back(node);
+    }
+  }
+  for (Node* child : node->children) CollectLeaves(child, leaves);
+}
+
+void CollectMethods(Node* node, std::vector<Node*>* methods) {
+  if (node->type == "MethodDeclaration") methods->push_back(node);
+  for (Node* child : node->children) CollectMethods(child, methods);
+}
+
+// ------------------------------------------------------- method length
+// The reference counts lines of JavaParser's pretty-printed body
+// (FunctionVisitor.java:42-55; note its `!=`-on-String filters are
+// always-true, so `{`/`}`-only and blank lines DO count). Without a
+// pretty-printer we approximate with the source text of the body, which
+// matches at the boundaries that matter: 0 for empty bodies (filtered
+// by MinCodeLength=1) and large for the MaxCodeLength cutoff.
+long MethodLength(const std::string& src, const Node* body) {
+  std::string code = src.substr(body->begin, body->end - body->begin);
+  std::string clean;
+  clean.reserve(code.size());
+  for (size_t i = 0; i < code.size(); ++i) {
+    if (code[i] == '\r' && i + 1 < code.size() && code[i + 1] == '\n') {
+      clean.push_back('\n');
+      ++i;
+    } else if (code[i] == '\t') {
+      clean.push_back(' ');
+    } else {
+      clean.push_back(code[i]);
+    }
+  }
+  // strip the outer braces
+  if (!clean.empty() && clean.front() == '{') clean.erase(clean.begin());
+  if (!clean.empty() && clean.back() == '}') clean.pop_back();
+  // trim
+  size_t b = clean.find_first_not_of(" \n");
+  if (b == std::string::npos) return 0;
+  size_t e = clean.find_last_not_of(" \n");
+  clean = clean.substr(b, e - b + 1);
+  if (clean.empty()) return 0;
+  long count = 0;
+  std::istringstream lines(clean);
+  std::string line;
+  while (std::getline(lines, line)) {
+    size_t first = line.find_first_not_of(" ");
+    std::string trimmed =
+        first == std::string::npos ? "" : line.substr(first);
+    if (trimmed.rfind("/", 0) == 0 || trimmed.rfind("*", 0) == 0) continue;
+    ++count;
+  }
+  return count;
+}
+
+// ------------------------------------------------------------- paths
+std::vector<const Node*> TreeStack(const Node* node) {
+  std::vector<const Node*> stack;
+  for (const Node* cur = node; cur != nullptr; cur = cur->parent)
+    stack.push_back(cur);
+  return stack;
+}
+
+class MethodExtractor {
+ public:
+  MethodExtractor(const ExtractOptions& options,
+                  std::unordered_map<const Node*, NodeProps>* props)
+      : options_(options), props_(props) {}
+
+  const NodeProps& Props(const Node* n) {
+    auto it = props_->find(n);
+    if (it == props_->end()) {
+      bool is_leaf = false;  // only queried for path-interior nodes here
+      it = props_->emplace(n, ComputeProps(n, is_leaf)).first;
+    }
+    return it->second;
+  }
+
+  int SaturateChildId(int child_id) const {
+    return std::min(child_id, options_.max_child_id);
+  }
+
+  // FeatureExtractor.java:120-191.
+  std::string GeneratePath(const Node* source, const Node* target) {
+    std::vector<const Node*> source_stack = TreeStack(source);
+    std::vector<const Node*> target_stack = TreeStack(target);
+
+    int common_prefix = 0;
+    int si = static_cast<int>(source_stack.size()) - 1;
+    int ti = static_cast<int>(target_stack.size()) - 1;
+    while (si >= 0 && ti >= 0 && source_stack[si] == target_stack[ti]) {
+      ++common_prefix;
+      --si;
+      --ti;
+    }
+    int path_length = static_cast<int>(source_stack.size()) +
+                      static_cast<int>(target_stack.size()) -
+                      2 * common_prefix;
+    if (path_length > options_.max_path_length) return "";
+    if (si >= 0 && ti >= 0) {
+      int path_width = Props(target_stack[ti]).child_id -
+                       Props(source_stack[si]).child_id;
+      if (path_width > options_.max_path_width) return "";
+    }
+
+    std::string out;
+    // upward leg (source side)
+    for (int i = 0;
+         i < static_cast<int>(source_stack.size()) - common_prefix; ++i) {
+      const Node* cur = source_stack[i];
+      const NodeProps& cp = Props(cur);
+      std::string child_id;
+      const std::string& parent_raw = Props(cur->parent).raw_type;
+      if (i == 0 || kParentTypesWithChildId.count(parent_raw)) {
+        child_id = std::to_string(SaturateChildId(cp.child_id));
+      }
+      out += "(" + cp.type + child_id + ")^";
+    }
+    // common ancestor
+    const Node* common =
+        source_stack[source_stack.size() - common_prefix];
+    std::string common_child_id;
+    if (common->parent != nullptr &&
+        kParentTypesWithChildId.count(Props(common->parent).raw_type)) {
+      common_child_id =
+          std::to_string(SaturateChildId(Props(common).child_id));
+    }
+    out += "(" + Props(common).type + common_child_id + ")";
+    // downward leg (target side)
+    for (int i = static_cast<int>(target_stack.size()) - common_prefix - 1;
+         i >= 0; --i) {
+      const Node* cur = target_stack[i];
+      const NodeProps& cp = Props(cur);
+      std::string child_id;
+      if (i == 0 || kParentTypesWithChildId.count(cp.raw_type)) {
+        child_id = std::to_string(SaturateChildId(cp.child_id));
+      }
+      out += "_(" + cp.type + child_id + ")";
+    }
+    return out;
+  }
+
+ private:
+  const ExtractOptions& options_;
+  std::unordered_map<const Node*, NodeProps>* props_;
+};
+
+std::vector<std::string> ExtractFromUnit(const std::string& src, Node* unit,
+                                         const ExtractOptions& options) {
+  std::vector<Node*> methods;
+  CollectMethods(unit, &methods);
+
+  std::vector<std::string> lines;
+  for (Node* method : methods) {
+    // FunctionVisitor.java:37: only methods with bodies.
+    Node* body = nullptr;
+    for (Node* child : method->children)
+      if (child->type == "BlockStmt") body = child;
+    if (body == nullptr) continue;
+    long length = MethodLength(src, body);
+    if (length < options.min_code_length || length > options.max_code_length)
+      continue;
+
+    // label (FunctionVisitor.java:30-35)
+    std::vector<std::string> parts = SplitToSubtokens(method->name);
+    std::string label;
+    if (parts.empty()) {
+      label = NormalizeName(method->name, kBlankWord);
+    } else {
+      for (size_t i = 0; i < parts.size(); ++i) {
+        if (i) label += "|";
+        label += parts[i];
+      }
+    }
+
+    std::vector<Node*> leaves;
+    CollectLeaves(method, &leaves);
+
+    std::unordered_map<const Node*, NodeProps> props;
+    for (Node* leaf : leaves) props.emplace(leaf, ComputeProps(leaf, true));
+    MethodExtractor extractor(options, &props);
+
+    std::string line = label;
+    bool any = false;
+    for (size_t i = 0; i < leaves.size(); ++i) {
+      for (size_t j = i + 1; j < leaves.size(); ++j) {
+        std::string path = extractor.GeneratePath(leaves[i], leaves[j]);
+        if (path.empty()) continue;
+        const std::string& source_name = props.at(leaves[i]).name;
+        const std::string& target_name = props.at(leaves[j]).name;
+        std::string path_field =
+            options.no_hash ? path
+                            : std::to_string(JavaStringHashCode(path));
+        line += " " + source_name + "," + path_field + "," + target_name;
+        any = true;
+      }
+    }
+    if (any) lines.push_back(line);  // ProgramFeatures.isEmpty filter
+  }
+  return lines;
+}
+
+}  // namespace
+
+std::vector<std::string> ExtractFromSource(const std::string& code,
+                                           const ExtractOptions& options) {
+  // FeatureExtractor.java:51-75 wrap-retries.
+  static const char* kClassPrefix = "public class Test {";
+  static const char* kClassSuffix = "}";
+  static const char* kMethodPrefix = "SomeUnknownReturnType f() {";
+  static const char* kMethodSuffix = "return noSuchReturnValue; }";
+
+  std::vector<std::string> attempts = {
+      code,
+      std::string(kClassPrefix) + kMethodPrefix + code + kMethodSuffix +
+          kClassSuffix,
+      std::string(kClassPrefix) + code + kClassSuffix,
+  };
+  std::string last_error;
+  for (size_t a = 0; a < attempts.size(); ++a) {
+    try {
+      Arena arena;
+      Node* unit = ParseJava(attempts[a], &arena);
+      return ExtractFromUnit(attempts[a], unit, options);
+    } catch (const std::exception& e) {
+      last_error = e.what();
+    }
+  }
+  throw ParseError(last_error);
+}
+
+}  // namespace c2v

@@ -1,0 +1,46 @@
+// Path-context extraction over the parsed AST.
+//
+// Reimplements the reference pipeline for one source string:
+//   FunctionVisitor (FunctionVisitor.java:25-40)
+//   -> LeavesCollectorVisitor (LeavesCollectorVisitor.java:20-37)
+//   -> pairwise generatePath (FeatureExtractor.java:91-191)
+//   -> `label ctx...` line per method (ProgramFeatures.java:19-25,
+//      ProgramRelation.java:31-34).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace c2v {
+
+struct ExtractOptions {
+  int max_path_length = 8;
+  int max_path_width = 2;
+  bool no_hash = false;
+  int min_code_length = 1;      // lines (CommandLineValues.java:30-31)
+  int max_code_length = 10000;  // lines (CommandLineValues.java:33-34)
+  int max_child_id = INT32_MAX; // saturation (CommandLineValues.java:39-40)
+};
+
+// Java String#hashCode over the path's UTF-16 units (paths are ASCII so
+// bytes == units): h = 31*h + c with int32 wraparound
+// (ProgramRelation.java:18).
+int32_t JavaStringHashCode(const std::string& s);
+
+// Reference Common.normalizeName (Common.java:36-53), including its
+// literal-regex quirks ("\\n" removal and the `//s+` pattern).
+std::string NormalizeName(const std::string& original,
+                          const std::string& default_string);
+
+// Reference Common.splitToSubtokens (Common.java:71-76).
+std::vector<std::string> SplitToSubtokens(const std::string& s);
+
+// Extracts all methods from `code`, applying the reference's
+// wrap-retries on parse failure (FeatureExtractor.java:51-75).
+// Returns one output line per method ("label tok,path,tok ..."), or
+// throws ParseError if every parse attempt fails.
+std::vector<std::string> ExtractFromSource(const std::string& code,
+                                           const ExtractOptions& options);
+
+}  // namespace c2v

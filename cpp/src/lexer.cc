@@ -1,0 +1,185 @@
+#include "lexer.h"
+
+#include <array>
+#include <cctype>
+#include <unordered_set>
+
+namespace c2v {
+
+namespace {
+
+const std::unordered_set<std::string_view> kKeywords = {
+    "abstract", "assert", "boolean", "break", "byte", "case", "catch",
+    "char", "class", "const", "continue", "default", "do", "double",
+    "else", "enum", "extends", "final", "finally", "float", "for",
+    "goto", "if", "implements", "import", "instanceof", "int",
+    "interface", "long", "native", "new", "package", "private",
+    "protected", "public", "return", "short", "static", "strictfp",
+    "super", "switch", "synchronized", "this", "throw", "throws",
+    "transient", "try", "void", "volatile", "while",
+    // literal words: lexed as idents, mapped to literal expressions by
+    // the parser; listed here so they are never plain identifiers.
+    "true", "false", "null",
+};
+
+bool IdentStart(char c) {
+  return std::isalpha(static_cast<unsigned char>(c)) || c == '_' || c == '$' ||
+         static_cast<unsigned char>(c) >= 0x80;  // permissive unicode idents
+}
+bool IdentPart(char c) {
+  return IdentStart(c) || std::isdigit(static_cast<unsigned char>(c));
+}
+bool Digit(char c) { return c >= '0' && c <= '9'; }
+bool HexDigit(char c) {
+  return Digit(c) || (c >= 'a' && c <= 'f') || (c >= 'A' && c <= 'F');
+}
+
+// Multi-char punctuation, longest-match-first. Anything starting with `>`
+// is NOT combined: the parser needs single `>` tokens to close generics,
+// and merges adjacent `>`s into `>>`/`>>>`/`>=`/`>>=`/`>>>=` itself.
+constexpr std::array<std::string_view, 20> kPunctMulti = {
+    "<<=", "...", "<<", "<=", "::", "->", "==", "!=", "&&", "||",
+    "++", "--", "+=", "-=", "*=", "/=", "&=", "|=", "^=", "%=",
+};
+
+}  // namespace
+
+bool IsJavaKeyword(std::string_view word) { return kKeywords.count(word) > 0; }
+
+std::vector<Token> Lex(std::string_view src) {
+  std::vector<Token> out;
+  size_t i = 0;
+  const size_t n = src.size();
+  auto push = [&](Tok k, size_t start, size_t end) {
+    out.push_back(Token{k, src.substr(start, end - start),
+                        static_cast<int>(start), static_cast<int>(end)});
+  };
+
+  while (i < n) {
+    char c = src[i];
+    // whitespace
+    if (c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\f') {
+      ++i;
+      continue;
+    }
+    // comments
+    if (c == '/' && i + 1 < n && src[i + 1] == '/') {
+      i += 2;
+      while (i < n && src[i] != '\n') ++i;
+      continue;
+    }
+    if (c == '/' && i + 1 < n && src[i + 1] == '*') {
+      size_t start = i;
+      i += 2;
+      while (i + 1 < n && !(src[i] == '*' && src[i + 1] == '/')) ++i;
+      if (i + 1 >= n) throw LexError("unterminated block comment at " +
+                                     std::to_string(start));
+      i += 2;
+      continue;
+    }
+    // identifiers / keywords
+    if (IdentStart(c)) {
+      size_t start = i;
+      while (i < n && IdentPart(src[i])) ++i;
+      push(Tok::kIdent, start, i);
+      continue;
+    }
+    // numeric literals
+    if (Digit(c) || (c == '.' && i + 1 < n && Digit(src[i + 1]))) {
+      size_t start = i;
+      bool is_float = false;
+      if (c == '0' && i + 1 < n && (src[i + 1] == 'x' || src[i + 1] == 'X')) {
+        i += 2;
+        while (i < n && (HexDigit(src[i]) || src[i] == '_')) ++i;
+        // hex floating-point (0x1.8p3) — rare; `+`/`-` is only part of
+        // the literal immediately after the p/P exponent marker
+        if (i < n && (src[i] == '.' || src[i] == 'p' || src[i] == 'P')) {
+          is_float = true;
+          if (src[i] == '.') {
+            ++i;
+            while (i < n && (HexDigit(src[i]) || src[i] == '_')) ++i;
+          }
+          if (i < n && (src[i] == 'p' || src[i] == 'P')) {
+            ++i;
+            if (i < n && (src[i] == '+' || src[i] == '-')) ++i;
+            while (i < n && Digit(src[i])) ++i;
+          }
+        }
+      } else if (c == '0' && i + 1 < n &&
+                 (src[i + 1] == 'b' || src[i + 1] == 'B')) {
+        i += 2;
+        while (i < n && (src[i] == '0' || src[i] == '1' || src[i] == '_')) ++i;
+      } else {
+        while (i < n && (Digit(src[i]) || src[i] == '_')) ++i;
+        if (i < n && src[i] == '.') {
+          is_float = true;
+          ++i;
+          while (i < n && (Digit(src[i]) || src[i] == '_')) ++i;
+        }
+        if (i < n && (src[i] == 'e' || src[i] == 'E')) {
+          is_float = true;
+          ++i;
+          if (i < n && (src[i] == '+' || src[i] == '-')) ++i;
+          while (i < n && Digit(src[i])) ++i;
+        }
+      }
+      Tok kind = is_float ? Tok::kDoubleLit : Tok::kIntLit;
+      if (i < n) {
+        if (src[i] == 'l' || src[i] == 'L') {
+          kind = Tok::kLongLit;
+          ++i;
+        } else if (src[i] == 'f' || src[i] == 'F') {
+          kind = Tok::kFloatLit;
+          ++i;
+        } else if (src[i] == 'd' || src[i] == 'D') {
+          kind = Tok::kDoubleLit;
+          ++i;
+        }
+      }
+      push(kind, start, i);
+      continue;
+    }
+    // char / string literals
+    if (c == '\'' || c == '"') {
+      size_t start = i;
+      char quote = c;
+      ++i;
+      while (i < n && src[i] != quote) {
+        if (src[i] == '\\' && i + 1 < n) i += 2;
+        else if (src[i] == '\n' ) throw LexError("newline in literal at " +
+                                                 std::to_string(start));
+        else ++i;
+      }
+      if (i >= n) throw LexError("unterminated literal at " +
+                                 std::to_string(start));
+      ++i;  // closing quote
+      push(quote == '\'' ? Tok::kCharLit : Tok::kStringLit, start, i);
+      continue;
+    }
+    // punctuation: longest match among known multi-char ops (note: `>`
+    // sequences stay single tokens; see header comment)
+    {
+      size_t start = i;
+      size_t matched = 1;
+      for (std::string_view p : kPunctMulti) {
+        if (p.size() > 1 && src.compare(i, p.size(), p) == 0) {
+          matched = p.size();
+          break;
+        }
+      }
+      static const std::string_view kSingles = "(){}[];,.@?:~!<>=+-*/&|^%";
+      if (matched == 1 && kSingles.find(c) == std::string_view::npos) {
+        throw LexError(std::string("unexpected character `") + c + "` at " +
+                       std::to_string(i));
+      }
+      i += matched;
+      push(Tok::kPunct, start, i);
+      continue;
+    }
+  }
+  out.push_back(Token{Tok::kEof, src.substr(n, 0), static_cast<int>(n),
+                      static_cast<int>(n)});
+  return out;
+}
+
+}  // namespace c2v

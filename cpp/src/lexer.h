@@ -1,0 +1,46 @@
+// Java 8 lexer for the native path-context extractor.
+//
+// Produces the token stream consumed by parser.cc. Comments are dropped
+// (the reference extractor ignores Comment nodes entirely:
+// LeavesCollectorVisitor.java:21-23). Numeric/string/char literals keep
+// their raw source text — the extractor emits literal text through
+// normalizeName, never their decoded values.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace c2v {
+
+enum class Tok : uint8_t {
+  kEof,
+  kIdent,     // identifier or keyword (text distinguishes)
+  kIntLit,    // decimal/hex/octal/binary integer (no L suffix)
+  kLongLit,   // integer with l/L suffix
+  kFloatLit,  // f/F suffix
+  kDoubleLit, // floating literal without f suffix
+  kCharLit,   // raw text including quotes
+  kStringLit, // raw text including quotes
+  kPunct,     // operator / separator, text holds the exact spelling
+};
+
+struct Token {
+  Tok kind = Tok::kEof;
+  std::string_view text;
+  int pos = 0;  // byte offset of first char
+  int end = 0;  // byte offset past last char
+};
+
+struct LexError : std::runtime_error {
+  explicit LexError(const std::string& m) : std::runtime_error(m) {}
+};
+
+// Lexes the whole source; throws LexError on malformed input.
+std::vector<Token> Lex(std::string_view source);
+
+bool IsJavaKeyword(std::string_view word);
+
+}  // namespace c2v

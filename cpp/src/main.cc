@@ -1,0 +1,162 @@
+// c2v-extract: native Java AST path-context extractor.
+//
+// CLI-compatible with the reference jar (App.java:18-37,
+// CommandLineValues.java:12-40):
+//   c2v-extract --max_path_length 8 --max_path_width 2
+//       (--file F | --dir D) [--no_hash] [--num_threads N]
+//       [--min_code_len N] [--max_code_len N] [--max_child_id N]
+//       [--pretty_print]
+//
+// Output: one line per method, `label tok,path,tok ...`, file blocks
+// printed atomically (ExtractFeaturesTask.java:36-52). Parse failures
+// are reported on stderr and the file skipped, like the reference's
+// printStackTrace-and-continue.
+
+#include <algorithm>
+#include <atomic>
+#include <cstring>
+#include <filesystem>
+#include <fstream>
+#include <iostream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "extract.h"
+
+namespace fs = std::filesystem;
+
+namespace {
+
+struct Args {
+  std::string file;
+  std::string dir;
+  c2v::ExtractOptions options;
+  int num_threads = 32;  // CommandLineValues.java:27-28
+};
+
+bool ParseArgs(int argc, char** argv, Args* args) {
+  bool have_len = false, have_width = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto need_value = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        std::cerr << flag << " requires a value\n";
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--file") args->file = need_value("--file");
+    else if (a == "--dir") args->dir = need_value("--dir");
+    else if (a == "--max_path_length") {
+      args->options.max_path_length = std::atoi(need_value(a.c_str()));
+      have_len = true;
+    } else if (a == "--max_path_width") {
+      args->options.max_path_width = std::atoi(need_value(a.c_str()));
+      have_width = true;
+    } else if (a == "--no_hash") args->options.no_hash = true;
+    else if (a == "--num_threads") args->num_threads = std::atoi(need_value(a.c_str()));
+    else if (a == "--min_code_len") args->options.min_code_length = std::atoi(need_value(a.c_str()));
+    else if (a == "--max_code_len") args->options.max_code_length = std::atoi(need_value(a.c_str()));
+    else if (a == "--max_child_id") args->options.max_child_id = std::atoi(need_value(a.c_str()));
+    else if (a == "--pretty_print") { /* accepted for CLI parity */ }
+    else {
+      std::cerr << "unknown flag: " << a << "\n";
+      return false;
+    }
+  }
+  // required=true in the reference (CommandLineValues.java:18-22)
+  if (!have_len || !have_width) {
+    std::cerr << "--max_path_length and --max_path_width are required\n";
+    return false;
+  }
+  if (args->file.empty() == args->dir.empty()) {
+    std::cerr << "exactly one of --file/--dir is required\n";
+    return false;
+  }
+  return true;
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << in.rdbuf();
+  return ss.str();
+}
+
+std::mutex g_stdout_mutex;
+
+// Extracts one file and prints its block of method lines atomically.
+void ProcessFile(const std::string& path, const c2v::ExtractOptions& options) {
+  std::vector<std::string> lines;
+  try {
+    lines = c2v::ExtractFromSource(ReadFile(path), options);
+  } catch (const std::exception& e) {
+    std::lock_guard<std::mutex> lock(g_stdout_mutex);
+    std::cerr << "failed to extract " << path << ": " << e.what() << "\n";
+    return;
+  }
+  if (lines.empty()) return;
+  std::string block;
+  for (size_t i = 0; i < lines.size(); ++i) {
+    block += lines[i];
+    block += "\n";
+  }
+  std::lock_guard<std::mutex> lock(g_stdout_mutex);
+  std::cout << block;
+}
+
+bool HasJavaExtension(const fs::path& p) {
+  std::string ext = p.extension().string();
+  std::transform(ext.begin(), ext.end(), ext.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return ext == ".java";
+}
+
+int RunDir(const Args& args) {
+  std::vector<std::string> files;
+  std::error_code ec;
+  if (!fs::is_directory(args.dir, ec) || ec) {
+    std::cerr << "--dir " << args.dir << " is not a readable directory\n";
+    return 1;
+  }
+  for (auto it = fs::recursive_directory_iterator(
+           args.dir, fs::directory_options::skip_permission_denied, ec);
+       it != fs::recursive_directory_iterator(); it.increment(ec)) {
+    if (ec) break;
+    if (it->is_regular_file(ec) && HasJavaExtension(it->path()))
+      files.push_back(it->path().string());
+  }
+  std::atomic<size_t> next{0};
+  int n_threads = std::max(1, std::min<int>(args.num_threads,
+                                            std::thread::hardware_concurrency()
+                                                ? std::thread::hardware_concurrency()
+                                                : 4));
+  std::vector<std::thread> workers;
+  for (int t = 0; t < n_threads; ++t) {
+    workers.emplace_back([&]() {
+      while (true) {
+        size_t i = next.fetch_add(1);
+        if (i >= files.size()) return;
+        ProcessFile(files[i], args.options);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args args;
+  if (!ParseArgs(argc, argv, &args)) return 2;
+  if (!args.file.empty()) {
+    ProcessFile(args.file, args.options);
+    return 0;
+  }
+  return RunDir(args);
+}

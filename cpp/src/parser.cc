@@ -1,0 +1,1678 @@
+#include "parser.h"
+
+#include <cassert>
+#include <functional>
+#include <unordered_map>
+
+#include "lexer.h"
+
+namespace c2v {
+
+namespace {
+
+const std::unordered_map<std::string, std::string> kUnbox = {
+    {"Boolean", "boolean"}, {"Byte", "byte"},     {"Character", "char"},
+    {"Double", "double"},   {"Float", "float"},   {"Integer", "int"},
+    {"Long", "long"},       {"Short", "short"},
+};
+
+bool IsPrimitiveName(std::string_view s) {
+  return s == "boolean" || s == "byte" || s == "char" || s == "short" ||
+         s == "int" || s == "long" || s == "float" || s == "double";
+}
+
+bool IsModifierName(std::string_view s) {
+  return s == "public" || s == "protected" || s == "private" ||
+         s == "static" || s == "abstract" || s == "final" || s == "native" ||
+         s == "synchronized" || s == "transient" || s == "volatile" ||
+         s == "strictfp" || s == "default";
+}
+
+class Parser {
+ public:
+  Parser(std::string_view src, Arena* arena)
+      : arena_(arena), toks_(Lex(src)) {}
+
+  Node* ParseCompilationUnit() {
+    Node* cu = New("CompilationUnit", Pos());
+    // package declaration (possibly annotated)
+    size_t save = p_;
+    std::vector<Node*> leading_annotations = ParseAnnotations();
+    if (IsKw("package")) {
+      int begin = Pos();
+      Next();
+      Node* name = ParseQualifiedName();
+      Expect(";");
+      Node* pkg = New("PackageDeclaration", begin);
+      for (Node* a : leading_annotations) Adopt(pkg, a);
+      Adopt(pkg, name);
+      pkg->end = PrevEnd();
+      Adopt(cu, pkg);
+    } else {
+      p_ = save;  // annotations belong to the first type declaration
+    }
+    while (IsKw("import")) {
+      int begin = Pos();
+      Next();
+      if (IsKw("static")) Next();
+      Node* name = ParseQualifiedName();
+      if (Accept(".")) Expect("*");
+      Expect(";");
+      Node* imp = New("ImportDeclaration", begin);
+      Adopt(imp, name);
+      imp->end = PrevEnd();
+      Adopt(cu, imp);
+    }
+    while (!AtEof()) {
+      if (Accept(";")) continue;
+      Adopt(cu, ParseTypeDeclaration());
+    }
+    cu->end = PrevEnd();
+    return cu;
+  }
+
+ private:
+  // ------------------------------------------------------------ tokens
+  const Token& Cur() const { return toks_[p_]; }
+  const Token& LookAhead(size_t k) const {
+    size_t i = p_ + k;
+    return toks_[i < toks_.size() ? i : toks_.size() - 1];
+  }
+  bool AtEof() const { return Cur().kind == Tok::kEof; }
+  int Pos() const { return Cur().pos; }
+  int PrevEnd() const { return p_ > 0 ? toks_[p_ - 1].end : 0; }
+  void Next() { if (p_ + 1 < toks_.size()) ++p_; }
+  bool Is(std::string_view t) const {
+    return Cur().kind == Tok::kPunct && Cur().text == t;
+  }
+  bool IsKw(std::string_view t) const {
+    return Cur().kind == Tok::kIdent && Cur().text == t;
+  }
+  bool IsIdent() const {
+    return Cur().kind == Tok::kIdent && !IsJavaKeyword(Cur().text);
+  }
+  bool Accept(std::string_view t) {
+    if (Is(t)) { Next(); return true; }
+    return false;
+  }
+  bool AcceptKw(std::string_view t) {
+    if (IsKw(t)) { Next(); return true; }
+    return false;
+  }
+  void Expect(std::string_view t) {
+    if (!Accept(t)) Fail(std::string("expected `") + std::string(t) + "`");
+  }
+  void ExpectKw(std::string_view t) {
+    if (!AcceptKw(t)) Fail(std::string("expected `") + std::string(t) + "`");
+  }
+  std::string ExpectIdent() {
+    if (!IsIdent()) Fail("expected identifier");
+    std::string s(Cur().text);
+    Next();
+    return s;
+  }
+  [[noreturn]] void Fail(const std::string& why) const {
+    throw ParseError(why + " at offset " + std::to_string(Pos()) +
+                     " (token `" + std::string(Cur().text) + "`)");
+  }
+  Node* New(const char* type, int begin) {
+    Node* n = arena_->New(type);
+    n->begin = begin;
+    return n;
+  }
+  Node* Finish(Node* n) {
+    n->end = PrevEnd();
+    return n;
+  }
+
+  // `>`-sequences are lexed as single tokens; combine by adjacency.
+  bool GtRun(size_t count, bool then_eq) const {
+    for (size_t k = 0; k < count; ++k) {
+      const Token& t = toks_[p_ + k < toks_.size() ? p_ + k : toks_.size() - 1];
+      if (!(t.kind == Tok::kPunct && t.text == ">")) return false;
+      if (k > 0 && toks_[p_ + k - 1].end != t.pos) return false;
+    }
+    if (then_eq) {
+      const Token& t = LookAhead(count);
+      if (!(t.kind == Tok::kPunct && t.text == "=")) return false;
+      if (toks_[p_ + count - 1].end != t.pos) return false;
+    }
+    return true;
+  }
+
+  // --------------------------------------------------------- names
+  Node* ParseQualifiedName() {
+    // package/import names: NameExpr / QualifiedNameExpr chain
+    int begin = Pos();
+    Node* n = New("NameExpr", begin);
+    n->text = ExpectIdent();
+    n->end = PrevEnd();
+    while (Is(".") && LookAhead(1).kind == Tok::kIdent &&
+           !IsJavaKeyword(LookAhead(1).text)) {
+      Next();
+      Node* q = New("QualifiedNameExpr", begin);
+      Adopt(q, n);
+      q->text = ExpectIdent();
+      q->end = PrevEnd();
+      n = q;
+    }
+    return n;
+  }
+
+  Node* MakeNameExpr(int begin, std::string name) {
+    Node* n = New("NameExpr", begin);
+    n->text = std::move(name);
+    n->end = PrevEnd();
+    return n;
+  }
+
+  // --------------------------------------------------------- modifiers
+  // Consumes modifier keywords and annotations in any order; returns
+  // the annotation nodes in source order (modifiers are not AST nodes
+  // in alpha.4 — an EnumSet — so they vanish from the tree).
+  std::vector<Node*> ParseModifiers() {
+    std::vector<Node*> annotations;
+    while (true) {
+      if (Cur().kind == Tok::kIdent && IsModifierName(Cur().text)) {
+        // `default` only a modifier inside interfaces; as a statement
+        // keyword it appears in switch which never reaches here.
+        Next();
+      } else if (Is("@") && !(LookAhead(1).kind == Tok::kIdent &&
+                              LookAhead(1).text == "interface")) {
+        annotations.push_back(ParseAnnotation());
+      } else {
+        break;
+      }
+    }
+    return annotations;
+  }
+
+  std::vector<Node*> ParseAnnotations() {
+    std::vector<Node*> annotations;
+    while (Is("@") && !(LookAhead(1).kind == Tok::kIdent &&
+                        LookAhead(1).text == "interface")) {
+      annotations.push_back(ParseAnnotation());
+    }
+    return annotations;
+  }
+
+  Node* ParseAnnotation() {
+    int begin = Pos();
+    Expect("@");
+    Node* name = ParseQualifiedName();
+    if (!Accept("(")) {
+      Node* a = New("MarkerAnnotationExpr", begin);
+      Adopt(a, name);
+      return Finish(a);
+    }
+    if (Accept(")")) {
+      Node* a = New("NormalAnnotationExpr", begin);
+      Adopt(a, name);
+      return Finish(a);
+    }
+    // `ident =` -> normal annotation pairs, else single member value
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "=") {
+      Node* a = New("NormalAnnotationExpr", begin);
+      Adopt(a, name);
+      do {
+        int pb = Pos();
+        Node* pair = New("MemberValuePair", pb);
+        pair->text = ExpectIdent();
+        Expect("=");
+        Adopt(pair, ParseElementValue());
+        Finish(pair);
+        Adopt(a, pair);
+      } while (Accept(","));
+      Expect(")");
+      return Finish(a);
+    }
+    Node* a = New("SingleMemberAnnotationExpr", begin);
+    Adopt(a, name);
+    Adopt(a, ParseElementValue());
+    Expect(")");
+    return Finish(a);
+  }
+
+  Node* ParseElementValue() {
+    if (Is("{")) return ParseArrayInitializer();
+    if (Is("@")) return ParseAnnotation();
+    return ParseConditional();  // conditional expression per grammar
+  }
+
+  // --------------------------------------------------------- types
+  // A type in a declaration position: primitives stay bare unless they
+  // have dims; reference types (and any array) get the alpha.4
+  // ReferenceType wrapper.
+  Node* ParseType() {
+    int begin = Pos();
+    Node* base;
+    if (Cur().kind == Tok::kIdent && IsPrimitiveName(Cur().text)) {
+      base = New("PrimitiveType", begin);
+      base->text = std::string(Cur().text);
+      Next();
+      base->end = PrevEnd();
+    } else {
+      base = ParseClassOrInterfaceType();
+    }
+    int dims = 0;
+    while (Is("[") && LookAhead(1).kind == Tok::kPunct &&
+           LookAhead(1).text == "]") {
+      Next();
+      Next();
+      ++dims;
+    }
+    if (base->type == "PrimitiveType" && dims == 0) return base;
+    Node* ref = New("ReferenceType", begin);
+    Adopt(ref, base);
+    ref->end = PrevEnd();
+    if (dims == 0) ref->end = base->end;  // same Range as inner type
+    return ref;
+  }
+
+  Node* ParseClassOrInterfaceType() {
+    int begin = Pos();
+    Node* t = New("ClassOrInterfaceType", begin);
+    t->name = ExpectIdent();
+    ApplyBoxing(t);
+    t->end = PrevEnd();
+    MaybeTypeArgs(t);
+    while (Is(".") && LookAhead(1).kind == Tok::kIdent &&
+           !IsJavaKeyword(LookAhead(1).text)) {
+      Next();
+      Node* outer = New("ClassOrInterfaceType", begin);
+      Adopt(outer, t);
+      outer->name = ExpectIdent();
+      ApplyBoxing(outer);
+      outer->end = PrevEnd();
+      MaybeTypeArgs(outer);
+      t = outer;
+    }
+    return t;
+  }
+
+  void ApplyBoxing(Node* t) {
+    auto it = kUnbox.find(t->name);
+    if (it != kUnbox.end()) {
+      t->boxed = true;
+      t->unboxed_name = it->second;
+    }
+    t->text = t->name;  // leaf toString when childless
+  }
+
+  // Attaches type arguments as children if `<` starts a generic
+  // argument list here (backtracks otherwise — only reached in type
+  // context so `<` is always typeargs).
+  void MaybeTypeArgs(Node* t) {
+    if (!Is("<")) return;
+    Next();
+    if (GtRun(1, false)) {  // diamond `<>`
+      Next();
+      t->end = PrevEnd();
+      return;  // typeArguments empty: NOT a generic parent
+    }
+    bool any = false;
+    do {
+      Adopt(t, ParseTypeArgument());
+      any = true;
+    } while (Accept(","));
+    CloseGeneric();
+    t->end = PrevEnd();
+    if (any) t->generic_parent = true;
+  }
+
+  // Consumes one `>` worth of generic closing, splitting nothing: the
+  // lexer already emits single `>` tokens.
+  void CloseGeneric() {
+    if (!Is(">")) Fail("expected `>`");
+    Next();
+  }
+
+  Node* ParseTypeArgument() {
+    if (Is("?")) {
+      int begin = Pos();
+      Next();
+      Node* w = New("WildcardType", begin);
+      w->text = "?";
+      if (AcceptKw("extends")) Adopt(w, ParseType());
+      else if (AcceptKw("super")) Adopt(w, ParseType());
+      return Finish(w);
+    }
+    return ParseType();
+  }
+
+  std::vector<Node*> ParseTypeParameters() {
+    std::vector<Node*> out;
+    Expect("<");
+    do {
+      int begin = Pos();
+      Node* tp = New("TypeParameter", begin);
+      tp->text = tp->name = ExpectIdent();
+      if (AcceptKw("extends")) {
+        do {
+          Adopt(tp, ParseClassOrInterfaceType());
+        } while (Accept("&"));
+      }
+      Finish(tp);
+      out.push_back(tp);
+    } while (Accept(","));
+    CloseGeneric();
+    return out;
+  }
+
+  // ---------------------------------------------- type declarations
+  Node* ParseTypeDeclaration() {
+    int begin = Pos();
+    std::vector<Node*> annotations = ParseModifiers();
+    if (IsKw("class") || IsKw("interface"))
+      return ParseClassOrInterfaceDecl(begin, annotations);
+    if (IsKw("enum")) return ParseEnumDecl(begin, annotations);
+    if (Is("@")) {  // @interface
+      Next();
+      ExpectKw("interface");
+      return ParseAnnotationDecl(begin, annotations);
+    }
+    Fail("expected type declaration");
+  }
+
+  Node* ParseClassOrInterfaceDecl(int begin, std::vector<Node*>& annotations) {
+    bool is_interface = IsKw("interface");
+    Next();  // class | interface
+    // alpha.4 ctor order: annotations, nameExpr, members, then
+    // typeParameters/extends/implements. Children order here follows
+    // source order instead; only method-subtree childIds are
+    // output-relevant and those are unaffected (SURVEY.md §2.2).
+    Node* decl = New("ClassOrInterfaceDeclaration", begin);
+    (void)is_interface;
+    for (Node* a : annotations) Adopt(decl, a);
+    int nb = Pos();
+    decl->name = ExpectIdent();
+    Adopt(decl, MakeNameExpr(nb, decl->name));
+    if (Is("<")) {
+      for (Node* tp : ParseTypeParameters()) Adopt(decl, tp);
+    }
+    if (AcceptKw("extends")) {
+      do {
+        Adopt(decl, ParseClassOrInterfaceType());
+      } while (Accept(","));
+    }
+    if (AcceptKw("implements")) {
+      do {
+        Adopt(decl, ParseClassOrInterfaceType());
+      } while (Accept(","));
+    }
+    ParseClassBody(decl);
+    return Finish(decl);
+  }
+
+  void ParseClassBody(Node* decl) {
+    Expect("{");
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated class body");
+      if (Accept(";")) continue;
+      Adopt(decl, ParseMember(decl->name));
+    }
+  }
+
+  Node* ParseEnumDecl(int begin, std::vector<Node*>& annotations) {
+    Next();  // enum
+    Node* decl = New("EnumDeclaration", begin);
+    for (Node* a : annotations) Adopt(decl, a);
+    int nb = Pos();
+    decl->name = ExpectIdent();
+    Adopt(decl, MakeNameExpr(nb, decl->name));
+    if (AcceptKw("implements")) {
+      do {
+        Adopt(decl, ParseClassOrInterfaceType());
+      } while (Accept(","));
+    }
+    Expect("{");
+    // enum constants
+    if (!Is(";") && !Is("}")) {
+      do {
+        if (Is("}") || Is(";")) break;
+        int cb = Pos();
+        std::vector<Node*> cann = ParseAnnotations();
+        Node* c = New("EnumConstantDeclaration", cb);
+        for (Node* a : cann) Adopt(c, a);
+        c->name = ExpectIdent();
+        if (Accept("(")) {
+          if (!Is(")")) {
+            do {
+              Adopt(c, ParseExpression());
+            } while (Accept(","));
+          }
+          Expect(")");
+        }
+        if (Is("{")) {
+          Node* body_holder = c;
+          ParseClassBody(body_holder);
+        }
+        Finish(c);
+        Adopt(decl, c);
+      } while (Accept(","));
+    }
+    if (Accept(";")) {
+      while (!Is("}")) {
+        if (AtEof()) Fail("unterminated enum body");
+        if (Accept(";")) continue;
+        Adopt(decl, ParseMember(decl->name));
+      }
+    }
+    Expect("}");
+    return Finish(decl);
+  }
+
+  Node* ParseAnnotationDecl(int begin, std::vector<Node*>& annotations) {
+    Node* decl = New("AnnotationDeclaration", begin);
+    for (Node* a : annotations) Adopt(decl, a);
+    int nb = Pos();
+    decl->name = ExpectIdent();
+    Adopt(decl, MakeNameExpr(nb, decl->name));
+    Expect("{");
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated annotation body");
+      if (Accept(";")) continue;
+      int mb = Pos();
+      std::vector<Node*> mann = ParseModifiers();
+      if (IsKw("class") || IsKw("interface")) {
+        Adopt(decl, ParseClassOrInterfaceDecl(mb, mann));
+        continue;
+      }
+      if (IsKw("enum")) {
+        Adopt(decl, ParseEnumDecl(mb, mann));
+        continue;
+      }
+      // annotation member: Type name() default value;  |  field
+      size_t save = p_;
+      Node* type = TryParseType();
+      if (type != nullptr && IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+          LookAhead(1).text == "(") {
+        Node* m = New("AnnotationMemberDeclaration", mb);
+        for (Node* a : mann) Adopt(m, a);
+        Adopt(m, type);
+        ExpectIdent();
+        Expect("(");
+        Expect(")");
+        if (AcceptKw("default")) Adopt(m, ParseElementValue());
+        Expect(";");
+        Adopt(decl, Finish(m));
+      } else {
+        p_ = save;
+        Adopt(decl, ParseFieldLike(mb, mann));
+      }
+    }
+    return Finish(decl);
+  }
+
+  // One class member (method/ctor/field/initializer/inner type).
+  Node* ParseMember(const std::string& enclosing_name) {
+    int begin = Pos();
+    std::vector<Node*> annotations = ParseModifiers();
+    if (IsKw("class") || IsKw("interface"))
+      return ParseClassOrInterfaceDecl(begin, annotations);
+    if (IsKw("enum")) return ParseEnumDecl(begin, annotations);
+    if (Is("@")) {
+      Next();
+      ExpectKw("interface");
+      return ParseAnnotationDecl(begin, annotations);
+    }
+    if (Is("{")) {  // (static) initializer; `static` consumed as modifier
+      Node* init = New("InitializerDeclaration", begin);
+      for (Node* a : annotations) Adopt(init, a);
+      Adopt(init, ParseBlock());
+      return Finish(init);
+    }
+    // generic method/ctor type parameters
+    std::vector<Node*> type_params;
+    if (Is("<")) type_params = ParseTypeParameters();
+    // constructor?
+    if (IsIdent() && Cur().text == enclosing_name &&
+        LookAhead(1).kind == Tok::kPunct && LookAhead(1).text == "(") {
+      Node* ctor = New("ConstructorDeclaration", begin);
+      for (Node* a : annotations) Adopt(ctor, a);
+      for (Node* tp : type_params) Adopt(ctor, tp);
+      int nb = Pos();
+      ctor->name = ExpectIdent();
+      Adopt(ctor, MakeNameExpr(nb, ctor->name));
+      ParseParamsInto(ctor);
+      ParseThrowsInto(ctor);
+      Adopt(ctor, ParseBlock());
+      return Finish(ctor);
+    }
+    // method or field: parse type then look for `(`
+    Node* ret_type;
+    if (IsKw("void")) {
+      int tb = Pos();
+      Next();
+      ret_type = New("VoidType", tb);
+      ret_type->text = "void";
+      ret_type->end = PrevEnd();
+    } else {
+      ret_type = ParseType();
+    }
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "(") {
+      return ParseMethodRest(begin, annotations, type_params, ret_type);
+    }
+    return ParseFieldRest(begin, annotations, ret_type);
+  }
+
+  Node* ParseMethodRest(int begin, std::vector<Node*>& annotations,
+                        std::vector<Node*>& type_params, Node* ret_type) {
+    // alpha.4 MethodDeclaration children order (2.x ctor):
+    // annotations, typeParameters, type, nameExpr, parameters, throws,
+    // body (tensor for childId of the masked METHOD_NAME NameExpr).
+    Node* m = New("MethodDeclaration", begin);
+    for (Node* a : annotations) Adopt(m, a);
+    for (Node* tp : type_params) Adopt(m, tp);
+    Adopt(m, ret_type);
+    int nb = Pos();
+    m->name = ExpectIdent();
+    Adopt(m, MakeNameExpr(nb, m->name));
+    ParseParamsInto(m);
+    while (Is("[")) {  // legacy `int f()[]`
+      Next();
+      Expect("]");
+    }
+    ParseThrowsInto(m);
+    if (Is("{")) {
+      Adopt(m, ParseBlock());
+    } else {
+      Expect(";");  // abstract/interface method: no body child
+    }
+    return Finish(m);
+  }
+
+  void ParseParamsInto(Node* decl) {
+    Expect("(");
+    if (!Is(")")) {
+      do {
+        Adopt(decl, ParseParameter());
+      } while (Accept(","));
+    }
+    Expect(")");
+  }
+
+  Node* ParseParameter() {
+    int begin = Pos();
+    std::vector<Node*> annotations = ParseModifiers();  // final/@A
+    Node* p = New("Parameter", begin);
+    for (Node* a : annotations) Adopt(p, a);
+    Adopt(p, ParseType());
+    Accept("...");  // varargs flag, not a node
+    Adopt(p, ParseVariableDeclaratorId());
+    return Finish(p);
+  }
+
+  Node* ParseVariableDeclaratorId() {
+    int begin = Pos();
+    Node* id = New("VariableDeclaratorId", begin);
+    id->text = ExpectIdent();
+    while (Is("[")) {
+      Next();
+      Expect("]");
+    }
+    return Finish(id);
+  }
+
+  void ParseThrowsInto(Node* decl) {
+    // alpha.4/2.x: throws is a NameExpr list
+    if (AcceptKw("throws")) {
+      do {
+        Adopt(decl, ParseQualifiedName());
+      } while (Accept(","));
+    }
+  }
+
+  Node* ParseFieldRest(int begin, std::vector<Node*>& annotations,
+                       Node* type) {
+    Node* f = New("FieldDeclaration", begin);
+    for (Node* a : annotations) Adopt(f, a);
+    Adopt(f, type);
+    do {
+      Adopt(f, ParseVariableDeclarator());
+    } while (Accept(","));
+    Expect(";");
+    return Finish(f);
+  }
+
+  Node* ParseFieldLike(int begin, std::vector<Node*> annotations) {
+    Node* type = ParseType();
+    return ParseFieldRest(begin, annotations, type);
+  }
+
+  Node* ParseVariableDeclarator() {
+    int begin = Pos();
+    Node* v = New("VariableDeclarator", begin);
+    Adopt(v, ParseVariableDeclaratorId());
+    if (Accept("=")) Adopt(v, ParseVariableInitializer());
+    return Finish(v);
+  }
+
+  Node* ParseVariableInitializer() {
+    if (Is("{")) return ParseArrayInitializer();
+    return ParseExpression();
+  }
+
+  Node* ParseArrayInitializer() {
+    int begin = Pos();
+    Expect("{");
+    Node* init = New("ArrayInitializerExpr", begin);
+    if (!Is("}")) {
+      do {
+        if (Is("}")) break;  // trailing comma
+        Adopt(init, ParseVariableInitializer());
+      } while (Accept(","));
+    }
+    Expect("}");
+    // empty `{}` is childless: a leaf whose toString prints "{}"
+    if (init->children.empty()) init->text = "{}";
+    return Finish(init);
+  }
+
+  // --------------------------------------------------------- statements
+  Node* ParseBlock() {
+    int begin = Pos();
+    Expect("{");
+    Node* b = New("BlockStmt", begin);
+    b->is_statement = true;
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated block");
+      Adopt(b, ParseStatement());
+    }
+    return Finish(b);
+  }
+
+  Node* Stmt(const char* type, int begin) {
+    Node* s = New(type, begin);
+    s->is_statement = true;
+    return s;
+  }
+
+  Node* ParseStatement() {
+    int begin = Pos();
+    if (Is("{")) return ParseBlock();
+    if (Accept(";")) return Finish(Stmt("EmptyStmt", begin));
+    if (IsKw("if")) return ParseIf();
+    if (IsKw("while")) return ParseWhile();
+    if (IsKw("do")) return ParseDo();
+    if (IsKw("for")) return ParseFor();
+    if (IsKw("switch")) return ParseSwitch();
+    if (IsKw("try")) return ParseTry();
+    if (IsKw("return")) {
+      Next();
+      Node* s = Stmt("ReturnStmt", begin);
+      if (!Is(";")) Adopt(s, ParseExpression());
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("throw")) {
+      Next();
+      Node* s = Stmt("ThrowStmt", begin);
+      Adopt(s, ParseExpression());
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("break")) {
+      Next();
+      Node* s = Stmt("BreakStmt", begin);
+      if (IsIdent()) Next();  // label is a String in alpha.4, not a node
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("continue")) {
+      Next();
+      Node* s = Stmt("ContinueStmt", begin);
+      if (IsIdent()) Next();
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("synchronized")) {
+      Next();
+      Node* s = Stmt("SynchronizedStmt", begin);
+      Expect("(");
+      Adopt(s, ParseExpression());
+      Expect(")");
+      Adopt(s, ParseBlock());
+      return Finish(s);
+    }
+    if (IsKw("assert")) {
+      Next();
+      Node* s = Stmt("AssertStmt", begin);
+      Adopt(s, ParseExpression());
+      if (Accept(":")) Adopt(s, ParseExpression());
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("this") && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "(") {
+      // this(...) constructor invocation
+      Next();
+      Node* s = Stmt("ExplicitConstructorInvocationStmt", begin);
+      ParseArgsInto(s);
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("super") && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "(") {
+      Next();
+      Node* s = Stmt("ExplicitConstructorInvocationStmt", begin);
+      ParseArgsInto(s);
+      Expect(";");
+      return Finish(s);
+    }
+    // local class
+    {
+      size_t save = p_;
+      std::vector<Node*> annotations = ParseModifiers();
+      if (IsKw("class") || IsKw("interface")) {
+        Node* s = Stmt("TypeDeclarationStmt", begin);
+        Adopt(s, ParseClassOrInterfaceDecl(begin, annotations));
+        return Finish(s);
+      }
+      p_ = save;
+    }
+    // labeled statement
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == ":") {
+      Next();
+      Next();
+      Node* s = Stmt("LabeledStmt", begin);
+      Adopt(s, ParseStatement());
+      return Finish(s);
+    }
+    // local variable declaration (backtracking try) or expression stmt
+    {
+      size_t save = p_;
+      Node* decl = TryParseVariableDeclarationExpr();
+      if (decl != nullptr && Is(";")) {
+        Next();
+        Node* s = Stmt("ExpressionStmt", begin);
+        Adopt(s, decl);
+        return Finish(s);
+      }
+      p_ = save;
+    }
+    Node* s = Stmt("ExpressionStmt", begin);
+    Adopt(s, ParseExpression());
+    Expect(";");
+    return Finish(s);
+  }
+
+  Node* ParseIf() {
+    int begin = Pos();
+    Next();
+    Node* s = Stmt("IfStmt", begin);
+    Expect("(");
+    Adopt(s, ParseExpression());
+    Expect(")");
+    Adopt(s, ParseStatement());
+    if (AcceptKw("else")) Adopt(s, ParseStatement());
+    return Finish(s);
+  }
+
+  Node* ParseWhile() {
+    int begin = Pos();
+    Next();
+    Node* s = Stmt("WhileStmt", begin);
+    Expect("(");
+    Adopt(s, ParseExpression());
+    Expect(")");
+    Adopt(s, ParseStatement());
+    return Finish(s);
+  }
+
+  Node* ParseDo() {
+    int begin = Pos();
+    Next();
+    // 2.x ctor order: body, condition
+    Node* s = Stmt("DoStmt", begin);
+    Adopt(s, ParseStatement());
+    ExpectKw("while");
+    Expect("(");
+    Adopt(s, ParseExpression());
+    Expect(")");
+    Expect(";");
+    return Finish(s);
+  }
+
+  Node* ParseFor() {
+    int begin = Pos();
+    Next();
+    Expect("(");
+    // foreach: `for (Type x : expr)`
+    {
+      size_t save = p_;
+      Node* var = TryParseVariableDeclarationExpr(/*single=*/true);
+      if (var != nullptr && Is(":")) {
+        Next();
+        Node* s = Stmt("ForeachStmt", begin);
+        Adopt(s, var);
+        Adopt(s, ParseExpression());
+        Expect(")");
+        Adopt(s, ParseStatement());
+        return Finish(s);
+      }
+      p_ = save;
+    }
+    Node* s = Stmt("ForStmt", begin);
+    // init
+    if (!Is(";")) {
+      size_t save = p_;
+      Node* decl = TryParseVariableDeclarationExpr();
+      if (decl != nullptr && Is(";")) {
+        Adopt(s, decl);
+      } else {
+        p_ = save;
+        do {
+          Adopt(s, ParseExpression());
+        } while (Accept(","));
+      }
+    }
+    Expect(";");
+    if (!Is(";")) Adopt(s, ParseExpression());  // compare
+    Expect(";");
+    if (!Is(")")) {
+      do {
+        Adopt(s, ParseExpression());  // update
+      } while (Accept(","));
+    }
+    Expect(")");
+    Adopt(s, ParseStatement());
+    return Finish(s);
+  }
+
+  Node* ParseSwitch() {
+    int begin = Pos();
+    Next();
+    Node* s = Stmt("SwitchStmt", begin);
+    Expect("(");
+    Adopt(s, ParseExpression());
+    Expect(")");
+    Expect("{");
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated switch");
+      int eb = Pos();
+      Node* entry = Stmt("SwitchEntryStmt", eb);
+      if (AcceptKw("case")) {
+        Adopt(entry, ParseExpression());
+        Expect(":");
+      } else {
+        ExpectKw("default");
+        Expect(":");
+      }
+      while (!IsKw("case") && !IsKw("default") && !Is("}")) {
+        Adopt(entry, ParseStatement());
+      }
+      Finish(entry);
+      Adopt(s, entry);
+    }
+    return Finish(s);
+  }
+
+  Node* ParseTry() {
+    int begin = Pos();
+    Next();
+    Node* s = Stmt("TryStmt", begin);
+    if (Accept("(")) {  // try-with-resources
+      do {
+        if (Is(")")) break;
+        Node* res = TryParseVariableDeclarationExpr();
+        if (res == nullptr) Fail("expected resource declaration");
+        Adopt(s, res);
+      } while (Accept(";"));
+      Expect(")");
+    }
+    Adopt(s, ParseBlock());
+    while (IsKw("catch")) {
+      int cb = Pos();
+      Next();
+      Node* clause = New("CatchClause", cb);
+      Expect("(");
+      // catch parameter with possible union type `A | B e`
+      int pb = Pos();
+      std::vector<Node*> pann = ParseModifiers();
+      Node* param = New("Parameter", pb);
+      for (Node* a : pann) Adopt(param, a);
+      Node* first = ParseType();
+      if (Is("|")) {
+        Node* u = New("UnionType", first->begin);
+        Adopt(u, first);
+        while (Accept("|")) Adopt(u, ParseType());
+        u->end = PrevEnd();
+        Adopt(param, u);
+      } else {
+        Adopt(param, first);
+      }
+      Adopt(param, ParseVariableDeclaratorId());
+      Finish(param);
+      Adopt(clause, param);
+      Expect(")");
+      Adopt(clause, ParseBlock());
+      Finish(clause);
+      Adopt(s, clause);
+    }
+    if (AcceptKw("finally")) Adopt(s, ParseBlock());
+    return Finish(s);
+  }
+
+  // Tries to parse `[final|@A]* Type declarator(, declarator)*` and
+  // returns a VariableDeclarationExpr, or nullptr (position restored).
+  Node* TryParseVariableDeclarationExpr(bool single = false) {
+    size_t save = p_;
+    int begin = Pos();
+    std::vector<Node*> annotations = ParseModifiers();
+    Node* type = TryParseType();
+    if (type == nullptr || !IsIdent()) {
+      p_ = save;
+      return nullptr;
+    }
+    Node* e = New("VariableDeclarationExpr", begin);
+    for (Node* a : annotations) Adopt(e, a);
+    Adopt(e, type);
+    if (single) {
+      Adopt(e, ParseVariableDeclaratorNoInit());
+      return Finish(e);
+    }
+    Adopt(e, ParseVariableDeclarator());
+    while (Accept(",")) Adopt(e, ParseVariableDeclarator());
+    return Finish(e);
+  }
+
+  Node* ParseVariableDeclaratorNoInit() {
+    int begin = Pos();
+    Node* v = New("VariableDeclarator", begin);
+    Adopt(v, ParseVariableDeclaratorId());
+    return Finish(v);
+  }
+
+  Node* TryParseType() {
+    size_t save = p_;
+    try {
+      return ParseType();
+    } catch (const ParseError&) {
+      p_ = save;
+      return nullptr;
+    }
+  }
+
+  // --------------------------------------------------------- expressions
+  Node* ParseExpression() { return ParseAssignment(); }
+
+  Node* ParseAssignment() {
+    int begin = Pos();
+    Node* lhs = ParseConditional();
+    std::string op = AssignOpHere();
+    if (op.empty()) return lhs;
+    Node* e = New("AssignExpr", begin);
+    e->op = op;
+    Adopt(e, lhs);
+    Adopt(e, ParseAssignment());
+    return Finish(e);
+  }
+
+  // Returns the alpha.4 AssignExpr.Operator name and consumes the
+  // operator tokens, or "" if not at an assignment operator.
+  std::string AssignOpHere() {
+    if (Is("=")) { Next(); return "assign"; }
+    if (Is("+=")) { Next(); return "plus"; }
+    if (Is("-=")) { Next(); return "minus"; }
+    if (Is("*=")) { Next(); return "star"; }
+    if (Is("/=")) { Next(); return "slash"; }
+    if (Is("&=")) { Next(); return "and"; }
+    if (Is("|=")) { Next(); return "or"; }
+    if (Is("^=")) { Next(); return "xor"; }
+    if (Is("%=")) { Next(); return "rem"; }
+    if (Is("<<=")) { Next(); return "lShift"; }
+    if (Is(">")) {
+      if (GtRun(3, true)) { Next(); Next(); Next(); Next(); return "rUnsignedShift"; }
+      if (GtRun(2, true)) { Next(); Next(); Next(); return "rSignedShift"; }
+    }
+    return "";
+  }
+
+  Node* ParseConditional() {
+    int begin = Pos();
+    Node* cond = ParseLambdaOr(&Parser::ParseOrOr);
+    if (!Is("?")) return cond;
+    Next();
+    Node* e = New("ConditionalExpr", begin);
+    Adopt(e, cond);
+    Adopt(e, ParseExpression());
+    Expect(":");
+    Adopt(e, ParseConditional());
+    return Finish(e);
+  }
+
+  // Lambda can appear anywhere an expression does; detect `ident ->`
+  // and `( ... ) ->` before binary parsing.
+  Node* ParseLambdaOr(Node* (Parser::*next_level)()) {
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "->") {
+      return ParseLambdaFromSingleParam();
+    }
+    if (Is("(") && LambdaAhead()) return ParseLambdaFromParenParams();
+    return (this->*next_level)();
+  }
+
+  bool LambdaAhead() const {
+    // balanced scan from `(` to matching `)`; lambda iff `->` follows
+    assert(Is("("));
+    int depth = 0;
+    for (size_t k = p_; k < toks_.size(); ++k) {
+      const Token& t = toks_[k];
+      if (t.kind == Tok::kPunct) {
+        if (t.text == "(") ++depth;
+        else if (t.text == ")") {
+          --depth;
+          if (depth == 0) {
+            const Token& after = toks_[k + 1 < toks_.size() ? k + 1
+                                                            : toks_.size() - 1];
+            return after.kind == Tok::kPunct && after.text == "->";
+          }
+        } else if (t.text == ";") {
+          return false;
+        }
+      } else if (t.kind == Tok::kEof) {
+        return false;
+      }
+    }
+    return false;
+  }
+
+  Node* ParseLambdaFromSingleParam() {
+    int begin = Pos();
+    Node* lam = New("LambdaExpr", begin);
+    int pb = Pos();
+    Node* param = New("Parameter", pb);
+    Adopt(param, ParseVariableDeclaratorId());
+    Finish(param);
+    Adopt(lam, param);
+    Expect("->");
+    ParseLambdaBody(lam);
+    return Finish(lam);
+  }
+
+  Node* ParseLambdaFromParenParams() {
+    int begin = Pos();
+    Node* lam = New("LambdaExpr", begin);
+    Expect("(");
+    if (!Is(")")) {
+      do {
+        int pb = Pos();
+        std::vector<Node*> pann = ParseModifiers();
+        Node* param = New("Parameter", pb);
+        for (Node* a : pann) Adopt(param, a);
+        // typed param?  `(Type x) ->` vs `(x) ->`
+        size_t save = p_;
+        Node* type = TryParseType();
+        if (type != nullptr && IsIdent()) {
+          Adopt(param, type);
+        } else {
+          p_ = save;
+        }
+        Adopt(param, ParseVariableDeclaratorId());
+        Finish(param);
+        Adopt(lam, param);
+      } while (Accept(","));
+    }
+    Expect(")");
+    Expect("->");
+    ParseLambdaBody(lam);
+    return Finish(lam);
+  }
+
+  void ParseLambdaBody(Node* lam) {
+    if (Is("{")) {
+      Adopt(lam, ParseBlock());
+    } else {
+      // expression body is wrapped in ExpressionStmt by alpha.4
+      int begin = Pos();
+      Node* s = Stmt("ExpressionStmt", begin);
+      Adopt(s, ParseExpression());
+      Finish(s);
+      Adopt(lam, s);
+    }
+  }
+
+  Node* BinaryChain(Node* (Parser::*next)(),
+                    const std::function<std::string()>& op_here) {
+    int begin = Pos();
+    Node* lhs = (this->*next)();
+    while (true) {
+      std::string op = op_here();
+      if (op.empty()) return lhs;
+      Node* e = New("BinaryExpr", begin);
+      e->op = op;
+      Adopt(e, lhs);
+      Adopt(e, (this->*next)());
+      Finish(e);
+      lhs = e;
+    }
+  }
+
+  Node* ParseOrOr() {
+    return BinaryChain(&Parser::ParseAndAnd, [this]() -> std::string {
+      if (Is("||")) { Next(); return "or"; }
+      return "";
+    });
+  }
+  Node* ParseAndAnd() {
+    return BinaryChain(&Parser::ParseBitOr, [this]() -> std::string {
+      if (Is("&&")) { Next(); return "and"; }
+      return "";
+    });
+  }
+  Node* ParseBitOr() {
+    return BinaryChain(&Parser::ParseBitXor, [this]() -> std::string {
+      if (Is("|")) { Next(); return "binOr"; }
+      return "";
+    });
+  }
+  Node* ParseBitXor() {
+    return BinaryChain(&Parser::ParseBitAnd, [this]() -> std::string {
+      if (Is("^")) { Next(); return "xor"; }
+      return "";
+    });
+  }
+  Node* ParseBitAnd() {
+    return BinaryChain(&Parser::ParseEquality, [this]() -> std::string {
+      if (Is("&")) { Next(); return "binAnd"; }
+      return "";
+    });
+  }
+  Node* ParseEquality() {
+    return BinaryChain(&Parser::ParseRelational, [this]() -> std::string {
+      if (Is("==")) { Next(); return "equals"; }
+      if (Is("!=")) { Next(); return "notEquals"; }
+      return "";
+    });
+  }
+
+  Node* ParseRelational() {
+    int begin = Pos();
+    Node* lhs = ParseShift();
+    while (true) {
+      if (IsKw("instanceof")) {
+        Next();
+        Node* e = New("InstanceOfExpr", begin);
+        Adopt(e, lhs);
+        Adopt(e, ParseType());
+        Finish(e);
+        lhs = e;
+        continue;
+      }
+      std::string op;
+      if (Is("<=")) { Next(); op = "lessEquals"; }
+      else if (Is("<")) { Next(); op = "less"; }
+      else if (Is(">") && GtRun(1, true) && !GtRun(2, false)) {
+        Next(); Next(); op = "greaterEquals";
+      } else if (Is(">") && !GtRun(2, false)) { Next(); op = "greater"; }
+      if (op.empty()) return lhs;
+      Node* e = New("BinaryExpr", begin);
+      e->op = op;
+      Adopt(e, lhs);
+      Adopt(e, ParseShift());
+      Finish(e);
+      lhs = e;
+    }
+  }
+
+  Node* ParseShift() {
+    int begin = Pos();
+    Node* lhs = ParseAdditive();
+    while (true) {
+      std::string op;
+      if (Is("<<")) { Next(); op = "lShift"; }
+      else if (Is(">") && GtRun(3, false) && !GtRun(3, true)) {
+        Next(); Next(); Next(); op = "rUnsignedShift";
+      } else if (Is(">") && GtRun(2, false) && !GtRun(2, true) &&
+                 !GtRun(3, false)) {
+        Next(); Next(); op = "rSignedShift";
+      }
+      if (op.empty()) return lhs;
+      Node* e = New("BinaryExpr", begin);
+      e->op = op;
+      Adopt(e, lhs);
+      Adopt(e, ParseAdditive());
+      Finish(e);
+      lhs = e;
+    }
+  }
+
+  Node* ParseAdditive() {
+    return BinaryChain(&Parser::ParseMultiplicative, [this]() -> std::string {
+      if (Is("+")) { Next(); return "plus"; }
+      if (Is("-")) { Next(); return "minus"; }
+      return "";
+    });
+  }
+  Node* ParseMultiplicative() {
+    return BinaryChain(&Parser::ParseUnary, [this]() -> std::string {
+      if (Is("*")) { Next(); return "times"; }
+      if (Is("/")) { Next(); return "divide"; }
+      if (Is("%")) { Next(); return "remainder"; }
+      return "";
+    });
+  }
+
+  Node* ParseUnary() {
+    int begin = Pos();
+    if (Is("+")) {
+      Next();
+      return UnaryOf(begin, "positive", ParseUnary());
+    }
+    if (Is("-")) {
+      Next();
+      return UnaryOf(begin, "negative", ParseUnary());
+    }
+    if (Is("++")) {
+      Next();
+      return UnaryOf(begin, "preIncrement", ParseUnary());
+    }
+    if (Is("--")) {
+      Next();
+      return UnaryOf(begin, "preDecrement", ParseUnary());
+    }
+    if (Is("!")) {
+      Next();
+      return UnaryOf(begin, "not", ParseUnary());
+    }
+    if (Is("~")) {
+      Next();
+      return UnaryOf(begin, "inverse", ParseUnary());
+    }
+    // cast?
+    if (Is("(")) {
+      size_t save = p_;
+      Node* cast = TryParseCast(begin);
+      if (cast != nullptr) return cast;
+      p_ = save;
+    }
+    return ParsePostfix();
+  }
+
+  Node* UnaryOf(int begin, const char* op, Node* operand) {
+    Node* e = New("UnaryExpr", begin);
+    e->op = op;
+    Adopt(e, operand);
+    return Finish(e);
+  }
+
+  Node* TryParseCast(int begin) {
+    try {
+      Expect("(");
+      Node* type = ParseType();
+      if (!Is(")")) return nullptr;
+      // union-type casts `(A & B) x` (Java 8) — treat as cast to first
+      while (Accept("&")) ParseClassOrInterfaceType();
+      Expect(")");
+      bool primitive = type->type == "PrimitiveType" ||
+                       (!type->children.empty() &&
+                        type->children[0]->type == "PrimitiveType");
+      // After `)`, a cast must be followed by the start of a unary
+      // expression; for reference types exclude `+`/`-` (those read as
+      // binary ops on the parenthesized expr, matching Java's grammar).
+      bool operand_start =
+          IsIdent() || Cur().kind == Tok::kIntLit ||
+          Cur().kind == Tok::kLongLit || Cur().kind == Tok::kFloatLit ||
+          Cur().kind == Tok::kDoubleLit || Cur().kind == Tok::kCharLit ||
+          Cur().kind == Tok::kStringLit || Is("(") || Is("!") || Is("~") ||
+          IsKw("new") || IsKw("this") || IsKw("super") || IsKw("true") ||
+          IsKw("false") || IsKw("null") ||
+          (Cur().kind == Tok::kIdent && IsPrimitiveName(Cur().text));
+      if (primitive) operand_start = operand_start || Is("+") || Is("-") ||
+                                     Is("++") || Is("--");
+      if (!operand_start) return nullptr;
+      Node* e = New("CastExpr", begin);
+      Adopt(e, type);
+      Adopt(e, ParseUnary());
+      return Finish(e);
+    } catch (const ParseError&) {
+      return nullptr;
+    }
+  }
+
+  Node* ParsePostfix() {
+    int begin = Pos();
+    Node* e = ParsePrimary();
+    while (true) {
+      if (Is("++")) {
+        Next();
+        e = UnaryOf(begin, "posIncrement", e);
+      } else if (Is("--")) {
+        Next();
+        e = UnaryOf(begin, "posDecrement", e);
+      } else {
+        return e;
+      }
+    }
+  }
+
+  void ParseArgsInto(Node* call) {
+    Expect("(");
+    if (!Is(")")) {
+      do {
+        Adopt(call, ParseExpression());
+      } while (Accept(","));
+    }
+    Expect(")");
+  }
+
+  Node* ParsePrimary() {
+    int begin = Pos();
+    Node* e = ParsePrimaryPrefix();
+    // suffix chains
+    while (true) {
+      if (Is(".")) {
+        // `.class` after a name — handled in prefix via type context;
+        // here: field access, method call, this/super/new qualifiers
+        Next();
+        if (IsKw("this")) {
+          Next();
+          Node* t = New("ThisExpr", begin);
+          Adopt(t, e);
+          e = Finish(t);
+          continue;
+        }
+        if (IsKw("super")) {
+          Next();
+          Node* t = New("SuperExpr", begin);
+          Adopt(t, e);
+          e = Finish(t);
+          continue;
+        }
+        if (IsKw("new")) {
+          // qualified inner creation `outer.new Inner()`
+          Next();
+          e = ParseCreatorRest(begin, e);
+          continue;
+        }
+        if (IsKw("class")) {
+          Next();
+          Node* c = New("ClassExpr", begin);
+          Adopt(c, e);
+          e = Finish(c);
+          continue;
+        }
+        // optional explicit type args for generic method call
+        std::vector<Node*> type_args;
+        if (Is("<")) {
+          size_t save = p_;
+          try {
+            Next();
+            if (!GtRun(1, false)) {
+              do {
+                type_args.push_back(ParseTypeArgument());
+              } while (Accept(","));
+            }
+            CloseGeneric();
+          } catch (const ParseError&) {
+            p_ = save;
+            type_args.clear();
+          }
+        }
+        int nb = Pos();
+        std::string name = ExpectIdent();
+        if (Is("(")) {
+          // alpha.4 MethodCallExpr children: scope, typeArgs, nameExpr,
+          // args (ctor order)
+          Node* call = New("MethodCallExpr", begin);
+          Adopt(call, e);
+          for (Node* ta : type_args) Adopt(call, ta);
+          Adopt(call, MakeNameExpr(nb, name));
+          ParseArgsInto(call);
+          e = Finish(call);
+        } else {
+          Node* fa = New("FieldAccessExpr", begin);
+          Adopt(fa, e);
+          for (Node* ta : type_args) Adopt(fa, ta);
+          Adopt(fa, MakeNameExpr(nb, name));
+          e = Finish(fa);
+        }
+        continue;
+      }
+      if (Is("[")) {
+        Next();
+        Node* aa = New("ArrayAccessExpr", begin);
+        Adopt(aa, e);
+        Adopt(aa, ParseExpression());
+        Expect("]");
+        e = Finish(aa);
+        continue;
+      }
+      if (Is("::")) {
+        Next();
+        Node* mr = New("MethodReferenceExpr", begin);
+        Adopt(mr, e);
+        if (Is("<")) {  // rare explicit type args on method ref
+          Next();
+          if (!GtRun(1, false)) {
+            do {
+              Adopt(mr, ParseTypeArgument());
+            } while (Accept(","));
+          }
+          CloseGeneric();
+        }
+        if (AcceptKw("new")) {
+          mr->text = "new";
+        } else {
+          mr->text = ExpectIdent();
+        }
+        e = Finish(mr);
+        continue;
+      }
+      return e;
+    }
+  }
+
+  Node* ParsePrimaryPrefix() {
+    int begin = Pos();
+    const Token& t = Cur();
+    switch (t.kind) {
+      case Tok::kIntLit: {
+        Node* e = New("IntegerLiteralExpr", begin);
+        e->text = std::string(t.text);
+        e->is_int_literal = true;
+        Next();
+        return Finish(e);
+      }
+      case Tok::kLongLit: {
+        Node* e = New("LongLiteralExpr", begin);
+        e->text = std::string(t.text);
+        Next();
+        return Finish(e);
+      }
+      case Tok::kFloatLit:
+      case Tok::kDoubleLit: {
+        Node* e = New("DoubleLiteralExpr", begin);
+        e->text = std::string(t.text);
+        Next();
+        return Finish(e);
+      }
+      case Tok::kCharLit: {
+        Node* e = New("CharLiteralExpr", begin);
+        e->text = std::string(t.text);
+        Next();
+        return Finish(e);
+      }
+      case Tok::kStringLit: {
+        Node* e = New("StringLiteralExpr", begin);
+        e->text = std::string(t.text);
+        Next();
+        return Finish(e);
+      }
+      default:
+        break;
+    }
+    if (IsKw("true") || IsKw("false")) {
+      Node* e = New("BooleanLiteralExpr", begin);
+      e->text = std::string(Cur().text);
+      Next();
+      return Finish(e);
+    }
+    if (IsKw("null")) {
+      Node* e = New("NullLiteralExpr", begin);
+      e->text = "null";
+      e->is_null_literal = true;
+      Next();
+      return Finish(e);
+    }
+    if (IsKw("this")) {
+      Next();
+      Node* e = New("ThisExpr", begin);
+      e->text = "this";
+      return Finish(e);
+    }
+    // lambdas can start a primary (e.g. as a cast operand)
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "->") {
+      return ParseLambdaFromSingleParam();
+    }
+    if (Is("(") && LambdaAhead()) return ParseLambdaFromParenParams();
+    if (IsKw("super")) {
+      Next();
+      Node* e = New("SuperExpr", begin);
+      e->text = "super";
+      return Finish(e);
+    }
+    if (IsKw("new")) {
+      Next();
+      return ParseCreatorRest(begin, nullptr);
+    }
+    if (Is("(")) {
+      Next();
+      Node* e = New("EnclosedExpr", begin);
+      Adopt(e, ParseExpression());
+      Expect(")");
+      return Finish(e);
+    }
+    if (IsKw("void") && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "." && LookAhead(2).text == "class") {
+      Next();
+      Node* vt = New("VoidType", begin);
+      vt->text = "void";
+      vt->end = PrevEnd();
+      Next();
+      Next();
+      Node* c = New("ClassExpr", begin);
+      Adopt(c, vt);
+      return Finish(c);
+    }
+    // primitive type in expression context: `int.class`, `int[]::new`,
+    // `int[].class`
+    if (Cur().kind == Tok::kIdent && IsPrimitiveName(Cur().text)) {
+      Node* type = ParseType();
+      if (Accept(".")) {
+        ExpectKw("class");
+        Node* c = New("ClassExpr", begin);
+        Adopt(c, type);
+        return Finish(c);
+      }
+      Node* te = New("TypeExpr", begin);
+      Adopt(te, type);
+      return Finish(te);
+    }
+    if (IsIdent()) {
+      // plain method call `f(args)` — MethodCallExpr with no scope
+      if (LookAhead(1).kind == Tok::kPunct && LookAhead(1).text == "(") {
+        Node* call = New("MethodCallExpr", begin);
+        Adopt(call, MakeNameExpr(begin, ExpectIdent()));
+        ParseArgsInto(call);
+        return Finish(call);
+      }
+      // array-type expressions like `String[]::new` / `Foo[].class`
+      if (LookAhead(1).kind == Tok::kPunct && LookAhead(1).text == "[" &&
+          LookAhead(2).kind == Tok::kPunct && LookAhead(2).text == "]") {
+        size_t save = p_;
+        Node* type = TryParseType();
+        if (type != nullptr && (Is("::") || Is("."))) {
+          if (Accept(".")) {
+            ExpectKw("class");
+            Node* c = New("ClassExpr", begin);
+            Adopt(c, type);
+            return Finish(c);
+          }
+          Node* te = New("TypeExpr", begin);
+          Adopt(te, type);
+          return Finish(te);
+        }
+        p_ = save;
+      }
+      return MakeNameExpr(begin, ExpectIdent());
+    }
+    Fail("expected expression");
+  }
+
+  // After `new` (and optional outer scope for qualified creation).
+  Node* ParseCreatorRest(int begin, Node* scope) {
+    // optional constructor type args `new <T> Foo(...)`
+    std::vector<Node*> ctor_type_args;
+    if (Is("<")) {
+      Next();
+      if (!GtRun(1, false)) {
+        do {
+          ctor_type_args.push_back(ParseTypeArgument());
+        } while (Accept(","));
+      }
+      CloseGeneric();
+    }
+    // element type: primitive (array only) or class type
+    if (Cur().kind == Tok::kIdent && IsPrimitiveName(Cur().text)) {
+      int tb = Pos();
+      Node* et = New("PrimitiveType", tb);
+      et->text = std::string(Cur().text);
+      Next();
+      et->end = PrevEnd();
+      return ParseArrayCreatorRest(begin, et);
+    }
+    Node* type = ParseClassOrInterfaceType();
+    if (Is("[")) return ParseArrayCreatorRest(begin, type);
+    // object creation — alpha.4 children order: scope, type, typeArgs,
+    // args, anonymous class body
+    Node* e = New("ObjectCreationExpr", begin);
+    Adopt(e, scope);
+    Adopt(e, type);
+    for (Node* ta : ctor_type_args) Adopt(e, ta);
+    ParseArgsInto(e);
+    if (Is("{")) {
+      // anonymous class body: members adopted directly (alpha.4 stores
+      // List<BodyDeclaration>)
+      Expect("{");
+      while (!Accept("}")) {
+        if (AtEof()) Fail("unterminated anonymous class body");
+        if (Accept(";")) continue;
+        Adopt(e, ParseMember(type->name));
+      }
+    }
+    return Finish(e);
+  }
+
+  Node* ParseArrayCreatorRest(int begin, Node* element_type) {
+    // `new T[d0][d1][]...` or `new T[] {...}` — alpha.4
+    // ArrayCreationExpr children: type, dimension exprs, initializer
+    Node* e = New("ArrayCreationExpr", begin);
+    Adopt(e, element_type);
+    while (Is("[")) {
+      Next();
+      if (!Is("]")) Adopt(e, ParseExpression());
+      Expect("]");
+    }
+    if (Is("{")) Adopt(e, ParseArrayInitializer());
+    return Finish(e);
+  }
+
+  Arena* arena_;
+  std::vector<Token> toks_;
+  size_t p_ = 0;
+};
+
+}  // namespace
+
+Node* ParseJava(std::string_view source, Arena* arena) {
+  Parser parser(source, arena);
+  return parser.ParseCompilationUnit();
+}
+
+}  // namespace c2v

@@ -1,0 +1,25 @@
+// Recursive-descent Java 8 parser producing the alpha.4-shaped AST.
+//
+// Mirrors what the reference gets from JavaParser 3.0.0-alpha.4
+// (FeatureExtractor.java:61: JavaParser.parse). Throws ParseError on
+// input it cannot parse; the driver then applies the reference's
+// wrap-retries (FeatureExtractor.java:51-75) and finally skips the file
+// (ExtractFeaturesTask.java:38-43).
+#pragma once
+
+#include <stdexcept>
+#include <string>
+#include <string_view>
+
+#include "ast.h"
+
+namespace c2v {
+
+struct ParseError : std::runtime_error {
+  explicit ParseError(const std::string& m) : std::runtime_error(m) {}
+};
+
+// Parses a full compilation unit. Nodes live in `arena`.
+Node* ParseJava(std::string_view source, Arena* arena);
+
+}  // namespace c2v

@@ -1,0 +1,215 @@
+"""Tests for the native C++ Java path-context extractor (cpp/).
+
+Golden behavior is pinned against the reference extractor's documented
+semantics (FeatureExtractor.java:120-191 path grammar,
+Property.java:26-77 node naming, Common.java:36-76 normalization,
+ProgramRelation.java:18 Java-hashCode path hashing).
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+from code2vec_tpu.common import java_string_hashcode
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO_ROOT, "cpp", "build", "c2v-extract")
+
+FACTORIAL = """\
+int f(int n) {
+    if (n == 0) {
+        return 1;
+    } else {
+        return n * f(n-1);
+    }
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def extractor():
+    if not os.path.exists(BINARY):
+        rc = subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "cpp")],
+                            capture_output=True, text=True)
+        assert rc.returncode == 0, rc.stderr
+    def run(path, *extra):
+        cmd = [BINARY, "--max_path_length", "8", "--max_path_width", "2",
+               "--file", path, *extra]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout.splitlines()
+    return run
+
+
+@pytest.fixture()
+def java_file(tmp_path):
+    def write(code, name="Input.java"):
+        p = tmp_path / name
+        p.write_text(code)
+        return str(p)
+    return write
+
+
+def test_factorial_golden(extractor, java_file):
+    """The snippet from the reference's shipped Input.java: bare method,
+    wrapped by the parse retries (FeatureExtractor.java:51-75)."""
+    lines = extractor(java_file(FACTORIAL), "--no_hash")
+    assert len(lines) == 1
+    parts = lines[0].split(" ")
+    assert parts[0] == "f"
+    contexts = [c.split(",") for c in parts[1:]]
+    # every context is a (token, path, token) triple
+    assert all(len(c) == 3 for c in contexts)
+    # the method-name leaf is masked (Property.java:66-68)
+    assert any(c[0] == "METHOD_NAME" or c[2] == "METHOD_NAME"
+               for c in contexts)
+    # known context: return type leaf <-> masked name leaf with the
+    # alpha.4 MethodDeclaration child ids (type=0, nameExpr=1)
+    assert ["int", "(PrimitiveType0)^(MethodDeclaration)_(NameExpr1)",
+            "METHOD_NAME"] in contexts
+    # recursion: n-1 argument context with operator-suffixed type
+    assert ["n", "(NameExpr0)^(BinaryExpr:minus1)_(IntegerLiteralExpr1)",
+            "1"] in contexts
+    # path length cap: no path has more than 8 up/down hops + 1 node
+    for _, path, _ in contexts:
+        assert len(re.findall(r"[\^_]", path)) <= 8
+
+
+def test_hashed_mode_matches_java_hashcode(extractor, java_file):
+    plain = extractor(java_file(FACTORIAL), "--no_hash")
+    hashed = extractor(java_file(FACTORIAL))
+    for raw, enc in zip(plain[0].split(" ")[1:], hashed[0].split(" ")[1:]):
+        w1, path, w2 = raw.split(",")
+        h1, phash, h2 = enc.split(",")
+        assert (w1, w2) == (h1, h2)
+        assert str(java_string_hashcode(path)) == phash
+
+
+def test_label_subtokenization(extractor, java_file):
+    code = "class A { void setMaxHTTPRetries2Go(int x) { x++; } }"
+    lines = extractor(java_file(code), "--no_hash")
+    # Common.java:71-76 split: camelCase, acronym boundary, digits removed
+    assert lines[0].split(" ")[0] == "set|max|http|retries|go"
+
+
+def test_method_name_masking_and_tokens_lowercase(extractor, java_file):
+    code = """
+class A {
+  int addItem(String itemName) { return itemName.length() + MAX_SIZE; }
+}
+"""
+    line = extractor(java_file(code), "--no_hash")[0]
+    tokens = set()
+    for ctx in line.split(" ")[1:]:
+        w1, _, w2 = ctx.split(",")
+        tokens.add(w1)
+        tokens.add(w2)
+    # normalizeName lowercases and strips non-alpha (Common.java:36-53)
+    assert "itemname" in tokens
+    assert "maxsize" in tokens
+    assert "METHOD_NAME" in tokens
+    assert not any(t != "METHOD_NAME" and t.lower() != t for t in tokens)
+
+
+def test_boxed_type_rewrite(extractor, java_file):
+    """Integer leaf: type becomes PrimitiveType, name the unboxed type
+    (Property.java:29-31,62-64)."""
+    code = "class A { Integer box(Integer v) { return v; } }"
+    line = extractor(java_file(code), "--no_hash")[0]
+    assert "(PrimitiveType" in line
+    assert "ClassOrInterfaceType" not in line
+    tokens = {c.split(",")[i] for c in line.split(" ")[1:] for i in (0, 2)}
+    assert "int" in tokens and "integer" not in tokens
+
+
+def test_numeric_literals_keep_digits(extractor, java_file):
+    """Out-of-whitelist ints keep digits in the printed token: the <NUM>
+    masking touches only the never-printed SplitName (Property.java:70-76,
+    ProgramRelation.java:31-34)."""
+    code = "class A { int f() { return 37 + 64; } }"
+    line = extractor(java_file(code), "--no_hash")[0]
+    tokens = {c.split(",")[i] for c in line.split(" ")[1:] for i in (0, 2)}
+    assert "37" in tokens and "64" in tokens
+
+
+def test_empty_methods_filtered(extractor, java_file):
+    """MinCodeLength=1 drops empty bodies (FeatureExtractor.java:79-82)."""
+    code = "class A { void empty() {} int real() { return 1; } }"
+    lines = extractor(java_file(code), "--no_hash")
+    assert [ln.split(" ")[0] for ln in lines] == ["real"]
+
+
+def test_interface_and_abstract_methods_skipped(extractor, java_file):
+    code = """
+interface I { int size(); }
+abstract class B implements I { abstract void g(); int h() { return 2; } }
+"""
+    lines = extractor(java_file(code), "--no_hash")
+    assert [ln.split(" ")[0] for ln in lines] == ["h"]
+
+
+def test_nested_and_anonymous_methods(extractor, java_file):
+    """Methods of anonymous classes are separate examples AND their
+    leaves appear in the enclosing method (FunctionVisitor.java:18-23)."""
+    code = """
+class A {
+  Runnable outer() {
+    return new Runnable() {
+      public void run() { int innerVar = 5; innerVar++; }
+    };
+  }
+}
+"""
+    lines = extractor(java_file(code), "--no_hash")
+    labels = [ln.split(" ")[0] for ln in lines]
+    assert labels == ["outer", "run"]
+    # inner leaf participates in outer method's contexts
+    assert "innervar" in lines[0]
+
+
+def test_dir_mode_and_parse_failure_resilience(tmp_path, extractor):
+    good = tmp_path / "Good.java"
+    good.write_text("class G { int ok() { return 1; } }")
+    bad = tmp_path / "Bad.java"
+    bad.write_text("class { this is not java ]]]")
+    proc = subprocess.run(
+        [BINARY, "--max_path_length", "8", "--max_path_width", "2",
+         "--dir", str(tmp_path), "--no_hash"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("ok ")
+    assert "Bad.java" in proc.stderr
+
+
+def test_path_width_prunes_distant_siblings(extractor, java_file):
+    """max_path_width limits sibling distance at the common ancestor
+    (FeatureExtractor.java:145-151)."""
+    code = "class A { int f(int a, int b, int c, int d) { return a; } }"
+    wide = subprocess.run(
+        [BINARY, "--max_path_length", "8", "--max_path_width", "99",
+         "--file", java_file(code), "--no_hash"],
+        capture_output=True, text=True).stdout
+    narrow = subprocess.run(
+        [BINARY, "--max_path_length", "8", "--max_path_width", "1",
+         "--file", java_file(code), "--no_hash"],
+        capture_output=True, text=True).stdout
+    assert len(wide.split(" ")) > len(narrow.split(" "))
+
+
+def test_extractor_bridge_prefers_native(tmp_path, extractor):
+    """serving/extractor_bridge.py drives the native binary end-to-end."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving.extractor_bridge import PathExtractor
+
+    src = tmp_path / "Input.java"
+    src.write_text(FACTORIAL)
+    config = Config(train_data_path_prefix="<t>", max_contexts=200)
+    lines, hash_to_path = PathExtractor(config).extract_paths(str(src))
+    assert len(lines) == 1
+    first = lines[0].rstrip().split(" ")
+    assert first[0] == "f"
+    # bridge re-hashes readable paths; mapping must invert
+    w1, hashed, w2 = first[1].split(",")
+    assert hash_to_path[hashed].startswith("(")
