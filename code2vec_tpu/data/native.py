@@ -1,0 +1,145 @@
+"""ctypes bridge to the native host data-pipeline core (libc2vdata.so).
+
+The C library implements the text hot loop — per-line split, vocab
+lookup, pad/mask — with the exact semantics of the Python path
+(`data/reader.py parse_context_lines`, itself mirroring the reference's
+in-graph pipeline, reference: path_context_reader.py:184-228). Python
+keeps orchestration (shuffling, batching, filtering, device transfer);
+C++ does the byte crunching. Falls back cleanly when the library is not
+built (`make -C cpp`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import weakref
+from typing import Optional, Sequence
+
+import numpy as np
+
+_LIB_ENV = "C2V_NATIVE_DATALOADER"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_checked = False
+
+
+def _library_path() -> str:
+    env = os.environ.get(_LIB_ENV)
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "cpp", "build", "libc2vdata.so")
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Loads and signature-checks libc2vdata.so once; None if unavailable."""
+    global _lib, _lib_checked
+    with _lock:
+        if _lib_checked:
+            return _lib
+        _lib_checked = True
+        path = _library_path()
+        if not os.path.exists(path):
+            return None
+        lib = ctypes.CDLL(path)
+        i32, i64, p = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+        lib.c2v_tables_create.restype = p
+        lib.c2v_tables_create.argtypes = [i32, i32, i32, i32, i32]
+        lib.c2v_tables_destroy.argtypes = [p]
+        lib.c2v_tables_load.argtypes = [p, i32, ctypes.c_char_p, i64,
+                                        ctypes.POINTER(i32), i64]
+        lib.c2v_parse_text.restype = i64
+        lib.c2v_parse_text.argtypes = [p, ctypes.c_char_p, i64, i32,
+                                       ctypes.POINTER(i32), ctypes.POINTER(i32),
+                                       ctypes.POINTER(i32), ctypes.POINTER(i32),
+                                       ctypes.c_void_p, i64]
+        lib.c2v_pack_file.restype = i64
+        lib.c2v_pack_file.argtypes = [p, ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, i32, i32]
+        _lib = lib
+        return _lib
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeTables:
+    """Native string->id tables for one `Code2VecVocabs` instance."""
+
+    def __init__(self, vocabs):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("libc2vdata.so not built (run `make -C cpp`)")
+        self._lib = lib
+        tok, pth, tgt = (vocabs.token_vocab, vocabs.path_vocab,
+                         vocabs.target_vocab)
+        self._handle = lib.c2v_tables_create(
+            tok.pad_index, tok.oov_index, pth.pad_index, pth.oov_index,
+            tgt.oov_index)
+        for which, vocab in enumerate((tok, pth, tgt)):
+            items = sorted(vocab.word_to_index.items(), key=lambda kv: kv[1])
+            words = "\n".join(w for w, _ in items).encode("utf-8", "surrogateescape")
+            ids = np.asarray([i for _, i in items], dtype=np.int32)
+            lib.c2v_tables_load(self._handle, which, words, len(words),
+                                _i32ptr(ids), len(items))
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.c2v_tables_destroy(handle)
+            self._handle = None
+
+    # ------------------------------------------------------------------
+
+    def parse_lines(self, lines: Sequence[str], max_contexts: int):
+        """Parse context lines to (src, pth, tgt, label, mask) arrays."""
+        # one '\n' terminator per line so blank lines still yield a row
+        text = "".join(line if line.endswith("\n") else line + "\n"
+                       for line in lines)
+        data = text.encode("utf-8", "surrogateescape")
+        n, m = len(lines), max_contexts
+        src = np.empty((n, m), dtype=np.int32)
+        pth = np.empty((n, m), dtype=np.int32)
+        tgt = np.empty((n, m), dtype=np.int32)
+        label = np.empty((n,), dtype=np.int32)
+        mask = np.empty((n, m), dtype=np.float32)
+        parsed = self._lib.c2v_parse_text(
+            self._handle, data, len(data), m, _i32ptr(src), _i32ptr(pth),
+            _i32ptr(tgt), _i32ptr(label),
+            mask.ctypes.data_as(ctypes.c_void_p), n)
+        # "\n".join never yields extra rows; a short count means a bug.
+        assert parsed == n, (parsed, n)
+        return src, pth, tgt, label, mask
+
+    def pack_file(self, c2v_path: str, out_path: str, max_contexts: int,
+                  targets_path: Optional[str] = None,
+                  num_threads: int = 0) -> int:
+        """Compile `.c2v` -> `.c2vb`; returns the row count."""
+        rows = self._lib.c2v_pack_file(
+            self._handle, c2v_path.encode(), out_path.encode(),
+            targets_path.encode() if targets_path else None,
+            max_contexts, num_threads)
+        if rows < 0:
+            raise IOError(f"native pack failed for {c2v_path} -> {out_path}")
+        return rows
+
+
+# Weak-keyed so dropping a Code2VecVocabs frees its (large) native
+# tables; NativeTables holds no back-reference to the key.
+_tables_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def tables_for(vocabs) -> Optional[NativeTables]:
+    """Returns (cached) native tables for `vocabs`, or None if the
+    library isn't built."""
+    if load_library() is None:
+        return None
+    tables = _tables_cache.get(vocabs)
+    if tables is None:
+        tables = NativeTables(vocabs)
+        _tables_cache[vocabs] = tables
+    return tables

@@ -56,6 +56,17 @@ def pack_c2v(c2v_path: str, vocabs: Code2VecVocabs, max_contexts: int,
     n_rows = 0
     targets_sidecar = out_path + ".targets" if write_targets_sidecar else None
 
+    # Native whole-file compile when libc2vdata.so is built (same layout,
+    # multithreaded split+lookup in C++); both branches share the meta
+    # write below.
+    from code2vec_tpu.data import native
+    tables = native.tables_for(vocabs)
+    if tables is not None:
+        n_rows = tables.pack_file(c2v_path, out_path, max_contexts,
+                                  targets_path=targets_sidecar)
+        return _write_pack_meta(out_path, c2v_path, n_rows, max_contexts,
+                                vocabs)
+
     with open(tmp_path, "wb") as out:
         out.write(_HEADER.pack(_MAGIC, _VERSION, 0, max_contexts))
         tgt_file = open(targets_sidecar, "w") if targets_sidecar else None
@@ -76,6 +87,11 @@ def pack_c2v(c2v_path: str, vocabs: Code2VecVocabs, max_contexts: int,
         out.seek(0)
         out.write(_HEADER.pack(_MAGIC, _VERSION, n_rows, max_contexts))
     os.replace(tmp_path, out_path)
+    return _write_pack_meta(out_path, c2v_path, n_rows, max_contexts, vocabs)
+
+
+def _write_pack_meta(out_path: str, c2v_path: str, n_rows: int,
+                     max_contexts: int, vocabs: Code2VecVocabs) -> str:
     meta = {"rows": n_rows, "max_contexts": max_contexts,
             "vocab_fingerprint": vocabs_fingerprint(vocabs),
             "source": os.path.basename(c2v_path)}

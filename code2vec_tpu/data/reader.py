@@ -93,6 +93,27 @@ def parse_context_lines(
     """
     n = len(lines)
     m = max_contexts
+    keep = keep_strings or estimator_action.is_predict
+    if not keep:
+        # Hot path: the native C++ core does split+lookup+mask when built
+        # (identical semantics; tests/test_native_dataloader.py pins it).
+        from code2vec_tpu.data import native
+        tables = native.tables_for(vocabs)
+        if tables is not None:
+            src, pth, tgt, label, mask = tables.parse_lines(lines, m)
+            return RowBatch(
+                source_token_indices=src,
+                path_indices=pth,
+                target_token_indices=tgt,
+                context_valid_mask=mask,
+                target_index=label,
+                example_valid=np.ones((n,), dtype=bool),
+                # only evaluation reads the raw targets; training must not
+                # pay a per-line Python loop after the C call
+                target_strings=(
+                    [line.split(" ", 1)[0].rstrip("\n") for line in lines]
+                    if estimator_action.is_evaluate else None),
+            )
     token_w2i = vocabs.token_vocab.word_to_index
     path_w2i = vocabs.path_vocab.word_to_index
     token_oov = vocabs.token_vocab.oov_index
@@ -105,7 +126,6 @@ def parse_context_lines(
     tgt = np.full((n, m), token_pad, dtype=np.int32)
     target_index = np.empty((n,), dtype=np.int32)
     target_strings: List[str] = []
-    keep = keep_strings or estimator_action.is_predict
     if keep:
         src_s = np.full((n, m), "", dtype=object)
         pth_s = np.full((n, m), "", dtype=object)
