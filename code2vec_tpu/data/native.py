@@ -96,12 +96,16 @@ class NativeTables:
     # ------------------------------------------------------------------
 
     def parse_lines(self, lines: Sequence[str], max_contexts: int):
-        """Parse context lines to (src, pth, tgt, label, mask) arrays."""
+        """Parse context lines to (src, pth, tgt, label, mask) arrays,
+        or None when the input needs the Python path (a "line" with an
+        interior newline would shift every following row)."""
         # one '\n' terminator per line so blank lines still yield a row
         text = "".join(line if line.endswith("\n") else line + "\n"
                        for line in lines)
         data = text.encode("utf-8", "surrogateescape")
         n, m = len(lines), max_contexts
+        if data.count(b"\n") != n:
+            return None
         src = np.empty((n, m), dtype=np.int32)
         pth = np.empty((n, m), dtype=np.int32)
         tgt = np.empty((n, m), dtype=np.int32)

@@ -163,7 +163,14 @@ class PackedDataset:
         sidecar = self.path + ".targets"
         if self._target_strings is None and os.path.exists(sidecar):
             with open(sidecar, "r") as f:
-                self._target_strings = f.read().splitlines()
+                strings = f.read().splitlines()
+            # cross-check: a stale/partial sidecar (e.g. interrupted
+            # re-pack) must not silently mislabel evaluation rows
+            if len(strings) != self.num_rows_total:
+                raise ValueError(
+                    f"{sidecar} has {len(strings)} rows but {self.path} has "
+                    f"{self.num_rows_total}; re-pack the dataset.")
+            self._target_strings = strings
         return self._target_strings
 
     def gather(self, rows: np.ndarray, estimator_action: EstimatorAction,

@@ -99,8 +99,9 @@ def parse_context_lines(
         # (identical semantics; tests/test_native_dataloader.py pins it).
         from code2vec_tpu.data import native
         tables = native.tables_for(vocabs)
-        if tables is not None:
-            src, pth, tgt, label, mask = tables.parse_lines(lines, m)
+        parsed = tables.parse_lines(lines, m) if tables is not None else None
+        if parsed is not None:
+            src, pth, tgt, label, mask = parsed
             return RowBatch(
                 source_token_indices=src,
                 path_indices=pth,

@@ -1,0 +1,73 @@
+// Roslyn-shaped syntax tree for the C# extractor.
+//
+// `kind` strings are Roslyn SyntaxKind names (the reference prints
+// node.Kind() into path strings, Extractor.cs:52-87). Tokens are kept
+// separate from node children: Roslyn's ChildNodes() — which defines
+// the childId (Extractor.cs:90-99) and the width check
+// (PathFinder.cs:96-106) — excludes tokens, while leaves in the C#
+// pipeline ARE tokens (Tree.cs:168-183).
+#pragma once
+
+#include <deque>
+#include <string>
+#include <vector>
+
+#include "cs_lexer.h"
+
+namespace c2v {
+
+struct CsNode;
+
+// One syntax token attached to a node (only the ones the extractor can
+// care about are attached: identifiers, literals, predefined-type
+// keywords; punctuation/other keywords are dropped at parse time).
+struct CsAttachedToken {
+  std::string value;      // Roslyn ValueText
+  CsTok lex_kind = CsTok::kIdent;
+  CsNode* parent = nullptr;
+  int pos = 0;            // source offset (identity + ordering)
+};
+
+struct CsNode {
+  std::string kind;
+  CsNode* parent = nullptr;
+  std::vector<CsNode*> children;        // Roslyn ChildNodes()
+  std::vector<int> token_ids;           // indices into CsTree::tokens
+  int begin = 0, end = 0;
+};
+
+class CsArena {
+ public:
+  CsNode* New(std::string kind) {
+    nodes_.emplace_back();
+    nodes_.back().kind = std::move(kind);
+    return &nodes_.back();
+  }
+
+  int NewToken(std::string value, CsTok lex_kind, int pos) {
+    tokens_.push_back(CsAttachedToken{std::move(value), lex_kind, nullptr,
+                                      pos});
+    return static_cast<int>(tokens_.size()) - 1;
+  }
+
+  CsAttachedToken& Token(int id) { return tokens_[id]; }
+  const CsAttachedToken& Token(int id) const { return tokens_[id]; }
+  size_t NumTokens() const { return tokens_.size(); }
+
+ private:
+  std::deque<CsNode> nodes_;
+  std::deque<CsAttachedToken> tokens_;
+};
+
+inline void CsAdopt(CsNode* parent, CsNode* child) {
+  if (child == nullptr) return;
+  child->parent = parent;
+  parent->children.push_back(child);
+}
+
+inline void CsAttach(CsArena* arena, CsNode* node, int token_id) {
+  arena->Token(token_id).parent = node;
+  node->token_ids.push_back(token_id);
+}
+
+}  // namespace c2v

@@ -1,0 +1,430 @@
+#include "cs_extract.h"
+
+#include <algorithm>
+#include <cctype>
+#include <random>
+#include <unordered_map>
+#include <unordered_set>
+
+#include "cs_ast.h"
+#include "cs_parser.h"
+
+namespace c2v {
+
+namespace {
+
+constexpr const char* kMethodName = "METHOD_NAME";  // Extractor.cs:20
+
+// Extractor.cs:23-24
+const std::unordered_set<std::string> kParentKindsWithChildId = {
+    "SimpleAssignmentExpression", "ElementAccessExpression",
+    "SimpleMemberAccessExpression", "InvocationExpression",
+    "BracketedArgumentList", "ArgumentList"};
+
+// Utilities.cs:37
+const std::unordered_set<std::string> kNumericKeep = {"0", "1", "2", "3",
+                                                      "4", "5", "10"};
+
+}  // namespace
+
+int32_t DotNetStringHashCode(const std::string& s) {
+  // classic .NET Framework 32-bit algorithm over UTF-16 units (inputs
+  // here are ASCII path/kind strings, so bytes == units)
+  uint32_t hash1 = (5381u << 16) + 5381u;
+  uint32_t hash2 = hash1;
+  for (size_t i = 0; i < s.size(); i += 2) {
+    hash1 = ((hash1 << 5) + hash1) ^ static_cast<unsigned char>(s[i]);
+    if (i + 1 < s.size())
+      hash2 = ((hash2 << 5) + hash2) ^ static_cast<unsigned char>(s[i + 1]);
+  }
+  return static_cast<int32_t>(hash1 + hash2 * 1566083941u);
+}
+
+std::string CsNormalizeName(const std::string& s) {
+  // Utilities.cs:103-154, step by step.
+  std::string lower;
+  lower.reserve(s.size());
+  for (char c : s)
+    lower.push_back(
+        static_cast<char>(std::tolower(static_cast<unsigned char>(c))));
+  // Replace("\\\\n", "") — the C# literal is the 3-char text `\\n`
+  std::string a;
+  for (size_t i = 0; i < lower.size();) {
+    if (i + 2 < lower.size() + 0u && lower.compare(i, 3, "\\\\n") == 0) {
+      i += 3;
+    } else {
+      a.push_back(lower[i]);
+      ++i;
+    }
+  }
+  // Replace("[\"',]", "") — LITERAL string replace (a no-regex quirk)
+  std::string b;
+  const std::string quirk = "[\"',]";
+  for (size_t i = 0; i < a.size();) {
+    if (a.compare(i, quirk.size(), quirk) == 0) {
+      i += quirk.size();
+    } else {
+      b.push_back(a[i]);
+      ++i;
+    }
+  }
+  // remove whitespace, then non-ASCII bytes
+  std::string partial;
+  for (char c : b) {
+    if (std::isspace(static_cast<unsigned char>(c))) continue;
+    if (static_cast<unsigned char>(c) >= 0x80) continue;
+    partial.push_back(c);
+  }
+  // '\n'->'N', '\r'->'R' are dead after whitespace removal; ','->'C' live
+  for (char& c : partial) {
+    if (c == ',') c = 'C';
+  }
+  std::string completely;
+  for (char c : partial)
+    if (std::isalpha(static_cast<unsigned char>(c))) completely.push_back(c);
+  if (completely.empty()) {
+    bool all_digits = !partial.empty();
+    for (char c : partial)
+      if (!std::isdigit(static_cast<unsigned char>(c))) all_digits = false;
+    if (all_digits)
+      return kNumericKeep.count(partial) ? partial : std::string("NUM");
+    return "";
+  }
+  return completely;
+}
+
+std::vector<std::string> CsSplitToSubtokens(const std::string& s) {
+  // same split regex as the Java side (Utilities.cs:92-98), but parts
+  // are normalized with the C# NormalizeName
+  std::string str = s;
+  size_t b = str.find_first_not_of(" \t\r\n\f\v");
+  size_t e = str.find_last_not_of(" \t\r\n\f\v");
+  str = (b == std::string::npos) ? "" : str.substr(b, e - b + 1);
+
+  std::vector<std::string> out;
+  std::string cur;
+  auto flush = [&]() {
+    if (!cur.empty()) {
+      std::string norm = CsNormalizeName(cur);
+      if (!norm.empty()) out.push_back(norm);
+    }
+    cur.clear();
+  };
+  auto upper = [&](size_t k) {
+    return k < str.size() && std::isupper(static_cast<unsigned char>(str[k]));
+  };
+  auto lower_at = [&](size_t k) {
+    return k < str.size() && std::islower(static_cast<unsigned char>(str[k]));
+  };
+  for (size_t i = 0; i < str.size(); ++i) {
+    char c = str[i];
+    if (c == '_' || std::isdigit(static_cast<unsigned char>(c)) ||
+        std::isspace(static_cast<unsigned char>(c))) {
+      flush();
+      continue;
+    }
+    cur.push_back(c);
+    if ((std::islower(static_cast<unsigned char>(c)) && upper(i + 1)) ||
+        (std::isupper(static_cast<unsigned char>(c)) && upper(i + 1) &&
+         lower_at(i + 2))) {
+      flush();
+    }
+  }
+  flush();
+  return out;
+}
+
+namespace {
+
+std::string SplitNameUnlessEmpty(const std::string& original) {
+  // Extractor.cs:140-163
+  std::vector<std::string> subtokens = CsSplitToSubtokens(original);
+  std::string name;
+  for (size_t i = 0; i < subtokens.size(); ++i) {
+    if (i) name += "|";
+    name += subtokens[i];
+  }
+  if (name.empty()) name = CsNormalizeName(original);
+  bool all_space = !name.empty();
+  for (char c : name)
+    if (!std::isspace(static_cast<unsigned char>(c))) all_space = false;
+  if (all_space) name = "SPACE";
+  if (name.empty()) name = "BLANK";
+  if (original == kMethodName) name = original;
+  return name;
+}
+
+// Tree.cs:168-183: leaf tokens are identifiers, literals, and
+// predefined-type keywords — minus `var` in a local declaration.
+bool IsLeafToken(const CsArena& arena, int token_id) {
+  const CsAttachedToken& tok = arena.Token(token_id);
+  const CsNode* parent = tok.parent;
+  if (parent == nullptr) return false;
+  if (tok.lex_kind == CsTok::kIdent && tok.value == "var" &&
+      parent->kind == "IdentifierName" && parent->parent != nullptr &&
+      parent->parent->kind == "VariableDeclaration" &&
+      parent->parent->parent != nullptr &&
+      parent->parent->parent->kind == "LocalDeclarationStatement") {
+    return false;
+  }
+  if (parent->kind == "PredefinedType") return true;
+  if (tok.lex_kind == CsTok::kIdent)
+    return !IsCsKeyword(tok.value) || tok.value == "var";
+  return tok.lex_kind == CsTok::kNumeric || tok.lex_kind == CsTok::kString ||
+         tok.lex_kind == CsTok::kChar;
+}
+
+// Leaves of a subtree in the reference walker's order: child subtrees'
+// leaves first (in child order), then the node's own leaf tokens
+// (Tree.cs:60-79).
+void CollectLeaves(const CsArena& arena, const CsNode* node,
+                   std::vector<int>* out) {
+  for (const CsNode* child : node->children) CollectLeaves(arena, child, out);
+  for (int token_id : node->token_ids)
+    if (IsLeafToken(arena, token_id)) out->push_back(token_id);
+}
+
+void CollectMethods(CsNode* node, std::vector<CsNode*>* out) {
+  if (node->kind == "MethodDeclaration") out->push_back(node);
+  for (CsNode* child : node->children) CollectMethods(child, out);
+}
+
+int Depth(const CsNode* n) {
+  int d = 0;
+  while (n->parent != nullptr) {
+    n = n->parent;
+    ++d;
+  }
+  return d;
+}
+
+struct CsPath {
+  std::vector<const CsNode*> left_side;   // token.parent upward, excl. anc
+  const CsNode* ancestor = nullptr;
+  std::vector<const CsNode*> right_side;  // anc-child downward to token.parent
+};
+
+// PathFinder.cs:82-109.
+bool FindPath(const CsNode* l_parent, const CsNode* r_parent, int max_length,
+              int max_width, CsPath* out) {
+  int dl = Depth(l_parent), dr = Depth(r_parent);
+  // common ancestor
+  const CsNode* l = l_parent;
+  const CsNode* r = r_parent;
+  int cl = dl, cr = dr;
+  while (l != r) {
+    if (cl >= cr) {
+      l = l->parent;
+      --cl;
+    } else {
+      r = r->parent;
+      --cr;
+    }
+  }
+  const CsNode* p = l;
+  int dp = cl;
+  if (dl + dr - 2 * dp + 2 > max_length) return false;
+
+  out->left_side.clear();
+  out->right_side.clear();
+  for (const CsNode* cur = l_parent; cur != p; cur = cur->parent)
+    out->left_side.push_back(cur);
+  for (const CsNode* cur = r_parent; cur != p; cur = cur->parent)
+    out->right_side.push_back(cur);
+  std::reverse(out->right_side.begin(), out->right_side.end());
+  out->ancestor = p;
+
+  if (!out->left_side.empty() && !out->right_side.empty()) {
+    const std::vector<CsNode*>& siblings = p->children;
+    auto index_of = [&](const CsNode* n) {
+      for (size_t i = 0; i < siblings.size(); ++i)
+        if (siblings[i] == n) return static_cast<int>(i);
+      return -1;
+    };
+    int il = index_of(out->left_side.back());
+    int ir = index_of(out->right_side.front());
+    if (std::abs(il - ir) >= max_width) return false;
+  }
+  return true;
+}
+
+int TruncatedChildId(const CsNode* n) {
+  // Extractor.cs:90-99 (cap at 3)
+  const CsNode* parent = n->parent;
+  int index = 0;
+  for (const CsNode* child : parent->children) {
+    if (child == n) break;
+    ++index;
+  }
+  return std::min(index, 3);
+}
+
+std::string PathNodesToString(const CsPath& path) {
+  // Extractor.cs:46-88
+  std::string out;
+  auto append_node = [&](const CsNode* n) {
+    out += n->kind;
+    if (n->parent != nullptr &&
+        kParentKindsWithChildId.count(n->parent->kind)) {
+      out += std::to_string(TruncatedChildId(n));
+    }
+  };
+  if (!path.left_side.empty()) {
+    append_node(path.left_side.front());
+    for (size_t i = 1; i < path.left_side.size(); ++i) {
+      out += "^";
+      append_node(path.left_side[i]);
+    }
+    out += "^";
+  }
+  out += path.ancestor->kind;
+  if (!path.right_side.empty()) {
+    out += "_";
+    append_node(path.right_side.front());
+    for (size_t i = 1; i < path.right_side.size(); ++i) {
+      out += "_";
+      append_node(path.right_side[i]);
+    }
+  }
+  return out;
+}
+
+struct Variable {
+  std::string name;         // token name or METHOD_NAME
+  std::vector<int> leaves;  // token ids, insertion order
+};
+
+}  // namespace
+
+std::vector<std::string> CsExtractFromSource(const std::string& code,
+                                             const CsExtractOptions& options) {
+  CsArena arena;
+  CsParseResult parsed = CsParse(code, &arena);
+
+  std::vector<CsNode*> methods;
+  CollectMethods(parsed.root, &methods);
+
+  // comment contexts come from the WHOLE file for every method
+  // (Extractor.cs:204-205 uses tree.GetRoot() inside the method loop —
+  // reproduced as-is)
+  std::vector<std::string> comment_contexts;
+  for (const CsComment& comment : parsed.comments) {
+    if (comment.kind == 2) continue;  // /// doc comments excluded
+    std::string text(comment.text);
+    const std::string trim_chars = " /*{}";
+    size_t b = text.find_first_not_of(trim_chars);
+    size_t e = text.find_last_not_of(trim_chars);
+    text = (b == std::string::npos) ? "" : text.substr(b, e - b + 1);
+    std::string normalized = SplitNameUnlessEmpty(text);
+    std::vector<std::string> parts;
+    size_t start = 0;
+    while (true) {
+      size_t bar = normalized.find('|', start);
+      parts.push_back(normalized.substr(
+          start, bar == std::string::npos ? bar : bar - start));
+      if (bar == std::string::npos) break;
+      start = bar + 1;
+    }
+    for (size_t i = 0; i * 5 < parts.size(); ++i) {
+      std::string batch;
+      for (size_t j = i * 5; j < std::min(parts.size(), (i + 1) * 5); ++j) {
+        if (j > i * 5) batch += "|";
+        batch += parts[j];
+      }
+      comment_contexts.push_back(batch + ",COMMENT," + batch);
+    }
+  }
+
+  std::vector<std::string> results;
+  for (CsNode* method : methods) {
+    // method name = the identifier token attached to the declaration
+    std::string method_name;
+    for (int token_id : method->token_ids) {
+      method_name = arena.Token(token_id).value;
+      break;
+    }
+    std::vector<int> leaves;
+    CollectLeaves(arena, method, &leaves);
+
+    // group into variables by (masked) name, first-seen order
+    // (Variable.CreateFromMethod, Variable.cs:71-108)
+    std::vector<Variable> variables;
+    std::unordered_map<std::string, size_t> by_name;
+    for (int token_id : leaves) {
+      const CsAttachedToken& tok = arena.Token(token_id);
+      std::string name =
+          (tok.parent->kind == "MethodDeclaration" &&
+           tok.lex_kind == CsTok::kIdent)
+              ? kMethodName
+              : tok.value;
+      auto it = by_name.find(name);
+      if (it == by_name.end()) {
+        it = by_name.emplace(name, variables.size()).first;
+        variables.push_back(Variable{name, {}});
+      }
+      variables[it->second].leaves.push_back(token_id);
+    }
+
+    // pairs: Choose2 then self-pairs, reservoir-sampled to MaxContexts
+    // (Extractor.cs:111-117; deterministic seed, see header)
+    std::vector<std::pair<size_t, size_t>> pairs;
+    std::mt19937 rng(options.sample_seed);
+    int64_t seen = 0;
+    auto offer = [&](size_t a, size_t bb) {
+      ++seen;
+      if (static_cast<int>(pairs.size()) <
+          options.max_contexts) {
+        pairs.emplace_back(a, bb);
+      } else {
+        int64_t position = std::uniform_int_distribution<int64_t>(
+            0, seen - 1)(rng);
+        if (position < options.max_contexts)
+          pairs[static_cast<size_t>(position)] = {a, bb};
+      }
+    };
+    for (size_t i = 0; i < variables.size(); ++i)
+      for (size_t j = i + 1; j < variables.size(); ++j) offer(i, j);
+    for (size_t i = 0; i < variables.size(); ++i) offer(i, i);
+
+    std::vector<std::string> contexts;
+    CsPath path;
+    for (const auto& [vi, vj] : pairs) {
+      for (int rhs : variables[vj].leaves) {
+        for (int lhs : variables[vi].leaves) {
+          if (lhs == rhs) continue;
+          const CsAttachedToken& lt = arena.Token(lhs);
+          const CsAttachedToken& rt = arena.Token(rhs);
+          if (!FindPath(lt.parent, rt.parent, options.max_length,
+                        options.max_width, &path))
+            continue;
+          std::string path_str = PathNodesToString(path);
+          std::string path_field =
+              options.no_hash
+                  ? path_str
+                  : std::to_string(DotNetStringHashCode(path_str));
+          contexts.push_back(SplitNameUnlessEmpty(variables[vi].name) + "," +
+                             path_field + "," +
+                             SplitNameUnlessEmpty(variables[vj].name));
+        }
+      }
+    }
+    for (const std::string& comment_ctx : comment_contexts)
+      contexts.push_back(comment_ctx);
+
+    std::vector<std::string> label_parts = CsSplitToSubtokens(method_name);
+    std::string label;
+    for (size_t i = 0; i < label_parts.size(); ++i) {
+      if (i) label += "|";
+      label += label_parts[i];
+    }
+    std::string line = label + " ";
+    for (size_t i = 0; i < contexts.size(); ++i) {
+      if (i) line += " ";
+      line += contexts[i];
+    }
+    results.push_back(line);
+  }
+  return results;
+}
+
+}  // namespace c2v

@@ -1,0 +1,40 @@
+// C# path-context extraction: the reference's variable-centric pipeline
+// (Extractor.cs:168-222): leaf tokens grouped into Variables by name,
+// reservoir-sampled variable pairs, all leaf-pair paths per sampled
+// pair, plus per-method comment contexts, `label tok,path,tok ...`
+// output lines.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace c2v {
+
+struct CsExtractOptions {
+  int max_length = 9;        // Options.MaxLength default (Utilities.cs:19-20)
+  int max_width = 2;         // Options.MaxWidth default (Utilities.cs:22-23)
+  bool no_hash = false;
+  int max_contexts = 30000;  // sampled variable PAIRS (Utilities.cs:31-32)
+  uint32_t sample_seed = 0x5EEDu;  // deterministic, unlike the
+                                   // reference's unseeded Random
+};
+
+// .NET Framework (non-randomized, 32-bit) String.GetHashCode. The
+// reference calls String.GetHashCode (Extractor.cs:228) whose value is
+// process-randomized on .NET Core; this deterministic classic algorithm
+// is the stable replacement.
+int32_t DotNetStringHashCode(const std::string& s);
+
+// Reference Utilities.NormalizeName (Utilities.cs:103-154), including
+// its literal-Replace quirks, ','->'C' rewrite and NUM masking.
+std::string CsNormalizeName(const std::string& s);
+
+std::vector<std::string> CsSplitToSubtokens(const std::string& s);
+
+// Extracts all methods from one C# source; one output line per method.
+// Throws CsParseError on unparseable input (caller skips the file).
+std::vector<std::string> CsExtractFromSource(const std::string& code,
+                                             const CsExtractOptions& options);
+
+}  // namespace c2v

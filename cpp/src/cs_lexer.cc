@@ -1,0 +1,292 @@
+#include "cs_lexer.h"
+
+#include <array>
+#include <cctype>
+#include <cstring>
+#include <unordered_set>
+
+namespace c2v {
+
+namespace {
+
+const std::unordered_set<std::string_view> kCsKeywords = {
+    "abstract", "as", "base", "bool", "break", "byte", "case", "catch",
+    "char", "checked", "class", "const", "continue", "decimal", "default",
+    "delegate", "do", "double", "else", "enum", "event", "explicit",
+    "extern", "false", "finally", "fixed", "float", "for", "foreach",
+    "goto", "if", "implicit", "in", "int", "interface", "internal", "is",
+    "lock", "long", "namespace", "new", "null", "object", "operator",
+    "out", "override", "params", "private", "protected", "public",
+    "readonly", "ref", "return", "sbyte", "sealed", "short", "sizeof",
+    "stackalloc", "static", "string", "struct", "switch", "this", "throw",
+    "true", "try", "typeof", "uint", "ulong", "unchecked", "unsafe",
+    "ushort", "using", "virtual", "void", "volatile", "while",
+    // contextual keywords (var/async/await/yield/...) are identifiers
+};
+
+bool IdentStart(char c) {
+  return std::isalpha(static_cast<unsigned char>(c)) || c == '_' ||
+         static_cast<unsigned char>(c) >= 0x80;
+}
+bool IdentPart(char c) {
+  return IdentStart(c) || std::isdigit(static_cast<unsigned char>(c));
+}
+bool Digit(char c) { return c >= '0' && c <= '9'; }
+bool HexDigit(char c) {
+  return Digit(c) || (c >= 'a' && c <= 'f') || (c >= 'A' && c <= 'F');
+}
+
+// "?\?=" avoids the ??= trigraph warning; the escape is only in this
+// C++ source, the matched text is `??=`.
+constexpr std::array<std::string_view, 23> kPunctMulti = {
+    "<<=", "?\?=", "?.", "?\?", "::", "=>", "==", "!=", "<=", "&&", "||",
+    "++", "--", "+=", "-=", "*=", "/=", "&=", "|=", "^=", "%=", "<<",
+    "->",
+};
+
+char UnescapeChar(std::string_view s, size_t* i) {
+  // after backslash; returns the decoded char (approximate for \u)
+  char c = s[*i];
+  ++*i;
+  switch (c) {
+    case 'n': return '\n';
+    case 't': return '\t';
+    case 'r': return '\r';
+    case '0': return '\0';
+    case 'a': return '\a';
+    case 'b': return '\b';
+    case 'f': return '\f';
+    case 'v': return '\v';
+    case 'u':
+    case 'x':
+    case 'U': {
+      // consume hex digits; emit '?' for non-ASCII (ValueText is only
+      // fed to normalization, which strips non-alpha anyway)
+      unsigned int value = 0;
+      int count = 0;
+      while (*i < s.size() && HexDigit(s[*i]) && count < (c == 'U' ? 8 : 4)) {
+        value = value * 16 + (Digit(s[*i]) ? s[*i] - '0'
+                                           : (std::tolower(s[*i]) - 'a' + 10));
+        ++*i;
+        ++count;
+      }
+      return value < 0x80 ? static_cast<char>(value) : '?';
+    }
+    default: return c;  // \\ \' \" and unknown escapes
+  }
+}
+
+}  // namespace
+
+bool IsCsKeyword(std::string_view word) { return kCsKeywords.count(word) > 0; }
+
+CsLexOutput CsLex(std::string_view src) {
+  CsLexOutput out;
+  size_t i = 0;
+  const size_t n = src.size();
+  // skip a UTF-8 BOM
+  if (n >= 3 && src.compare(0, 3, "\xEF\xBB\xBF") == 0) i = 3;
+  bool at_line_start = true;
+
+  auto push = [&](CsTok k, size_t start, size_t end, std::string value) {
+    out.tokens.push_back(CsToken{k, src.substr(start, end - start),
+                                 std::move(value), static_cast<int>(start),
+                                 static_cast<int>(end)});
+  };
+
+  while (i < n) {
+    char c = src[i];
+    if (c == '\n') {
+      at_line_start = true;
+      ++i;
+      continue;
+    }
+    if (c == ' ' || c == '\t' || c == '\r' || c == '\f') {
+      ++i;
+      continue;
+    }
+    // preprocessor directive: drop the line (approximation — both arms
+    // of #if/#else stay in the token stream)
+    if (c == '#' && at_line_start) {
+      while (i < n && src[i] != '\n') ++i;
+      continue;
+    }
+    at_line_start = false;
+    // comments (retained for comment contexts)
+    if (c == '/' && i + 1 < n && src[i + 1] == '/') {
+      size_t start = i;
+      bool doc = i + 2 < n && src[i + 2] == '/' &&
+                 !(i + 3 < n && src[i + 3] == '/');  // exactly ///
+      while (i < n && src[i] != '\n') ++i;
+      out.comments.push_back(CsComment{doc ? 2 : 0,
+                                       src.substr(start, i - start),
+                                       static_cast<int>(start)});
+      continue;
+    }
+    if (c == '/' && i + 1 < n && src[i + 1] == '*') {
+      size_t start = i;
+      i += 2;
+      while (i + 1 < n && !(src[i] == '*' && src[i + 1] == '/')) ++i;
+      if (i + 1 >= n) throw CsLexError("unterminated comment");
+      i += 2;
+      out.comments.push_back(CsComment{1, src.substr(start, i - start),
+                                       static_cast<int>(start)});
+      continue;
+    }
+    // verbatim / interpolated strings
+    if ((c == '@' || c == '$') && i + 1 < n) {
+      bool verbatim = false, interpolated = false;
+      size_t j = i;
+      while (j < n && (src[j] == '@' || src[j] == '$')) {
+        verbatim |= src[j] == '@';
+        interpolated |= src[j] == '$';
+        ++j;
+      }
+      if (j < n && src[j] == '"') {
+        size_t start = i;
+        i = j + 1;
+        std::string value;
+        if (verbatim) {
+          while (i < n) {
+            if (src[i] == '"') {
+              if (i + 1 < n && src[i + 1] == '"') {
+                value.push_back('"');
+                i += 2;
+                continue;
+              }
+              break;
+            }
+            value.push_back(src[i]);
+            ++i;
+          }
+          if (i >= n) throw CsLexError("unterminated verbatim string");
+          ++i;
+        } else {
+          while (i < n && src[i] != '"') {
+            if (src[i] == '\\' && i + 1 < n) {
+              ++i;
+              value.push_back(UnescapeChar(src, &i));
+            } else if (src[i] == '\n') {
+              throw CsLexError("newline in string");
+            } else {
+              value.push_back(src[i]);
+              ++i;
+            }
+          }
+          if (i >= n) throw CsLexError("unterminated string");
+          ++i;
+        }
+        (void)interpolated;  // single-token approximation of $-strings
+        push(CsTok::kString, start, i, std::move(value));
+        continue;
+      }
+      if (c == '@' && j < n && IdentStart(src[j])) {
+        // @identifier: ValueText drops the @
+        size_t start = i;
+        i = j;
+        size_t id_start = i;
+        while (i < n && IdentPart(src[i])) ++i;
+        push(CsTok::kIdent, start, i,
+             std::string(src.substr(id_start, i - id_start)));
+        continue;
+      }
+      if (c == '$') throw CsLexError("stray $");
+      // fall through for bare '@' (invalid)
+      throw CsLexError("stray @");
+    }
+    if (IdentStart(c)) {
+      size_t start = i;
+      while (i < n && IdentPart(src[i])) ++i;
+      push(CsTok::kIdent, start, i, std::string(src.substr(start, i - start)));
+      continue;
+    }
+    if (Digit(c) || (c == '.' && i + 1 < n && Digit(src[i + 1]))) {
+      size_t start = i;
+      if (c == '0' && i + 1 < n && (src[i + 1] == 'x' || src[i + 1] == 'X')) {
+        i += 2;
+        while (i < n && (HexDigit(src[i]) || src[i] == '_')) ++i;
+      } else if (c == '0' && i + 1 < n &&
+                 (src[i + 1] == 'b' || src[i + 1] == 'B')) {
+        i += 2;
+        while (i < n && (src[i] == '0' || src[i] == '1' || src[i] == '_')) ++i;
+      } else {
+        while (i < n && (Digit(src[i]) || src[i] == '_')) ++i;
+        if (i < n && src[i] == '.' && i + 1 < n && Digit(src[i + 1])) {
+          ++i;
+          while (i < n && (Digit(src[i]) || src[i] == '_')) ++i;
+        }
+        if (i < n && (src[i] == 'e' || src[i] == 'E')) {
+          ++i;
+          if (i < n && (src[i] == '+' || src[i] == '-')) ++i;
+          while (i < n && Digit(src[i])) ++i;
+        }
+      }
+      // suffixes: u/l/ul/lu/f/d/m in any case
+      while (i < n && std::strchr("uUlLfFdDmM", src[i]) != nullptr) ++i;
+      push(CsTok::kNumeric, start, i,
+           std::string(src.substr(start, i - start)));
+      continue;
+    }
+    if (c == '\'') {
+      size_t start = i;
+      ++i;
+      std::string value;
+      while (i < n && src[i] != '\'') {
+        if (src[i] == '\\' && i + 1 < n) {
+          ++i;
+          value.push_back(UnescapeChar(src, &i));
+        } else {
+          value.push_back(src[i]);
+          ++i;
+        }
+      }
+      if (i >= n) throw CsLexError("unterminated char literal");
+      ++i;
+      push(CsTok::kChar, start, i, std::move(value));
+      continue;
+    }
+    if (c == '"') {
+      size_t start = i;
+      ++i;
+      std::string value;
+      while (i < n && src[i] != '"') {
+        if (src[i] == '\\' && i + 1 < n) {
+          ++i;
+          value.push_back(UnescapeChar(src, &i));
+        } else if (src[i] == '\n') {
+          throw CsLexError("newline in string");
+        } else {
+          value.push_back(src[i]);
+          ++i;
+        }
+      }
+      if (i >= n) throw CsLexError("unterminated string");
+      ++i;
+      push(CsTok::kString, start, i, std::move(value));
+      continue;
+    }
+    {
+      size_t start = i;
+      size_t matched = 1;
+      for (std::string_view p : kPunctMulti) {
+        if (p.size() > 1 && src.compare(i, p.size(), p) == 0) {
+          matched = p.size();
+          break;
+        }
+      }
+      static const std::string_view kSingles = "(){}[];,.@?:~!<>=+-*/&|^%$#";
+      if (matched == 1 && kSingles.find(c) == std::string_view::npos) {
+        throw CsLexError(std::string("unexpected character `") + c + "`");
+      }
+      i += matched;
+      push(CsTok::kPunct, start, i,
+           std::string(src.substr(start, matched)));
+      continue;
+    }
+  }
+  out.tokens.push_back(CsToken{CsTok::kEof, src.substr(n, 0), "",
+                               static_cast<int>(n), static_cast<int>(n)});
+  return out;
+}
+
+}  // namespace c2v

@@ -1,0 +1,60 @@
+// C# lexer for the native path-context extractor (C# pipeline).
+//
+// Differences from the Java lexer: verbatim strings (@"..." with ""
+// escapes), interpolated strings ($"..." lexed as single string tokens —
+// documented divergence from Roslyn's InterpolatedStringExpression),
+// @identifiers, numeric suffixes (u/l/ul/f/d/m), preprocessor directive
+// lines (dropped), and comments are RETAINED (the reference emits
+// comment contexts per method, Extractor.cs:204-218).
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace c2v {
+
+enum class CsTok : uint8_t {
+  kEof,
+  kIdent,    // identifier or keyword (text distinguishes; @id has value
+             // without the @)
+  kNumeric,  // NumericLiteralToken (int or real, any suffix)
+  kString,   // StringLiteralToken (incl. verbatim/interpolated)
+  kChar,     // CharacterLiteralToken
+  kPunct,
+};
+
+struct CsToken {
+  CsTok kind = CsTok::kEof;
+  std::string_view text;  // raw source spelling
+  std::string value;      // ValueText: unquoted/unescaped for literals,
+                          // @-stripped for identifiers
+  int pos = 0;
+  int end = 0;
+};
+
+struct CsComment {
+  // kinds mirror Roslyn trivia: 0 = single-line (//), 1 = multi-line
+  // (/* */ and /** */), 2 = single-line doc (///) — excluded from
+  // comment contexts like Roslyn's SingleLineDocumentationCommentTrivia.
+  int kind = 0;
+  std::string_view text;  // raw, including the // or /* */ delimiters
+  int pos = 0;
+};
+
+struct CsLexError : std::runtime_error {
+  explicit CsLexError(const std::string& m) : std::runtime_error(m) {}
+};
+
+struct CsLexOutput {
+  std::vector<CsToken> tokens;
+  std::vector<CsComment> comments;  // source order
+};
+
+CsLexOutput CsLex(std::string_view source);
+
+bool IsCsKeyword(std::string_view word);
+
+}  // namespace c2v

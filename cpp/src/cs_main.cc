@@ -1,0 +1,148 @@
+// c2v-extract-cs: native C# path-context extractor.
+//
+// CLI-compatible with the reference's dotnet Options (Utilities.cs:11-33,
+// Program.cs:21-55):
+//   c2v-extract-cs --path <file-or-dir> [--max_length 9] [--max_width 2]
+//       [--max_contexts 30000] [--threads N] [--no_hash]
+//       [--ofile_name OUT]
+// Writes to stdout unless --ofile_name is given (append, like the
+// reference's StreamWriter(append: true)). Unparseable files are
+// reported on stderr and skipped.
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <filesystem>
+#include <fstream>
+#include <iostream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "cs_extract.h"
+
+namespace fs = std::filesystem;
+
+namespace {
+
+struct Args {
+  std::string path;
+  std::string ofile_name;
+  c2v::CsExtractOptions options;
+  int threads = 1;  // Options default (Utilities.cs:13-14)
+};
+
+bool ParseArgs(int argc, char** argv, Args* args) {
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto need_value = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        std::cerr << a << " requires a value\n";
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--path" || a == "-p") args->path = need_value();
+    else if (a == "--max_length" || a == "-l") args->options.max_length = std::atoi(need_value());
+    else if (a == "--max_width") args->options.max_width = std::atoi(need_value());
+    else if (a == "--max_contexts") args->options.max_contexts = std::atoi(need_value());
+    else if (a == "--threads" || a == "-t") args->threads = std::atoi(need_value());
+    else if (a == "--no_hash" || a == "-h") args->options.no_hash = true;
+    else if (a == "--ofile_name" || a == "-o") args->ofile_name = need_value();
+    else {
+      std::cerr << "unknown flag: " << a << "\n";
+      return false;
+    }
+  }
+  if (args->path.empty()) {
+    std::cerr << "--path is required\n";
+    return false;
+  }
+  return true;
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << in.rdbuf();
+  return ss.str();
+}
+
+std::mutex g_out_mutex;
+
+void ProcessFile(const std::string& path, const c2v::CsExtractOptions& options,
+                 std::ostream& out) {
+  std::vector<std::string> lines;
+  try {
+    lines = c2v::CsExtractFromSource(ReadFile(path), options);
+  } catch (const std::exception& e) {
+    std::lock_guard<std::mutex> lock(g_out_mutex);
+    std::cerr << "failed to extract " << path << ": " << e.what() << "\n";
+    return;
+  }
+  if (lines.empty()) return;
+  std::string block;
+  for (const std::string& line : lines) {
+    block += line;
+    block += "\n";
+  }
+  std::lock_guard<std::mutex> lock(g_out_mutex);
+  out << block;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args args;
+  if (!ParseArgs(argc, argv, &args)) return 2;
+
+  std::ofstream file_out;
+  if (!args.ofile_name.empty()) {
+    file_out.open(args.ofile_name, std::ios::app);
+    if (!file_out) {
+      std::cerr << "cannot open output file " << args.ofile_name << "\n";
+      return 1;
+    }
+  }
+  std::ostream& out = args.ofile_name.empty() ? std::cout : file_out;
+
+  std::vector<std::string> files;
+  std::error_code ec;
+  if (fs::is_directory(args.path, ec)) {
+    for (auto it = fs::recursive_directory_iterator(
+             args.path, fs::directory_options::skip_permission_denied, ec);
+         it != fs::recursive_directory_iterator(); it.increment(ec)) {
+      if (ec) break;
+      if (!it->is_regular_file(ec)) continue;
+      std::string ext = it->path().extension().string();
+      std::transform(ext.begin(), ext.end(), ext.begin(),
+                     [](unsigned char c) { return std::tolower(c); });
+      if (ext == ".cs") files.push_back(it->path().string());
+    }
+  } else {
+    files.push_back(args.path);
+  }
+
+  std::atomic<size_t> next{0};
+  int n_threads = std::max(1, args.threads);
+  std::vector<std::thread> workers;
+  for (int t = 1; t < n_threads; ++t) {
+    workers.emplace_back([&]() {
+      while (true) {
+        size_t i = next.fetch_add(1);
+        if (i >= files.size()) return;
+        ProcessFile(files[i], args.options, out);
+      }
+    });
+  }
+  while (true) {
+    size_t i = next.fetch_add(1);
+    if (i >= files.size()) break;
+    ProcessFile(files[i], args.options, out);
+  }
+  for (auto& w : workers) w.join();
+  return 0;
+}

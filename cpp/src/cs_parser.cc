@@ -1,0 +1,1800 @@
+#include "cs_parser.h"
+
+#include <unordered_set>
+
+namespace c2v {
+
+namespace {
+
+const std::unordered_set<std::string_view> kPredefinedTypes = {
+    "bool", "byte", "sbyte", "short", "ushort", "int", "uint", "long",
+    "ulong", "float", "double", "decimal", "char", "string", "object",
+    "void",
+};
+
+const std::unordered_set<std::string_view> kModifiers = {
+    "public", "private", "protected", "internal", "static", "sealed",
+    "abstract", "virtual", "override", "readonly", "const", "volatile",
+    "extern", "unsafe", "new", "partial", "async", "ref",
+};
+
+bool IsAssignPunct(std::string_view t) {
+  return t == "=" || t == "+=" || t == "-=" || t == "*=" || t == "/=" ||
+         t == "%=" || t == "&=" || t == "|=" || t == "^=" || t == "<<=" ||
+         t == "?\?=";
+}
+
+std::string AssignKind(std::string_view t) {
+  if (t == "=") return "SimpleAssignmentExpression";
+  if (t == "+=") return "AddAssignmentExpression";
+  if (t == "-=") return "SubtractAssignmentExpression";
+  if (t == "*=") return "MultiplyAssignmentExpression";
+  if (t == "/=") return "DivideAssignmentExpression";
+  if (t == "%=") return "ModuloAssignmentExpression";
+  if (t == "&=") return "AndAssignmentExpression";
+  if (t == "|=") return "OrAssignmentExpression";
+  if (t == "^=") return "ExclusiveOrAssignmentExpression";
+  if (t == "<<=") return "LeftShiftAssignmentExpression";
+  if (t == "?\?=") return "CoalesceAssignmentExpression";
+  return "RightShiftAssignmentExpression";
+}
+
+class Parser {
+ public:
+  Parser(std::string_view src, CsArena* arena)
+      : arena_(arena), lexed_(CsLex(src)) {}
+
+  CsParseResult Parse() {
+    CsParseResult result;
+    result.root = ParseCompilationUnit();
+    result.comments = std::move(lexed_.comments);
+    return result;
+  }
+
+ private:
+  using Tok = CsTok;
+  // ------------------------------------------------------------ tokens
+  const CsToken& Cur() const { return lexed_.tokens[p_]; }
+  const CsToken& LookAhead(size_t k) const {
+    size_t i = p_ + k;
+    return lexed_.tokens[i < lexed_.tokens.size() ? i
+                                                  : lexed_.tokens.size() - 1];
+  }
+  bool AtEof() const { return Cur().kind == Tok::kEof; }
+  int Pos() const { return Cur().pos; }
+  int PrevEnd() const { return p_ > 0 ? lexed_.tokens[p_ - 1].end : 0; }
+  void Next() { if (p_ + 1 < lexed_.tokens.size()) ++p_; }
+  bool Is(std::string_view t) const {
+    return Cur().kind == Tok::kPunct && Cur().text == t;
+  }
+  bool IsKw(std::string_view t) const {
+    return Cur().kind == Tok::kIdent && Cur().text == t;
+  }
+  bool IsIdent() const {
+    return Cur().kind == Tok::kIdent && !IsCsKeyword(Cur().text);
+  }
+  bool Accept(std::string_view t) {
+    if (Is(t)) { Next(); return true; }
+    return false;
+  }
+  bool AcceptKw(std::string_view t) {
+    if (IsKw(t)) { Next(); return true; }
+    return false;
+  }
+  void Expect(std::string_view t) {
+    if (!Accept(t)) Fail(std::string("expected `") + std::string(t) + "`");
+  }
+  void ExpectKw(std::string_view t) {
+    if (!AcceptKw(t)) Fail(std::string("expected `") + std::string(t) + "`");
+  }
+  [[noreturn]] void Fail(const std::string& why) const {
+    throw CsParseError(why + " at offset " + std::to_string(Pos()) +
+                       " (token `" + std::string(Cur().text) + "`)");
+  }
+  CsNode* New(const char* kind, int begin) {
+    CsNode* n = arena_->New(kind);
+    n->begin = begin;
+    return n;
+  }
+  CsNode* Finish(CsNode* n) {
+    n->end = PrevEnd();
+    return n;
+  }
+  bool GtRun(size_t count, bool then_eq) const {
+    for (size_t k = 0; k < count; ++k) {
+      const CsToken& t = LookAhead(k);
+      if (!(t.kind == Tok::kPunct && t.text == ">")) return false;
+      if (k > 0 && LookAhead(k - 1).end != t.pos) return false;
+    }
+    if (then_eq) {
+      const CsToken& t = LookAhead(count);
+      return t.kind == Tok::kPunct && t.text == "=" &&
+             LookAhead(count - 1).end == t.pos;
+    }
+    return true;
+  }
+
+  // Attaches the current token (must be an identifier) to `node`.
+  void AttachIdent(CsNode* node) {
+    if (!IsIdent()) Fail("expected identifier");
+    int id = arena_->NewToken(Cur().value, Tok::kIdent, Pos());
+    CsAttach(arena_, node, id);
+    Next();
+  }
+
+  void AttachCurrentAs(CsNode* node, Tok kind) {
+    int id = arena_->NewToken(Cur().value, kind, Pos());
+    CsAttach(arena_, node, id);
+    Next();
+  }
+
+  // --------------------------------------------------------- names/types
+  // Simple name: IdentifierName or GenericName (with TypeArgumentList).
+  // In type contexts `<` is unconditionally a type-argument list; in
+  // expression contexts it needs the follow-set disambiguation (else
+  // `a < b` would misparse).
+  CsNode* ParseSimpleName(bool allow_generic = true,
+                          bool type_context = false) {
+    int begin = Pos();
+    if (!IsIdent()) Fail("expected name");
+    if (allow_generic && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "<" && TypeArgsAhead(1, !type_context)) {
+      CsNode* g = New("GenericName", begin);
+      AttachIdent(g);
+      CsAdopt(g, ParseTypeArgumentList());
+      return Finish(g);
+    }
+    CsNode* n = New("IdentifierName", begin);
+    AttachIdent(n);
+    return Finish(n);
+  }
+
+  CsNode* ParseTypeArgumentList() {
+    int begin = Pos();
+    Expect("<");
+    CsNode* list = New("TypeArgumentList", begin);
+    if (GtRun(1, false)) {  // open generic `<>`: OmittedTypeArgument
+      Next();
+      return Finish(list);
+    }
+    do {
+      CsAdopt(list, ParseType());
+    } while (Accept(","));
+    if (!Is(">")) Fail("expected `>`");
+    Next();
+    return Finish(list);
+  }
+
+  // Type grammar: (predefined | qualified name) rank-specifiers? `?`
+  CsNode* ParseType() {
+    int begin = Pos();
+    CsNode* t;
+    if (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text)) {
+      t = New("PredefinedType", begin);
+      AttachCurrentAs(t, Tok::kIdent);  // keyword token: leaf via parent
+      t->end = PrevEnd();
+    } else {
+      t = ParseSimpleName(true, /*type_context=*/true);
+      while (Is(".") ) {
+        // qualified name in type position
+        if (!(LookAhead(1).kind == Tok::kIdent &&
+              !IsCsKeyword(LookAhead(1).text)))
+          break;
+        Next();
+        CsNode* q = New("QualifiedName", begin);
+        CsAdopt(q, t);
+        CsAdopt(q, ParseSimpleName(true, /*type_context=*/true));
+        t = Finish(q);
+      }
+    }
+    if (Is("?") && !LambdaConditionalAmbiguity()) {
+      Next();
+      CsNode* nt = New("NullableType", begin);
+      CsAdopt(nt, t);
+      t = Finish(nt);
+    }
+    while (Is("[") && IsRankSpecifierAhead()) {
+      CsNode* at = New("ArrayType", begin);
+      CsAdopt(at, t);
+      while (Is("[") && IsRankSpecifierAhead()) {
+        CsAdopt(at, ParseRankSpecifier(/*allow_sizes=*/false));
+      }
+      t = Finish(at);
+    }
+    return t;
+  }
+
+  // In type context `?` always nullable; ambiguity only matters when
+  // ParseType is speculatively applied in expressions — handled by the
+  // try/backtrack wrapper, so no lookahead needed here.
+  bool LambdaConditionalAmbiguity() const { return false; }
+
+  bool IsRankSpecifierAhead() const {
+    // `[` followed by only commas then `]`
+    size_t k = 1;
+    while (LookAhead(k).kind == Tok::kPunct && LookAhead(k).text == ",") ++k;
+    return LookAhead(k).kind == Tok::kPunct && LookAhead(k).text == "]";
+  }
+
+  CsNode* ParseRankSpecifier(bool allow_sizes) {
+    int begin = Pos();
+    Expect("[");
+    CsNode* rank = New("ArrayRankSpecifier", begin);
+    if (!Is("]")) {
+      do {
+        if (Is(",") || Is("]")) {
+          CsAdopt(rank, Finish(New("OmittedArraySizeExpression", Pos())));
+        } else if (allow_sizes) {
+          CsAdopt(rank, ParseExpression());
+        } else {
+          Fail("unexpected rank size");
+        }
+      } while (Accept(","));
+    } else {
+      CsAdopt(rank, Finish(New("OmittedArraySizeExpression", Pos())));
+    }
+    Expect("]");
+    return Finish(rank);
+  }
+
+  // Does `<` at LookAhead(offset) start a plausible type-argument list?
+  // With require_follow (expression contexts) the token after the
+  // closing `>` must be one that cannot follow a comparison.
+  bool TypeArgsAhead(size_t offset, bool require_follow = true) const {
+    size_t k = offset + 1;
+    int depth = 1;
+    while (k < offset + 64) {
+      const CsToken& t = LookAhead(k);
+      if (t.kind == Tok::kEof) return false;
+      if (t.kind == Tok::kPunct) {
+        if (t.text == "<") ++depth;
+        else if (t.text == ">") {
+          --depth;
+          if (depth == 0) {
+            if (!require_follow) return true;
+            const CsToken& after = LookAhead(k + 1);
+            if (after.kind != Tok::kPunct) return false;
+            return after.text == "(" || after.text == ")" ||
+                   after.text == "]" || after.text == "}" ||
+                   after.text == ":" || after.text == ";" ||
+                   after.text == "," || after.text == "." ||
+                   after.text == "?" || after.text == "==" ||
+                   after.text == "!=" || after.text == "[" ||
+                   after.text == "{";
+          }
+        } else if (t.text == "(" || t.text == ")" || t.text == ";" ||
+                   t.text == "{" || t.text == "}" || t.text == "=" ||
+                   t.text == "&&" || t.text == "||") {
+          return false;
+        }
+      }
+      ++k;
+    }
+    return false;
+  }
+
+  // ---------------------------------------------------- compilation unit
+  CsNode* ParseCompilationUnit() {
+    CsNode* cu = New("CompilationUnit", Pos());
+    while (!AtEof()) {
+      if (IsKw("using") && !IsUsingStatementAhead()) {
+        CsAdopt(cu, ParseUsingDirective());
+      } else if (IsKw("namespace")) {
+        CsAdopt(cu, ParseNamespace());
+      } else if (Accept(";")) {
+        continue;
+      } else {
+        CsAdopt(cu, ParseTypeOrMember(/*top_level=*/true));
+      }
+    }
+    return Finish(cu);
+  }
+
+  bool IsUsingStatementAhead() const {
+    // top level `using` is always a directive
+    return false;
+  }
+
+  CsNode* ParseUsingDirective() {
+    int begin = Pos();
+    ExpectKw("using");
+    CsNode* u = New("UsingDirective", begin);
+    AcceptKw("static");
+    // alias `using A = B.C;`
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "=") {
+      int nb = Pos();
+      CsNode* ne = New("NameEquals", nb);
+      CsAdopt(ne, ParseSimpleName(/*allow_generic=*/false));
+      Finish(ne);
+      CsAdopt(u, ne);
+      Expect("=");
+    }
+    CsAdopt(u, ParseType());
+    Expect(";");
+    return Finish(u);
+  }
+
+  CsNode* ParseNamespace() {
+    int begin = Pos();
+    ExpectKw("namespace");
+    CsNode* ns = New("NamespaceDeclaration", begin);
+    CsAdopt(ns, ParseNamespaceName());
+    Expect("{");
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated namespace");
+      if (IsKw("using")) CsAdopt(ns, ParseUsingDirective());
+      else if (IsKw("namespace")) CsAdopt(ns, ParseNamespace());
+      else if (Accept(";")) continue;
+      else CsAdopt(ns, ParseTypeOrMember(true));
+    }
+    return Finish(ns);
+  }
+
+  CsNode* ParseNamespaceName() {
+    int begin = Pos();
+    CsNode* n = New("IdentifierName", begin);
+    AttachIdent(n);
+    Finish(n);
+    while (Accept(".")) {
+      CsNode* q = New("QualifiedName", begin);
+      CsAdopt(q, n);
+      CsNode* right = New("IdentifierName", Pos());
+      AttachIdent(right);
+      Finish(right);
+      CsAdopt(q, right);
+      n = Finish(q);
+    }
+    return n;
+  }
+
+  std::vector<CsNode*> ParseAttributeLists() {
+    std::vector<CsNode*> lists;
+    while (Is("[")) {
+      // distinguish from indexer access — attributes appear only where
+      // this is called (declaration positions)
+      int begin = Pos();
+      Next();
+      CsNode* list = New("AttributeList", begin);
+      // optional target `[return: ...]`
+      if (Cur().kind == Tok::kIdent && LookAhead(1).kind == Tok::kPunct &&
+          LookAhead(1).text == ":") {
+        Next();
+        Next();
+      }
+      do {
+        int ab = Pos();
+        CsNode* attr = New("Attribute", ab);
+        CsAdopt(attr, ParseTypeNameForAttribute());
+        if (Is("(")) {
+          int alb = Pos();
+          Next();
+          CsNode* args = New("AttributeArgumentList", alb);
+          if (!Is(")")) {
+            do {
+              int aab = Pos();
+              CsNode* arg = New("AttributeArgument", aab);
+              if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+                  LookAhead(1).text == "=") {
+                CsNode* ne = New("NameEquals", Pos());
+                CsAdopt(ne, ParseSimpleName(false));
+                Finish(ne);
+                CsAdopt(arg, ne);
+                Next();  // '='
+              }
+              CsAdopt(arg, ParseExpression());
+              Finish(arg);
+              CsAdopt(args, arg);
+            } while (Accept(","));
+          }
+          Expect(")");
+          Finish(args);
+          CsAdopt(attr, args);
+        }
+        Finish(attr);
+        CsAdopt(list, attr);
+      } while (Accept(","));
+      Expect("]");
+      Finish(list);
+      lists.push_back(list);
+    }
+    return lists;
+  }
+
+  CsNode* ParseTypeNameForAttribute() {
+    int begin = Pos();
+    CsNode* n = ParseSimpleName(false);
+    while (Is(".")) {
+      Next();
+      CsNode* q = New("QualifiedName", begin);
+      CsAdopt(q, n);
+      CsAdopt(q, ParseSimpleName(false));
+      n = Finish(q);
+    }
+    return n;
+  }
+
+  void SkipModifiers() {
+    while (Cur().kind == Tok::kIdent && kModifiers.count(Cur().text)) {
+      // `new` as modifier only before member declarations; at statement
+      // level this function is never called
+      Next();
+    }
+  }
+
+  // type declarations and members share modifier/attribute prefixes
+  CsNode* ParseTypeOrMember(bool top_level) {
+    int begin = Pos();
+    std::vector<CsNode*> attrs = ParseAttributeLists();
+    SkipModifiers();
+    if (IsKw("class") || IsKw("struct") || IsKw("interface"))
+      return ParseTypeDeclaration(begin, attrs);
+    if (IsKw("enum")) return ParseEnumDeclaration(begin, attrs);
+    if (IsKw("delegate")) return ParseDelegateDeclaration(begin, attrs);
+    if (top_level) Fail("expected type declaration");
+    return ParseMemberRest(begin, attrs);
+  }
+
+  CsNode* ParseTypeDeclaration(int begin, std::vector<CsNode*>& attrs) {
+    const char* kind = IsKw("class") ? "ClassDeclaration"
+                       : IsKw("struct") ? "StructDeclaration"
+                                        : "InterfaceDeclaration";
+    Next();
+    CsNode* decl = New(kind, begin);
+    for (CsNode* a : attrs) CsAdopt(decl, a);
+    std::string name = Cur().value;
+    AttachIdent(decl);
+    if (Is("<")) CsAdopt(decl, ParseTypeParameterList());
+    if (Accept(":")) {
+      int bb = Pos();
+      CsNode* bases = New("BaseList", bb);
+      do {
+        int sb = Pos();
+        CsNode* base = New("SimpleBaseType", sb);
+        CsAdopt(base, ParseType());
+        Finish(base);
+        CsAdopt(bases, base);
+      } while (Accept(","));
+      Finish(bases);
+      CsAdopt(decl, bases);
+    }
+    while (IsKw("where")) CsAdopt(decl, ParseConstraintClause());
+    Expect("{");
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated type body");
+      if (Accept(";")) continue;
+      CsAdopt(decl, ParseTypeOrMember(false));
+    }
+    Accept(";");
+    enclosing_type_names_.push_back(name);
+    enclosing_type_names_.pop_back();  // kept simple: name used below only
+    return Finish(decl);
+  }
+
+  CsNode* ParseTypeParameterList() {
+    int begin = Pos();
+    Expect("<");
+    CsNode* list = New("TypeParameterList", begin);
+    do {
+      AcceptKw("in");
+      AcceptKw("out");
+      int tb = Pos();
+      CsNode* tp = New("TypeParameter", tb);
+      AttachIdent(tp);
+      Finish(tp);
+      CsAdopt(list, tp);
+    } while (Accept(","));
+    if (!Is(">")) Fail("expected `>`");
+    Next();
+    return Finish(list);
+  }
+
+  CsNode* ParseConstraintClause() {
+    int begin = Pos();
+    ExpectKw("where");
+    CsNode* clause = New("TypeParameterConstraintClause", begin);
+    CsAdopt(clause, ParseSimpleName(false));
+    Expect(":");
+    do {
+      int cb = Pos();
+      if (AcceptKw("new")) {
+        Expect("(");
+        Expect(")");
+        CsAdopt(clause, Finish(New("ConstructorConstraint", cb)));
+      } else if (AcceptKw("class")) {
+        CsAdopt(clause, Finish(New("ClassConstraint", cb)));
+      } else if (AcceptKw("struct")) {
+        CsAdopt(clause, Finish(New("StructConstraint", cb)));
+      } else {
+        CsNode* tc = New("TypeConstraint", cb);
+        CsAdopt(tc, ParseType());
+        CsAdopt(clause, Finish(tc));
+      }
+    } while (Accept(","));
+    return Finish(clause);
+  }
+
+  CsNode* ParseEnumDeclaration(int begin, std::vector<CsNode*>& attrs) {
+    Next();  // enum
+    CsNode* decl = New("EnumDeclaration", begin);
+    for (CsNode* a : attrs) CsAdopt(decl, a);
+    AttachIdent(decl);
+    if (Accept(":")) {
+      int bb = Pos();
+      CsNode* bases = New("BaseList", bb);
+      CsNode* base = New("SimpleBaseType", Pos());
+      CsAdopt(base, ParseType());
+      Finish(base);
+      CsAdopt(bases, base);
+      Finish(bases);
+      CsAdopt(decl, bases);
+    }
+    Expect("{");
+    while (!Is("}")) {
+      int mb = Pos();
+      std::vector<CsNode*> mattrs = ParseAttributeLists();
+      CsNode* member = New("EnumMemberDeclaration", mb);
+      for (CsNode* a : mattrs) CsAdopt(member, a);
+      AttachIdent(member);
+      if (Accept("=")) {
+        int eb = Pos();
+        CsNode* ev = New("EqualsValueClause", eb);
+        CsAdopt(ev, ParseExpression());
+        Finish(ev);
+        CsAdopt(member, ev);
+      }
+      Finish(member);
+      CsAdopt(decl, member);
+      if (!Accept(",")) break;
+    }
+    Expect("}");
+    Accept(";");
+    return Finish(decl);
+  }
+
+  CsNode* ParseDelegateDeclaration(int begin, std::vector<CsNode*>& attrs) {
+    Next();  // delegate
+    CsNode* decl = New("DelegateDeclaration", begin);
+    for (CsNode* a : attrs) CsAdopt(decl, a);
+    CsAdopt(decl, ParseReturnType());
+    AttachIdent(decl);
+    if (Is("<")) CsAdopt(decl, ParseTypeParameterList());
+    CsAdopt(decl, ParseParameterList());
+    while (IsKw("where")) CsAdopt(decl, ParseConstraintClause());
+    Expect(";");
+    return Finish(decl);
+  }
+
+  CsNode* ParseReturnType() {
+    if (IsKw("void")) {
+      int begin = Pos();
+      CsNode* t = New("PredefinedType", begin);
+      AttachCurrentAs(t, Tok::kIdent);
+      return Finish(t);
+    }
+    return ParseType();
+  }
+
+  // member after attributes/modifiers: method/ctor/property/field/etc.
+  CsNode* ParseMemberRest(int begin, std::vector<CsNode*>& attrs) {
+    // destructor `~Name() {}`
+    if (Is("~")) {
+      Next();
+      CsNode* d = New("DestructorDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(d, a);
+      AttachIdent(d);
+      CsAdopt(d, ParseParameterList());
+      CsAdopt(d, ParseBlock());
+      return Finish(d);
+    }
+    // constructor: `Name (` where Name is an identifier
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "(") {
+      CsNode* ctor = New("ConstructorDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(ctor, a);
+      AttachIdent(ctor);
+      CsAdopt(ctor, ParseParameterList());
+      if (Accept(":")) {
+        int ib = Pos();
+        const char* kind = IsKw("base") ? "BaseConstructorInitializer"
+                                        : "ThisConstructorInitializer";
+        Next();
+        CsNode* init = New(kind, ib);
+        CsAdopt(init, ParseArgumentList());
+        Finish(init);
+        CsAdopt(ctor, init);
+      }
+      if (Is("{")) CsAdopt(ctor, ParseBlock());
+      else {
+        if (Accept("=>")) {
+          int ab = Pos();
+          CsNode* arrow = New("ArrowExpressionClause", ab);
+          CsAdopt(arrow, ParseExpression());
+          Finish(arrow);
+          CsAdopt(ctor, arrow);
+        }
+        Expect(";");
+      }
+      return Finish(ctor);
+    }
+    // event field: `event Type name;`
+    if (IsKw("event")) {
+      Next();
+      CsNode* ev = New("EventFieldDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(ev, a);
+      CsAdopt(ev, ParseVariableDeclaration());
+      Expect(";");
+      return Finish(ev);
+    }
+    // operator declarations: `Type operator +(...)` / conversion ops
+    if (IsKw("implicit") || IsKw("explicit")) {
+      Next();
+      ExpectKw("operator");
+      CsNode* op = New("ConversionOperatorDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(op, a);
+      CsAdopt(op, ParseType());
+      CsAdopt(op, ParseParameterList());
+      if (Is("{")) CsAdopt(op, ParseBlock());
+      else { MaybeArrowBody(op); Expect(";"); }
+      return Finish(op);
+    }
+    CsNode* type = ParseReturnType();
+    if (IsKw("operator")) {
+      Next();
+      CsNode* op = New("OperatorDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(op, a);
+      CsAdopt(op, type);
+      if (Cur().kind == Tok::kPunct) Next();  // the operator symbol
+      CsAdopt(op, ParseParameterList());
+      if (Is("{")) CsAdopt(op, ParseBlock());
+      else { MaybeArrowBody(op); Expect(";"); }
+      return Finish(op);
+    }
+    // indexer: `Type this[...]`
+    if (IsKw("this")) {
+      Next();
+      CsNode* idx = New("IndexerDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(idx, a);
+      CsAdopt(idx, type);
+      CsAdopt(idx, ParseBracketedParameterList());
+      CsAdopt(idx, ParseAccessorListOrArrow());
+      return Finish(idx);
+    }
+    if (!IsIdent()) Fail("expected member name");
+    // method: name possibly generic, then `(`
+    size_t la = 1;
+    bool generic = LookAhead(1).kind == Tok::kPunct &&
+                   LookAhead(1).text == "<" && TypeArgsAhead(1);
+    if (generic) {
+      // find the matching `>` then check `(`
+      size_t k = 2;
+      int depth = 1;
+      while (depth > 0) {
+        const CsToken& t = LookAhead(k);
+        if (t.kind == Tok::kEof) break;
+        if (t.kind == Tok::kPunct && t.text == "<") ++depth;
+        if (t.kind == Tok::kPunct && t.text == ">") --depth;
+        ++k;
+      }
+      la = k;
+    }
+    bool is_method = LookAhead(la).kind == Tok::kPunct &&
+                     LookAhead(la).text == "(";
+    if (is_method) {
+      CsNode* m = New("MethodDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(m, a);
+      CsAdopt(m, type);
+      AttachIdent(m);
+      if (Is("<")) CsAdopt(m, ParseTypeParameterList());
+      CsAdopt(m, ParseParameterList());
+      while (IsKw("where")) CsAdopt(m, ParseConstraintClause());
+      if (Is("{")) {
+        CsAdopt(m, ParseBlock());
+      } else {
+        MaybeArrowBody(m);
+        Expect(";");
+      }
+      return Finish(m);
+    }
+    // property: name then `{` or `=>`
+    if (LookAhead(1).kind == Tok::kPunct &&
+        (LookAhead(1).text == "{" || LookAhead(1).text == "=>")) {
+      CsNode* prop = New("PropertyDeclaration", begin);
+      for (CsNode* a : attrs) CsAdopt(prop, a);
+      CsAdopt(prop, type);
+      AttachIdent(prop);
+      CsAdopt(prop, ParseAccessorListOrArrow());
+      if (Accept("=")) {  // auto-property initializer
+        int eb = Pos();
+        CsNode* ev = New("EqualsValueClause", eb);
+        CsAdopt(ev, ParseExpression());
+        Finish(ev);
+        CsAdopt(prop, ev);
+        Expect(";");
+      }
+      return Finish(prop);
+    }
+    // field: declarators
+    CsNode* f = New("FieldDeclaration", begin);
+    for (CsNode* a : attrs) CsAdopt(f, a);
+    CsAdopt(f, ParseVariableDeclarationWithType(type, begin));
+    Expect(";");
+    return Finish(f);
+  }
+
+  void MaybeArrowBody(CsNode* owner) {
+    if (Accept("=>")) {
+      int ab = Pos();
+      CsNode* arrow = New("ArrowExpressionClause", ab);
+      CsAdopt(arrow, ParseExpression());
+      Finish(arrow);
+      CsAdopt(owner, arrow);
+    }
+  }
+
+  CsNode* ParseAccessorListOrArrow() {
+    int begin = Pos();
+    if (Is("=>")) {
+      Next();
+      CsNode* arrow = New("ArrowExpressionClause", begin);
+      CsAdopt(arrow, ParseExpression());
+      Finish(arrow);
+      Expect(";");
+      return arrow;
+    }
+    Expect("{");
+    CsNode* list = New("AccessorList", begin);
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated accessor list");
+      int ab = Pos();
+      std::vector<CsNode*> attrs = ParseAttributeLists();
+      SkipModifiers();
+      const char* kind = "UnknownAccessorDeclaration";
+      if (AcceptKw("get")) kind = "GetAccessorDeclaration";
+      else if (AcceptKw("set")) kind = "SetAccessorDeclaration";
+      else if (AcceptKw("add")) kind = "AddAccessorDeclaration";
+      else if (AcceptKw("remove")) kind = "RemoveAccessorDeclaration";
+      else Fail("expected accessor");
+      CsNode* acc = New(kind, ab);
+      for (CsNode* a : attrs) CsAdopt(acc, a);
+      if (Is("{")) CsAdopt(acc, ParseBlock());
+      else if (Is("=>")) { MaybeArrowBody(acc); Expect(";"); }
+      else Expect(";");
+      Finish(acc);
+      CsAdopt(list, acc);
+    }
+    return Finish(list);
+  }
+
+  CsNode* ParseParameterList() {
+    int begin = Pos();
+    Expect("(");
+    CsNode* list = New("ParameterList", begin);
+    if (!Is(")")) {
+      do {
+        CsAdopt(list, ParseParameter());
+      } while (Accept(","));
+    }
+    Expect(")");
+    return Finish(list);
+  }
+
+  CsNode* ParseBracketedParameterList() {
+    int begin = Pos();
+    Expect("[");
+    CsNode* list = New("BracketedParameterList", begin);
+    if (!Is("]")) {
+      do {
+        CsAdopt(list, ParseParameter());
+      } while (Accept(","));
+    }
+    Expect("]");
+    return Finish(list);
+  }
+
+  CsNode* ParseParameter() {
+    int begin = Pos();
+    std::vector<CsNode*> attrs = ParseAttributeLists();
+    while (IsKw("ref") || IsKw("out") || IsKw("in") || IsKw("params") ||
+           IsKw("this")) {
+      Next();
+    }
+    CsNode* p = New("Parameter", begin);
+    for (CsNode* a : attrs) CsAdopt(p, a);
+    CsAdopt(p, ParseType());
+    AttachIdent(p);
+    if (Accept("=")) {
+      int eb = Pos();
+      CsNode* ev = New("EqualsValueClause", eb);
+      CsAdopt(ev, ParseExpression());
+      Finish(ev);
+      CsAdopt(p, ev);
+    }
+    return Finish(p);
+  }
+
+  // ------------------------------------------------------- statements
+  CsNode* ParseBlock() {
+    int begin = Pos();
+    Expect("{");
+    CsNode* b = New("Block", begin);
+    while (!Accept("}")) {
+      if (AtEof()) Fail("unterminated block");
+      CsAdopt(b, ParseStatement());
+    }
+    return Finish(b);
+  }
+
+  CsNode* ParseStatement() {
+    int begin = Pos();
+    if (Is("{")) return ParseBlock();
+    if (Accept(";")) return Finish(New("EmptyStatement", begin));
+    if (IsKw("if")) {
+      Next();
+      CsNode* s = New("IfStatement", begin);
+      Expect("(");
+      CsAdopt(s, ParseExpression());
+      Expect(")");
+      CsAdopt(s, ParseStatement());
+      if (IsKw("else")) {
+        int eb = Pos();
+        Next();
+        CsNode* e = New("ElseClause", eb);
+        CsAdopt(e, ParseStatement());
+        Finish(e);
+        CsAdopt(s, e);
+      }
+      return Finish(s);
+    }
+    if (IsKw("while")) {
+      Next();
+      CsNode* s = New("WhileStatement", begin);
+      Expect("(");
+      CsAdopt(s, ParseExpression());
+      Expect(")");
+      CsAdopt(s, ParseStatement());
+      return Finish(s);
+    }
+    if (IsKw("do")) {
+      Next();
+      CsNode* s = New("DoStatement", begin);
+      CsAdopt(s, ParseStatement());
+      ExpectKw("while");
+      Expect("(");
+      CsAdopt(s, ParseExpression());
+      Expect(")");
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("for")) return ParseFor(begin);
+    if (IsKw("foreach")) {
+      Next();
+      CsNode* s = New("ForEachStatement", begin);
+      Expect("(");
+      CsAdopt(s, ParseType());
+      AttachIdent(s);
+      ExpectKw("in");
+      CsAdopt(s, ParseExpression());
+      Expect(")");
+      CsAdopt(s, ParseStatement());
+      return Finish(s);
+    }
+    if (IsKw("return")) {
+      Next();
+      CsNode* s = New("ReturnStatement", begin);
+      if (!Is(";")) CsAdopt(s, ParseExpression());
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("throw")) {
+      Next();
+      CsNode* s = New("ThrowStatement", begin);
+      if (!Is(";")) CsAdopt(s, ParseExpression());
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("break")) {
+      Next();
+      Expect(";");
+      return Finish(New("BreakStatement", begin));
+    }
+    if (IsKw("continue")) {
+      Next();
+      Expect(";");
+      return Finish(New("ContinueStatement", begin));
+    }
+    if (IsKw("switch")) {
+      Next();
+      CsNode* s = New("SwitchStatement", begin);
+      Expect("(");
+      CsAdopt(s, ParseExpression());
+      Expect(")");
+      Expect("{");
+      while (!Accept("}")) {
+        if (AtEof()) Fail("unterminated switch");
+        int sb = Pos();
+        CsNode* section = New("SwitchSection", sb);
+        bool any_label = false;
+        while (IsKw("case") || IsKw("default")) {
+          int lb = Pos();
+          if (AcceptKw("case")) {
+            CsNode* label = New("CaseSwitchLabel", lb);
+            CsAdopt(label, ParseExpression());
+            Expect(":");
+            Finish(label);
+            CsAdopt(section, label);
+          } else {
+            Next();
+            Expect(":");
+            CsAdopt(section, Finish(New("DefaultSwitchLabel", lb)));
+          }
+          any_label = true;
+        }
+        if (!any_label) Fail("expected switch label");
+        while (!IsKw("case") && !IsKw("default") && !Is("}")) {
+          CsAdopt(section, ParseStatement());
+        }
+        Finish(section);
+        CsAdopt(s, section);
+      }
+      return Finish(s);
+    }
+    if (IsKw("try")) {
+      Next();
+      CsNode* s = New("TryStatement", begin);
+      CsAdopt(s, ParseBlock());
+      while (IsKw("catch")) {
+        int cb = Pos();
+        Next();
+        CsNode* clause = New("CatchClause", cb);
+        if (Accept("(")) {
+          int db = Pos();
+          CsNode* decl = New("CatchDeclaration", db);
+          CsAdopt(decl, ParseType());
+          if (IsIdent()) AttachIdent(decl);
+          Expect(")");
+          Finish(decl);
+          CsAdopt(clause, decl);
+        }
+        if (IsKw("when")) {
+          int fb = Pos();
+          Next();
+          Expect("(");
+          CsNode* filter = New("CatchFilterClause", fb);
+          CsAdopt(filter, ParseExpression());
+          Expect(")");
+          Finish(filter);
+          CsAdopt(clause, filter);
+        }
+        CsAdopt(clause, ParseBlock());
+        Finish(clause);
+        CsAdopt(s, clause);
+      }
+      if (IsKw("finally")) {
+        int fb = Pos();
+        Next();
+        CsNode* fin = New("FinallyClause", fb);
+        CsAdopt(fin, ParseBlock());
+        Finish(fin);
+        CsAdopt(s, fin);
+      }
+      return Finish(s);
+    }
+    if (IsKw("using")) {
+      Next();
+      CsNode* s = New("UsingStatement", begin);
+      Expect("(");
+      size_t save = p_;
+      CsNode* decl = TryParseVariableDeclaration();
+      if (decl != nullptr && Is(")")) {
+        CsAdopt(s, decl);
+      } else {
+        p_ = save;
+        CsAdopt(s, ParseExpression());
+      }
+      Expect(")");
+      CsAdopt(s, ParseStatement());
+      return Finish(s);
+    }
+    if (IsKw("lock")) {
+      Next();
+      CsNode* s = New("LockStatement", begin);
+      Expect("(");
+      CsAdopt(s, ParseExpression());
+      Expect(")");
+      CsAdopt(s, ParseStatement());
+      return Finish(s);
+    }
+    if (IsKw("yield")) {
+      Next();
+      if (AcceptKw("break")) {
+        Expect(";");
+        return Finish(New("YieldBreakStatement", begin));
+      }
+      ExpectKw("return");
+      CsNode* s = New("YieldReturnStatement", begin);
+      CsAdopt(s, ParseExpression());
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("goto")) {
+      Next();
+      CsNode* s = New("GotoStatement", begin);
+      if (AcceptKw("case")) CsAdopt(s, ParseExpression());
+      else if (!AcceptKw("default") && IsIdent()) Next();  // label token
+      Expect(";");
+      return Finish(s);
+    }
+    if (IsKw("checked") || IsKw("unchecked")) {
+      const char* kind =
+          IsKw("checked") ? "CheckedStatement" : "UncheckedStatement";
+      Next();
+      CsNode* s = New(kind, begin);
+      CsAdopt(s, ParseBlock());
+      return Finish(s);
+    }
+    // const local: `const Type x = ...;`
+    if (IsKw("const")) {
+      Next();
+      CsNode* s = New("LocalDeclarationStatement", begin);
+      CsNode* decl = TryParseVariableDeclaration();
+      if (decl == nullptr) Fail("expected const declaration");
+      CsAdopt(s, decl);
+      Expect(";");
+      return Finish(s);
+    }
+    // labeled statement
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == ":") {
+      Next();
+      Next();
+      CsNode* s = New("LabeledStatement", begin);
+      CsAdopt(s, ParseStatement());
+      return Finish(s);
+    }
+    // local declaration vs expression
+    {
+      size_t save = p_;
+      CsNode* decl = TryParseVariableDeclaration();
+      if (decl != nullptr && Is(";")) {
+        Next();
+        CsNode* s = New("LocalDeclarationStatement", begin);
+        CsAdopt(s, decl);
+        return Finish(s);
+      }
+      p_ = save;
+    }
+    CsNode* s = New("ExpressionStatement", begin);
+    CsAdopt(s, ParseExpression());
+    Expect(";");
+    return Finish(s);
+  }
+
+  CsNode* ParseFor(int begin) {
+    Next();  // for
+    CsNode* s = New("ForStatement", begin);
+    Expect("(");
+    if (!Is(";")) {
+      size_t save = p_;
+      CsNode* decl = TryParseVariableDeclaration();
+      if (decl != nullptr && Is(";")) {
+        CsAdopt(s, decl);
+      } else {
+        p_ = save;
+        do {
+          CsAdopt(s, ParseExpression());
+        } while (Accept(","));
+      }
+    }
+    Expect(";");
+    if (!Is(";")) CsAdopt(s, ParseExpression());
+    Expect(";");
+    if (!Is(")")) {
+      do {
+        CsAdopt(s, ParseExpression());
+      } while (Accept(","));
+    }
+    Expect(")");
+    CsAdopt(s, ParseStatement());
+    return Finish(s);
+  }
+
+  CsNode* ParseVariableDeclarationWithType(CsNode* type, int begin) {
+    CsNode* decl = New("VariableDeclaration", begin);
+    CsAdopt(decl, type);
+    do {
+      CsAdopt(decl, ParseVariableDeclarator());
+    } while (Accept(","));
+    return Finish(decl);
+  }
+
+  CsNode* ParseVariableDeclaration() {
+    int begin = Pos();
+    CsNode* type = ParseType();
+    return ParseVariableDeclarationWithType(type, begin);
+  }
+
+  CsNode* TryParseVariableDeclaration() {
+    size_t save = p_;
+    try {
+      int begin = Pos();
+      CsNode* type = ParseType();
+      if (!IsIdent()) {
+        p_ = save;
+        return nullptr;
+      }
+      return ParseVariableDeclarationWithType(type, begin);
+    } catch (const CsParseError&) {
+      p_ = save;
+      return nullptr;
+    }
+  }
+
+  CsNode* ParseVariableDeclarator() {
+    int begin = Pos();
+    CsNode* v = New("VariableDeclarator", begin);
+    AttachIdent(v);
+    if (Accept("=")) {
+      int eb = Pos();
+      CsNode* ev = New("EqualsValueClause", eb);
+      if (Is("{")) CsAdopt(ev, ParseInitializerExpression("ArrayInitializerExpression"));
+      else CsAdopt(ev, ParseExpression());
+      Finish(ev);
+      CsAdopt(v, ev);
+    }
+    return Finish(v);
+  }
+
+  // ------------------------------------------------------ expressions
+  CsNode* ParseExpression() { return ParseAssignment(); }
+
+  CsNode* ParseAssignment() {
+    int begin = Pos();
+    CsNode* lhs = ParseConditional();
+    std::string_view t = Cur().kind == Tok::kPunct ? Cur().text
+                                                   : std::string_view();
+    if (!t.empty() && IsAssignPunct(t)) {
+      Next();
+      CsNode* e = New(AssignKind(t).c_str(), begin);
+      CsAdopt(e, lhs);
+      CsAdopt(e, ParseAssignment());
+      return Finish(e);
+    }
+    if (Is(">") && GtRun(2, true)) {  // >>=
+      Next();
+      Next();
+      Next();
+      CsNode* e = New("RightShiftAssignmentExpression", begin);
+      CsAdopt(e, lhs);
+      CsAdopt(e, ParseAssignment());
+      return Finish(e);
+    }
+    return lhs;
+  }
+
+  CsNode* ParseConditional() {
+    int begin = Pos();
+    CsNode* cond = ParseCoalesce();
+    if (!Is("?")) return cond;
+    Next();
+    CsNode* e = New("ConditionalExpression", begin);
+    CsAdopt(e, cond);
+    CsAdopt(e, ParseExpression());
+    Expect(":");
+    CsAdopt(e, ParseExpression());
+    return Finish(e);
+  }
+
+  CsNode* ParseCoalesce() {
+    int begin = Pos();
+    CsNode* lhs = ParseLogicalOr();
+    if (!Is("??")) return lhs;
+    Next();
+    CsNode* e = New("CoalesceExpression", begin);
+    CsAdopt(e, lhs);
+    CsAdopt(e, ParseCoalesce());  // right associative
+    return Finish(e);
+  }
+
+  CsNode* BinaryChain(CsNode* (Parser::*next)(),
+                      const char* (Parser::*op_here)()) {
+    int begin = Pos();
+    CsNode* lhs = (this->*next)();
+    while (true) {
+      const char* kind = (this->*op_here)();
+      if (kind == nullptr) return lhs;
+      CsNode* e = New(kind, begin);
+      CsAdopt(e, lhs);
+      CsAdopt(e, (this->*next)());
+      Finish(e);
+      lhs = e;
+    }
+  }
+
+  const char* OpOrOr() {
+    if (Is("||")) { Next(); return "LogicalOrExpression"; }
+    return nullptr;
+  }
+  const char* OpAndAnd() {
+    if (Is("&&")) { Next(); return "LogicalAndExpression"; }
+    return nullptr;
+  }
+  const char* OpBitOr() {
+    if (Is("|")) { Next(); return "BitwiseOrExpression"; }
+    return nullptr;
+  }
+  const char* OpBitXor() {
+    if (Is("^")) { Next(); return "ExclusiveOrExpression"; }
+    return nullptr;
+  }
+  const char* OpBitAnd() {
+    if (Is("&")) { Next(); return "BitwiseAndExpression"; }
+    return nullptr;
+  }
+  const char* OpEquality() {
+    if (Is("==")) { Next(); return "EqualsExpression"; }
+    if (Is("!=")) { Next(); return "NotEqualsExpression"; }
+    return nullptr;
+  }
+
+  CsNode* ParseLogicalOr() { return BinaryChain(&Parser::ParseLogicalAnd, &Parser::OpOrOr); }
+  CsNode* ParseLogicalAnd() { return BinaryChain(&Parser::ParseBitOr, &Parser::OpAndAnd); }
+  CsNode* ParseBitOr() { return BinaryChain(&Parser::ParseBitXor, &Parser::OpBitOr); }
+  CsNode* ParseBitXor() { return BinaryChain(&Parser::ParseBitAnd, &Parser::OpBitXor); }
+  CsNode* ParseBitAnd() { return BinaryChain(&Parser::ParseEquality, &Parser::OpBitAnd); }
+  CsNode* ParseEquality() { return BinaryChain(&Parser::ParseRelational, &Parser::OpEquality); }
+
+  CsNode* ParseRelational() {
+    int begin = Pos();
+    CsNode* lhs = ParseShift();
+    while (true) {
+      if (IsKw("is")) {
+        Next();
+        CsNode* e = New("IsExpression", begin);
+        CsAdopt(e, lhs);
+        CsAdopt(e, ParseType());
+        // `is Type name` (C#7 pattern): consume the name, no node
+        if (IsIdent()) Next();
+        Finish(e);
+        lhs = e;
+        continue;
+      }
+      if (IsKw("as")) {
+        Next();
+        CsNode* e = New("AsExpression", begin);
+        CsAdopt(e, lhs);
+        CsAdopt(e, ParseType());
+        Finish(e);
+        lhs = e;
+        continue;
+      }
+      const char* kind = nullptr;
+      if (Is("<=")) { Next(); kind = "LessThanOrEqualExpression"; }
+      else if (Is("<")) { Next(); kind = "LessThanExpression"; }
+      else if (Is(">") && GtRun(1, true) && !GtRun(2, false)) {
+        Next(); Next(); kind = "GreaterThanOrEqualExpression";
+      } else if (Is(">") && !GtRun(2, false)) {
+        Next(); kind = "GreaterThanExpression";
+      }
+      if (kind == nullptr) return lhs;
+      CsNode* e = New(kind, begin);
+      CsAdopt(e, lhs);
+      CsAdopt(e, ParseShift());
+      Finish(e);
+      lhs = e;
+    }
+  }
+
+  CsNode* ParseShift() {
+    int begin = Pos();
+    CsNode* lhs = ParseAdditive();
+    while (true) {
+      const char* kind = nullptr;
+      if (Is("<<")) { Next(); kind = "LeftShiftExpression"; }
+      else if (Is(">") && GtRun(2, false) && !GtRun(2, true)) {
+        Next(); Next(); kind = "RightShiftExpression";
+      }
+      if (kind == nullptr) return lhs;
+      CsNode* e = New(kind, begin);
+      CsAdopt(e, lhs);
+      CsAdopt(e, ParseAdditive());
+      Finish(e);
+      lhs = e;
+    }
+  }
+
+  const char* OpAdd() {
+    if (Is("+")) { Next(); return "AddExpression"; }
+    if (Is("-")) { Next(); return "SubtractExpression"; }
+    return nullptr;
+  }
+  const char* OpMul() {
+    if (Is("*")) { Next(); return "MultiplyExpression"; }
+    if (Is("/")) { Next(); return "DivideExpression"; }
+    if (Is("%")) { Next(); return "ModuloExpression"; }
+    return nullptr;
+  }
+
+  CsNode* ParseAdditive() { return BinaryChain(&Parser::ParseMultiplicative, &Parser::OpAdd); }
+  CsNode* ParseMultiplicative() { return BinaryChain(&Parser::ParseUnary, &Parser::OpMul); }
+
+  CsNode* ParseUnary() {
+    int begin = Pos();
+    if (Is("-")) { Next(); return UnaryOf(begin, "UnaryMinusExpression"); }
+    if (Is("+")) { Next(); return UnaryOf(begin, "UnaryPlusExpression"); }
+    if (Is("!")) { Next(); return UnaryOf(begin, "LogicalNotExpression"); }
+    if (Is("~")) { Next(); return UnaryOf(begin, "BitwiseNotExpression"); }
+    if (Is("++")) { Next(); return UnaryOf(begin, "PreIncrementExpression"); }
+    if (Is("--")) { Next(); return UnaryOf(begin, "PreDecrementExpression"); }
+    if (IsKw("await")) {
+      Next();
+      return UnaryOf(begin, "AwaitExpression");
+    }
+    if (Is("(")) {
+      size_t save = p_;
+      CsNode* cast = TryParseCast(begin);
+      if (cast != nullptr) return cast;
+      p_ = save;
+    }
+    return ParsePostfix();
+  }
+
+  CsNode* UnaryOf(int begin, const char* kind) {
+    CsNode* e = New(kind, begin);
+    CsAdopt(e, ParseUnary());
+    return Finish(e);
+  }
+
+  CsNode* TryParseCast(int begin) {
+    try {
+      Expect("(");
+      CsNode* type = ParseType();
+      if (!Is(")")) return nullptr;
+      Next();
+      bool primitive = type->kind == "PredefinedType";
+      bool operand_start =
+          IsIdent() || Cur().kind == Tok::kNumeric ||
+          Cur().kind == Tok::kString || Cur().kind == Tok::kChar ||
+          Is("(") || Is("!") || Is("~") || IsKw("new") || IsKw("this") ||
+          IsKw("base") || IsKw("true") || IsKw("false") || IsKw("null") ||
+          IsKw("typeof") || IsKw("default") ||
+          (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text));
+      if (primitive)
+        operand_start = operand_start || Is("+") || Is("-") || Is("++") ||
+                        Is("--");
+      if (!operand_start) return nullptr;
+      CsNode* e = New("CastExpression", begin);
+      CsAdopt(e, type);
+      CsAdopt(e, ParseUnary());
+      return Finish(e);
+    } catch (const CsParseError&) {
+      return nullptr;
+    }
+  }
+
+  CsNode* ParsePostfix() {
+    int begin = Pos();
+    CsNode* e = ParsePrimary();
+    while (true) {
+      if (Is("++")) {
+        Next();
+        CsNode* u = New("PostIncrementExpression", begin);
+        CsAdopt(u, e);
+        e = Finish(u);
+      } else if (Is("--")) {
+        Next();
+        CsNode* u = New("PostDecrementExpression", begin);
+        CsAdopt(u, e);
+        e = Finish(u);
+      } else {
+        return e;
+      }
+    }
+  }
+
+  CsNode* ParseArgumentList() {
+    int begin = Pos();
+    Expect("(");
+    CsNode* list = New("ArgumentList", begin);
+    ParseArgumentsInto(list, ")");
+    return Finish(list);
+  }
+
+  void ParseArgumentsInto(CsNode* list, std::string_view closer) {
+    if (!Is(closer)) {
+      do {
+        int ab = Pos();
+        CsNode* arg = New("Argument", ab);
+        if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+            LookAhead(1).text == ":") {
+          CsNode* nc = New("NameColon", Pos());
+          CsAdopt(nc, ParseSimpleName(false));
+          Finish(nc);
+          CsAdopt(arg, nc);
+          Next();  // ':'
+        }
+        while (IsKw("ref") || IsKw("out") || IsKw("in")) Next();
+        // `out var x` declaration expressions: consume declaration-ish
+        if (IsKw("var") && IsIdent()) {}
+        CsAdopt(arg, ParseExpression());
+        Finish(arg);
+        CsAdopt(list, arg);
+      } while (Accept(","));
+    }
+    Expect(std::string(closer).c_str());
+  }
+
+  CsNode* ParsePrimary() {
+    int begin = Pos();
+    CsNode* e = ParsePrimaryPrefix();
+    while (true) {
+      if (Is(".")) {
+        Next();
+        CsNode* ma = New("SimpleMemberAccessExpression", begin);
+        CsAdopt(ma, e);
+        CsAdopt(ma, ParseSimpleName());
+        e = Finish(ma);
+        continue;
+      }
+      if (Is("?.")) {
+        Next();
+        // ConditionalAccessExpression with MemberBinding
+        CsNode* ca = New("ConditionalAccessExpression", begin);
+        CsAdopt(ca, e);
+        int mb = Pos();
+        CsNode* bind = New("MemberBindingExpression", mb);
+        CsAdopt(bind, ParseSimpleName());
+        Finish(bind);
+        CsAdopt(ca, bind);
+        e = Finish(ca);
+        continue;
+      }
+      if (Is("(")) {
+        CsNode* call = New("InvocationExpression", begin);
+        CsAdopt(call, e);
+        CsAdopt(call, ParseArgumentList());
+        e = Finish(call);
+        continue;
+      }
+      if (Is("[")) {
+        int bb = Pos();
+        Next();
+        CsNode* access = New("ElementAccessExpression", begin);
+        CsAdopt(access, e);
+        CsNode* args = New("BracketedArgumentList", bb);
+        ParseArgumentsInto(args, "]");
+        Finish(args);
+        CsAdopt(access, args);
+        e = Finish(access);
+        continue;
+      }
+      return e;
+    }
+  }
+
+  CsNode* ParseInitializerExpression(const char* kind) {
+    int begin = Pos();
+    Expect("{");
+    CsNode* init = New(kind, begin);
+    if (!Is("}")) {
+      do {
+        if (Is("}")) break;  // trailing comma
+        if (Is("{")) {
+          CsAdopt(init,
+                  ParseInitializerExpression("ComplexElementInitializerExpression"));
+        } else {
+          CsAdopt(init, ParseExpression());
+        }
+      } while (Accept(","));
+    }
+    Expect("}");
+    return Finish(init);
+  }
+
+  CsNode* ParsePrimaryPrefix() {
+    int begin = Pos();
+    switch (Cur().kind) {
+      case Tok::kNumeric: {
+        CsNode* e = New("NumericLiteralExpression", begin);
+        AttachCurrentAs(e, Tok::kNumeric);
+        return Finish(e);
+      }
+      case Tok::kString: {
+        CsNode* e = New("StringLiteralExpression", begin);
+        AttachCurrentAs(e, Tok::kString);
+        return Finish(e);
+      }
+      case Tok::kChar: {
+        CsNode* e = New("CharacterLiteralExpression", begin);
+        AttachCurrentAs(e, Tok::kChar);
+        return Finish(e);
+      }
+      default:
+        break;
+    }
+    if (IsKw("true")) {
+      Next();
+      return Finish(New("TrueLiteralExpression", begin));
+    }
+    if (IsKw("false")) {
+      Next();
+      return Finish(New("FalseLiteralExpression", begin));
+    }
+    if (IsKw("null")) {
+      Next();
+      return Finish(New("NullLiteralExpression", begin));
+    }
+    if (IsKw("this")) {
+      Next();
+      return Finish(New("ThisExpression", begin));
+    }
+    if (IsKw("base")) {
+      Next();
+      return Finish(New("BaseExpression", begin));
+    }
+    if (IsKw("typeof")) {
+      Next();
+      Expect("(");
+      CsNode* e = New("TypeOfExpression", begin);
+      CsAdopt(e, ParseType());
+      Expect(")");
+      return Finish(e);
+    }
+    if (IsKw("default")) {
+      Next();
+      CsNode* e = New("DefaultExpression", begin);
+      if (Accept("(")) {
+        CsAdopt(e, ParseType());
+        Expect(")");
+      }
+      return Finish(e);
+    }
+    if (IsKw("sizeof")) {
+      Next();
+      Expect("(");
+      CsNode* e = New("SizeOfExpression", begin);
+      CsAdopt(e, ParseType());
+      Expect(")");
+      return Finish(e);
+    }
+    if (IsKw("checked") || IsKw("unchecked")) {
+      const char* kind = IsKw("checked") ? "CheckedExpression"
+                                         : "UncheckedExpression";
+      Next();
+      Expect("(");
+      CsNode* e = New(kind, begin);
+      CsAdopt(e, ParseExpression());
+      Expect(")");
+      return Finish(e);
+    }
+    if (IsKw("new")) return ParseCreation(begin);
+    if (IsKw("delegate")) {
+      Next();
+      CsNode* e = New("AnonymousMethodExpression", begin);
+      if (Is("(")) CsAdopt(e, ParseParameterList());
+      CsAdopt(e, ParseBlock());
+      return Finish(e);
+    }
+    if (IsKw("async")) {
+      // async lambda / anonymous method
+      size_t save = p_;
+      Next();
+      CsNode* lam = TryParseLambda(begin);
+      if (lam != nullptr) return lam;
+      p_ = save;
+    }
+    {
+      CsNode* lam = TryParseLambda(begin);
+      if (lam != nullptr) return lam;
+    }
+    if (Is("(")) {
+      Next();
+      CsNode* e = New("ParenthesizedExpression", begin);
+      CsAdopt(e, ParseExpression());
+      Expect(")");
+      return Finish(e);
+    }
+    if (Cur().kind == Tok::kIdent &&
+        (IsIdent() || kPredefinedTypes.count(Cur().text))) {
+      if (kPredefinedTypes.count(Cur().text)) {
+        // predefined type in expression position: `int.Parse(...)`
+        CsNode* t = New("PredefinedType", begin);
+        AttachCurrentAs(t, Tok::kIdent);
+        return Finish(t);
+      }
+      return ParseSimpleName();
+    }
+    Fail("expected expression");
+  }
+
+  CsNode* TryParseLambda(int begin) {
+    // `x => ...`
+    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "=>") {
+      CsNode* lam = New("SimpleLambdaExpression", begin);
+      int pb = Pos();
+      CsNode* param = New("Parameter", pb);
+      AttachIdent(param);
+      Finish(param);
+      CsAdopt(lam, param);
+      Expect("=>");
+      ParseLambdaBody(lam);
+      return Finish(lam);
+    }
+    // `( ... ) => ...`
+    if (Is("(") && ParenLambdaAhead()) {
+      CsNode* lam = New("ParenthesizedLambdaExpression", begin);
+      int plb = Pos();
+      Next();
+      CsNode* params = New("ParameterList", plb);
+      if (!Is(")")) {
+        do {
+          int pb = Pos();
+          CsNode* param = New("Parameter", pb);
+          while (IsKw("ref") || IsKw("out") || IsKw("in")) Next();
+          size_t save = p_;
+          try {
+            CsNode* type = ParseType();
+            if (IsIdent()) {
+              CsAdopt(param, type);
+            } else {
+              p_ = save;
+            }
+          } catch (const CsParseError&) {
+            p_ = save;
+          }
+          AttachIdent(param);
+          Finish(param);
+          CsAdopt(params, param);
+        } while (Accept(","));
+      }
+      Expect(")");
+      Finish(params);
+      CsAdopt(lam, params);
+      Expect("=>");
+      ParseLambdaBody(lam);
+      return Finish(lam);
+    }
+    return nullptr;
+  }
+
+  bool ParenLambdaAhead() const {
+    int depth = 0;
+    for (size_t k = p_; k < lexed_.tokens.size(); ++k) {
+      const CsToken& t = lexed_.tokens[k];
+      if (t.kind == Tok::kEof) return false;
+      if (t.kind != Tok::kPunct) continue;
+      if (t.text == "(") ++depth;
+      else if (t.text == ")") {
+        --depth;
+        if (depth == 0) {
+          const CsToken& after =
+              lexed_.tokens[k + 1 < lexed_.tokens.size() ? k + 1 : k];
+          return after.kind == Tok::kPunct && after.text == "=>";
+        }
+      } else if (t.text == ";") {
+        return false;
+      }
+    }
+    return false;
+  }
+
+  void ParseLambdaBody(CsNode* lam) {
+    if (Is("{")) CsAdopt(lam, ParseBlock());
+    else CsAdopt(lam, ParseExpression());
+  }
+
+  CsNode* ParseCreation(int begin) {
+    Next();  // new
+    // implicit array `new[] {...}` / `new {...}` anonymous object
+    if (Is("[")) {
+      Next();
+      Expect("]");
+      CsNode* e = New("ImplicitArrayCreationExpression", begin);
+      CsAdopt(e, ParseInitializerExpression("ArrayInitializerExpression"));
+      return Finish(e);
+    }
+    if (Is("{")) {
+      CsNode* e = New("AnonymousObjectCreationExpression", begin);
+      Next();
+      while (!Accept("}")) {
+        if (AtEof()) Fail("unterminated anonymous object");
+        int mb = Pos();
+        CsNode* member = New("AnonymousObjectMemberDeclarator", mb);
+        if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+            LookAhead(1).text == "=") {
+          CsNode* ne = New("NameEquals", Pos());
+          CsAdopt(ne, ParseSimpleName(false));
+          Finish(ne);
+          CsAdopt(member, ne);
+          Next();
+        }
+        CsAdopt(member, ParseExpression());
+        Finish(member);
+        CsAdopt(e, member);
+        if (!Accept(",")) {
+          Expect("}");
+          break;
+        }
+      }
+      return Finish(e);
+    }
+    CsNode* type = ParseTypeNoArray();
+    // array creation with explicit sizes: `new T[expr]...`
+    if (Is("[") && !IsRankSpecifierAhead()) {
+      int ab = type->begin;
+      CsNode* at = New("ArrayType", ab);
+      CsAdopt(at, type);
+      CsAdopt(at, ParseRankSpecifier(/*allow_sizes=*/true));
+      while (Is("[")) CsAdopt(at, ParseRankSpecifier(false));
+      Finish(at);
+      CsNode* e = New("ArrayCreationExpression", begin);
+      CsAdopt(e, at);
+      if (Is("{"))
+        CsAdopt(e, ParseInitializerExpression("ArrayInitializerExpression"));
+      return Finish(e);
+    }
+    if (Is("[")) {  // `new T[] {...}`
+      int ab = type->begin;
+      CsNode* at = New("ArrayType", ab);
+      CsAdopt(at, type);
+      while (Is("[")) CsAdopt(at, ParseRankSpecifier(false));
+      Finish(at);
+      CsNode* e = New("ArrayCreationExpression", begin);
+      CsAdopt(e, at);
+      if (Is("{"))
+        CsAdopt(e, ParseInitializerExpression("ArrayInitializerExpression"));
+      return Finish(e);
+    }
+    CsNode* e = New("ObjectCreationExpression", begin);
+    CsAdopt(e, type);
+    if (Is("(")) CsAdopt(e, ParseArgumentList());
+    if (Is("{")) {
+      CsAdopt(e, ParseInitializerExpression(
+                      "CollectionInitializerExpression"));
+    }
+    return Finish(e);
+  }
+
+  // type without trailing array rank specifiers (creation handles those)
+  CsNode* ParseTypeNoArray() {
+    int begin = Pos();
+    CsNode* t;
+    if (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text)) {
+      t = New("PredefinedType", begin);
+      AttachCurrentAs(t, Tok::kIdent);
+      t->end = PrevEnd();
+    } else {
+      t = ParseSimpleName(true, /*type_context=*/true);
+      while (Is(".") && LookAhead(1).kind == Tok::kIdent &&
+             !IsCsKeyword(LookAhead(1).text)) {
+        Next();
+        CsNode* q = New("QualifiedName", begin);
+        CsAdopt(q, t);
+        CsAdopt(q, ParseSimpleName(true, /*type_context=*/true));
+        t = Finish(q);
+      }
+    }
+    if (Is("?")) {
+      Next();
+      CsNode* nt = New("NullableType", begin);
+      CsAdopt(nt, t);
+      t = Finish(nt);
+    }
+    return t;
+  }
+
+  CsArena* arena_;
+  CsLexOutput lexed_;
+  size_t p_ = 0;
+  std::vector<std::string> enclosing_type_names_;
+};
+
+}  // namespace
+
+CsParseResult CsParse(std::string_view source, CsArena* arena) {
+  Parser parser(source, arena);
+  return parser.Parse();
+}
+
+}  // namespace c2v

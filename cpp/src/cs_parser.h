@@ -1,0 +1,32 @@
+// Recursive-descent C# parser producing the Roslyn-shaped AST.
+//
+// Covers the language core the reference extractor sees through Roslyn
+// (CSharpSyntaxTree.ParseText, Extractor.cs:170): namespaces, type
+// declarations, members (methods/ctors/properties/fields/events/
+// indexers/operators), the full statement set, and expressions incl.
+// lambdas, conditional access and generics. Intentionally out of scope
+// (throws CsParseError; the driver skips the file like the reference's
+// exception path): LINQ query syntax, unsafe blocks, tuples/patterns
+// (C#7+). Interpolated strings are single tokens (cs_lexer.h).
+#pragma once
+
+#include <stdexcept>
+#include <string>
+#include <string_view>
+
+#include "cs_ast.h"
+
+namespace c2v {
+
+struct CsParseError : std::runtime_error {
+  explicit CsParseError(const std::string& m) : std::runtime_error(m) {}
+};
+
+struct CsParseResult {
+  CsNode* root = nullptr;          // CompilationUnit
+  std::vector<CsComment> comments; // source order, from the lexer
+};
+
+CsParseResult CsParse(std::string_view source, CsArena* arena);
+
+}  // namespace c2v

@@ -1,0 +1,199 @@
+"""Tests for the native C# path-context extractor (cpp/c2v-extract-cs).
+
+Pinned against the reference C# pipeline's semantics: variable-centric
+contexts (Extractor.cs:111-138), Roslyn-kind path strings with truncated
+childIds (Extractor.cs:46-99), NUM masking and the C# normalizeName
+quirks (Utilities.cs:103-154), comment contexts (Extractor.cs:204-218),
+and classic .NET string hashing (Extractor.cs:224-233).
+"""
+
+import ctypes
+import os
+import subprocess
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO_ROOT, "cpp", "build", "c2v-extract-cs")
+
+TEMP_CS = """\
+namespace Extractor
+{
+    class Temp
+    {
+        class NestedClass
+        {
+            void fooBar()
+            {
+                a.b = c;
+            }
+        }
+    }
+}
+"""
+
+
+def dotnet_string_hashcode(s: str) -> int:
+    """Classic .NET Framework 32-bit String.GetHashCode."""
+    h1 = ctypes.c_int32((5381 << 16) + 5381).value
+    h2 = h1
+    for i in range(0, len(s), 2):
+        h1 = ctypes.c_int32(((h1 << 5) + h1) ^ ord(s[i])).value
+        if i + 1 < len(s):
+            h2 = ctypes.c_int32(((h2 << 5) + h2) ^ ord(s[i + 1])).value
+    return ctypes.c_int32(h1 + ctypes.c_int32(h2 * 1566083941).value).value
+
+
+@pytest.fixture(scope="module")
+def extractor():
+    if not os.path.exists(BINARY):
+        rc = subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "cpp")],
+                            capture_output=True, text=True)
+        assert rc.returncode == 0, rc.stderr
+    def run(path, *extra):
+        proc = subprocess.run([BINARY, "--path", path, *extra],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout.splitlines()
+    return run
+
+
+@pytest.fixture()
+def cs_file(tmp_path):
+    def write(code, name="Input.cs"):
+        p = tmp_path / name
+        p.write_text(code)
+        return str(p)
+    return write
+
+
+def test_temp_cs_golden(extractor, cs_file):
+    """The reference's shipped Temp.cs fixture."""
+    lines = extractor(cs_file(TEMP_CS), "--no_hash")
+    assert len(lines) == 1
+    parts = lines[0].split(" ")
+    assert parts[0] == "foo|bar"
+    contexts = [c.split(",") for c in parts[1:] if c]
+    # Roslyn-kind paths, no parentheses; childIds under member access
+    assert ["a", "IdentifierName0^SimpleMemberAccessExpression_IdentifierName1",
+            "b"] in contexts
+    # METHOD_NAME masking of the method's identifier token
+    assert any("METHOD_NAME" in (c[0], c[2]) for c in contexts)
+    # the void return type is a PredefinedType-token leaf
+    assert any(c[0] == "void" and c[1].startswith("PredefinedType")
+               for c in contexts)
+
+
+def test_hashed_mode_matches_dotnet_hash(extractor, cs_file):
+    plain = extractor(cs_file(TEMP_CS), "--no_hash")
+    hashed = extractor(cs_file(TEMP_CS))
+    for raw, enc in zip(plain[0].split(" ")[1:], hashed[0].split(" ")[1:]):
+        if not raw:
+            continue
+        w1, path, w2 = raw.split(",")
+        h1, phash, h2 = enc.split(",")
+        assert (w1, w2) == (h1, h2)
+        if path == "COMMENT":
+            assert phash == "COMMENT"  # comment contexts are never hashed
+        else:
+            assert str(dotnet_string_hashcode(path)) == phash
+
+
+def test_num_masking_and_whitelist(extractor, cs_file):
+    """NUM replaces out-of-whitelist integers; {0,1,2,3,4,5,10} kept
+    (Utilities.cs:37,136-148) — unlike the Java side, this is printed."""
+    code = "class A { int F(int x) { return x + 37 + 5 + 10 + 1234; } }"
+    line = extractor(cs_file(code), "--no_hash")[0]
+    tokens = {c.split(",")[i] for c in line.split(" ")[1:] if c for i in (0, 2)}
+    assert "NUM" in tokens
+    assert "5" in tokens and "10" in tokens
+    assert "37" not in tokens and "1234" not in tokens
+
+
+def test_variable_grouping_groups_same_name(extractor, cs_file):
+    """All occurrences of one name form one Variable; self-pairs give
+    occurrence-to-occurrence paths (Extractor.cs:115-116)."""
+    code = """
+class A {
+  int Sum(int[] data) {
+    int total = 0;
+    total = total + data[0];
+    return total;
+  }
+}
+"""
+    line = extractor(cs_file(code), "--no_hash")[0]
+    contexts = [c.split(",") for c in line.split(" ")[1:] if c]
+    # self-pair: total <-> total across distinct occurrences
+    assert any(c[0] == "total" and c[2] == "total" for c in contexts)
+    # element access childId: BracketedArgumentList parents add ids
+    assert any("BracketedArgumentList" in c[1] for c in contexts)
+
+
+def test_comment_contexts(extractor, cs_file):
+    code = """
+class A {
+  // reads the frobnicator index quickly for caching purposes extra words
+  int F(int x) { return x; }
+  /* block note */
+  int G(int y) { return y; }
+}
+"""
+    lines = extractor(cs_file(code), "--no_hash")
+    assert len(lines) == 2
+    for line in lines:  # whole-file comments attach to EVERY method
+        ctxs = [c for c in line.split(" ")[1:] if ",COMMENT," in c]
+        assert len(ctxs) >= 3  # 2 batches from the long comment + block
+        first = ctxs[0].split(",")
+        assert first[0] == first[2]
+        assert len(first[0].split("|")) <= 5  # 5-subtoken batches
+    doc = "class B { /// doc excluded\n int H(int z) { return z; } }"
+    doc_lines = extractor(cs_file(doc, "B.cs"), "--no_hash")
+    assert not any("COMMENT" in ln for ln in doc_lines)
+
+
+def test_var_keyword_excluded(extractor, cs_file):
+    code = "class A { int F() { var count = 1; return count; } }"
+    line = extractor(cs_file(code), "--no_hash")[0]
+    tokens = {c.split(",")[i] for c in line.split(" ")[1:] if c for i in (0, 2)}
+    assert "var" not in tokens
+    assert "count" in tokens
+
+
+def test_string_literal_subtokens(extractor, cs_file):
+    code = 'class A { string F() { return "hello worldPeace"; } }'
+    line = extractor(cs_file(code), "--no_hash")[0]
+    tokens = {c.split(",")[i] for c in line.split(" ")[1:] if c for i in (0, 2)}
+    assert "hello|world|peace" in tokens
+
+
+def test_methods_without_bodies_still_extracted(extractor, cs_file):
+    """No body filter in the C# pipeline (Extractor.cs:172-178):
+    interface methods produce (possibly context-light) lines too."""
+    code = "interface I { int Size(); }"
+    lines = extractor(cs_file(code), "--no_hash")
+    assert len(lines) == 1
+    assert lines[0].startswith("size ")
+
+
+def test_parse_failure_skips_file(tmp_path, extractor):
+    good = tmp_path / "Good.cs"
+    good.write_text("class G { int Ok() { return 1; } }")
+    bad = tmp_path / "Bad.cs"
+    bad.write_text("class ]]] not csharp {{{")
+    proc = subprocess.run([BINARY, "--path", str(tmp_path), "--no_hash"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("ok ")
+    assert "Bad.cs" in proc.stderr
+
+
+def test_ofile_append_mode(tmp_path, extractor, cs_file):
+    src = cs_file(TEMP_CS)
+    out = tmp_path / "out.txt"
+    for _ in range(2):
+        subprocess.run([BINARY, "--path", src, "--no_hash",
+                        "--ofile_name", str(out)], check=True,
+                       capture_output=True)
+    content = out.read_text().splitlines()
+    assert len(content) == 2  # append semantics, like the reference
