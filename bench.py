@@ -38,7 +38,8 @@ def _build(config):
     import jax
     import jax.numpy as jnp
     from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
-    from code2vec_tpu.training.state import create_train_state, make_optimizer
+    from code2vec_tpu.training.state import (create_train_state,
+                                             make_optimizer)
     from code2vec_tpu.training.step import TrainStepBuilder
 
     dims = ModelDims(
@@ -82,9 +83,10 @@ def main() -> None:
     config = Config(train_data_path_prefix="<bench>",
                     train_batch_size=BATCH, max_contexts=CONTEXTS,
                     compute_dtype="bfloat16")
+    from code2vec_tpu.training.state import dropout_rng
     state, train_step, dims = _build(config)
     batch = _synthetic_batch(dims)
-    rng = jax.random.PRNGKey(2)
+    rng = dropout_rng(config)
 
     for _ in range(WARMUP_STEPS):
         state, loss = train_step(state, *batch, rng)

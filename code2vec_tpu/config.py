@@ -80,6 +80,16 @@ class Config:
     # Use the hand-written shard_map tensor-parallel kernels instead of
     # relying purely on GSPMD sharding propagation (only matters if tp>1).
     use_manual_tp_kernels: bool = True
+    # Storage dtype for Adam's first moment (optax mu_dtype). bfloat16
+    # halves its HBM traffic in the memory-bound update (+~5% step
+    # throughput at java14m scale) with negligible effect on convergence;
+    # set "float32" for bit-strict Adam.
+    adam_mu_dtype: str = "bfloat16"
+    # PRNG implementation for the per-step dropout key. The TPU hardware
+    # generator ("rbg") produces the ~78M dropout bits per flagship step
+    # far faster than the default threefry (+~5% step throughput);
+    # parameter initialization always uses threefry for reproducibility.
+    dropout_prng_impl: str = "rbg"
     # Prefer the packed int32 binary sidecar (.c2vb) when present.
     use_packed_data: bool = True
     # Number of batches the host pipeline keeps in flight ahead of device.
@@ -192,6 +202,12 @@ class Config:
                 f"context-parallel degree cp ({self.cp}).")
         if self.compute_dtype not in ("bfloat16", "float32"):
             raise ValueError("compute_dtype must be bfloat16 or float32.")
+        if self.adam_mu_dtype not in ("bfloat16", "float32"):
+            raise ValueError("adam_mu_dtype must be bfloat16 or float32.")
+        if self.dropout_prng_impl not in ("rbg", "threefry2x32",
+                                          "unsafe_rbg"):
+            raise ValueError(
+                "dropout_prng_impl must be rbg, threefry2x32 or unsafe_rbg.")
 
     # ---------------------------------------------------------------- logging
 

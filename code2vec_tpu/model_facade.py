@@ -32,7 +32,7 @@ from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
 from code2vec_tpu.training import checkpoint as ckpt_mod
 from code2vec_tpu.training.loop import Trainer
 from code2vec_tpu.training.state import (
-    TrainState, create_train_state, make_optimizer, num_params,
+    TrainState, create_train_state, dropout_rng, make_optimizer, num_params,
 )
 from code2vec_tpu.training.step import TrainStepBuilder, device_put_batch
 from code2vec_tpu.vocab import Code2VecVocabs, VocabType
@@ -141,7 +141,7 @@ class Code2VecModel:
         trainer = Trainer(config, train_step, mesh=self.mesh,
                           evaluate_fn=evaluate_fn, save_fn=save_fn)
         self.state = trainer.train(self.state, self._train_batches(),
-                                   jax.random.PRNGKey(config.seed + 1))
+                                   dropout_rng(config))
         if config.is_saving:
             self.save()
             self.log(f"Model saved in: {config.model_save_path}")

@@ -32,9 +32,17 @@ class TrainState:
 def make_optimizer(config) -> optax.GradientTransformation:
     # reference uses tf.compat.v1.train.AdamOptimizer() defaults
     # (tensorflow_model.py:231): lr 1e-3, b1 .9, b2 .999, eps 1e-8.
+    # mu storage dtype is a throughput knob (config.adam_mu_dtype).
     return optax.adam(
         learning_rate=config.learning_rate,
-        b1=config.adam_beta1, b2=config.adam_beta2, eps=config.adam_eps)
+        b1=config.adam_beta1, b2=config.adam_beta2, eps=config.adam_eps,
+        mu_dtype=jnp.dtype(config.adam_mu_dtype))
+
+
+def dropout_rng(config, salt: int = 2) -> jax.Array:
+    """Per-run dropout key using the configured PRNG implementation (the
+    hardware `rbg` generator by default — see config.dropout_prng_impl)."""
+    return jax.random.key(config.seed + salt, impl=config.dropout_prng_impl)
 
 
 def init_params(module: Code2VecModule, rng: jax.Array):

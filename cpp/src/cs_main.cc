@@ -111,6 +111,10 @@ int main(int argc, char** argv) {
 
   std::vector<std::string> files;
   std::error_code ec;
+  if (!fs::exists(args.path, ec) || ec) {
+    std::cerr << "--path " << args.path << " does not exist\n";
+    return 1;
+  }
   if (fs::is_directory(args.path, ec)) {
     for (auto it = fs::recursive_directory_iterator(
              args.path, fs::directory_options::skip_permission_denied, ec);

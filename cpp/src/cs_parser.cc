@@ -442,7 +442,6 @@ class Parser {
     Next();
     CsNode* decl = New(kind, begin);
     for (CsNode* a : attrs) CsAdopt(decl, a);
-    std::string name = Cur().value;
     AttachIdent(decl);
     if (Is("<")) CsAdopt(decl, ParseTypeParameterList());
     if (Accept(":")) {
@@ -466,8 +465,6 @@ class Parser {
       CsAdopt(decl, ParseTypeOrMember(false));
     }
     Accept(";");
-    enclosing_type_names_.push_back(name);
-    enclosing_type_names_.pop_back();  // kept simple: name used below only
     return Finish(decl);
   }
 
@@ -1413,10 +1410,36 @@ class Parser {
           CsAdopt(arg, nc);
           Next();  // ':'
         }
-        while (IsKw("ref") || IsKw("out") || IsKw("in")) Next();
-        // `out var x` declaration expressions: consume declaration-ish
-        if (IsKw("var") && IsIdent()) {}
-        CsAdopt(arg, ParseExpression());
+        bool by_ref = false;
+        while (IsKw("ref") || IsKw("out") || IsKw("in")) {
+          by_ref = true;
+          Next();
+        }
+        // `out var x` / `out T x` declaration expressions (C#7):
+        // DeclarationExpression [type, SingleVariableDesignation]
+        CsNode* decl_expr = nullptr;
+        if (by_ref) {
+          size_t save = p_;
+          try {
+            int db = Pos();
+            CsNode* type = ParseType();
+            if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+                (LookAhead(1).text == "," || LookAhead(1).text == ")")) {
+              decl_expr = New("DeclarationExpression", db);
+              CsAdopt(decl_expr, type);
+              CsNode* desig = New("SingleVariableDesignation", Pos());
+              AttachIdent(desig);
+              Finish(desig);
+              CsAdopt(decl_expr, desig);
+              Finish(decl_expr);
+            } else {
+              p_ = save;
+            }
+          } catch (const CsParseError&) {
+            p_ = save;
+          }
+        }
+        CsAdopt(arg, decl_expr != nullptr ? decl_expr : ParseExpression());
         Finish(arg);
         CsAdopt(list, arg);
       } while (Accept(","));
@@ -1787,7 +1810,6 @@ class Parser {
   CsArena* arena_;
   CsLexOutput lexed_;
   size_t p_ = 0;
-  std::vector<std::string> enclosing_type_names_;
 };
 
 }  // namespace
