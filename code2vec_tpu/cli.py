@@ -63,6 +63,9 @@ def arguments_parser() -> ArgumentParser:
     parser.add_argument("--gspmd", action="store_true",
                         help="disable the manual shard_map TP kernels and "
                              "rely on GSPMD sharding propagation")
+    parser.add_argument("--profile_dir", metavar="DIR",
+                        help="write a jax.profiler trace of train batches "
+                             "10-20 to DIR (TensorBoard/Perfetto viewable)")
     return parser
 
 
@@ -85,6 +88,7 @@ def config_from_args(argv=None) -> Config:
         seed=args.seed,
         use_packed_data=not args.no_packed_data,
         use_manual_tp_kernels=not args.gspmd,
+        profile_dir=args.profile_dir,
     )
     if args.batch_size:
         config.train_batch_size = args.batch_size
@@ -102,6 +106,11 @@ def main(argv=None) -> None:
     # dispatch mirrors reference code2vec.py:16-37
     config = config_from_args(argv)
     config.verify()
+
+    # joins the multi-host runtime when a coordinator is configured;
+    # no-op on single-process runs (parallel/distributed.py)
+    from code2vec_tpu.parallel import distributed
+    distributed.initialize()
 
     from code2vec_tpu.model_facade import Code2VecModel
     model = Code2VecModel(config)

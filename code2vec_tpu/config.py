@@ -94,6 +94,9 @@ class Config:
     use_packed_data: bool = True
     # Number of batches the host pipeline keeps in flight ahead of device.
     prefetch_batches: int = 4
+    # When set, a jax.profiler trace of train batches 10-20 is written
+    # here (viewable in TensorBoard / Perfetto).
+    profile_dir: Optional[str] = None
     # Random seed for params/dropout.
     seed: int = 42
 

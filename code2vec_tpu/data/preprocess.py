@@ -10,12 +10,13 @@ one Python module. Run-once and I/O-bound, so Python is the right tool
 
 from __future__ import annotations
 
+import os
 import pickle
 import random
+import subprocess
+import sys
 from collections import Counter
-from typing import Dict, Iterable, Optional, Tuple
-
-from code2vec_tpu.common import count_lines_in_file
+from typing import Dict, Optional, Tuple
 
 
 def build_histograms(raw_path: str) -> Tuple[Counter, Counter, Counter]:
@@ -163,3 +164,133 @@ def preprocess(train_raw: str, val_raw: str, test_raw: str, output_name: str,
     save_dictionaries(output_name, word_to_count, path_to_count,
                       target_to_count, num_training_examples, log=log)
     return output_name
+
+
+# --------------------------------------------------------------- extraction
+
+def _native_extractor(language: str) -> str:
+    binary = {"java": "c2v-extract", "csharp": "c2v-extract-cs"}[language]
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(here, "cpp", "build", binary)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"native extractor `{path}` not built; run `make -C cpp`.")
+    return path
+
+
+def extract_dir(source_dir: str, out_path: str, language: str = "java",
+                max_path_length: int = 8, max_path_width: int = 2,
+                num_threads: int = 32, shuffle: bool = False,
+                seed: int = 0, log=print) -> str:
+    """Run the native AST path extractor over a source tree, writing raw
+    context lines to `out_path` (optionally shuffled, as the reference
+    pipes the train split through `shuf`, preprocess.sh:42-48).
+    """
+    extractor = _native_extractor(language)
+    if language == "java":
+        command = [extractor, "--max_path_length", str(max_path_length),
+                   "--max_path_width", str(max_path_width),
+                   "--dir", source_dir, "--num_threads", str(num_threads)]
+    else:
+        command = [extractor, "--path", source_dir,
+                   "--max_length", str(max_path_length),
+                   "--max_width", str(max_path_width),
+                   "--threads", str(num_threads)]
+    log(f"Extracting {source_dir} -> {out_path} ({language})")
+    with open(out_path + ".tmp", "w") as out:
+        result = subprocess.run(command, stdout=out, stderr=subprocess.PIPE,
+                                text=True)
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"extractor failed ({result.returncode}): {result.stderr[-2000:]}")
+    if result.stderr:
+        skipped = result.stderr.count("failed to extract")
+        if skipped:
+            log(f"  ({skipped} files skipped as unparseable)")
+    if shuffle:
+        # like the reference's `| shuf`: whole-file shuffle of the raw
+        # train split (training also reshuffles per epoch from the
+        # packed dataset, so this only decorrelates the histogram pass)
+        with open(out_path + ".tmp", "r") as f:
+            lines = f.readlines()
+        random.Random(seed).shuffle(lines)
+        with open(out_path + ".tmp", "w") as f:
+            f.writelines(lines)
+    os.replace(out_path + ".tmp", out_path)
+    return out_path
+
+
+def main(argv=None) -> None:
+    """End-to-end offline preprocessing CLI (the preprocess.sh equivalent):
+
+      python -m code2vec_tpu.data.preprocess \\
+          --train_dir DIR --val_dir DIR --test_dir DIR \\
+          --output_name data/java-small/java-small [--language java]
+
+    or, from already-extracted raw context files:
+
+      python -m code2vec_tpu.data.preprocess \\
+          --train_raw F --val_raw F --test_raw F --output_name NAME
+    """
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="code2vec_tpu.preprocess", description=main.__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--train_dir")
+    parser.add_argument("--val_dir")
+    parser.add_argument("--test_dir")
+    parser.add_argument("--train_raw")
+    parser.add_argument("--val_raw")
+    parser.add_argument("--test_raw")
+    parser.add_argument("--output_name", required=True)
+    parser.add_argument("--language", choices=["java", "csharp"],
+                        default="java")
+    parser.add_argument("--max_contexts", type=int, default=200)
+    parser.add_argument("--max_path_length", type=int, default=8)
+    parser.add_argument("--max_path_width", type=int, default=2)
+    parser.add_argument("--word_vocab_size", type=int, default=1301136)
+    parser.add_argument("--path_vocab_size", type=int, default=911417)
+    parser.add_argument("--target_vocab_size", type=int, default=261245)
+    parser.add_argument("--num_threads", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from_dirs = args.train_dir or args.val_dir or args.test_dir
+    from_raws = args.train_raw or args.val_raw or args.test_raw
+    if bool(from_dirs) == bool(from_raws):
+        parser.error("provide either --{train,val,test}_dir or "
+                     "--{train,val,test}_raw (not both)")
+    if from_dirs and not (args.train_dir and args.val_dir and args.test_dir):
+        parser.error("--train_dir, --val_dir and --test_dir are all required")
+    if from_raws and not (args.train_raw and args.val_raw and args.test_raw):
+        parser.error("--train_raw, --val_raw and --test_raw are all required")
+
+    out_dir = os.path.dirname(args.output_name)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+
+    if from_dirs:
+        raws = {}
+        for role, source_dir in (("train", args.train_dir),
+                                 ("val", args.val_dir),
+                                 ("test", args.test_dir)):
+            raws[role] = extract_dir(
+                source_dir, f"{args.output_name}.{role}.raw.txt",
+                language=args.language, max_path_length=args.max_path_length,
+                max_path_width=args.max_path_width,
+                num_threads=args.num_threads, shuffle=role == "train",
+                seed=args.seed)
+    else:
+        raws = {"train": args.train_raw, "val": args.val_raw,
+                "test": args.test_raw}
+
+    preprocess(raws["train"], raws["val"], raws["test"], args.output_name,
+               max_contexts=args.max_contexts,
+               word_vocab_size=args.word_vocab_size,
+               path_vocab_size=args.path_vocab_size,
+               target_vocab_size=args.target_vocab_size, seed=args.seed)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -252,7 +252,8 @@ class PathContextReader:
                  data_path: Optional[str] = None,
                  shard_index: int = 0, num_shards: int = 1,
                  repeat_endlessly: bool = False,
-                 parse_chunk_lines: int = 4096):
+                 parse_chunk_lines: int = 4096,
+                 batch_size: Optional[int] = None):
         self.vocabs = vocabs
         self.config = config
         self.estimator_action = estimator_action
@@ -262,6 +263,8 @@ class PathContextReader:
         self.num_shards = num_shards
         self.repeat_endlessly = repeat_endlessly
         self.parse_chunk_lines = parse_chunk_lines
+        # per-host batch override for multi-host runs
+        self.batch_size_override = batch_size
         self._rng = random.Random(config.seed)
 
     # ------------------------------------------------------------------
@@ -274,7 +277,7 @@ class PathContextReader:
             self.estimator_action, keep_strings=True)
 
     def __iter__(self) -> Iterator[RowBatch]:
-        batch_size = self.config.batch_size(
+        batch_size = self.batch_size_override or self.config.batch_size(
             is_evaluating=self.estimator_action.is_evaluate)
         if self.estimator_action.is_train:
             epochs = None if self.repeat_endlessly else self.config.num_train_epochs

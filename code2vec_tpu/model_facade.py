@@ -28,6 +28,7 @@ from code2vec_tpu.data.reader import (
 from code2vec_tpu.evaluation.evaluator import Evaluator
 from code2vec_tpu.evaluation.metrics import ModelEvaluationResults
 from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+from code2vec_tpu.parallel import distributed
 from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
 from code2vec_tpu.training import checkpoint as ckpt_mod
 from code2vec_tpu.training.loop import Trainer
@@ -109,26 +110,40 @@ class Code2VecModel:
             self.log(f"Packing {c2v_path} -> {packed_path} (one-time)")
             pack_c2v(c2v_path, self.vocabs, self.config.max_contexts,
                      out_path=packed_path)
-        return PackedDataset(packed_path, self.vocabs)
+        shard_index, num_shards = distributed.host_shard()
+        return PackedDataset(packed_path, self.vocabs,
+                             shard_index=shard_index, num_shards=num_shards)
 
     def _train_batches(self) -> Iterable:
         config = self.config
+        # each host feeds its slice of the global batch
+        # (parallel/distributed.py)
+        batch_size = distributed.local_batch_size(config.train_batch_size)
         if config.use_packed_data:
             ds = self._packed_dataset(config.train_data_path)
-            return ds.iter_batches(config.train_batch_size,
+            return ds.iter_batches(batch_size,
                                    EstimatorAction.Train,
                                    num_epochs=config.num_train_epochs,
                                    seed=config.seed)
-        return PathContextReader(self.vocabs, config, EstimatorAction.Train)
+        shard_index, num_shards = distributed.host_shard()
+        return PathContextReader(self.vocabs, config, EstimatorAction.Train,
+                                 shard_index=shard_index,
+                                 num_shards=num_shards,
+                                 batch_size=batch_size)
 
     def _eval_batches(self) -> Iterable:
         config = self.config
+        batch_size = distributed.local_batch_size(config.test_batch_size)
         if config.use_packed_data:
             ds = self._packed_dataset(config.test_data_path)
-            return ds.iter_batches(config.test_batch_size,
+            return ds.iter_batches(batch_size,
                                    EstimatorAction.Evaluate,
                                    with_target_strings=True)
-        return PathContextReader(self.vocabs, config, EstimatorAction.Evaluate)
+        shard_index, num_shards = distributed.host_shard()
+        return PathContextReader(self.vocabs, config, EstimatorAction.Evaluate,
+                                 shard_index=shard_index,
+                                 num_shards=num_shards,
+                                 batch_size=batch_size)
 
     # ------------------------------------------------------------ train
 
@@ -139,7 +154,8 @@ class Code2VecModel:
         evaluate_fn = ((lambda state: self._evaluate_with_params(state.params))
                        if config.is_testing else None)
         trainer = Trainer(config, train_step, mesh=self.mesh,
-                          evaluate_fn=evaluate_fn, save_fn=save_fn)
+                          evaluate_fn=evaluate_fn, save_fn=save_fn,
+                          profile_dir=config.profile_dir)
         self.state = trainer.train(self.state, self._train_batches(),
                                    dropout_rng(config))
         if config.is_saving:

@@ -296,9 +296,14 @@ class TrainStepBuilder:
 
 
 def device_put_batch(batch, mesh: Optional[Mesh]):
-    """Transfer a RowBatch's model arrays to device with their shardings."""
+    """Transfer a RowBatch's model arrays to device with their shardings.
+    On a multi-host runtime each process contributes its local rows and
+    the result is a global sharded array (parallel/distributed.py)."""
     arrays = _batch_arrays(batch)
     if mesh is None:
         return tuple(jnp.asarray(a) for a in arrays)
+    if jax.process_count() > 1:
+        from code2vec_tpu.parallel import distributed
+        return distributed.global_batch_arrays(batch, mesh)
     shardings = tuple(NamedSharding(mesh, s) for s in _batch_spec_tuple())
     return tuple(jax.device_put(a, s) for a, s in zip(arrays, shardings))

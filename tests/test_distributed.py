@@ -1,0 +1,52 @@
+"""Single-process semantics of the multi-host helpers
+(parallel/distributed.py); true multi-host needs a pod, but the
+single-process path must be exactly equivalent to plain device_put."""
+
+import jax
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.reader import RowBatch
+from code2vec_tpu.parallel import distributed
+from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+
+
+def _batch(b, m):
+    rng = np.random.default_rng(0)
+    return RowBatch(
+        source_token_indices=rng.integers(0, 9, (b, m)).astype(np.int32),
+        path_indices=rng.integers(0, 9, (b, m)).astype(np.int32),
+        target_token_indices=rng.integers(0, 9, (b, m)).astype(np.int32),
+        context_valid_mask=np.ones((b, m), np.float32),
+        target_index=rng.integers(0, 9, (b,)).astype(np.int32),
+        example_valid=np.ones((b,), bool))
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    distributed.initialize()  # must not raise or try to connect
+    assert distributed.host_shard() == (0, 1)
+
+
+def test_local_batch_size():
+    assert distributed.local_batch_size(1024) == 1024
+    with pytest.raises(ValueError):
+        # fake a 3-host world
+        orig = jax.process_count
+        jax.process_count = lambda: 3
+        try:
+            distributed.local_batch_size(1024)
+        finally:
+            jax.process_count = orig
+
+
+def test_global_batch_arrays_matches_device_put():
+    from code2vec_tpu.training.step import device_put_batch
+    mesh = make_mesh(MeshPlan(dp=2, tp=2, cp=2))
+    batch = _batch(4, 4)
+    via_helper = distributed.global_batch_arrays(batch, mesh)
+    via_put = device_put_batch(batch, mesh)
+    for a, b in zip(via_helper, via_put):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.sharding == b.sharding
