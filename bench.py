@@ -53,7 +53,7 @@ def _build(config):
                             compute_dtype=jnp.dtype(config.compute_dtype))
     optimizer = make_optimizer(config)
     state = create_train_state(module, optimizer, jax.random.PRNGKey(0),
-                               mesh=None)
+                               mesh=None, config=config)
     builder = TrainStepBuilder(module, optimizer, config, mesh=None)
     return state, builder.make_train_step(state), dims
 

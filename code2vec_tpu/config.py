@@ -80,6 +80,22 @@ class Config:
     # Use the hand-written shard_map tensor-parallel kernels instead of
     # relying purely on GSPMD sharding propagation (only matters if tp>1).
     use_manual_tp_kernels: bool = True
+    # Touched-rows (lazy) Adam for the token/path embedding tables
+    # (training/sparse_adam.py) instead of a dense update over all ~285M
+    # of their parameters. Default OFF on single-chip after measurement:
+    # XLA's fused scatter+Adam already runs at the HBM roofline
+    # (~670 GB/s on a v5e chip), while the sparse path's sort/permute/
+    # segment/scatter chain is bound per *index-array row* (~70-120M
+    # rows/s) regardless of how few unique rows a batch touches — it
+    # measured slower at java14m scale on both uniform and Zipf(1.07) id
+    # distributions. Where it genuinely wins is the manual
+    # tensor-parallel path at pod scale: the sparse (ids, grad-rows)
+    # all-gather exchanged per step is ~5x smaller than a dense psum of
+    # the two table-shaped gradients. Semantics are lazy Adam (TF's
+    # LazyAdam; the reference's tf.train.AdamOptimizer
+    # (tensorflow_model.py:231) decays moments and updates vars densely
+    # even for sparse grads, matching our dense default's cost model).
+    use_sparse_embedding_update: bool = False
     # Storage dtype for Adam's first moment (optax mu_dtype). bfloat16
     # halves its HBM traffic in the memory-bound update (+~5% step
     # throughput at java14m scale) with negligible effect on convergence;

@@ -67,7 +67,7 @@ class Code2VecModel:
         self.optimizer = make_optimizer(config)
         self.state = create_train_state(
             self.module, self.optimizer, jax.random.PRNGKey(config.seed),
-            mesh=self.mesh)
+            mesh=self.mesh, config=config)
         self.builder = TrainStepBuilder(self.module, self.optimizer, config,
                                         mesh=self.mesh)
         if config.is_loading:

@@ -130,14 +130,38 @@ class Code2VecModule(nn.Module):
         src = jnp.take(self.token_embedding, source_token_indices, axis=0)
         pth = jnp.take(self.path_embedding, path_indices, axis=0)
         tgt = jnp.take(self.token_embedding, target_token_indices, axis=0)
-        ctx = jnp.concatenate([src, pth, tgt], axis=-1)      # (B, M, 3d)
+        return self.transform_gathered(src, pth, tgt,
+                                       deterministic=deterministic)
+
+    def transform_gathered(
+        self,
+        source_rows: jax.Array,            # (B, M, token_dim) f32
+        path_rows: jax.Array,              # (B, M, path_dim) f32
+        target_rows: jax.Array,            # (B, M, token_dim) f32
+        deterministic: bool = True,
+    ) -> jax.Array:
+        """Concat, dropout, tanh-transform pre-gathered embedding rows.
+
+        Entry point for the sparse-optimizer train step
+        (training/step.py): gathers happen *outside* the differentiated
+        function so gradients arrive per-row instead of as dense
+        table-shaped scatters (training/sparse_adam.py).
+        """
+        ctx = jnp.concatenate([source_rows, path_rows, target_rows],
+                              axis=-1)                       # (B, M, 3d)
+        # Cast to the compute dtype *before* dropout: the masked/scaled
+        # (B, M, 3d) intermediate (and its backward) then moves through
+        # HBM at half width. The 1/keep scale in bfloat16 differs from
+        # f32 scaling below dropout's own noise floor; with
+        # compute_dtype=float32 this is exactly the reference math
+        # (tensorflow_model.py:244-245, keep=0.75).
+        ctx = ctx.astype(self.compute_dtype)
         if not deterministic:
-            # reference keeps 75% (tensorflow_model.py:244-245).
             keep = self.dropout_keep_rate
             rng = self.make_rng("dropout")
             mask = jax.random.bernoulli(rng, p=keep, shape=ctx.shape)
-            ctx = jnp.where(mask, ctx / keep, 0.0)
-        ctx = ctx.astype(self.compute_dtype)
+            ctx = jnp.where(mask, ctx / jnp.asarray(keep, ctx.dtype),
+                            jnp.zeros((), ctx.dtype))
         transformed = jnp.tanh(
             jnp.einsum("bmc,cd->bmd", ctx, self.transform.astype(self.compute_dtype),
                        preferred_element_type=jnp.float32))
@@ -176,6 +200,19 @@ class Code2VecModule(nn.Module):
             logits = jnp.where(col[None, :] < self.dims.real_target_vocab_size,
                                logits, -jnp.inf)
         return logits
+
+    def apply_from_rows(self, source_rows, path_rows, target_rows,
+                        context_valid_mask, deterministic: bool = True):
+        """Full forward from pre-gathered embedding rows (sparse-update
+        train path): (logits, code_vectors f32, attention)."""
+        transformed = self.transform_gathered(
+            source_rows, path_rows, target_rows, deterministic=deterministic)
+        code_vectors, attention = masked_single_query_attention(
+            transformed, self.attention[:, 0], context_valid_mask,
+            axis_name=self.context_axis_name)
+        code_vectors = code_vectors.astype(jnp.float32)
+        logits = self.logits_from_code_vectors(code_vectors)
+        return logits, code_vectors, attention
 
     def __call__(self, source_token_indices, path_indices, target_token_indices,
                  context_valid_mask, deterministic: bool = True):

@@ -1,0 +1,121 @@
+"""Touched-rows (lazy) Adam for the giant embedding tables.
+
+The reference's TF1 `AdamOptimizer` applies *sparse* slot updates for
+embedding gathers (reference: tensorflow_model.py:231 + TF sparse-apply
+semantics): only rows referenced by the batch are touched. A dense optax
+Adam update instead streams all ~285M token+path parameters (plus both
+moments) through HBM every step — the single largest cost of the flagship
+step. This module restores the sparse behavior TPU-natively:
+
+- gradients are taken w.r.t. the *gathered rows* (B*M rows, not the
+  (V, d) table), so no dense-shaped gradient ever materializes;
+- duplicate ids within the batch are combined by sort + segment-sum
+  (Adam is nonlinear in the gradient, so duplicates must be summed
+  before the moment update, matching what a dense update of the
+  scatter-added gradient would see);
+- the table and both moments are updated by scatter-add of *deltas*
+  (non-representative duplicate positions contribute exact zeros, so
+  scatter ordering is irrelevant).
+
+Semantics are **lazy Adam** (TF's `tf.train.AdamOptimizer._apply_sparse`
+family): moments of untouched rows do not decay, and untouched rows
+receive no momentum-driven update. This deviates from dense Adam only on
+rows absent from the batch; the first update of any row from zero-init
+moments is bit-identical (see tests/test_sparse_adam.py). Bias
+correction uses the global step count, like TF.
+
+Update math mirrors optax.scale_by_adam + scale_by_learning_rate so the
+dense and sparse paths agree on touched rows:
+
+  mu' = b1*mu + (1-b1)*g;  nu' = b2*nu + (1-b2)*g^2
+  p' = p - lr * (mu'/(1-b1^t)) / (sqrt(nu'/(1-b2^t)) + eps)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class RowAdamSlots:
+    """Adam moments for one embedding table (same leading shape)."""
+    mu: jax.Array
+    nu: jax.Array
+
+
+@flax.struct.dataclass
+class HybridOptState:
+    """Optimizer state: optax state over the dense subtree + per-table
+    row-sparse Adam slots for the embedding tables."""
+    dense: Any
+    slots: Dict[str, RowAdamSlots]
+
+
+def init_slots(table: jax.Array, mu_dtype=jnp.float32) -> RowAdamSlots:
+    return RowAdamSlots(
+        mu=jnp.zeros(table.shape, dtype=mu_dtype),
+        nu=jnp.zeros(table.shape, dtype=jnp.float32))
+
+
+def combine_duplicate_rows(ids: jax.Array, grads: jax.Array
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort ids and sum gradient rows of duplicates onto the first
+    occurrence. Returns (ids_sorted, summed_grads, is_representative):
+    non-representative positions carry an exactly-zero gradient row.
+
+    Static shapes throughout (jit/XLA friendly): output length equals
+    input length; dedup is expressed with a segment-sum, not jnp.unique.
+    """
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    ids_s = jnp.take(ids, order)
+    g_s = jnp.take(grads, order, axis=0)
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), ids_s[1:] != ids_s[:-1]])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    g_sum = jax.ops.segment_sum(g_s, seg, num_segments=n)
+    g_u = jnp.where(first[:, None], jnp.take(g_sum, seg, axis=0),
+                    jnp.zeros_like(g_s))
+    return ids_s, g_u, first
+
+
+def sparse_adam_rows(table: jax.Array, slots: RowAdamSlots,
+                     ids: jax.Array, grads: jax.Array, *,
+                     t: jax.Array, lr: float, b1: float, b2: float,
+                     eps: float) -> Tuple[jax.Array, RowAdamSlots]:
+    """Lazy-Adam-update the rows of `table` named by `ids` (duplicates
+    allowed) with gradient rows `grads`; `t` is the 1-based global step.
+
+    Ids may lie outside [0, table.shape[0]) — such positions are dropped
+    (used by the tensor-parallel path, where each shard owns a row range
+    and remaps foreign ids past the end of its local shard).
+    """
+    ids = ids.astype(jnp.int32)
+    ids_s, g_u, first = combine_duplicate_rows(ids, grads)
+
+    # Reads clamp (out-of-range rows are read but their delta is dropped
+    # at the scatter below); writes drop out-of-range indices.
+    mu_rows = jnp.take(slots.mu, ids_s, axis=0, mode="clip").astype(jnp.float32)
+    nu_rows = jnp.take(slots.nu, ids_s, axis=0, mode="clip")
+
+    new_mu = b1 * mu_rows + (1.0 - b1) * g_u
+    new_nu = b2 * nu_rows + (1.0 - b2) * (g_u * g_u)
+    tf32 = t.astype(jnp.float32)
+    mu_hat = new_mu / (1.0 - jnp.power(b1, tf32))
+    nu_hat = new_nu / (1.0 - jnp.power(b2, tf32))
+    delta_p = (-lr * mu_hat / (jnp.sqrt(nu_hat) + eps)).astype(table.dtype)
+
+    fm = first[:, None]
+    zeros = jnp.zeros_like(delta_p)
+    table = table.at[ids_s].add(jnp.where(fm, delta_p, zeros), mode="drop")
+    mu = slots.mu.at[ids_s].add(
+        jnp.where(fm, new_mu - mu_rows, jnp.zeros_like(new_mu))
+        .astype(slots.mu.dtype), mode="drop")
+    nu = slots.nu.at[ids_s].add(
+        jnp.where(fm, new_nu - nu_rows, jnp.zeros_like(new_nu)),
+        mode="drop")
+    return table, RowAdamSlots(mu=mu, nu=nu)

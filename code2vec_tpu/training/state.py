@@ -20,13 +20,33 @@ from jax.sharding import Mesh
 
 from code2vec_tpu.models.code2vec import Code2VecModule
 from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.training.sparse_adam import HybridOptState, init_slots
 
 
 @flax.struct.dataclass
 class TrainState:
     step: jax.Array         # scalar int32
     params: Any             # flax param dict
-    opt_state: Any          # optax state
+    opt_state: Any          # optax state, or HybridOptState (sparse mode)
+
+
+# Tables updated by the touched-rows sparse Adam path
+# (training/sparse_adam.py) when config.use_sparse_embedding_update.
+# target_embedding stays dense: its gradient flows through the full
+# softmax, so every row is touched every step.
+SPARSE_PARAM_NAMES = ("token_embedding", "path_embedding")
+
+
+def split_sparse_dense(params):
+    """Partition a flax param dict into (sparse tables, dense rest)."""
+    sparse = {k: v for k, v in params.items() if k in SPARSE_PARAM_NAMES}
+    dense = {k: v for k, v in params.items() if k not in SPARSE_PARAM_NAMES}
+    return sparse, dense
+
+
+def uses_sparse_update(config) -> bool:
+    return bool(config is not None
+                and getattr(config, "use_sparse_embedding_update", False))
 
 
 def make_optimizer(config) -> optax.GradientTransformation:
@@ -65,16 +85,30 @@ def create_train_state(
     optimizer: optax.GradientTransformation,
     rng: jax.Array,
     mesh: Optional[Mesh] = None,
+    config=None,
 ) -> TrainState:
     """Build a TrainState; with a mesh, every leaf is created directly into
-    its NamedSharding (no host-side full materialization)."""
+    its NamedSharding (no host-side full materialization).
+
+    With `config.use_sparse_embedding_update`, `optimizer` covers only the
+    dense subtree and the token/path tables get RowAdamSlots."""
+    sparse = uses_sparse_update(config)
+    mu_dtype = (jnp.dtype(config.adam_mu_dtype) if sparse else None)
 
     def init_fn(rng):
         params = init_params(module, rng)
+        if sparse:
+            sparse_params, dense_params = split_sparse_dense(params)
+            opt_state = HybridOptState(
+                dense=optimizer.init(dense_params),
+                slots={name: init_slots(table, mu_dtype)
+                       for name, table in sparse_params.items()})
+        else:
+            opt_state = optimizer.init(params)
         return TrainState(
             step=jnp.zeros((), dtype=jnp.int32),
             params=params,
-            opt_state=optimizer.init(params))
+            opt_state=opt_state)
 
     if mesh is None:
         return jax.jit(init_fn)(rng)

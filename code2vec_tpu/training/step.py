@@ -36,7 +36,12 @@ from code2vec_tpu.ops.attention import masked_single_query_attention
 from code2vec_tpu.ops import sharded as tp_ops
 from code2vec_tpu.parallel import mesh as mesh_lib
 from code2vec_tpu.parallel.mesh import AXIS_CTX, AXIS_DATA, AXIS_MODEL
-from code2vec_tpu.training.state import TrainState, state_spec_tree
+from code2vec_tpu.training.sparse_adam import (
+    HybridOptState, sparse_adam_rows,
+)
+from code2vec_tpu.training.state import (
+    TrainState, split_sparse_dense, state_spec_tree, uses_sparse_update,
+)
 
 
 class EvalOutputs(NamedTuple):
@@ -81,9 +86,46 @@ class TrainStepBuilder:
     # ------------------------------------------------------------- train
 
     def make_train_step(self, example_state: TrainState) -> Callable:
+        # The opt_state structure is ground truth for which update path
+        # the state was created for (state.create_train_state honors
+        # config.use_sparse_embedding_update).
+        sparse = isinstance(example_state.opt_state, HybridOptState)
+        if sparse != uses_sparse_update(self.config):
+            raise ValueError(
+                f"TrainState opt_state is {'sparse' if sparse else 'dense'} "
+                f"but config.use_sparse_embedding_update="
+                f"{self.config.use_sparse_embedding_update}; pass the same "
+                f"config to create_train_state and TrainStepBuilder.")
         if self.manual:
+            if sparse:
+                return self._make_manual_sparse_train_step(example_state)
             return self._make_manual_train_step(example_state)
+        if sparse:
+            return self._make_gspmd_sparse_train_step(example_state)
         return self._make_gspmd_train_step(example_state)
+
+    def _adam_kwargs(self):
+        # Must mirror state.make_optimizer (the dense subtree's optax
+        # transform): if that ever grows a schedule/clipping wrapper, the
+        # sparse rows must receive the equivalent treatment here.
+        cfg = self.config
+        return dict(lr=cfg.learning_rate, b1=cfg.adam_beta1,
+                    b2=cfg.adam_beta2, eps=cfg.adam_eps)
+
+    def _jit_train_step(self, fn, example_state: TrainState) -> Callable:
+        """Stage a (state, *batch, rng) -> (state, loss) callable through
+        jit: donated state, mesh shardings when a mesh is present. Single
+        source of the train-step sharding contract for all four builders."""
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=0)
+        state_sh = mesh_lib.shardings(self.mesh, state_spec_tree(example_state))
+        batch_sh = tuple(NamedSharding(self.mesh, s) for s in _batch_spec_tuple())
+        scalar_sh = NamedSharding(self.mesh, P())
+        return jax.jit(
+            fn,
+            in_shardings=(state_sh,) + batch_sh + (scalar_sh,),
+            out_shardings=(state_sh, scalar_sh),
+            donate_argnums=0)
 
     def _loss_from_logits(self, logits, labels, valid):
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
@@ -111,30 +153,79 @@ class TrainStepBuilder:
             return TrainState(step=state.step + 1, params=params,
                               opt_state=opt_state), loss
 
-        if self.mesh is None:
-            return jax.jit(train_step, donate_argnums=0)
+        return self._jit_train_step(train_step, example_state)
 
-        state_sh = mesh_lib.shardings(self.mesh, state_spec_tree(example_state))
-        batch_sh = tuple(NamedSharding(self.mesh, s) for s in _batch_spec_tuple())
-        rng_sh = NamedSharding(self.mesh, P())
-        return jax.jit(
-            train_step,
-            in_shardings=(state_sh,) + batch_sh + (rng_sh,),
-            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
-            donate_argnums=0)
+    def _make_gspmd_sparse_train_step(self, example_state: TrainState) -> Callable:
+        """Train step with touched-rows Adam for the token/path tables
+        (training/sparse_adam.py): gathers run outside the differentiated
+        function, so gradients arrive as (B*M, d) rows and no dense
+        table-shaped gradient or dense optimizer update ever exists."""
+        module, optimizer = self.module, self.optimizer
+        adam = self._adam_kwargs()
+
+        def train_step(state: TrainState, src, pth, tgt, mask, labels, valid, rng):
+            dropout_rng = jax.random.fold_in(rng, state.step)
+            tok_table = state.params["token_embedding"]
+            path_table = state.params["path_embedding"]
+            src_rows = jnp.take(tok_table, src, axis=0)
+            tgt_rows = jnp.take(tok_table, tgt, axis=0)
+            path_rows = jnp.take(path_table, pth, axis=0)
+            _, dense_params = split_sparse_dense(state.params)
+
+            def loss_fn(dense_params, src_rows, path_rows, tgt_rows):
+                full = dict(dense_params, token_embedding=tok_table,
+                            path_embedding=path_table)
+                logits, _, _ = module.apply(
+                    {"params": full}, src_rows, path_rows, tgt_rows, mask,
+                    deterministic=False, rngs={"dropout": dropout_rng},
+                    method=Code2VecModule.apply_from_rows)
+                return self._loss_from_logits(logits, labels, valid)
+
+            loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
+                dense_params, src_rows, path_rows, tgt_rows)
+            g_dense, g_src, g_path, g_tgt = grads
+
+            updates, dense_state = optimizer.update(
+                g_dense, state.opt_state.dense, dense_params)
+            new_dense = optax.apply_updates(dense_params, updates)
+
+            t = state.step + 1
+            slots = state.opt_state.slots
+            tok_ids = jnp.concatenate([src.reshape(-1), tgt.reshape(-1)])
+            tok_grads = jnp.concatenate([
+                g_src.reshape(-1, tok_table.shape[1]),
+                g_tgt.reshape(-1, tok_table.shape[1])])
+            new_tok, tok_slots = sparse_adam_rows(
+                tok_table, slots["token_embedding"], tok_ids, tok_grads,
+                t=t, **adam)
+            new_path, path_slots = sparse_adam_rows(
+                path_table, slots["path_embedding"], pth.reshape(-1),
+                g_path.reshape(-1, path_table.shape[1]), t=t, **adam)
+
+            params = dict(new_dense, token_embedding=new_tok,
+                          path_embedding=new_path)
+            opt_state = HybridOptState(
+                dense=dense_state,
+                slots={"token_embedding": tok_slots,
+                       "path_embedding": path_slots})
+            return TrainState(step=t, params=params,
+                              opt_state=opt_state), loss
+
+        return self._jit_train_step(train_step, example_state)
 
     # ---- manual shard_map path ----------------------------------------
 
-    def _manual_encode(self, params, src, pth, tgt, mask, *,
-                       deterministic: bool, dropout_rng=None):
-        """Per-shard forward to (code_vectors, attention) with explicit
-        collectives; runs inside shard_map."""
+    def _manual_rows_to_code(self, params, src_e, pth_e, tgt_e, mask, *,
+                             deterministic: bool, dropout_rng=None):
+        """concat/dropout/tanh/attention from pre-gathered rows
+        (replicated over `model`, sharded over `data`/`ctx`); runs inside
+        shard_map."""
         cfg = self.config
         compute_dtype = self.module.compute_dtype
-        src_e = tp_ops.tp_embedding_lookup(params["token_embedding"], src, AXIS_MODEL)
-        pth_e = tp_ops.tp_embedding_lookup(params["path_embedding"], pth, AXIS_MODEL)
-        tgt_e = tp_ops.tp_embedding_lookup(params["token_embedding"], tgt, AXIS_MODEL)
         ctx = jnp.concatenate([src_e, pth_e, tgt_e], axis=-1)
+        # Pre-dropout cast, as in models/code2vec.py transform_gathered
+        # (halves the masked intermediate's HBM traffic in bfloat16).
+        ctx = ctx.astype(compute_dtype)
         if not deterministic:
             # Same dropout pattern on every model shard (activations are
             # replicated over `model`), distinct across data/ctx shards.
@@ -143,14 +234,31 @@ class TrainStepBuilder:
                 jax.lax.axis_index(AXIS_CTX))
             keep = cfg.dropout_keep_rate
             mask_drop = jax.random.bernoulli(local_rng, p=keep, shape=ctx.shape)
-            ctx = jnp.where(mask_drop, ctx / keep, 0.0)
-        ctx = ctx.astype(compute_dtype)
+            ctx = jnp.where(mask_drop, ctx / jnp.asarray(keep, ctx.dtype),
+                            jnp.zeros((), ctx.dtype))
         transformed = jnp.tanh(jnp.einsum(
             "bmc,cd->bmd", ctx, params["transform"].astype(compute_dtype),
             preferred_element_type=jnp.float32)).astype(compute_dtype)
         code_vectors, attention = masked_single_query_attention(
             transformed, params["attention"][:, 0], mask, axis_name=AXIS_CTX)
         return code_vectors.astype(jnp.float32), attention
+
+    def _manual_gather(self, params, src, pth, tgt):
+        """Vocab-parallel gathers (masked local gather + psum over
+        `model`); results are replicated over the model axis."""
+        src_e = tp_ops.tp_embedding_lookup(params["token_embedding"], src, AXIS_MODEL)
+        pth_e = tp_ops.tp_embedding_lookup(params["path_embedding"], pth, AXIS_MODEL)
+        tgt_e = tp_ops.tp_embedding_lookup(params["token_embedding"], tgt, AXIS_MODEL)
+        return src_e, pth_e, tgt_e
+
+    def _manual_encode(self, params, src, pth, tgt, mask, *,
+                       deterministic: bool, dropout_rng=None):
+        """Per-shard forward to (code_vectors, attention) with explicit
+        collectives; runs inside shard_map."""
+        src_e, pth_e, tgt_e = self._manual_gather(params, src, pth, tgt)
+        return self._manual_rows_to_code(
+            params, src_e, pth_e, tgt_e, mask,
+            deterministic=deterministic, dropout_rng=dropout_rng)
 
     def _manual_ce(self, params, code_vectors, labels, valid):
         local_logits = tp_ops.tp_logits(
@@ -210,16 +318,115 @@ class TrainStepBuilder:
             in_specs=(state_specs,) + batch_specs + (P(),),
             out_specs=(state_specs, P()),
             check_vma=False)
-
         # shard_map is staged through jit for donation + caching.
-        state_sh = mesh_lib.shardings(self.mesh, state_specs)
-        batch_sh = tuple(NamedSharding(self.mesh, s) for s in batch_specs)
-        return jax.jit(
-            sharded,
-            in_shardings=(state_sh,) + batch_sh
-            + (NamedSharding(self.mesh, P()),),
-            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
-            donate_argnums=0)
+        return self._jit_train_step(sharded, example_state)
+
+    def _make_manual_sparse_train_step(self, example_state: TrainState) -> Callable:
+        """shard_map train step with touched-rows Adam on the row-sharded
+        token/path tables.
+
+        Gradient exchange for the tables is *sparse*: instead of a dense
+        psum of two table-shaped gradients (~1.1 GB at java14m scale),
+        each device all-gathers the (ids, grad-rows) lists over the
+        data/ctx axes (O(global_batch * M * d), ~5x smaller) and each
+        model shard applies the updates for the row range it owns.
+        Param/slot replicas across data/ctx stay bit-identical because
+        every device sees the same global update list.
+        """
+        assert self.mesh is not None
+        optimizer = self.optimizer
+        adam = self._adam_kwargs()
+        state_specs = state_spec_tree(example_state)
+        param_specs = state_specs.params
+        batch_specs = _batch_spec_tuple()
+        dense_specs = {k: v for k, v in param_specs.items()
+                       if k not in ("token_embedding", "path_embedding")}
+
+        def per_shard(state: TrainState, src, pth, tgt, mask, labels, valid, rng):
+            dropout_rng = jax.random.fold_in(rng, state.step)
+            params = state.params
+            tok_shard = params["token_embedding"]
+            path_shard = params["path_embedding"]
+            src_e, pth_e, tgt_e = self._manual_gather(params, src, pth, tgt)
+            _, dense_params = split_sparse_dense(params)
+
+            def loss_fn(dense_params, src_e, pth_e, tgt_e):
+                code_vectors, _ = self._manual_rows_to_code(
+                    dense_params, src_e, pth_e, tgt_e, mask,
+                    deterministic=False, dropout_rng=dropout_rng)
+                loss, _ = self._manual_ce(dense_params, code_vectors,
+                                          labels, valid)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
+                dense_params, src_e, pth_e, tgt_e)
+            g_dense, g_src, g_pth, g_tgt = grads
+
+            # Dense leaves: storage-replication transpose rule (as in the
+            # dense manual path).
+            def reduce_grad(g, spec):
+                axes = mesh_lib.replicated_axes_for_spec(spec)
+                return jax.lax.psum(g, axes) if axes else g
+            g_dense = jax.tree.map(reduce_grad, g_dense, dense_specs,
+                                   is_leaf=lambda x: isinstance(x, jax.Array))
+            updates, dense_state = optimizer.update(
+                g_dense, state.opt_state.dense, dense_params)
+            new_dense = optax.apply_updates(dense_params, updates)
+
+            # Row gradients: the gathered rows are replicated over `model`
+            # but consumed by per-shard logit slices, so the true gradient
+            # is the psum of local contributions over `model`.
+            g_src, g_pth, g_tgt = jax.lax.psum(
+                (g_src, g_pth, g_tgt), AXIS_MODEL)
+
+            def exchange(ids2d, grows):
+                """All-gather (ids, grad rows) over data+ctx so every
+                model-shard replica applies the same global update list."""
+                ids_flat = ids2d.reshape(-1)
+                g_flat = grows.reshape(-1, grows.shape[-1])
+                ids_all = jax.lax.all_gather(
+                    ids_flat, (AXIS_DATA, AXIS_CTX), axis=0, tiled=True)
+                g_all = jax.lax.all_gather(
+                    g_flat, (AXIS_DATA, AXIS_CTX), axis=0, tiled=True)
+                return ids_all, g_all
+
+            tok_ids2d = jnp.concatenate([src, tgt], axis=1)
+            tok_g2d = jnp.concatenate([g_src, g_tgt], axis=1)
+            tok_ids, tok_g = exchange(tok_ids2d, tok_g2d)
+            pth_ids, pth_g = exchange(pth, g_pth)
+
+            def to_local(ids, rows_local):
+                offset = jax.lax.axis_index(AXIS_MODEL) * rows_local
+                local = ids - offset
+                # Foreign rows -> one past the local end; sparse_adam_rows
+                # drops out-of-range writes.
+                return jnp.where((local >= 0) & (local < rows_local),
+                                 local, rows_local)
+
+            t = state.step + 1
+            slots = state.opt_state.slots
+            new_tok, tok_slots = sparse_adam_rows(
+                tok_shard, slots["token_embedding"],
+                to_local(tok_ids, tok_shard.shape[0]), tok_g, t=t, **adam)
+            new_path, path_slots = sparse_adam_rows(
+                path_shard, slots["path_embedding"],
+                to_local(pth_ids, path_shard.shape[0]), pth_g, t=t, **adam)
+
+            params = dict(new_dense, token_embedding=new_tok,
+                          path_embedding=new_path)
+            opt_state = HybridOptState(
+                dense=dense_state,
+                slots={"token_embedding": tok_slots,
+                       "path_embedding": path_slots})
+            return TrainState(step=t, params=params,
+                              opt_state=opt_state), loss
+
+        sharded = jax.shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=(state_specs,) + batch_specs + (P(),),
+            out_specs=(state_specs, P()),
+            check_vma=False)
+        return self._jit_train_step(sharded, example_state)
 
     # -------------------------------------------------------------- eval
 

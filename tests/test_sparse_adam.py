@@ -1,0 +1,275 @@
+"""Touched-rows (lazy) Adam parity: sparse update vs dense optax.
+
+The sparse path (training/sparse_adam.py + the sparse train steps in
+training/step.py) must agree with a dense optax Adam update exactly on
+touched rows, and deviate only in the documented lazy-Adam way on
+untouched rows (their moments neither decay nor drive an update).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import RowBatch
+from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+from code2vec_tpu.training.sparse_adam import (
+    HybridOptState, combine_duplicate_rows, sparse_adam_rows,
+)
+from code2vec_tpu.training.state import create_train_state, make_optimizer
+from code2vec_tpu.training.step import TrainStepBuilder, device_put_batch
+
+LR, B1, B2, EPS = 1e-3, 0.9, 0.999, 1e-8
+
+
+def _np_lazy_adam(table, mu, nu, ids, grads, t):
+    """Numpy reference: sum duplicate grads, lazy-update touched rows."""
+    table, mu, nu = table.copy(), mu.copy(), nu.copy()
+    uniq = np.unique(ids)
+    for row in uniq:
+        if not (0 <= row < table.shape[0]):
+            continue
+        g = grads[ids == row].sum(axis=0)
+        mu[row] = B1 * mu[row] + (1 - B1) * g
+        nu[row] = B2 * nu[row] + (1 - B2) * g * g
+        mu_hat = mu[row] / (1 - B1 ** t)
+        nu_hat = nu[row] / (1 - B2 ** t)
+        table[row] -= LR * mu_hat / (np.sqrt(nu_hat) + EPS)
+    return table, mu, nu
+
+
+def test_combine_duplicate_rows():
+    ids = jnp.array([3, 1, 3, 0, 1, 3], jnp.int32)
+    grads = jnp.arange(6, dtype=jnp.float32)[:, None] * jnp.ones((6, 2))
+    ids_s, g_u, first = jax.jit(combine_duplicate_rows)(ids, grads)
+    np.testing.assert_array_equal(np.asarray(ids_s), [0, 1, 1, 3, 3, 3])
+    # representative rows carry the duplicate-summed grad, others zero
+    rep = np.asarray(first)
+    got = np.asarray(g_u)[:, 0]
+    np.testing.assert_array_equal(rep, [True, True, False, True, False, False])
+    np.testing.assert_allclose(got, [3.0, 1 + 4, 0.0, 0 + 2 + 5, 0.0, 0.0])
+
+
+@pytest.mark.parametrize("steps", [1, 4])
+def test_sparse_adam_rows_matches_numpy_lazy(steps):
+    rng = np.random.default_rng(0)
+    V, d, N = 13, 5, 9
+    table = rng.standard_normal((V, d)).astype(np.float32)
+    mu = np.zeros((V, d), np.float32)
+    nu = np.zeros((V, d), np.float32)
+    jt, jmu, jnu = jnp.asarray(table), jnp.asarray(mu), jnp.asarray(nu)
+
+    step = jax.jit(lambda t_, s_, i_, g_, tt: sparse_adam_rows(
+        t_, s_, i_, g_, t=tt, lr=LR, b1=B1, b2=B2, eps=EPS))
+
+    from code2vec_tpu.training.sparse_adam import RowAdamSlots
+    slots = RowAdamSlots(mu=jmu, nu=jnu)
+    for t in range(1, steps + 1):
+        ids = rng.integers(0, V, (N,)).astype(np.int32)
+        grads = rng.standard_normal((N, d)).astype(np.float32)
+        jt, slots = step(jt, slots, jnp.asarray(ids), jnp.asarray(grads),
+                         jnp.asarray(t, jnp.int32))
+        table, mu, nu = _np_lazy_adam(table, mu, nu, ids, grads, t)
+
+    np.testing.assert_allclose(np.asarray(jt), table, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(slots.mu), mu, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(slots.nu), nu, rtol=1e-5, atol=1e-7)
+
+
+def test_sparse_adam_rows_drops_out_of_range():
+    """Out-of-range ids (the TP foreign-row sentinel) change nothing."""
+    table = jnp.ones((4, 3))
+    from code2vec_tpu.training.sparse_adam import init_slots
+    slots = init_slots(table)
+    ids = jnp.array([4, 4, 7], jnp.int32)    # all foreign
+    grads = jnp.ones((3, 3))
+    new_table, new_slots = jax.jit(
+        lambda: sparse_adam_rows(table, slots, ids, grads,
+                                 t=jnp.asarray(1), lr=LR, b1=B1, b2=B2,
+                                 eps=EPS))()
+    np.testing.assert_array_equal(np.asarray(new_table), np.asarray(table))
+    np.testing.assert_array_equal(np.asarray(new_slots.mu),
+                                  np.asarray(slots.mu))
+
+
+def test_sparse_adam_first_step_matches_dense_optax():
+    """From zero moments, one sparse update == one dense optax.adam update
+    on the scatter-added gradient (untouched rows move in neither: their
+    dense update is -lr*0/(sqrt(0)+eps) = 0)."""
+    rng = np.random.default_rng(1)
+    V, d, N = 11, 4, 20
+    table = rng.standard_normal((V, d)).astype(np.float32)
+    ids = rng.integers(0, 7, (N,)).astype(np.int32)   # rows 7..10 untouched
+    grads = rng.standard_normal((N, d)).astype(np.float32)
+
+    dense_grad = np.zeros((V, d), np.float32)
+    np.add.at(dense_grad, ids, grads)
+    tx = optax.adam(LR, b1=B1, b2=B2, eps=EPS)
+    opt_state = tx.init(jnp.asarray(table))
+    updates, _ = tx.update(jnp.asarray(dense_grad), opt_state)
+    dense_new = np.asarray(optax.apply_updates(jnp.asarray(table), updates))
+
+    from code2vec_tpu.training.sparse_adam import init_slots
+    sparse_new, _ = sparse_adam_rows(
+        jnp.asarray(table), init_slots(jnp.asarray(table)),
+        jnp.asarray(ids), jnp.asarray(grads), t=jnp.asarray(1),
+        lr=LR, b1=B1, b2=B2, eps=EPS)
+
+    np.testing.assert_allclose(np.asarray(sparse_new), dense_new,
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------- steps
+
+DIMS = ModelDims(token_vocab_size=24, path_vocab_size=16,
+                 target_vocab_size=16, token_dim=4, path_dim=4)
+
+
+def _config(**kw):
+    defaults = dict(train_data_path_prefix="unused", compute_dtype="float32",
+                    train_batch_size=8, test_batch_size=8, max_contexts=8,
+                    adam_mu_dtype="float32", dropout_keep_rate=1.0)
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def _batch(rng, B, M, dims):
+    # every token/path id appears somewhere => lazy == dense Adam even
+    # over multiple steps (all rows touched every step)
+    src = rng.integers(0, dims.token_vocab_size, (B, M)).astype(np.int32)
+    src.reshape(-1)[:dims.token_vocab_size] = np.arange(dims.token_vocab_size)
+    pth = rng.integers(0, dims.path_vocab_size, (B, M)).astype(np.int32)
+    pth.reshape(-1)[:dims.path_vocab_size] = np.arange(dims.path_vocab_size)
+    tgt = rng.integers(0, dims.token_vocab_size, (B, M)).astype(np.int32)
+    mask = np.ones((B, M), np.float32)
+    labels = rng.integers(1, dims.real_target_vocab_size, (B,)).astype(np.int32)
+    return RowBatch(
+        source_token_indices=src, path_indices=pth, target_token_indices=tgt,
+        context_valid_mask=mask, target_index=labels,
+        example_valid=np.ones((B,), bool))
+
+
+def _state_and_step(config, dims, mesh=None, sparse=True):
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=config.dropout_keep_rate)
+    opt = make_optimizer(config)
+    cfg = dataclasses.replace(config, use_sparse_embedding_update=sparse)
+    state = create_train_state(module, opt, jax.random.PRNGKey(7), mesh=mesh,
+                               config=cfg)
+    builder = TrainStepBuilder(module, opt, cfg, mesh=mesh)
+    return state, builder.make_train_step(state)
+
+
+@pytest.mark.parametrize("mu_dtype", ["float32", "bfloat16"])
+def test_sparse_step_matches_dense_step_all_rows_touched(mu_dtype):
+    """Single-device: 3 steps of the sparse train step == 3 steps of the
+    dense train step when every embedding row is touched every step
+    (dropout off; same rng). bfloat16 mu (the shipped default,
+    config.py) exercises the upcast/compute/downcast-delta scatter in
+    sparse_adam_rows with correspondingly looser tolerances."""
+    config = _config(adam_mu_dtype=mu_dtype)
+    batch = _batch(np.random.default_rng(2), 8, 8, DIMS)
+    arrays = device_put_batch(batch, None)
+    rng = jax.random.PRNGKey(3)
+
+    state_d, step_d = _state_and_step(config, DIMS, sparse=False)
+    state_s, step_s = _state_and_step(config, DIMS, sparse=True)
+    assert isinstance(state_s.opt_state, HybridOptState)
+    assert state_s.opt_state.slots["token_embedding"].mu.dtype == jnp.dtype(mu_dtype)
+
+    for _ in range(3):
+        state_d, loss_d = step_d(state_d, *arrays, rng)
+        state_s, loss_s = step_s(state_s, *arrays, rng)
+    np.testing.assert_allclose(float(loss_d), float(loss_s), rtol=1e-5)
+    loose = mu_dtype == "bfloat16"
+    for name in state_d.params:
+        np.testing.assert_allclose(
+            np.asarray(state_d.params[name]), np.asarray(state_s.params[name]),
+            rtol=1e-2 if loose else 1e-4, atol=2e-5 if loose else 1e-6,
+            err_msg=f"param {name} diverged")
+
+
+def test_sparse_lazy_leaves_untouched_rows_alone():
+    """Rows absent from the batch must not move under the sparse path
+    (the documented lazy-Adam deviation from dense Adam)."""
+    config = _config()
+    rng_np = np.random.default_rng(4)
+    B, M = 8, 8
+    # restrict ids to the lower half of each vocab
+    src = rng_np.integers(0, DIMS.token_vocab_size // 2, (B, M)).astype(np.int32)
+    pth = rng_np.integers(0, DIMS.path_vocab_size // 2, (B, M)).astype(np.int32)
+    tgt = rng_np.integers(0, DIMS.token_vocab_size // 2, (B, M)).astype(np.int32)
+    batch = RowBatch(
+        source_token_indices=src, path_indices=pth, target_token_indices=tgt,
+        context_valid_mask=np.ones((B, M), np.float32),
+        target_index=rng_np.integers(1, 16, (B,)).astype(np.int32),
+        example_valid=np.ones((B,), bool))
+    arrays = device_put_batch(batch, None)
+
+    state, step = _state_and_step(config, DIMS, sparse=True)
+    tok0 = np.asarray(state.params["token_embedding"]).copy()
+    for t in range(3):
+        state, _ = step(state, *arrays, jax.random.PRNGKey(t))
+    tok3 = np.asarray(state.params["token_embedding"])
+    half = DIMS.token_vocab_size // 2
+    np.testing.assert_array_equal(tok3[half:], tok0[half:])
+    assert np.abs(tok3[:half] - tok0[:half]).max() > 0
+
+
+@pytest.mark.parametrize("plan", [
+    MeshPlan(dp=8, tp=1, cp=1),
+    MeshPlan(dp=2, tp=2, cp=2),
+])
+def test_gspmd_sparse_step_matches_single_device(plan):
+    config = _config(dp=plan.dp, tp=plan.tp, cp=plan.cp,
+                     use_manual_tp_kernels=False)
+    dims = DIMS.padded_to(plan.tp) if plan.tp > 1 else DIMS
+    batch = _batch(np.random.default_rng(5), 8, 8, dims)
+    rng = jax.random.PRNGKey(6)
+
+    state1, step1 = _state_and_step(_config(), dims, sparse=True)
+    new1, loss1 = step1(state1, *device_put_batch(batch, None), rng)
+
+    mesh = make_mesh(plan)
+    stateN, stepN = _state_and_step(config, dims, mesh=mesh, sparse=True)
+    newN, lossN = stepN(stateN, *device_put_batch(batch, mesh), rng)
+
+    np.testing.assert_allclose(float(loss1), float(lossN), rtol=1e-5)
+    for name in new1.params:
+        np.testing.assert_allclose(
+            np.asarray(new1.params[name]), np.asarray(newN.params[name]),
+            rtol=2e-4, atol=2e-5, err_msg=f"param {name} diverged")
+
+
+@pytest.mark.parametrize("plan", [
+    MeshPlan(dp=2, tp=2, cp=2),
+    MeshPlan(dp=1, tp=8, cp=1),
+    MeshPlan(dp=2, tp=1, cp=4),
+])
+def test_manual_sparse_step_matches_single_device(plan):
+    """shard_map sparse path (sparse grad exchange via all_gather +
+    per-shard row-range updates) == single-device sparse step."""
+    config = _config(dp=plan.dp, tp=plan.tp, cp=plan.cp,
+                     use_manual_tp_kernels=True)
+    dims = DIMS.padded_to(plan.tp) if plan.tp > 1 else DIMS
+    batch = _batch(np.random.default_rng(7), 8, 8, dims)
+    rng = jax.random.PRNGKey(8)
+
+    state1, step1 = _state_and_step(_config(), dims, sparse=True)
+    new1, loss1 = step1(state1, *device_put_batch(batch, None), rng)
+
+    mesh = make_mesh(plan)
+    stateN, stepN = _state_and_step(config, dims, mesh=mesh, sparse=True)
+    assert (plan.tp > 1 or plan.cp > 1)  # manual kernels engaged
+    newN, lossN = stepN(stateN, *device_put_batch(batch, mesh), rng)
+
+    np.testing.assert_allclose(float(loss1), float(lossN), rtol=1e-5)
+    for name in new1.params:
+        np.testing.assert_allclose(
+            np.asarray(new1.params[name]), np.asarray(newN.params[name]),
+            rtol=2e-4, atol=2e-5, err_msg=f"param {name} diverged")
