@@ -40,6 +40,11 @@ def arguments_parser() -> ArgumentParser:
                         choices=["jax", "tensorflow", "keras"], default="jax",
                         help="accepted for reference CLI compatibility; this "
                              "framework always runs the JAX/TPU backend")
+    parser.add_argument("--tensorboard", dest="use_tensorboard",
+                        action="store_true",
+                        help="write TensorBoard scalars (train loss/"
+                             "throughput + eval metrics) next to the model "
+                             "artifacts")
     parser.add_argument("-v", "--verbose", dest="verbose_mode", type=int,
                         default=1, help="verbose mode in {0,1,2}")
     parser.add_argument("-lp", "--logs-path", dest="logs_path", metavar="FILE",
@@ -63,6 +68,10 @@ def arguments_parser() -> ArgumentParser:
     parser.add_argument("--gspmd", action="store_true",
                         help="disable the manual shard_map TP kernels and "
                              "rely on GSPMD sharding propagation")
+    parser.add_argument("--sparse_embedding_update", action="store_true",
+                        help="touched-rows (lazy) Adam for the token/path "
+                             "tables; wins at pod scale with the manual TP "
+                             "kernels (see config.py)")
     parser.add_argument("--profile_dir", metavar="DIR",
                         help="write a jax.profiler trace of train batches "
                              "10-20 to DIR (TensorBoard/Perfetto viewable)")
@@ -83,6 +92,8 @@ def config_from_args(argv=None) -> Config:
         save_t2v=args.save_t2v,
         verbose_mode=args.verbose_mode,
         logs_path=args.logs_path,
+        use_tensorboard=args.use_tensorboard,
+        use_sparse_embedding_update=args.sparse_embedding_update,
         dp=args.dp, tp=args.tp, cp=args.cp,
         compute_dtype=args.compute_dtype,
         seed=args.seed,

@@ -201,6 +201,13 @@ class Config:
         return os.path.dirname(self.model_load_path or "")
 
     @property
+    def tensorboard_dir(self) -> str:
+        # reference: keras_model.py:158-163 roots the TensorBoard callback
+        # next to the model artifacts.
+        base = self.model_save_path or self.model_load_path or "code2vec"
+        return base + "_tb"
+
+    @property
     def mesh_size(self) -> int:
         return self.dp * self.tp * self.cp
 

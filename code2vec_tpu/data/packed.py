@@ -29,7 +29,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from code2vec_tpu.data import reader as reader_mod
-from code2vec_tpu.data.reader import EstimatorAction, RowBatch
+from code2vec_tpu.data.reader import EpochEnd, EstimatorAction, RowBatch
 from code2vec_tpu.vocab import Code2VecVocabs
 
 _MAGIC = b"C2VB"
@@ -103,10 +103,8 @@ def _write_pack_meta(out_path: str, c2v_path: str, n_rows: int,
 def _write_chunk(out, tgt_file, chunk, vocabs, max_contexts) -> int:
     batch = reader_mod.parse_context_lines(
         chunk, vocabs, max_contexts, EstimatorAction.Evaluate)
-    # Row-major per-chunk blocks would complicate the memmap; instead we
-    # buffer whole columns per chunk and interleave chunk-by-chunk, then fix
-    # layout at read time? Simpler: single pass writes rows interleaved as
-    # [target, src, path, tgt] per row so the file is appendable.
+    # Each row is written interleaved as [target, src, path, tgt] so the
+    # file stays appendable in a single streaming pass.
     n, m = batch.source_token_indices.shape
     rec = np.empty((n, 1 + 3 * m), dtype=np.int32)
     rec[:, 0] = batch.target_index
@@ -173,7 +171,7 @@ class PackedDataset:
             self._target_strings = strings
         return self._target_strings
 
-    def gather(self, rows: np.ndarray, estimator_action: EstimatorAction,
+    def gather(self, rows: np.ndarray,
                with_target_strings: bool = False) -> RowBatch:
         m = self.max_contexts
         rec = np.asarray(self._rec[rows])  # copy out of the memmap
@@ -215,10 +213,22 @@ class PackedDataset:
             keep_chunks.append(rows[any_valid])
         return np.concatenate(keep_chunks) if keep_chunks else np.empty((0,), np.int64)
 
+    def steps_per_epoch(self, batch_size: int,
+                        estimator_action: EstimatorAction) -> int:
+        """Exact number of batches one data pass yields (post-filter) —
+        unlike the reference's raw-line `train_steps_per_epoch`
+        (config.py:165-167), this counts the rows the trainer will
+        actually consume."""
+        n = len(self._filtered_row_ids(estimator_action))
+        if estimator_action.is_train:
+            return n // batch_size
+        return -(-n // batch_size)  # eval pads the tail batch
+
     def iter_batches(self, batch_size: int, estimator_action: EstimatorAction,
                      num_epochs: int = 1, seed: int = 0,
                      repeat_endlessly: bool = False,
-                     with_target_strings: bool = False) -> Iterator[RowBatch]:
+                     with_target_strings: bool = False,
+                     yield_epoch_markers: bool = False) -> Iterator[RowBatch]:
         rows = self._filtered_row_ids(estimator_action)
         rng = np.random.default_rng(seed)
         epoch = 0
@@ -227,10 +237,11 @@ class PackedDataset:
             n_full = (len(order) // batch_size) * batch_size
             for start in range(0, n_full, batch_size):
                 yield self.gather(order[start:start + batch_size],
-                                  estimator_action, with_target_strings)
+                                  with_target_strings)
             tail = len(order) - n_full
             if tail and not estimator_action.is_train:
-                batch = self.gather(order[n_full:], estimator_action,
-                                    with_target_strings)
+                batch = self.gather(order[n_full:], with_target_strings)
                 yield reader_mod._pad_rows(batch, batch_size)
             epoch += 1
+            if yield_epoch_markers:
+                yield EpochEnd(epoch)

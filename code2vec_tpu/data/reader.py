@@ -33,6 +33,21 @@ import numpy as np
 from code2vec_tpu.vocab import Code2VecVocabs
 
 
+@dataclasses.dataclass(frozen=True)
+class EpochEnd:
+    """Marker yielded between epochs when a batch stream is constructed
+    with `yield_epoch_markers=True`.
+
+    This is the data-pass boundary itself — the trainer drives per-epoch
+    checkpointing/evaluation off these markers instead of a raw-line
+    `train_steps_per_epoch` estimate, so the schedule cannot drift when
+    rows are filtered out (the reference counts raw lines,
+    config.py:165-167, and its step math is therefore approximate).
+    `epoch` is 1-based: the marker follows the epoch's last batch.
+    """
+    epoch: int
+
+
 class EstimatorAction(enum.Enum):
     Train = "train"
     Evaluate = "evaluate"
@@ -228,8 +243,11 @@ def _pad_rows(batch: RowBatch, batch_size: int) -> RowBatch:
     return out
 
 
-def _iter_file_lines(path: str, shard_index: int, num_shards: int) -> Iterator[str]:
-    with open(path, "r", buffering=16 * 1024 * 1024) as f:
+def _iter_file_lines(path: str, shard_index: int, num_shards: int,
+                     buffer_size: int = 16 * 1024 * 1024) -> Iterator[str]:
+    # buffer_size plays the role of the reference's CsvDataset buffer
+    # (config.csv_buffer_size; reference: path_context_reader.py:122-125).
+    with open(path, "r", buffering=buffer_size) as f:
         for i, line in enumerate(f):
             if num_shards > 1 and i % num_shards != shard_index:
                 continue
@@ -253,7 +271,9 @@ class PathContextReader:
                  shard_index: int = 0, num_shards: int = 1,
                  repeat_endlessly: bool = False,
                  parse_chunk_lines: int = 4096,
-                 batch_size: Optional[int] = None):
+                 batch_size: Optional[int] = None,
+                 num_epochs: Optional[int] = None,
+                 yield_epoch_markers: bool = False):
         self.vocabs = vocabs
         self.config = config
         self.estimator_action = estimator_action
@@ -265,6 +285,14 @@ class PathContextReader:
         self.parse_chunk_lines = parse_chunk_lines
         # per-host batch override for multi-host runs
         self.batch_size_override = batch_size
+        # epoch-count override (resume trains only the remaining budget)
+        self.num_epochs_override = num_epochs
+        # Emit EpochEnd markers at file-pass boundaries (training only).
+        # With a bounded shuffle buffer the boundary is smeared by up to
+        # `shuffle_buffer_size` lines — the same smear the reference's
+        # `.repeat(epochs).shuffle(buffer)` pipeline has
+        # (path_context_reader.py:134-139).
+        self.yield_epoch_markers = yield_epoch_markers
         self._rng = random.Random(config.seed)
 
     # ------------------------------------------------------------------
@@ -280,24 +308,32 @@ class PathContextReader:
         batch_size = self.batch_size_override or self.config.batch_size(
             is_evaluating=self.estimator_action.is_evaluate)
         if self.estimator_action.is_train:
-            epochs = None if self.repeat_endlessly else self.config.num_train_epochs
+            if self.repeat_endlessly:
+                epochs = None
+            elif self.num_epochs_override is not None:
+                epochs = self.num_epochs_override
+            else:
+                epochs = self.config.num_train_epochs
             line_iter = self._shuffled_lines(epochs)
         else:
             line_iter = _iter_file_lines(self.data_path, self.shard_index,
-                                         self.num_shards)
+                                         self.num_shards,
+                                         self.config.csv_buffer_size)
         yield from self._batched(line_iter, batch_size)
 
     # ------------------------------------------------------------------
 
-    def _shuffled_lines(self, epochs: Optional[int]) -> Iterator[str]:
+    def _shuffled_lines(self, epochs: Optional[int]) -> Iterator:
         """Repeat + bounded shuffle buffer (reference semantics of
-        `.repeat(epochs).shuffle(buffer)`, path_context_reader.py:134-139)."""
+        `.repeat(epochs).shuffle(buffer)`, path_context_reader.py:134-139).
+        Yields an EpochEnd marker after every file pass."""
         buf: List[str] = []
         buf_size = self.config.shuffle_buffer_size
         epoch = 0
         while epochs is None or epoch < epochs:
             for line in _iter_file_lines(self.data_path, self.shard_index,
-                                         self.num_shards):
+                                         self.num_shards,
+                                         self.config.csv_buffer_size):
                 if len(buf) < buf_size:
                     buf.append(line)
                     continue
@@ -305,26 +341,58 @@ class PathContextReader:
                 out, buf[j] = buf[j], line
                 yield out
             epoch += 1
-        self._rng.shuffle(buf)
-        yield from buf
+            if epochs is not None and epoch == epochs:
+                # drain the buffer before the final marker
+                self._rng.shuffle(buf)
+                yield from buf
+                buf = []
+            yield EpochEnd(epoch)
 
-    def _batched(self, line_iter: Iterator[str], batch_size: int) -> Iterator[RowBatch]:
+    def _parse_chunk(self, chunk: List[str]) -> RowBatch:
+        raw = parse_context_lines(chunk, self.vocabs, self.config.max_contexts,
+                                  self.estimator_action)
+        keep = row_filter_mask(raw, self.vocabs, self.estimator_action)
+        return _select_rows(raw, np.nonzero(keep)[0])
+
+    def _parsed_chunks(self, line_iter: Iterator) -> Iterator:
+        """Yield filtered RowBatch chunks (and EpochEnd markers, in order)
+        with up to `config.reader_num_workers` chunks parsed concurrently —
+        the role of the reference's `num_parallel_calls=reader_num_workers`
+        dataset map (path_context_reader.py:141-142). The native split+
+        lookup core releases the GIL, so worker threads scale the hot
+        parse; EpochEnd markers act as ordering barriers."""
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = max(1, self.config.reader_num_workers)
+        chunk: List[str] = []
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            inflight: collections.deque = collections.deque()
+            for line in line_iter:
+                if isinstance(line, EpochEnd):
+                    # flush the partial chunk so every line of the pass is
+                    # emitted before its boundary marker
+                    if chunk:
+                        inflight.append(pool.submit(self._parse_chunk, chunk))
+                        chunk = []
+                    while inflight:
+                        yield inflight.popleft().result()
+                    yield line
+                    continue
+                chunk.append(line)
+                if len(chunk) >= self.parse_chunk_lines:
+                    inflight.append(pool.submit(self._parse_chunk, chunk))
+                    chunk = []
+                    while len(inflight) > workers:
+                        yield inflight.popleft().result()
+            if chunk:
+                inflight.append(pool.submit(self._parse_chunk, chunk))
+            while inflight:
+                yield inflight.popleft().result()
+
+    def _batched(self, line_iter: Iterator, batch_size: int) -> Iterator[RowBatch]:
         pending: List[RowBatch] = []
         pending_rows = 0
-        chunk: List[str] = []
-
-        def flush_chunk():
-            nonlocal pending_rows
-            if not chunk:
-                return
-            raw = parse_context_lines(chunk, self.vocabs, self.config.max_contexts,
-                                      self.estimator_action)
-            keep = row_filter_mask(raw, self.vocabs, self.estimator_action)
-            filtered = _select_rows(raw, np.nonzero(keep)[0])
-            if filtered.target_index.shape[0]:
-                pending.append(filtered)
-                pending_rows += filtered.target_index.shape[0]
-            chunk.clear()
 
         def pop_batches() -> Iterator[RowBatch]:
             nonlocal pending, pending_rows
@@ -340,12 +408,16 @@ class PathContextReader:
                     pending = [_select_rows(merged, np.arange(n - tail, n))]
                     pending_rows = tail
 
-        for line in line_iter:
-            chunk.append(line)
-            if len(chunk) >= self.parse_chunk_lines:
-                flush_chunk()
+        for item in self._parsed_chunks(line_iter):
+            if isinstance(item, EpochEnd):
                 yield from pop_batches()
-        flush_chunk()
+                if self.yield_epoch_markers:
+                    yield item
+                continue
+            if item.target_index.shape[0]:
+                pending.append(item)
+                pending_rows += item.target_index.shape[0]
+            yield from pop_batches()
         yield from pop_batches()
         if pending_rows:
             merged = _concat_batches(pending)

@@ -41,6 +41,12 @@ class Evaluator:
             config.top_k_words_considered_during_prediction, self.tables)
         subtoken_metric = SubtokensEvaluationMetric(self.tables)
         loss_sum = 0.0
+        # CE is summed on device over rows with a real in-vocab target
+        # (the eval step excludes OOV/PAD labels); this mirrors that mask
+        # host-side so the mean divides by the same row count.
+        oov_floor = max(self.vocabs.target_vocab.pad_index,
+                        self.vocabs.target_vocab.oov_index)
+        loss_rows = 0
         total_predictions = 0
         total_batches = 0
         start_time = time.time()
@@ -64,6 +70,8 @@ class Evaluator:
                 topk_metric.update_batch_from_indices(names, rows)
                 subtoken_metric.update_batch_from_indices(names, rows)
                 loss_sum += float(out.loss_sum)
+                loss_rows += int(np.sum(
+                    valid & (np.asarray(batch.target_index) > oov_floor)))
                 total_predictions += len(names)
                 total_batches += 1
                 if log_file is not None:
@@ -90,7 +98,7 @@ class Evaluator:
             subtoken_precision=subtoken_metric.precision,
             subtoken_recall=subtoken_metric.recall,
             subtoken_f1=subtoken_metric.f1,
-            loss=loss_sum / max(total_predictions, 1))
+            loss=loss_sum / max(loss_rows, 1))
 
     def _log_predictions(self, log_file, names, topk_rows) -> None:
         # reference: tensorflow_model.py:410-421

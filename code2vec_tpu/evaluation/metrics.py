@@ -50,6 +50,17 @@ class ModelEvaluationResults(NamedTuple):
             res = f"loss: {self.loss}, " + res
         return res
 
+    def tb_scalars(self):
+        """(tag, value) pairs for scalar logging (utils/tb.py)."""
+        out = [("top1_acc", float(self.topk_acc[0])),
+               ("topk_acc", float(self.topk_acc[-1])),
+               ("subtoken_precision", float(self.subtoken_precision)),
+               ("subtoken_recall", float(self.subtoken_recall)),
+               ("subtoken_f1", float(self.subtoken_f1))]
+        if self.loss is not None:
+            out.append(("loss", float(self.loss)))
+        return out
+
 
 class TargetWordTables:
     """Per-target-vocab-index caches: word, legality, normalized form,

@@ -54,6 +54,10 @@ class Code2VecModel:
         config.verify()
         self.log = config.log
         self.log("Creating code2vec TPU model")
+        # Full hyperparameter dump at model creation (reference:
+        # model_base.py:61-68 logs every config field).
+        for name, value in sorted(config.items()):
+            self.log(f"    {name}: {value}")
         if not config.release:
             self._init_num_of_examples()
         self.vocabs = Code2VecVocabs.load_or_create(config)
@@ -70,9 +74,17 @@ class Code2VecModel:
             mesh=self.mesh, config=config)
         self.builder = TrainStepBuilder(self.module, self.optimizer, config,
                                         mesh=self.mesh)
+        # Epoch numbering continues from the loaded artifact on resume
+        # (reference: keras_model.py:264-274 parses the epoch back from
+        # the checkpoint name; here it is carried in the artifact meta).
+        self.initial_epoch = 0
         if config.is_loading:
-            self.state = ckpt_mod.load_model(config.model_load_path, self.state)
-            self.log(f"Loaded model weights from {config.model_load_path}")
+            self.state = ckpt_mod.load_model(config.model_load_path,
+                                             self.state, config=config)
+            meta = ckpt_mod.load_model_meta(config.model_load_path)
+            self.initial_epoch = int(meta.get("epoch", 0))
+            self.log(f"Loaded model weights from {config.model_load_path} "
+                     f"(epoch {self.initial_epoch})")
         self._eval_step = None
         self._predict_step = None
         self.log(f"Model created: {num_params(self.state):,} parameters "
@@ -115,21 +127,40 @@ class Code2VecModel:
                              shard_index=shard_index, num_shards=num_shards)
 
     def _train_batches(self) -> Iterable:
+        """Training batch stream with EpochEnd markers at data-pass
+        boundaries (the trainer schedules save/eval off those). Also sets
+        `self._steps_per_epoch` (exact for packed data, None for the
+        streaming reader until its first pass completes)."""
         config = self.config
         # each host feeds its slice of the global batch
         # (parallel/distributed.py)
         batch_size = distributed.local_batch_size(config.train_batch_size)
+        self._steps_per_epoch = None
+        # `num_train_epochs` is the TOTAL epoch budget: a resumed run
+        # trains only the remainder (reference: keras fit(initial_epoch=
+        # nr_epochs_trained, epochs=NUM_TRAIN_EPOCHS), keras_model.py:
+        # 166-178, 264-274).
+        epochs_to_run = max(config.num_train_epochs - self.initial_epoch, 0)
+        if config.is_loading and epochs_to_run == 0:
+            self.log(f"Loaded model already trained {self.initial_epoch} "
+                     f"epochs (budget {config.num_train_epochs}); nothing "
+                     f"to train. Raise --epochs to continue.")
         if config.use_packed_data:
             ds = self._packed_dataset(config.train_data_path)
+            self._steps_per_epoch = ds.steps_per_epoch(
+                batch_size, EstimatorAction.Train)
             return ds.iter_batches(batch_size,
                                    EstimatorAction.Train,
-                                   num_epochs=config.num_train_epochs,
-                                   seed=config.seed)
+                                   num_epochs=epochs_to_run,
+                                   seed=config.seed,
+                                   yield_epoch_markers=True)
         shard_index, num_shards = distributed.host_shard()
         return PathContextReader(self.vocabs, config, EstimatorAction.Train,
                                  shard_index=shard_index,
                                  num_shards=num_shards,
-                                 batch_size=batch_size)
+                                 batch_size=batch_size,
+                                 num_epochs=epochs_to_run,
+                                 yield_epoch_markers=True)
 
     def _eval_batches(self) -> Iterable:
         config = self.config
@@ -153,11 +184,14 @@ class Code2VecModel:
         save_fn = self._make_save_fn() if config.is_saving else None
         evaluate_fn = ((lambda state: self._evaluate_with_params(state.params))
                        if config.is_testing else None)
+        batches = self._train_batches()
         trainer = Trainer(config, train_step, mesh=self.mesh,
                           evaluate_fn=evaluate_fn, save_fn=save_fn,
-                          profile_dir=config.profile_dir)
-        self.state = trainer.train(self.state, self._train_batches(),
-                                   dropout_rng(config))
+                          profile_dir=config.profile_dir,
+                          initial_epoch=self.initial_epoch,
+                          steps_per_epoch_hint=self._steps_per_epoch)
+        self.state = trainer.train(self.state, batches, dropout_rng(config))
+        self.initial_epoch = trainer.final_epoch
         if config.is_saving:
             self.save()
             self.log(f"Model saved in: {config.model_save_path}")
@@ -280,7 +314,8 @@ class Code2VecModel:
 
     def save(self, model_save_path: Optional[str] = None) -> str:
         path = model_save_path or self.config.model_save_path
-        return ckpt_mod.save_model(path, self.state, self.vocabs, self.config)
+        return ckpt_mod.save_model(path, self.state, self.vocabs, self.config,
+                                   epoch=self.initial_epoch)
 
     # --------------------------------------------------------- exports
 

@@ -39,6 +39,13 @@ class ModelDims:
     # under shard_map; padded classifier columns must never win, so logits
     # for ids >= real_target_vocab_size are masked to -inf.
     real_target_vocab_size: int = 0
+    # Highest special-word (PAD/OOV) index in the target vocab. Eval rows
+    # whose label is <= this floor have no real in-vocab target, so their
+    # CE term is excluded from the reported eval loss (train rows are
+    # already filtered by the reader; the reference's eval loop reports no
+    # loss at all, tensorflow_model.py:155-182, so the convention here is
+    # chosen to keep eval loss comparable to train loss).
+    target_oov_floor: int = 0
 
     def __post_init__(self):
         if self.real_target_vocab_size == 0:
@@ -72,12 +79,14 @@ class ModelDims:
 
     @classmethod
     def from_config_and_vocabs(cls, config, vocabs) -> "ModelDims":
+        tv = vocabs.target_vocab
         dims = cls(
             token_vocab_size=vocabs.token_vocab.size,
             path_vocab_size=vocabs.path_vocab.size,
-            target_vocab_size=vocabs.target_vocab.size,
+            target_vocab_size=tv.size,
             token_dim=config.token_embeddings_size,
             path_dim=config.path_embeddings_size,
+            target_oov_floor=max(tv.pad_index, tv.oov_index),
         )
         if config.tp > 1:
             dims = dims.padded_to(config.tp)

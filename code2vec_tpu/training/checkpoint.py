@@ -18,9 +18,8 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+from typing import Optional
 
-import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -33,56 +32,6 @@ RELEASED_SUFFIX = ".release"
 
 def _abs(path: str) -> str:
     return os.path.abspath(path)
-
-
-class CheckpointManager:
-    """Epoch-numbered checkpoints for one model path prefix."""
-
-    def __init__(self, directory: str, max_to_keep: int = 10):
-        self.directory = _abs(directory)
-        os.makedirs(self.directory, exist_ok=True)
-        self._manager = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=True),
-        )
-
-    def save(self, epoch: int, state: TrainState, released: bool = False) -> None:
-        target = {"params": state.params, "step": state.step}
-        if not released:
-            target["opt_state"] = state.opt_state
-        self._manager.save(epoch, args=ocp.args.StandardSave(target))
-
-    def restore(self, state_like: TrainState, epoch: Optional[int] = None) -> TrainState:
-        epoch = epoch if epoch is not None else self._manager.latest_step()
-        if epoch is None:
-            raise FileNotFoundError(
-                f"No checkpoint found under {self.directory}")
-        template = {"params": state_like.params, "step": state_like.step,
-                    "opt_state": state_like.opt_state}
-        saved_names = set()
-        try:
-            meta = self._manager.item_metadata(epoch)
-            saved_names = set(getattr(meta, "keys", lambda: [])())
-        except Exception:
-            pass
-        if saved_names and "opt_state" not in saved_names:
-            template.pop("opt_state")
-        restored = self._manager.restore(
-            epoch, args=ocp.args.StandardRestore(template))
-        return TrainState(
-            step=restored["step"],
-            params=restored["params"],
-            opt_state=restored.get("opt_state", state_like.opt_state))
-
-    def latest_epoch(self) -> Optional[int]:
-        return self._manager.latest_step()
-
-    def wait(self) -> None:
-        self._manager.wait_until_finished()
-
-    def close(self) -> None:
-        self._manager.close()
 
 
 def save_model(model_save_path: str, state: TrainState, vocabs, config,
@@ -104,6 +53,11 @@ def save_model(model_save_path: str, state: TrainState, vocabs, config,
             "token_embeddings_size": config.token_embeddings_size,
             "path_embeddings_size": config.path_embeddings_size,
             "separate_oov_and_pad": config.separate_oov_and_pad,
+            # opt_state pytree structure depends on the update mode;
+            # recorded so a mode mismatch fails with a clear error at
+            # restore time instead of an opaque Orbax structure mismatch.
+            "use_sparse_embedding_update": bool(
+                getattr(config, "use_sparse_embedding_update", False)),
         }, f, indent=2)
     ckptr = ocp.StandardCheckpointer()
     target = {"params": state.params, "step": state.step}
@@ -122,12 +76,26 @@ def load_model_meta(model_load_path: str) -> dict:
         return json.load(f)
 
 
-def load_model(model_load_path: str, state_like: TrainState) -> TrainState:
+def load_model(model_load_path: str, state_like: TrainState,
+               config=None) -> TrainState:
     """Restore a standalone artifact saved by `save_model`. `state_like`
     provides structure/shardings; released artifacts keep `state_like`'s
     (fresh) optimizer state."""
     base = _abs(model_load_path)
     meta = load_model_meta(base)
+    if config is not None and not meta.get("released", False):
+        saved_sparse = bool(meta.get("use_sparse_embedding_update", False))
+        want_sparse = bool(getattr(config, "use_sparse_embedding_update",
+                                   False))
+        if saved_sparse != want_sparse:
+            raise ValueError(
+                f"{base} was saved with use_sparse_embedding_update="
+                f"{saved_sparse} but this run has "
+                f"use_sparse_embedding_update={want_sparse}; the optimizer "
+                f"state layouts are incompatible. Either set the flag to "
+                f"match, or `--release` the artifact first (a released "
+                f"model carries no optimizer state and loads under either "
+                f"mode).")
     template = {"params": state_like.params, "step": state_like.step}
     if not meta.get("released", False):
         template["opt_state"] = state_like.opt_state

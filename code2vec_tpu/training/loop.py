@@ -2,10 +2,18 @@
 
 reference: tensorflow_model.py:40-112 — an endless `sess.run` loop with
 per-100-batch throughput logs (:83-89), per-epoch checkpoint + eval
-(:90-101). Here the step is one donated jitted call; the host thread only
-feeds prefetched batches and reads the loss scalar asynchronously
-(fetching it every batch would serialize host and device; we only block on
-it at log boundaries).
+(:90-101); keras_model.py:326-369 — mid-epoch evaluation every
+`NUM_TRAIN_BATCHES_TO_EVALUATE` batches;
+keras_checkpoint_saver_callback.py:92-127 — EMA throughput + epoch-ETA
+progress logging. Here the step is one donated jitted call; the host
+thread only feeds prefetched batches and reads the loss scalar
+asynchronously (fetching it every batch would serialize host and device;
+we only block on it at log boundaries).
+
+Epoch boundaries come from `EpochEnd` markers emitted by the data
+iterators at actual data-pass boundaries (data/reader.py) — not from a
+raw-line steps-per-epoch estimate — so checkpoints and per-epoch evals
+fire exactly once per pass regardless of how many rows the filter drops.
 """
 
 from __future__ import annotations
@@ -16,39 +24,106 @@ from typing import Callable, Iterable, Optional
 import jax
 import numpy as np
 
+from code2vec_tpu.data.reader import EpochEnd
 from code2vec_tpu.training.state import TrainState
 from code2vec_tpu.utils.prefetch import DevicePrefetcher
+
+# EMA smoothing for the throughput/ETA log, applied once per log window
+# (the reference smooths per-batch with 0.99,
+# keras_checkpoint_saver_callback.py:106-113; one window here aggregates
+# ~num_batches_to_log_progress batches, so a heavier weight on the new
+# observation gives a comparable horizon).
+_THROUGHPUT_EMA_ALPHA = 0.5
 
 
 class Trainer:
     def __init__(self, config, train_step: Callable, mesh=None,
                  evaluate_fn: Optional[Callable] = None,
                  save_fn: Optional[Callable] = None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 initial_epoch: int = 0,
+                 steps_per_epoch_hint: Optional[int] = None):
         self.config = config
         self.train_step = train_step
         self.mesh = mesh
         self.evaluate_fn = evaluate_fn
         self.save_fn = save_fn
         self.profile_dir = profile_dir
+        # Resumed runs continue the reference's `_iter<N>` numbering
+        # (keras_model.py:264-274 parses N back from the checkpoint name;
+        # here it comes from the loaded artifact's meta).
+        self.initial_epoch = initial_epoch
+        self.steps_per_epoch_hint = steps_per_epoch_hint
+        # Set by train(): the epoch count reached (initial + passes seen),
+        # recorded into the final artifact's meta so a later resume
+        # continues numbering.
+        self.final_epoch = initial_epoch
+
+    def _make_tb_writer(self):
+        if not self.config.use_tensorboard:
+            return None
+        from code2vec_tpu.utils.tb import ScalarWriter
+        logdir = self.config.tensorboard_dir
+        self.config.log(f"Writing TensorBoard scalars to {logdir}")
+        return ScalarWriter(logdir)
 
     def train(self, state: TrainState, batches: Iterable,
               rng: jax.Array) -> TrainState:
         config = self.config
         log = config.log
-        log("Starting training")
+        log("Starting training"
+            + (f" (resuming from epoch {self.initial_epoch})"
+               if self.initial_epoch else ""))
         start_time = time.time()
-        steps_per_epoch = config.train_steps_per_epoch
-        batches_per_save_and_eval = max(
-            int(steps_per_epoch * config.save_every_epochs), 1)
+        eval_every = config.num_train_batches_to_evaluate
+        tb = self._make_tb_writer()
 
-        batch_num = 0
+        batch_num = 0              # batches this run
+        epoch = self.initial_epoch
+        batch_in_epoch = 0
+        batches_since_eval = 0
+        steps_per_epoch = self.steps_per_epoch_hint
+        throughput_ema = None
         pending_losses = []
         multi_batch_start = time.time()
+        last_avg_loss = float("nan")
         prefetcher = DevicePrefetcher(batches, self.mesh,
                                       depth=config.prefetch_batches)
-        for arrays, _ in prefetcher:
+
+        def run_eval(state, label):
+            if self.evaluate_fn is None:
+                return
+            results = self.evaluate_fn(state)
+            if results is not None:
+                log(f"{label} -- {results}")
+                if tb is not None:
+                    step = int(np.asarray(jax.device_get(state.step)))
+                    for name, value in results.tb_scalars():
+                        tb.scalar(f"eval/{name}", value, step)
+                    tb.flush()
+
+        for item in prefetcher:
+            if isinstance(item, EpochEnd):
+                epoch = self.initial_epoch + item.epoch
+                if steps_per_epoch is None:
+                    steps_per_epoch = batch_in_epoch
+                batch_in_epoch = 0
+                batches_since_eval = 0
+                # Absolute-epoch cadence: stable across resumes; the final
+                # epoch always gets a save+eval even off-cadence.
+                if (epoch % config.save_every_epochs == 0
+                        or epoch >= config.num_train_epochs):
+                    if self.save_fn is not None:
+                        self.save_fn(state, epoch)
+                    run_eval(state, f"After {epoch} epochs")
+                pending_losses = []
+                multi_batch_start = time.time()
+                continue
+
+            arrays, _ = item
             batch_num += 1
+            batch_in_epoch += 1
+            batches_since_eval += 1
             if self.profile_dir and batch_num == 10:
                 jax.profiler.start_trace(self.profile_dir)
             state, loss = self.train_step(state, *arrays, rng)
@@ -59,28 +134,46 @@ class Trainer:
                 log(f"Wrote profiler trace to {self.profile_dir}")
             if batch_num % config.num_batches_to_log_progress == 0:
                 # Blocks on the device only here.
-                avg_loss = float(np.mean(jax.device_get(pending_losses)))
+                last_avg_loss = float(np.mean(jax.device_get(pending_losses)))
                 elapsed = time.time() - multi_batch_start
                 n = len(pending_losses) * config.train_batch_size
                 throughput = n / max(elapsed, 1e-9)
+                throughput_ema = (
+                    throughput if throughput_ema is None else
+                    _THROUGHPUT_EMA_ALPHA * throughput
+                    + (1 - _THROUGHPUT_EMA_ALPHA) * throughput_ema)
                 contexts_rate = throughput * config.max_contexts
-                log(f"Average loss at batch {batch_num}: {avg_loss:.6f}, "
+                eta = ""
+                if steps_per_epoch:
+                    remaining = max(steps_per_epoch - batch_in_epoch, 0)
+                    eta_s = remaining * config.train_batch_size / max(
+                        throughput_ema, 1e-9)
+                    eta = (f", epoch {epoch + 1}: "
+                           f"{batch_in_epoch}/{steps_per_epoch} batches, "
+                           f"ETA {int(eta_s) // 60}m{int(eta_s) % 60:02d}s")
+                log(f"Average loss at batch {batch_num}: {last_avg_loss:.6f}, "
                     f"\tthroughput: {throughput:.0f} samples/sec "
-                    f"({contexts_rate / 1e6:.2f}M path-contexts/sec)")
+                    f"({contexts_rate / 1e6:.2f}M path-contexts/sec{eta})")
+                if tb is not None:
+                    step = int(np.asarray(jax.device_get(state.step)))
+                    tb.scalar("train/loss", last_avg_loss, step)
+                    tb.scalar("train/examples_per_sec", throughput, step)
+                    tb.flush()
                 pending_losses = []
                 multi_batch_start = time.time()
-            if batch_num % batches_per_save_and_eval == 0:
-                epoch_num = int(batch_num / batches_per_save_and_eval
-                                * config.save_every_epochs)
-                if self.save_fn is not None:
-                    self.save_fn(state, epoch_num)
-                if self.evaluate_fn is not None:
-                    results = self.evaluate_fn(state)
-                    if results is not None:
-                        log(f"After {epoch_num} epochs -- {results}")
+            if eval_every and batches_since_eval >= eval_every:
+                # reference: ModelEvaluationCallback fires every
+                # NUM_TRAIN_BATCHES_TO_EVALUATE=1800 train batches
+                # (keras_model.py:326-369, config.py:55).
+                batches_since_eval = 0
+                run_eval(state, f"Mid-epoch (batch {batch_num}) evaluation")
+                pending_losses = []
                 multi_batch_start = time.time()
 
         log("Done training")
+        self.final_epoch = epoch
+        if tb is not None:
+            tb.close()
         elapsed = int(time.time() - start_time)
         log("Training time: %sH:%sM:%sS\n" % (
             elapsed // 3600, (elapsed // 60) % 60, elapsed % 60))

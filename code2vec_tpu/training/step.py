@@ -442,14 +442,20 @@ class TrainStepBuilder:
     def _make_gspmd_eval_step(self, example_state: TrainState, k: int) -> Callable:
         module = self.module
 
+        oov_floor = module.dims.target_oov_floor
+
         def eval_step(params, *batch_arrays) -> EvalOutputs:
             (src, pth, tgt, mask, labels, valid) = batch_arrays
             logits, code_vectors, attention = module.apply(
                 {"params": params}, src, pth, tgt, mask, deterministic=True)
             values, indices = jax.lax.top_k(logits, k)
             safe_logits = jnp.where(jnp.isfinite(logits), logits, -1e30)
+            # OOV/PAD-target rows carry no real label; excluding them keeps
+            # eval loss comparable to train loss (the reader drops such
+            # rows from training, data/reader.py row_filter_mask).
+            loss_rows = valid & (labels > oov_floor)
             ce = optax.softmax_cross_entropy_with_integer_labels(
-                safe_logits, labels) * valid.astype(jnp.float32)
+                safe_logits, labels) * loss_rows.astype(jnp.float32)
             return EvalOutputs(values, indices.astype(jnp.int32),
                                code_vectors, attention, jnp.sum(ce))
 
@@ -470,6 +476,8 @@ class TrainStepBuilder:
         param_specs = state_specs.params
         batch_specs = _batch_spec_tuple()
 
+        oov_floor = self.module.dims.target_oov_floor
+
         def per_shard(params, *batch_arrays) -> EvalOutputs:
             (src, pth, tgt, mask, labels, valid) = batch_arrays
             code_vectors, attention = self._manual_encode(
@@ -482,7 +490,8 @@ class TrainStepBuilder:
             ce = tp_ops.tp_softmax_ce(
                 jnp.where(jnp.isfinite(local_logits), local_logits, -1e30),
                 labels, AXIS_MODEL)
-            ce = ce * valid.astype(jnp.float32)
+            # Same OOV/PAD-target exclusion as the GSPMD eval step.
+            ce = ce * (valid & (labels > oov_floor)).astype(jnp.float32)
             loss_sum = jax.lax.psum(jnp.sum(ce), AXIS_DATA)
             return EvalOutputs(values, indices.astype(jnp.int32), code_vectors,
                                attention, loss_sum)

@@ -9,12 +9,15 @@ import queue
 import threading
 from typing import Iterable, Iterator, Optional
 
+from code2vec_tpu.data.reader import EpochEnd
 from code2vec_tpu.training.step import device_put_batch
 
 
 class DevicePrefetcher:
     """Wraps a RowBatch iterable; yields (device_arrays, host_batch) with up
-    to `depth` batches transferred ahead of consumption."""
+    to `depth` batches transferred ahead of consumption. EpochEnd markers
+    from the underlying iterable are passed through in order (bare, not
+    wrapped in a tuple)."""
 
     _SENTINEL = object()
 
@@ -31,6 +34,9 @@ class DevicePrefetcher:
     def _worker(self):
         try:
             for batch in self.batches:
+                if isinstance(batch, EpochEnd):
+                    self._queue.put(batch)
+                    continue
                 arrays = device_put_batch(batch, self.mesh)
                 self._queue.put(
                     (arrays, batch if self.keep_host_batch else None))

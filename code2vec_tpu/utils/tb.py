@@ -1,0 +1,105 @@
+"""Dependency-free TensorBoard scalar writer.
+
+The reference's `--tensorboard` flag attaches a Keras TensorBoard callback
+(reference: config.py:42-43, keras_model.py:158-163). This framework has
+no TensorFlow, so the event-file format is produced directly: a TFRecord
+stream (length + masked CRC32C framing) of hand-encoded `Event` protobuf
+messages containing scalar `Summary` values. Files written here load in
+stock TensorBoard.
+
+Wire format notes (protobuf encoding, stable since proto2):
+  Event:   wall_time=1 (double), step=2 (int64), file_version=3 (string),
+           summary=5 (message)
+  Summary: value=1 (repeated message); Value: tag=1 (string),
+           simple_value=2 (float)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+# ---------------------------------------------------------------- crc32c
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ----------------------------------------------------------- proto encode
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _event(wall_time: float, step: int, *, file_version: Optional[str] = None,
+           scalar: Optional[tuple] = None) -> bytes:
+    msg = bytearray()
+    msg += _varint((1 << 3) | 1) + struct.pack("<d", wall_time)
+    msg += _varint((2 << 3) | 0) + _varint(step)
+    if file_version is not None:
+        msg += _field_bytes(3, file_version.encode())
+    if scalar is not None:
+        tag, value = scalar
+        val = (_field_bytes(1, tag.encode())
+               + _varint((2 << 3) | 5) + struct.pack("<f", float(value)))
+        msg += _field_bytes(5, _field_bytes(1, val))
+    return bytes(msg)
+
+
+class ScalarWriter:
+    """Appends scalar events to one `events.out.tfevents.*` file."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        name = (f"events.out.tfevents.{int(time.time())}."
+                f"{socket.gethostname()}")
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._write(_event(time.time(), 0, file_version="brain.Event:2"))
+
+    def _write(self, record: bytes) -> None:
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", _masked_crc(record)))
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        self._write(_event(time.time(), int(step), scalar=(tag, value)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()

@@ -121,8 +121,7 @@ def test_packed_roundtrip_matches_text_parse(tiny_vocabs, tiny_config):
     ds = PackedDataset(packed_path, tiny_vocabs)
     assert ds.num_rows_total == 4
     text = parse_context_lines(lines, tiny_vocabs, 4, EstimatorAction.Evaluate)
-    packed = ds.gather(np.arange(4), EstimatorAction.Evaluate,
-                       with_target_strings=True)
+    packed = ds.gather(np.arange(4), with_target_strings=True)
     np.testing.assert_array_equal(packed.source_token_indices,
                                   text.source_token_indices)
     np.testing.assert_array_equal(packed.path_indices, text.path_indices)

@@ -1,0 +1,254 @@
+"""Epoch-accurate training-loop behavior: EpochEnd markers at data-pass
+boundaries, per-epoch save/eval scheduling, mid-epoch evaluation cadence
+(reference: keras_model.py:326-369), resume epoch numbering (reference:
+keras_model.py:264-274), the eval-loss OOV exclusion, and the native
+TensorBoard scalar writer."""
+
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.packed import PackedDataset, pack_c2v
+from code2vec_tpu.data.reader import (
+    EpochEnd, EstimatorAction, PathContextReader, RowBatch,
+)
+from code2vec_tpu.training.loop import Trainer
+
+
+def _write_c2v(path, lines):
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+@pytest.fixture
+def packed_ds(tiny_config, tiny_vocabs, tmp_path):
+    # 5 rows; one has an unknown target -> filtered from training.
+    lines = ["get|name foo,P1,bar baz,P2,foo  ",
+             "set|value bar,P3,baz   ",
+             "run foo,P2,foo bar,P1,bar  ",
+             "get|name baz,P1,foo   ",
+             "unknowntarget foo,P1,bar   "]
+    _write_c2v(tiny_config.train_data_path, lines)
+    packed = pack_c2v(tiny_config.train_data_path, tiny_vocabs,
+                      tiny_config.max_contexts)
+    return PackedDataset(packed, tiny_vocabs)
+
+
+def test_packed_epoch_markers_and_steps(packed_ds):
+    # 4 trainable rows, batch 2 -> 2 full batches/epoch.
+    assert packed_ds.steps_per_epoch(2, EstimatorAction.Train) == 2
+    items = list(packed_ds.iter_batches(2, EstimatorAction.Train,
+                                        num_epochs=3,
+                                        yield_epoch_markers=True))
+    markers = [x for x in items if isinstance(x, EpochEnd)]
+    assert [m.epoch for m in markers] == [1, 2, 3]
+    # each epoch: exactly 2 batches then its marker
+    shape = [isinstance(x, EpochEnd) for x in items]
+    assert shape == [False, False, True] * 3
+    # default: no markers (back-compat for non-trainer consumers)
+    plain = list(packed_ds.iter_batches(2, EstimatorAction.Train,
+                                        num_epochs=1))
+    assert not any(isinstance(x, EpochEnd) for x in plain)
+
+
+def test_reader_epoch_markers(tiny_config, tiny_vocabs):
+    lines = [f"get|name foo,P1,bar baz,P2,foo  " for _ in range(8)]
+    _write_c2v(tiny_config.train_data_path, lines)
+    tiny_config.num_train_epochs = 2
+    tiny_config.shuffle_buffer_size = 4
+    reader = PathContextReader(tiny_vocabs, tiny_config,
+                               EstimatorAction.Train,
+                               yield_epoch_markers=True)
+    items = list(reader)
+    markers = [x for x in items if isinstance(x, EpochEnd)]
+    assert [m.epoch for m in markers] == [1, 2]
+    batches = [x for x in items if not isinstance(x, EpochEnd)]
+    # 16 filtered rows over 2 epochs, batch 2 -> 8 batches total
+    assert sum(b.target_index.shape[0] for b in batches) == 16
+
+
+def _fake_batch(n=2, m=4):
+    return RowBatch(
+        source_token_indices=np.ones((n, m), np.int32),
+        path_indices=np.ones((n, m), np.int32),
+        target_token_indices=np.ones((n, m), np.int32),
+        context_valid_mask=np.ones((n, m), np.float32),
+        target_index=np.ones((n,), np.int32),
+        example_valid=np.ones((n,), bool))
+
+
+def _marker_stream(batches_per_epoch, epochs):
+    for e in range(epochs):
+        for _ in range(batches_per_epoch):
+            yield _fake_batch()
+        yield EpochEnd(e + 1)
+
+
+class _State:
+    step = np.zeros((), np.int32)
+
+
+def _run_trainer(config, stream, **kw):
+    saves, evals = [], []
+
+    def train_step(state, *args):
+        return state, np.float32(1.0)
+
+    trainer = Trainer(config, train_step,
+                      evaluate_fn=lambda s: evals.append(1),
+                      save_fn=lambda s, e: saves.append(e), **kw)
+    trainer.train(_State(), stream, rng=np.zeros((2,), np.uint32))
+    return saves, evals
+
+
+def test_trainer_saves_and_evals_once_per_epoch(tiny_config):
+    tiny_config.num_train_epochs = 3
+    tiny_config.verbose_mode = 0
+    saves, evals = _run_trainer(tiny_config, _marker_stream(5, 3))
+    assert saves == [1, 2, 3]
+    assert len(evals) == 3  # exactly one per data pass, incl. the final
+
+
+def test_trainer_resume_continues_epoch_numbering(tiny_config):
+    tiny_config.num_train_epochs = 2
+    tiny_config.verbose_mode = 0
+    saves, _ = _run_trainer(tiny_config, _marker_stream(3, 2),
+                            initial_epoch=5)
+    assert saves == [6, 7]
+
+
+def test_trainer_final_epoch_always_evaluated(tiny_config):
+    # save_every_epochs=2 with 3 epochs: boundary epochs 2 and (forced) 3.
+    tiny_config.num_train_epochs = 3
+    tiny_config.save_every_epochs = 2
+    tiny_config.verbose_mode = 0
+    saves, evals = _run_trainer(tiny_config, _marker_stream(4, 3))
+    assert saves == [2, 3]
+    assert len(evals) == 2
+
+
+def test_trainer_mid_epoch_eval_cadence(tiny_config):
+    # reference: NUM_TRAIN_BATCHES_TO_EVALUATE (keras_model.py:326-369).
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_train_batches_to_evaluate = 3
+    tiny_config.verbose_mode = 0
+    saves, evals = _run_trainer(tiny_config, _marker_stream(8, 1))
+    # batches 3 and 6 mid-epoch, plus the epoch-end eval
+    assert len(evals) == 3
+    assert saves == [1]
+
+
+# ------------------------------------------------------------ tb writer
+
+def _read_tb_events(path):
+    """Minimal TFRecord/Event parser validating the framing CRCs."""
+    from code2vec_tpu.utils.tb import _masked_crc
+    events = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            assert hcrc == _masked_crc(header)
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            assert dcrc == _masked_crc(data)
+            events.append(data)
+    return events
+
+
+def test_tb_writer_roundtrip(tmp_path):
+    from code2vec_tpu.utils.tb import ScalarWriter
+    w = ScalarWriter(str(tmp_path / "tb"))
+    w.scalar("train/loss", 1.5, step=7)
+    w.scalar("eval/f1", 0.25, step=7)
+    w.close()
+    events = _read_tb_events(w.path)
+    assert len(events) == 3  # file_version + 2 scalars
+    assert b"brain.Event:2" in events[0]
+    assert b"train/loss" in events[1]
+    # float 1.5 little-endian must appear in the first scalar event
+    assert struct.pack("<f", 1.5) in events[1]
+    assert b"eval/f1" in events[2]
+
+
+# ------------------------------------------------- eval-loss OOV exclusion
+
+def test_eval_loss_excludes_oov_targets(tiny_vocabs, tiny_config):
+    import jax
+    import jax.numpy as jnp
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+    from code2vec_tpu.training.step import TrainStepBuilder
+
+    tiny_config.compute_dtype = "float32"
+    dims = ModelDims.from_config_and_vocabs(tiny_config, tiny_vocabs)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32)
+    opt = make_optimizer(tiny_config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(0),
+                               config=tiny_config)
+    builder = TrainStepBuilder(module, opt, tiny_config)
+    eval_step = builder.make_eval_step(state)
+
+    n, m = 4, tiny_config.max_contexts
+    src = jnp.ones((n, m), jnp.int32)
+    pth = jnp.ones((n, m), jnp.int32)
+    tgt = jnp.ones((n, m), jnp.int32)
+    mask = jnp.ones((n, m), jnp.float32)
+    valid = jnp.array([True, True, True, False])
+    oov = tiny_vocabs.target_vocab.oov_index
+    labels_all_known = jnp.array([2, 3, 2, 2], jnp.int32)
+    labels_one_oov = jnp.array([2, 3, oov, 2], jnp.int32)
+
+    out_known = eval_step(state.params, src, pth, tgt, mask,
+                          labels_all_known, valid)
+    out_oov = eval_step(state.params, src, pth, tgt, mask,
+                        labels_one_oov, valid)
+    # the OOV row contributes nothing; the padded-invalid row never does
+    assert float(out_oov.loss_sum) < float(out_known.loss_sum)
+    two_rows = eval_step(state.params, src, pth, tgt, mask,
+                         labels_all_known,
+                         jnp.array([True, True, False, False]))
+    np.testing.assert_allclose(float(out_oov.loss_sum),
+                               float(two_rows.loss_sum), rtol=1e-6)
+
+
+# ------------------------------------------- checkpoint mode mismatch
+
+def test_checkpoint_mode_mismatch_is_a_clear_error(tmp_path, tiny_vocabs,
+                                                   tiny_config):
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training import checkpoint as ckpt_mod
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+
+    tiny_config.compute_dtype = "float32"
+    dims = ModelDims.from_config_and_vocabs(tiny_config, tiny_vocabs)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32)
+    opt = make_optimizer(tiny_config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(0),
+                               config=tiny_config)
+    path = str(tmp_path / "model")
+    ckpt_mod.save_model(path, state, tiny_vocabs, tiny_config, epoch=4)
+
+    meta = ckpt_mod.load_model_meta(path)
+    assert meta["epoch"] == 4
+    assert meta["use_sparse_embedding_update"] is False
+
+    sparse_config = dataclasses.replace(tiny_config,
+                                        use_sparse_embedding_update=True)
+    with pytest.raises(ValueError, match="use_sparse_embedding_update"):
+        ckpt_mod.load_model(path, state, config=sparse_config)
+    # released artifacts are mode-agnostic
+    rel = ckpt_mod.save_model(path, state, tiny_vocabs, tiny_config,
+                              released=True)
+    restored = ckpt_mod.load_model(rel, state, config=sparse_config)
+    assert int(np.asarray(restored.step)) == int(np.asarray(state.step))
