@@ -1,0 +1,11 @@
+class Input {
+    int[] values;
+
+    int sumValues() {
+        int acc = 0;
+        for (int v : this.values) {
+            acc += v;
+        }
+        return acc;
+    }
+}

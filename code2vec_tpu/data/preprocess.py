@@ -80,48 +80,55 @@ def process_file(file_path: str, data_file_role: str, dataset_name: str,
     reference: preprocess.py:23-74.
     """
     rng = rng or random.Random(0)
-    sum_total = sum_sampled = total = empty = max_unfiltered = 0
+    contexts_seen = contexts_kept = written = skipped_empty = 0
+    widest_method = 0
     output_path = f"{dataset_name}.{data_file_role}.c2v"
-    with open(output_path, "w") as outfile, open(file_path, "r") as file:
-        for line in file:
-            parts = line.rstrip("\n").split(" ")
-            target_name = parts[0]
-            contexts = parts[1:]
-            max_unfiltered = max(max_unfiltered, len(contexts))
-            sum_total += len(contexts)
+    with open(output_path, "w") as outfile, open(file_path, "r") as infile:
+        for line in infile:
+            fields = line.rstrip("\n").split(" ")
+            method_name, contexts = fields[0], fields[1:]
+            widest_method = max(widest_method, len(contexts))
+            contexts_seen += len(contexts)
 
             if len(contexts) > max_contexts:
-                context_parts = [c.split(",") for c in contexts]
-                full = [c for i, c in enumerate(contexts)
-                        if _context_full_found(context_parts[i], word_to_count,
-                                               path_to_count)]
-                partial = [c for i, c in enumerate(contexts)
-                           if _context_partial_found(context_parts[i], word_to_count,
-                                                     path_to_count)
-                           and not _context_full_found(context_parts[i],
-                                                       word_to_count, path_to_count)]
-                if len(full) > max_contexts:
-                    contexts = rng.sample(full, max_contexts)
-                elif len(full) + len(partial) > max_contexts:
-                    contexts = full + rng.sample(partial, max_contexts - len(full))
+                # Over-budget methods keep their fully-in-vocab contexts
+                # first, then partially-in-vocab ones, sampling at random
+                # within the tier that crosses the budget — the sampling
+                # contract the reference preprocessor defines
+                # (preprocess.py:41-56), which the vocab hit rate of the
+                # trained model depends on.
+                split = [c.split(",") for c in contexts]
+                in_vocab, mixed = [], []
+                for ctx, parts in zip(contexts, split):
+                    if _context_full_found(parts, word_to_count,
+                                           path_to_count):
+                        in_vocab.append(ctx)
+                    elif _context_partial_found(parts, word_to_count,
+                                                path_to_count):
+                        mixed.append(ctx)
+                if len(in_vocab) > max_contexts:
+                    contexts = rng.sample(in_vocab, max_contexts)
+                elif len(in_vocab) + len(mixed) > max_contexts:
+                    contexts = in_vocab + rng.sample(
+                        mixed, max_contexts - len(in_vocab))
                 else:
-                    contexts = full + partial
+                    contexts = in_vocab + mixed
 
-            if len(contexts) == 0:
-                empty += 1
+            if not contexts:
+                skipped_empty += 1
                 continue
-            sum_sampled += len(contexts)
+            contexts_kept += len(contexts)
             padding = " " * (max_contexts - len(contexts))
-            outfile.write(target_name + " " + " ".join(contexts) + padding + "\n")
-            total += 1
+            outfile.write(method_name + " " + " ".join(contexts) + padding + "\n")
+            written += 1
 
-    log(f"File: {file_path}")
-    log(f"Average total contexts: {float(sum_total) / max(total, 1)}")
-    log(f"Average final (after sampling) contexts: {float(sum_sampled) / max(total, 1)}")
-    log(f"Total examples: {total}")
-    log(f"Empty examples: {empty}")
-    log(f"Max number of contexts per word: {max_unfiltered}")
-    return total
+    denom = max(written, 1)
+    log(f"{output_path}: {written} examples written, {skipped_empty} "
+        f"skipped (no contexts)")
+    log(f"  contexts/method: {contexts_seen / denom:.1f} raw -> "
+        f"{contexts_kept / denom:.1f} after sampling "
+        f"(widest method: {widest_method})")
+    return written
 
 
 def save_dictionaries(dataset_name: str, word_to_count: Dict[str, int],
@@ -179,35 +186,113 @@ def _native_extractor(language: str) -> str:
     return path
 
 
-def extract_dir(source_dir: str, out_path: str, language: str = "java",
-                max_path_length: int = 8, max_path_width: int = 2,
-                num_threads: int = 32, shuffle: bool = False,
-                seed: int = 0, log=print) -> str:
-    """Run the native AST path extractor over a source tree, writing raw
-    context lines to `out_path` (optionally shuffled, as the reference
-    pipes the train split through `shuf`, preprocess.sh:42-48).
-    """
-    extractor = _native_extractor(language)
+def _extractor_command(extractor: str, language: str, target_flag: str,
+                       target: str, max_path_length: int,
+                       max_path_width: int, num_threads: int):
     if language == "java":
-        command = [extractor, "--max_path_length", str(max_path_length),
-                   "--max_path_width", str(max_path_width),
-                   "--dir", source_dir, "--num_threads", str(num_threads)]
-    else:
-        command = [extractor, "--path", source_dir,
-                   "--max_length", str(max_path_length),
-                   "--max_width", str(max_path_width),
-                   "--threads", str(num_threads)]
-    log(f"Extracting {source_dir} -> {out_path} ({language})")
-    with open(out_path + ".tmp", "w") as out:
+        return [extractor, "--max_path_length", str(max_path_length),
+                "--max_path_width", str(max_path_width),
+                target_flag, target, "--num_threads", str(num_threads)]
+    # the C# extractor takes --path for both files and directories
+    return [extractor, "--path", target,
+            "--max_length", str(max_path_length),
+            "--max_width", str(max_path_width),
+            "--threads", str(num_threads)]
+
+
+def _run_extractor_tree(out, extractor: str, language: str, target: str,
+                        max_path_length: int, max_path_width: int,
+                        num_threads: int, timeout: Optional[float],
+                        log, _retrying: bool = False) -> int:
+    """Extract `target` (a directory or file) into the open binary `out`
+    stream, with a kill-timer and recursive per-subdirectory retry: if the
+    whole tree times out, descend and extract each child separately so one
+    pathological file cannot stall the run — the reference driver's
+    resilience strategy (JavaExtractor/extract.py:38-58: kill-timer +
+    per-subdir re-extraction, partial output discarded). During a retry
+    descent, nonzero child exits are also skipped-and-logged rather than
+    fatal (a file that crashes the parser must not abort the run); a
+    nonzero exit on the original whole-tree attempt stays a hard error
+    (that is a broken setup, not a bad input file).
+    Returns the number of targets skipped after exhausting retries."""
+    is_dir = os.path.isdir(target)
+    flag = "--dir" if is_dir else "--file"
+    command = _extractor_command(extractor, language, flag, target,
+                                 max_path_length, max_path_width,
+                                 num_threads)
+    # stdout streams straight into `out` (no buffering of multi-GB
+    # extractions); on kill/failure the file is truncated back so a
+    # partial line from a killed run never survives (the reference
+    # deletes partial outputs, JavaExtractor/extract.py:56-58). `out` is
+    # binary-mode and only ever written through child fds, so tell() is
+    # the true fd offset.
+    out.flush()
+    pos = out.tell()
+
+    def descend() -> int:
+        skipped = 0
+        for name in sorted(os.listdir(target)):
+            child = os.path.join(target, name)
+            if not (os.path.isdir(child) or child.endswith(
+                    ".java" if language == "java" else ".cs")):
+                continue
+            skipped += _run_extractor_tree(
+                out, extractor, language, child, max_path_length,
+                max_path_width, num_threads, timeout, log, _retrying=True)
+        return skipped
+
+    try:
         result = subprocess.run(command, stdout=out, stderr=subprocess.PIPE,
-                                text=True)
+                                text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        out.truncate(pos)
+        out.seek(pos)
+        if not is_dir:
+            log(f"  TIMEOUT: skipping unextractable file {target}")
+            return 1
+        log(f"  TIMEOUT extracting {target}; retrying per child")
+        return descend()
     if result.returncode != 0:
+        out.truncate(pos)
+        out.seek(pos)
+        if _retrying:
+            if is_dir:
+                log(f"  extractor failed on {target} "
+                    f"({result.returncode}); retrying per child")
+                return descend()
+            log(f"  extractor failed on {target} ({result.returncode}); "
+                f"skipping")
+            return 1
         raise RuntimeError(
             f"extractor failed ({result.returncode}): {result.stderr[-2000:]}")
     if result.stderr:
-        skipped = result.stderr.count("failed to extract")
+        unparseable = result.stderr.count("failed to extract")
+        if unparseable:
+            log(f"  ({unparseable} files skipped as unparseable)")
+    return 0
+
+
+def extract_dir(source_dir: str, out_path: str, language: str = "java",
+                max_path_length: int = 8, max_path_width: int = 2,
+                num_threads: int = 32, shuffle: bool = False,
+                seed: int = 0, timeout: Optional[float] = 600000.0,
+                log=print) -> str:
+    """Run the native AST path extractor over a source tree, writing raw
+    context lines to `out_path` (optionally shuffled, as the reference
+    pipes the train split through `shuf`, preprocess.sh:42-48). A hung
+    extraction is killed after `timeout` seconds and retried per
+    subdirectory/file (reference: JavaExtractor/extract.py:38-58; the
+    default matches the reference's deliberately generous 600000s timer —
+    tighten it for interactive runs).
+    """
+    extractor = _native_extractor(language)
+    log(f"Extracting {source_dir} -> {out_path} ({language})")
+    with open(out_path + ".tmp", "wb") as out:
+        skipped = _run_extractor_tree(
+            out, extractor, language, source_dir, max_path_length,
+            max_path_width, num_threads, timeout, log)
         if skipped:
-            log(f"  ({skipped} files skipped as unparseable)")
+            log(f"  {skipped} targets skipped after timeout/failure")
     if shuffle:
         # like the reference's `| shuf`: whole-file shuffle of the raw
         # train split (training also reshuffles per epoch from the
@@ -253,6 +338,9 @@ def main(argv=None) -> None:
     parser.add_argument("--path_vocab_size", type=int, default=911417)
     parser.add_argument("--target_vocab_size", type=int, default=261245)
     parser.add_argument("--num_threads", type=int, default=32)
+    parser.add_argument("--extract_timeout", type=float, default=600.0,
+                        help="seconds before a hung extraction is killed "
+                             "and retried per subdirectory/file")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -280,7 +368,7 @@ def main(argv=None) -> None:
                 language=args.language, max_path_length=args.max_path_length,
                 max_path_width=args.max_path_width,
                 num_threads=args.num_threads, shuffle=role == "train",
-                seed=args.seed)
+                seed=args.seed, timeout=args.extract_timeout)
     else:
         raws = {"train": args.train_raw, "val": args.val_raw,
                 "test": args.test_raw}

@@ -117,3 +117,80 @@ def test_main_arg_validation(tmp_path):
         pp.main(["--output_name", str(tmp_path / "x"),
                  "--train_dir", "a", "--train_raw", "b",
                  "--val_dir", "c", "--test_dir", "d"])  # both modes
+
+
+def test_extract_timeout_retries_per_child(tmp_path):
+    """A hung whole-tree extraction is killed and retried per child; a
+    single hanging file is skipped and logged (reference resilience
+    semantics: JavaExtractor/extract.py:38-58)."""
+    import os
+    import stat
+
+    # Fake extractor: hangs on --dir and on any file named Hang.java;
+    # emits one line per other file.
+    fake = tmp_path / "fake-extract"
+    fake.write_text(
+        "#!/bin/sh\n"
+        "while [ $# -gt 0 ]; do\n"
+        "  case $1 in\n"
+        "    --dir) sleep 30;;\n"
+        "    --file) case $2 in *Hang.java) sleep 30;; "
+        "*) echo \"m a,$2,b\";; esac; shift;;\n"
+        "  esac\n"
+        "  shift\n"
+        "done\n")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    tree = tmp_path / "tree"
+    sub = tree / "proj"
+    sub.mkdir(parents=True)
+    (sub / "A.java").write_text("class A {}")
+    (sub / "Hang.java").write_text("class H {}")
+    (sub / "B.java").write_text("class B {}")
+
+    logs = []
+    out = tmp_path / "out.txt"
+    with open(out, "wb") as f:
+        skipped = pp._run_extractor_tree(
+            f, str(fake), "java", str(tree), 8, 2, 1, timeout=1.0,
+            log=logs.append)
+    lines = out.read_text().splitlines()
+    assert skipped == 1
+    assert len(lines) == 2  # A.java and B.java extracted
+    assert all("Hang" not in ln for ln in lines)
+    assert any("TIMEOUT" in m and "Hang.java" in m for m in logs)
+
+
+def test_extract_retry_skips_crashing_children(tmp_path):
+    """During a retry descent, a child that crashes the extractor is
+    skipped-and-logged, not fatal (the resilience path must survive
+    pathological inputs)."""
+    import stat
+
+    fake = tmp_path / "fake-extract"
+    fake.write_text(
+        "#!/bin/sh\n"
+        "while [ $# -gt 0 ]; do\n"
+        "  case $1 in\n"
+        "    --dir) sleep 30;;\n"
+        "    --file) case $2 in *Crash.java) exit 9;; "
+        "*) echo \"m a,$2,b\";; esac; shift;;\n"
+        "  esac\n"
+        "  shift\n"
+        "done\n")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "A.java").write_text("class A {}")
+    (tree / "Crash.java").write_text("class C {}")
+
+    logs = []
+    out = tmp_path / "out.txt"
+    with open(out, "wb") as f:
+        skipped = pp._run_extractor_tree(
+            f, str(fake), "java", str(tree), 8, 2, 1, timeout=1.0,
+            log=logs.append)
+    assert skipped == 1
+    assert out.read_text().count("\n") == 1
+    assert any("failed on" in m and "Crash.java" in m for m in logs)
