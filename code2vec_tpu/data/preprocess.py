@@ -275,15 +275,16 @@ def _run_extractor_tree(out, extractor: str, language: str, target: str,
 def extract_dir(source_dir: str, out_path: str, language: str = "java",
                 max_path_length: int = 8, max_path_width: int = 2,
                 num_threads: int = 32, shuffle: bool = False,
-                seed: int = 0, timeout: Optional[float] = 600000.0,
+                seed: int = 0, timeout: Optional[float] = 600.0,
                 log=print) -> str:
     """Run the native AST path extractor over a source tree, writing raw
     context lines to `out_path` (optionally shuffled, as the reference
     pipes the train split through `shuf`, preprocess.sh:42-48). A hung
     extraction is killed after `timeout` seconds and retried per
-    subdirectory/file (reference: JavaExtractor/extract.py:38-58; the
-    default matches the reference's deliberately generous 600000s timer —
-    tighten it for interactive runs).
+    subdirectory/file (reference: JavaExtractor/extract.py:38-58 — whose
+    `Timer(600000, kill)` is in seconds, ~7 days, so its kill-timer never
+    fires in practice; 600s here keeps the protection real and matches
+    the CLI's --extract_timeout default).
     """
     extractor = _native_extractor(language)
     log(f"Extracting {source_dir} -> {out_path} ({language})")

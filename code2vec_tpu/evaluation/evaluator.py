@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterable, Optional
 
+import jax
 import numpy as np
 
 from code2vec_tpu.evaluation.metrics import (
@@ -33,6 +34,20 @@ class Evaluator:
         self.mesh = mesh
         self.log_path = log_path
         self.tables = TargetWordTables(vocabs.target_vocab)
+
+    def _host_rows(self, arr) -> np.ndarray:
+        """Rows of a data-sharded eval output that THIS host computed.
+        Single-process: the whole array. Multi-host: the eval step's
+        outputs are global arrays sharded over `data`; each process can
+        only address (and only needs) the rows of its own data shard —
+        the same rows it contributed via `global_batch_arrays`."""
+        if jax.process_count() == 1 or not hasattr(arr, "addressable_shards"):
+            return np.asarray(arr)
+        blocks = {}  # row-start -> shard data (dedup tp/cp replicas)
+        for s in arr.addressable_shards:
+            blocks.setdefault(s.index[0].start or 0, s.data)
+        return np.concatenate(
+            [np.asarray(blocks[k]) for k in sorted(blocks)], axis=0)
 
     def evaluate(self, params, batches: Iterable,
                  code_vectors_path: Optional[str] = None) -> ModelEvaluationResults:
@@ -57,7 +72,7 @@ class Evaluator:
             for batch in batches:
                 arrays = device_put_batch(batch, self.mesh)
                 out = self.eval_step(params, *arrays)
-                topk_indices = np.asarray(out.topk_indices)
+                topk_indices = self._host_rows(out.topk_indices)
                 valid = np.asarray(batch.example_valid)
                 names = batch.target_strings
                 if names is None:
@@ -77,7 +92,7 @@ class Evaluator:
                 if log_file is not None:
                     self._log_predictions(log_file, names, rows)
                 if vectors_file is not None:
-                    code_vectors = np.asarray(out.code_vectors)[valid]
+                    code_vectors = self._host_rows(out.code_vectors)[valid]
                     for vec in code_vectors:
                         vectors_file.write(" ".join(map(str, vec)) + "\n")
                 if total_batches % config.num_batches_to_log_progress == 0:
@@ -92,6 +107,29 @@ class Evaluator:
                 vectors_file.close()
             if log_file is not None:
                 log_file.close()
+
+        # Multi-host: each process scored its own rows of each global
+        # batch; sum the raw counters across hosts so the reported metrics
+        # are global ratios of global counts (parallel/distributed.py).
+        # `loss_sum` is NOT reduced: the eval step psums CE over the whole
+        # global batch and replicates it, so every host already holds the
+        # global total. `loss_rows` is a host-local count, so it is.
+        if jax.process_count() > 1:
+            from code2vec_tpu.parallel import distributed
+            packed = np.concatenate([
+                [loss_rows,
+                 topk_metric.nr_predictions,
+                 subtoken_metric.nr_true_positives,
+                 subtoken_metric.nr_false_positives,
+                 subtoken_metric.nr_false_negatives],
+                topk_metric.nr_correct_predictions,
+            ])
+            packed = distributed.allreduce_host_scalars(packed)
+            (loss_rows, topk_metric.nr_predictions,
+             subtoken_metric.nr_true_positives,
+             subtoken_metric.nr_false_positives,
+             subtoken_metric.nr_false_negatives) = packed[:5]
+            topk_metric.nr_correct_predictions = packed[5:]
 
         return ModelEvaluationResults(
             topk_acc=topk_metric.topk_correct_predictions,

@@ -15,11 +15,13 @@ framework is exactly three things, provided here:
 3. `global_batch_arrays` — assembles per-host numpy shards into global
    `jax.Array`s over the mesh (`jax.make_array_from_process_local_data`),
    the multi-host replacement for a plain `device_put`.
+4. `allreduce_host_scalars` — sums small host-side metric counters
+   (eval tp/fp/fn, top-k hits, loss) across processes, so evaluation
+   over per-host data shards reports GLOBAL metrics (the evaluator
+   reduces its counters through this before computing ratios).
 
-Known limitation: evaluation on a multi-host runtime scores each host's
-data shard independently (per-host metrics; process 0's log covers its
-shard only) — cross-host metric reduction is future work. Training,
-checkpointing and the jitted step are fully multi-host.
+The per-example audit log (`log.txt`) stays per-host by design: each
+process logs the examples it scored; metrics are global.
 """
 
 from __future__ import annotations
@@ -83,6 +85,24 @@ def local_batch_size(global_batch_size: int) -> int:
             f"global batch size {global_batch_size} is not divisible by "
             f"the number of hosts {n}.")
     return global_batch_size // n
+
+
+def allreduce_host_scalars(values) -> "np.ndarray":
+    """Sum a small 1-D host-side float array across all processes.
+
+    Used by the evaluator to turn per-host metric counters (subtoken
+    tp/fp/fn, top-k hit counts, loss sums) into global totals before
+    computing ratios — ratios of sums, not means of per-host ratios,
+    so the result is exactly what a single-host run over the full data
+    would report. Single-process: identity (no collective compiled).
+    """
+    import numpy as np
+    values = np.asarray(values, dtype=np.float64)
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(values)
+    return np.sum(np.asarray(gathered), axis=0)
 
 
 def global_batch_arrays(batch, mesh: Mesh):

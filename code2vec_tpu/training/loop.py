@@ -42,12 +42,18 @@ class Trainer:
                  save_fn: Optional[Callable] = None,
                  profile_dir: Optional[str] = None,
                  initial_epoch: int = 0,
-                 steps_per_epoch_hint: Optional[int] = None):
+                 steps_per_epoch_hint: Optional[int] = None,
+                 stop_fn: Optional[Callable[[], bool]] = None):
         self.config = config
         self.train_step = train_step
         self.mesh = mesh
         self.evaluate_fn = evaluate_fn
         self.save_fn = save_fn
+        # Early stopping: checked after each epoch-boundary eval. The
+        # reference has no in-loop auto-stop but its README recommends
+        # training past the best epoch and keeping the best checkpoint
+        # (README.md:87-88); harnesses supply a patience rule here.
+        self.stop_fn = stop_fn
         self.profile_dir = profile_dir
         # Resumed runs continue the reference's `_iter<N>` numbering
         # (keras_model.py:264-274 parses N back from the checkpoint name;
@@ -116,6 +122,9 @@ class Trainer:
                     if self.save_fn is not None:
                         self.save_fn(state, epoch)
                     run_eval(state, f"After {epoch} epochs")
+                    if self.stop_fn is not None and self.stop_fn():
+                        log(f"Early stopping after epoch {epoch}")
+                        break
                 pending_losses = []
                 multi_batch_start = time.time()
                 continue

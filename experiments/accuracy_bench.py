@@ -52,7 +52,23 @@ def build_dataset(root: str, log=print) -> str:
     return prefix
 
 
-def run(root: str, epochs: int, log=print) -> dict:
+def target_oov_rate(c2v_path: str, target_vocab) -> float:
+    """Fraction of a split's examples whose exact target name is absent
+    from the training target vocabulary. Because the split is by project
+    (partially disjoint identifier vocabularies), some val/test names are
+    unpredictable-by-construction; the corpus Bayes ceiling must be read
+    net of this rate."""
+    total = oov = 0
+    with open(c2v_path) as f:
+        for line in f:
+            name = line.split(" ", 1)[0]
+            total += 1
+            if target_vocab.lookup_index(name) == target_vocab.oov_index:
+                oov += 1
+    return oov / max(total, 1)
+
+
+def run(root: str, epochs: int, patience: int, log=print) -> dict:
     import jax
     import numpy as np
     from code2vec_tpu.config import Config
@@ -64,14 +80,20 @@ def run(root: str, epochs: int, log=print) -> dict:
     if not os.path.exists(prefix + ".train.c2v"):
         prefix = build_dataset(root, log=log)
 
+    log("Computing Bayes ceiling (javagen.family_ceiling)...")
+    ceiling = javagen.family_ceiling(log=log)
+
     config = Config(
         train_data_path_prefix=prefix,
         test_data_path=prefix + ".val.c2v",
         model_save_path=os.path.join(root, "model", "genjava"),
         num_train_epochs=epochs,
         # one val point (and checkpoint) per epoch: the convergence curve
-        # is the artifact this harness exists to produce
+        # is the artifact this harness exists to produce. Mid-epoch evals
+        # off — they would corrupt patience counting and the per-epoch
+        # numbering of val_curve.
         save_every_epochs=1,
+        num_train_batches_to_evaluate=0,
         train_batch_size=1024,
         test_batch_size=1024,
         max_contexts=200,
@@ -80,11 +102,24 @@ def run(root: str, epochs: int, log=print) -> dict:
 
     curve = []
     t0 = time.time()
+    # Best-by-val-F1 params, the reference's "train past the best epoch,
+    # keep the best checkpoint" workflow (README.md:87-88). At generated-
+    # corpus vocab sizes a host copy is a few hundred MB at most.
+    best = {"f1": -1.0, "params": None, "epoch": 0, "since": 0}
 
     def eval_and_record(state):
         results = model._evaluate_with_params(state.params)
         curve.append(_metrics_dict(results, wall_s=round(time.time() - t0, 1)))
+        f1 = float(results.subtoken_f1)
+        if f1 > best["f1"]:
+            best.update(f1=f1, params=jax.device_get(state.params),
+                        epoch=len(curve), since=0)
+        else:
+            best["since"] += 1
         return results
+
+    def should_stop():
+        return patience > 0 and best["since"] >= patience
 
     # The reference evaluates against the val split during training
     # (train.sh:13-18); final test-split evaluation happens once below.
@@ -93,15 +128,25 @@ def run(root: str, epochs: int, log=print) -> dict:
     trainer = Trainer(config, train_step, mesh=model.mesh,
                       evaluate_fn=eval_and_record,
                       save_fn=model._make_save_fn() if config.is_saving else None,
-                      steps_per_epoch_hint=model._steps_per_epoch)
+                      steps_per_epoch_hint=model._steps_per_epoch,
+                      stop_fn=should_stop)
     model.state = trainer.train(model.state, batches, dropout_rng(config))
 
     val_best = max(curve, key=lambda r: r["f1"]) if curve else None
 
+    # Test-split evaluation uses the best-by-val-F1 params — the honest
+    # pairing (same weights for both numbers), fixing the round-2 flaw of
+    # comparing an undertrained val point against a later-epoch test run.
+    test_params = (best["params"] if best["params"] is not None
+                   else model.state.params)
     model.config.test_data_path = prefix + ".test.c2v"
     model.config.num_test_examples = model._count_examples(
         model.config.test_data_path)
-    test = model._evaluate_with_params(model.state.params)
+    test = model._evaluate_with_params(test_params)
+
+    oov = {role: target_oov_rate(f"{prefix}.{role}.c2v",
+                                 model.vocabs.target_vocab)
+           for role in ("val", "test")}
 
     out = {
         "dataset": {
@@ -114,7 +159,12 @@ def run(root: str, epochs: int, log=print) -> dict:
             "target_vocab": model.vocabs.target_vocab.size,
         },
         "epochs": epochs,
+        "epochs_trained": trainer.final_epoch,
+        "best_epoch": best["epoch"],
+        "patience": patience,
         "train_wall_s": round(time.time() - t0, 1),
+        "target_oov_rate": oov,
+        "ceiling": ceiling,
         "val_curve": curve,
         "val_best": val_best,
         "test": _metrics_dict(test),
@@ -136,6 +186,9 @@ def _metrics_dict(results, **extra) -> dict:
 def write_report(results: dict, path: str) -> None:
     t = results["test"]
     d = results["dataset"]
+    c = results["ceiling"]
+    oov = results["target_oov_rate"]
+    vb = results["val_best"] or {}
     lines = [
         "# BENCH_ACCURACY: end-to-end learning on a realistic generated Java corpus",
         "",
@@ -164,21 +217,59 @@ def write_report(results: dict, path: str) -> None:
         f"| path vocab | {d['path_vocab']} |",
         f"| target vocab | {d['target_vocab']} |",
         "",
+        "## Bayes ceiling (what a perfect predictor could score)",
+        "",
+        "The per-family verb synonyms make the task irreducibly ambiguous;",
+        "`javagen.family_ceiling` computes the Bayes-optimal scores by",
+        "conditional resampling of the generator itself (group draws by",
+        "identical observable code, read the name distribution off each",
+        "group, take the optimal prediction — exact enumeration, not a",
+        "heuristic; see the method comment in experiments/javagen.py).",
+        "",
+        "| ceiling metric | value |",
+        "|---|---|",
+        f"| exact match (top-1) | {c['exact_match']:.4f} |",
+        f"| top-5 | {c['top5']:.4f} |",
+        f"| subtoken F1 (micro) | {c['subtoken_f1_micro']:.4f} |",
+        "",
+        "The ceiling assumes an unrestricted predictor. A trained model can",
+        "only emit names from the *train* target vocabulary, and the split",
+        "is by project, so some val/test names are out-of-vocabulary by",
+        f"construction: measured target-OOV rate {oov['val']:.3f} (val) / "
+        f"{oov['test']:.3f} (test).",
+        "The effective exact-match ceiling on the test split is therefore",
+        f"≈ {(1 - oov['test']) * c['exact_match']:.4f}.",
+        "",
         "## Results",
         "",
-        f"Final **test** metrics after {results['epochs']} epochs "
-        f"({results['train_wall_s']}s wall incl. per-epoch eval):",
+        f"Trained {results['epochs_trained']} epochs (budget "
+        f"{results['epochs']}, early stop patience {results['patience']}, "
+        f"{results['train_wall_s']}s wall incl. per-epoch eval). Test",
+        f"metrics use the **best-by-val-F1** weights (epoch "
+        f"{results['best_epoch']}) — the same weights as the val-best row,",
+        "so the two numbers are directly comparable:",
         "",
-        "| metric | value |",
-        "|---|---|",
-        f"| top-1 accuracy | {t['top1']:.4f} |",
-        f"| top-5 accuracy | {t['top5']:.4f} |",
-        f"| top-10 accuracy | {t['top10']:.4f} |",
-        f"| subtoken precision | {t['precision']:.4f} |",
-        f"| subtoken recall | {t['recall']:.4f} |",
-        f"| **subtoken F1** | **{t['f1']:.4f}** |",
+        "| metric | test | val best | ceiling | test/ceiling |",
+        "|---|---|---|---|---|",
+        f"| top-1 accuracy | {t['top1']:.4f} | {vb.get('top1', 0):.4f} | "
+        f"{(1 - oov['test']) * c['exact_match']:.4f} | "
+        f"{t['top1'] / max((1 - oov['test']) * c['exact_match'], 1e-9):.1%} |",
+        f"| top-5 accuracy | {t['top5']:.4f} | {vb.get('top5', 0):.4f} | "
+        f"{(1 - oov['test']) * c['top5']:.4f} | "
+        f"{t['top5'] / max((1 - oov['test']) * c['top5'], 1e-9):.1%} |",
+        f"| subtoken precision | {t['precision']:.4f} | "
+        f"{vb.get('precision', 0):.4f} | — | — |",
+        f"| subtoken recall | {t['recall']:.4f} | {vb.get('recall', 0):.4f} "
+        f"| — | — |",
+        f"| **subtoken F1** | **{t['f1']:.4f}** | {vb.get('f1', 0):.4f} | "
+        f"{c['subtoken_f1_micro']:.4f} | "
+        f"{t['f1'] / c['subtoken_f1_micro']:.1%} |",
         "",
-        "Validation convergence (per epoch):",
+        "(The F1 ceiling is not OOV-adjusted: subtokens of an OOV name are",
+        "often still predictable via an in-vocab name, so the unadjusted",
+        "ceiling is the conservative denominator.)",
+        "",
+        "Validation convergence (one eval per actual data pass):",
         "",
         "| epoch | top-1 | top-5 | F1 |",
         "|---|---|---|---|",
@@ -194,13 +285,14 @@ def write_report(results: dict, path: str) -> None:
         "  model's top-k ranks the synonyms (`sumPrices`, `totalPrices`, ...)",
         "  and exact-match credit goes only to the sampled one. Real corpora",
         "  have the same property — java14m's F1≈59 reflects irreducible",
-        "  naming entropy, not model failure (POPL'19 §6).",
-        "- Subtoken F1 close to val-best F1 on the *test* projects (disjoint",
-        "  identifier distributions) shows the attention/path mechanism",
-        "  generalizes across projects, which is the claim F1≈59 makes on",
-        "  java14m's held-out projects.",
-        "- Convergence within a handful of epochs matches the reference's",
-        "  early-stopping profile (best F1 at epoch 8, README.md:87-88).",
+        "  naming entropy, not model failure (POPL'19 §6). Here that",
+        "  entropy is *known*: the ceiling table above is the corpus's",
+        "  measurable analog of java14m's unknown naming entropy.",
+        "- Test metrics on held-out projects (disjoint identifier",
+        "  distributions) measure generalization, not memorization — the",
+        "  claim java14m's F1≈59 makes on its held-out projects. Both test",
+        "  and val-best come from the same weights, so their gap is the",
+        "  project-shift cost, not a training-stage artifact.",
         "",
         "Raw numbers: `experiments/results/accuracy.json`. Reproduce with",
         "`python experiments/accuracy_bench.py --fresh` (deterministic seed).",
@@ -214,6 +306,9 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--root", default="/tmp/genjava_bench")
     p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--patience", type=int, default=3,
+                   help="early stop after this many epochs without val-F1 "
+                        "improvement (0 disables); reference README.md:87-88")
     p.add_argument("--fresh", action="store_true",
                    help="regenerate the corpus from scratch")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
@@ -227,7 +322,7 @@ def main(argv=None):
         shutil.rmtree(args.root)
     os.makedirs(args.root, exist_ok=True)
 
-    results = run(args.root, args.epochs)
+    results = run(args.root, args.epochs, args.patience)
     os.makedirs(os.path.join(REPO, "experiments", "results"), exist_ok=True)
     out_json = os.path.join(REPO, "experiments", "results", "accuracy.json")
     with open(out_json, "w") as f:

@@ -523,7 +523,181 @@ def generate_corpus(root: str, seed: int = 17, train_files: int = 2400,
     return dirs
 
 
+# ------------------------------------------------------------ Bayes ceiling
+#
+# The verb-synonym design makes the task irreducibly ambiguous: the method
+# body determines the family and the field, but not which synonym the
+# generator drew. `family_ceiling` computes the Bayes-optimal scores a
+# perfect predictor could reach on this corpus, so the harness's measured
+# F1 is interpretable as a fraction of the achievable ceiling (the way
+# java14m's F1≈59 should be read against naming entropy, POPL'19 §6).
+#
+# Method: conditional resampling of the actual generator. For each
+# sampled (family, field) context we re-run the family generator many
+# times and group the draws by the OBSERVABLE output — (body, params,
+# return type) — exactly what the model sees. Within a group, the
+# empirical name distribution IS the conditional P(name | code). This
+# uses the generator itself as the ground truth, so the ceiling can't
+# drift from the corpus the way a hand-maintained probability table
+# could. From each conditional distribution we take:
+#   - exact-match: max_name P(name | code)  (top-k: sum of k largest);
+#   - subtoken F1: the Bayes-optimal subtoken-set prediction, found by
+#     exact enumeration — tokens present in every outcome are always
+#     included (adding a sure token always raises F1), and we enumerate
+#     all subsets of the remaining uncertain tokens (verb variants;
+#     a handful, so the search is exact, not heuristic).
+# Expected tp/fp/fn are accumulated and aggregated micro-style, matching
+# SubtokensEvaluationMetric (evaluation/metrics.py; reference:
+# tensorflow_model.py:449-492).
+#
+# Two deliberate approximations, both small: class-level name dedup
+# (`made` in generate_class) slightly reshapes family frequencies, and
+# vocab OOV effects are ignored (the generated vocab is fully in-vocab).
+
+import itertools
+import re
+from collections import Counter
+
+_CAMEL_RE = re.compile(r"[A-Z]?[a-z0-9]+|[A-Z]+(?![a-z])")
+
+
+def _subtokens(name_parts: Sequence[str]) -> Tuple[str, ...]:
+    """Subtokens of the rendered name, as the extractor produces them
+    (camelCase split + lowercase; cpp/src/extract.cc target splitting).
+    A part like "indexOf" contributes two subtokens."""
+    return tuple(m.group(0).lower()
+                 for part in name_parts for m in _CAMEL_RE.finditer(part))
+
+
+def _bayes_prediction(outcomes: List[Tuple[Counter, float]]):
+    """Bayes-optimal subtoken prediction for one conditional distribution.
+
+    outcomes: [(subtoken Counter, probability)]. Returns
+    (expected_f1, E[tp], E[fp], E[fn]) under the optimal prediction.
+    """
+    certain = None
+    union: Counter = Counter()
+    for counter, _ in outcomes:
+        certain = counter if certain is None else certain & counter
+        union |= counter
+    uncertain = list((union - certain).keys())
+    sizes = [sum(c.values()) for c, _ in outcomes]
+
+    best = (-1.0, 0.0, 0.0, 0.0)
+    for r in range(len(uncertain) + 1):
+        for extra in itertools.combinations(uncertain, r):
+            pred = certain.copy()
+            for e in extra:
+                pred[e] = union[e]
+            pred_size = sum(pred.values())
+            ef1 = etp = efp = efn = 0.0
+            for (counter, p), t_size in zip(outcomes, sizes):
+                tp = sum((pred & counter).values())
+                ef1 += p * (2.0 * tp / (pred_size + t_size))
+                etp += p * tp
+                efp += p * (pred_size - tp)
+                efn += p * (t_size - tp)
+            if ef1 > best[0]:
+                best = (ef1, etp, efp, efn)
+    return best
+
+
+def family_ceiling(seed: int = 123, n_contexts: int = 4000,
+                   resamples: int = 1500, top_k: int = 10,
+                   log=print) -> Dict[str, float]:
+    """Bayes-optimal score ceilings for the generated corpus (see the
+    section comment above for the method). Returns a dict with
+    `exact_match` (top-1), `top5`/`top10`, `subtoken_f1_micro` (the
+    number comparable to the harness's reported F1) and
+    `subtoken_f1_macro` (mean per-example expected F1)."""
+    rng = random.Random(seed)
+    weights = [w for w, _ in FAMILIES]
+    fams = [g for _, g in FAMILIES]
+
+    # Aggregates over sampled contexts (each context = one method draw).
+    n = 0
+    exact_sum = 0.0
+    topk_sums = [0.0] * top_k
+    f1_macro_sum = 0.0
+    tp_sum = fp_sum = fn_sum = 0.0
+    cache: Dict[tuple, tuple] = {}
+
+    while n < n_contexts:
+        fam = rng.choices(fams, weights=weights)[0]
+        f = Field(rng, NOUNS)
+        probe = (fam(f, rng, "C") if fam is fam_with else fam(f, rng))
+        if probe is None:
+            continue  # family not applicable to this field: rejection,
+            # mirroring generate_class's retry loop
+        n += 1
+        # The conditional structure depends only on the family and the
+        # field's shape (kind/type/part count), not the noun identity.
+        key = (fam.__name__, f.kind, f.type, getattr(f, "elem", None),
+               len(f.name_parts))
+        hit = cache.get(key)
+        if hit is None:
+            groups: Dict[tuple, Counter] = {}
+            for _ in range(resamples):
+                name_parts, ret, params, body = (
+                    fam(f, rng, "C") if fam is fam_with else fam(f, rng))
+                observable = (tuple(body), params, ret)
+                groups.setdefault(observable, Counter())[
+                    _subtokens(name_parts)] += 1
+            ex = 0.0
+            tk = [0.0] * top_k
+            f1m = tp = fp = fn = 0.0
+            for name_counts in groups.values():
+                g_total = sum(name_counts.values())
+                g_p = g_total / resamples
+                probs = sorted((c / g_total for c in name_counts.values()),
+                               reverse=True)
+                ex += g_p * probs[0]
+                acc = 0.0
+                for i in range(top_k):
+                    if i < len(probs):
+                        acc += probs[i]
+                    tk[i] += g_p * acc
+                outcomes = [(Counter(toks), c / g_total)
+                            for toks, c in name_counts.items()]
+                bf1, btp, bfp, bfn = _bayes_prediction(outcomes)
+                f1m += g_p * bf1
+                tp += g_p * btp
+                fp += g_p * bfp
+                fn += g_p * bfn
+            hit = (ex, tuple(tk), f1m, tp, fp, fn)
+            cache[key] = hit
+        ex, tk, f1m, tp, fp, fn = hit
+        exact_sum += ex
+        for i in range(top_k):
+            topk_sums[i] += tk[i]
+        f1_macro_sum += f1m
+        tp_sum += tp
+        fp_sum += fp
+        fn_sum += fn
+
+    precision = tp_sum / max(tp_sum + fp_sum, 1e-12)
+    recall = tp_sum / max(tp_sum + fn_sum, 1e-12)
+    out = {
+        "exact_match": exact_sum / n,
+        "top5": topk_sums[4] / n,
+        "top10": topk_sums[min(9, top_k - 1)] / n,
+        "subtoken_precision": precision,
+        "subtoken_recall": recall,
+        "subtoken_f1_micro": 2 * precision * recall / max(
+            precision + recall, 1e-12),
+        "subtoken_f1_macro": f1_macro_sum / n,
+        "n_contexts": n,
+    }
+    log(f"family_ceiling: exact={out['exact_match']:.4f} "
+        f"top5={out['top5']:.4f} f1_micro={out['subtoken_f1_micro']:.4f}")
+    return out
+
+
 if __name__ == "__main__":
+    import json
     import sys
-    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/javagen_corpus"
-    generate_corpus(out)
+    if len(sys.argv) > 1 and sys.argv[1] == "ceiling":
+        print(json.dumps(family_ceiling(), indent=2))
+    else:
+        out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/javagen_corpus"
+        generate_corpus(out)

@@ -1,0 +1,141 @@
+"""Real 2-process `jax.distributed` test (CPU backend, gloo collectives).
+
+Unlike tests/test_distributed.py (which unit-tests the helpers'
+single-process semantics), this spawns TWO actual OS processes that join
+one distributed runtime — 2 local CPU devices each, 4 global — and runs
+the production multi-host path end to end: `distributed.initialize`,
+`allreduce_host_scalars`, `global_batch_arrays` feeding the real jitted
+train/eval steps over a dp=4 mesh, and the Evaluator's global-metric
+reduction. The parent computes every expected number single-process
+first; the children must reproduce them exactly (see tests/mp_child.py).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import RowBatch
+from code2vec_tpu.evaluation.evaluator import Evaluator
+from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+from code2vec_tpu.training.state import create_train_state, make_optimizer
+from code2vec_tpu.training.step import TrainStepBuilder, device_put_batch
+from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+B, M = 8, 8
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _full_batch():
+    rng = np.random.default_rng(11)
+    dims = ModelDims(token_vocab_size=24, path_vocab_size=16,
+                     target_vocab_size=16, token_dim=4, path_dim=4)
+    src = rng.integers(0, dims.token_vocab_size, (B, M)).astype(np.int32)
+    pth = rng.integers(0, dims.path_vocab_size, (B, M)).astype(np.int32)
+    tgt = rng.integers(0, dims.token_vocab_size, (B, M)).astype(np.int32)
+    mask = (rng.random((B, M)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0
+    labels = rng.integers(2, dims.real_target_vocab_size, (B,)).astype(np.int32)
+    valid = np.ones((B,), bool)
+    # Mix of in-vocab, multi-subtoken and never-predictable names so the
+    # evaluator's tp/fp/fn and top-k counters are all non-trivial.
+    names = ["w0", "w1", "w2|w3", "w4", "nosuchname", "w5", "w6|w0", "w7"]
+    return dims, RowBatch(
+        source_token_indices=src, path_indices=pth, target_token_indices=tgt,
+        context_valid_mask=mask, target_index=labels, example_valid=valid,
+        target_strings=names)
+
+
+def _vocabs():
+    freq = WordFreqDicts(
+        token_to_count={"foo": 10, "bar": 8, "baz": 5, "qux": 2},
+        path_to_count={"P1": 9, "P2": 7, "P3": 3},
+        target_to_count={f"w{i}": 20 - i for i in range(12)},
+        num_train_examples=100)
+    return Code2VecVocabs.create_from_freq_dicts(
+        freq, max_token_vocab_size=30, max_path_vocab_size=20,
+        max_target_vocab_size=20)
+
+
+def test_two_process_distributed(tmp_path):
+    dims, batch = _full_batch()
+
+    # ---- parent: single-device expected values on the full batch
+    config = Config(train_data_path_prefix="unused", compute_dtype="float32",
+                    train_batch_size=B, test_batch_size=B, max_contexts=M,
+                    dropout_keep_rate=1.0)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=1.0)
+    opt = make_optimizer(config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(7))
+    builder = TrainStepBuilder(module, opt, config, mesh=None)
+    arrays = device_put_batch(batch, None)
+    eval_step = builder.make_eval_step(state, k=3)
+    out = eval_step(state.params, *arrays)
+    expected_loss_sum = float(out.loss_sum)
+
+    evaluator = Evaluator(config, _vocabs(), eval_step, mesh=None,
+                          log_path=str(tmp_path / "log_single.txt"))
+    expected_eval = evaluator.evaluate(state.params, [batch])
+
+    # last: the train step donates its state buffers
+    train_step = builder.make_train_step(state)
+    _, expected_train_loss = train_step(state, *arrays, jax.random.PRNGKey(0))
+    expected_train_loss = float(expected_train_loss)
+
+    data_path = tmp_path / "mp_data.npz"
+    np.savez(data_path, B=B, src=batch.source_token_indices,
+             pth=batch.path_indices, tgt=batch.target_token_indices,
+             mask=batch.context_valid_mask, labels=batch.target_index,
+             valid=batch.example_valid, names=np.array(batch.target_strings),
+             expected_loss_sum=expected_loss_sum,
+             expected_train_loss=expected_train_loss)
+
+    # ---- children: 2 processes, one distributed runtime
+    port = _free_port()
+    out_path = tmp_path / "mp_out.json"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "mp_child.py"),
+         str(pid), str(port), str(data_path), str(out_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)]
+    outputs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"child {pid} failed:\n{text}"
+        assert f"mp_child {pid}: OK" in text
+
+    with open(out_path) as f:
+        got = json.load(f)
+
+    # global loss / train loss already asserted inside each child against
+    # the parent's numbers; re-check the reported copies here too
+    np.testing.assert_allclose(got["loss_sum"], expected_loss_sum, rtol=1e-5)
+    np.testing.assert_allclose(got["train_loss"], expected_train_loss,
+                               rtol=1e-5)
+    # the distributed Evaluator (per-host shards + counter allreduce) must
+    # report exactly the single-process metrics
+    np.testing.assert_allclose(got["eval"]["topk_acc"],
+                               expected_eval.topk_acc, atol=1e-12)
+    np.testing.assert_allclose(got["eval"]["precision"],
+                               expected_eval.subtoken_precision, atol=1e-12)
+    np.testing.assert_allclose(got["eval"]["recall"],
+                               expected_eval.subtoken_recall, atol=1e-12)
+    np.testing.assert_allclose(got["eval"]["f1"],
+                               expected_eval.subtoken_f1, atol=1e-12)
+    np.testing.assert_allclose(got["eval"]["loss"], expected_eval.loss,
+                               rtol=1e-6)
