@@ -58,13 +58,12 @@ def _build(config):
     return state, builder.make_train_step(state), dims
 
 
-def _synthetic_batch(dims):
+def _synthetic_batch(dims, b=BATCH, m=CONTEXTS):
     """Random int batch, device-resident, so timings measure the step."""
     import jax
     import jax.numpy as jnp
 
     ks = jax.random.split(jax.random.PRNGKey(1), 4)
-    b, m = BATCH, CONTEXTS
     src = jax.random.randint(ks[0], (b, m), 0, dims.token_vocab_size, jnp.int32)
     pth = jax.random.randint(ks[1], (b, m), 0, dims.path_vocab_size, jnp.int32)
     tgt = jax.random.randint(ks[2], (b, m), 0, dims.token_vocab_size, jnp.int32)
@@ -76,16 +75,19 @@ def _synthetic_batch(dims):
                  for x in (src, pth, tgt, mask, labels, valid))
 
 
-def main() -> None:
-    import jax
+def measure(batch_size: int = BATCH, contexts: int = CONTEXTS) -> dict:
+    """Time the flagship train step; returns the result dict (the JSON
+    contract's fields). Parameterized so experiments (e.g. the
+    MAX_CONTEXTS=500 stress config, BASELINE config #4) reuse the same
+    timing methodology."""
     from code2vec_tpu.config import Config
 
     config = Config(train_data_path_prefix="<bench>",
-                    train_batch_size=BATCH, max_contexts=CONTEXTS,
+                    train_batch_size=batch_size, max_contexts=contexts,
                     compute_dtype="bfloat16")
     from code2vec_tpu.training.state import dropout_rng
     state, train_step, dims = _build(config)
-    batch = _synthetic_batch(dims)
+    batch = _synthetic_batch(dims, batch_size, contexts)
     rng = dropout_rng(config)
 
     for _ in range(WARMUP_STEPS):
@@ -101,15 +103,19 @@ def main() -> None:
     float(loss)
     dt = time.perf_counter() - t0
 
-    examples_per_sec = TIMED_STEPS * BATCH / dt
-    print(json.dumps({
+    examples_per_sec = TIMED_STEPS * batch_size / dt
+    return {
         "metric": "java14m-scale train throughput, 1 chip "
-                  f"(batch {BATCH}, {CONTEXTS} ctx, 385M params, "
+                  f"(batch {batch_size}, {contexts} ctx, 385M params, "
                   f"{config.compute_dtype})",
         "value": round(examples_per_sec, 1),
         "unit": "examples/sec",
         "vs_baseline": round(examples_per_sec / V100_EXAMPLES_PER_SEC, 3),
-    }))
+    }
+
+
+def main() -> None:
+    print(json.dumps(measure()))
 
 
 if __name__ == "__main__":

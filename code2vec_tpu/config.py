@@ -101,6 +101,15 @@ class Config:
     # throughput at java14m scale) with negligible effect on convergence;
     # set "float32" for bit-strict Adam.
     adam_mu_dtype: str = "bfloat16"
+    # Storage dtype for Adam's second moment (nu). bfloat16 shaves
+    # ~3 GB of HBM traffic per flagship step (+10% examples/sec,
+    # BENCH_ROOFLINE.md) and was validated end-to-end: the accuracy
+    # harness converges to the same test F1 as with f32 nu. nu sets the
+    # per-parameter step size through a sqrt, so its rounding is more
+    # consequential than mu's — set "float32" (with adam_mu_dtype
+    # "float32") for bit-strict optax.adam. The sparse touched-rows path
+    # keeps its nu in f32 regardless (training/sparse_adam.py).
+    adam_nu_dtype: str = "bfloat16"
     # PRNG implementation for the per-step dropout key. The TPU hardware
     # generator ("rbg") produces the ~78M dropout bits per flagship step
     # far faster than the default threefry (+~5% step throughput);
@@ -230,6 +239,8 @@ class Config:
             raise ValueError("compute_dtype must be bfloat16 or float32.")
         if self.adam_mu_dtype not in ("bfloat16", "float32"):
             raise ValueError("adam_mu_dtype must be bfloat16 or float32.")
+        if self.adam_nu_dtype not in ("bfloat16", "float32"):
+            raise ValueError("adam_nu_dtype must be bfloat16 or float32.")
         if self.dropout_prng_impl not in ("rbg", "threefry2x32",
                                           "unsafe_rbg"):
             raise ValueError(

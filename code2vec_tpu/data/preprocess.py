@@ -176,7 +176,8 @@ def preprocess(train_raw: str, val_raw: str, test_raw: str, output_name: str,
 # --------------------------------------------------------------- extraction
 
 def _native_extractor(language: str) -> str:
-    binary = {"java": "c2v-extract", "csharp": "c2v-extract-cs"}[language]
+    binary = {"java": "c2v-extract", "csharp": "c2v-extract-cs",
+              "cs": "c2v-extract-cs"}[language]
     here = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     path = os.path.join(here, "cpp", "build", binary)

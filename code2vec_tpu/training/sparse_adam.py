@@ -112,8 +112,17 @@ def sparse_adam_rows(table: jax.Array, slots: RowAdamSlots,
     fm = first[:, None]
     zeros = jnp.zeros_like(delta_p)
     table = table.at[ids_s].add(jnp.where(fm, delta_p, zeros), mode="drop")
+    # The scatter must be an `add` (duplicate ids: non-representatives
+    # carry zero), but the value that ends up stored should equal what
+    # the dense optimizer stores: cast(new_mu, mu_dtype). So compute the
+    # delta against the *storage-dtype* target: old + (target - old) is
+    # exact whenever target - old is representable (common for nearby
+    # bf16 values), and within 1 ulp otherwise — no compounding drift
+    # from rounding an f32 delta, which is what accumulating
+    # bf16(new_mu - mu_rows) per step would produce.
+    mu_target = new_mu.astype(slots.mu.dtype).astype(jnp.float32)
     mu = slots.mu.at[ids_s].add(
-        jnp.where(fm, new_mu - mu_rows, jnp.zeros_like(new_mu))
+        jnp.where(fm, mu_target - mu_rows, jnp.zeros_like(new_mu))
         .astype(slots.mu.dtype), mode="drop")
     nu = slots.nu.at[ids_s].add(
         jnp.where(fm, new_nu - nu_rows, jnp.zeros_like(new_nu)),

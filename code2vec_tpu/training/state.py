@@ -49,14 +49,60 @@ def uses_sparse_update(config) -> bool:
                 and getattr(config, "use_sparse_embedding_update", False))
 
 
+def _scale_by_adam_nu_dtype(b1: float, b2: float, eps: float,
+                            mu_dtype, nu_dtype) -> optax.GradientTransformation:
+    """optax.scale_by_adam with a storage dtype for the SECOND moment as
+    well (optax only exposes mu_dtype). Math is performed in the
+    gradient's dtype (f32 here); only storage is cast — exactly how
+    optax handles mu. Used when config.adam_nu_dtype != float32."""
+    mu_dtype, nu_dtype = jnp.dtype(mu_dtype), jnp.dtype(nu_dtype)
+
+    def init_fn(params):
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(lambda p: jnp.zeros_like(p, dtype=mu_dtype),
+                            params),
+            nu=jax.tree.map(lambda p: jnp.zeros_like(p, dtype=nu_dtype),
+                            params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_increment(state.count)
+        mu = jax.tree.map(
+            lambda g, m: b1 * m.astype(g.dtype) + (1.0 - b1) * g,
+            updates, state.mu)
+        nu = jax.tree.map(
+            lambda g, n: b2 * n.astype(g.dtype) + (1.0 - b2) * (g * g),
+            updates, state.nu)
+        b1c = 1.0 - b1 ** count.astype(jnp.float32)
+        b2c = 1.0 - b2 ** count.astype(jnp.float32)
+        new_updates = jax.tree.map(
+            lambda m, n: (m / b1c) / (jnp.sqrt(n / b2c) + eps), mu, nu)
+        return new_updates, optax.ScaleByAdamState(
+            count=count,
+            mu=jax.tree.map(lambda m: m.astype(mu_dtype), mu),
+            nu=jax.tree.map(lambda n: n.astype(nu_dtype), nu))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(config) -> optax.GradientTransformation:
     # reference uses tf.compat.v1.train.AdamOptimizer() defaults
     # (tensorflow_model.py:231): lr 1e-3, b1 .9, b2 .999, eps 1e-8.
-    # mu storage dtype is a throughput knob (config.adam_mu_dtype).
-    return optax.adam(
-        learning_rate=config.learning_rate,
-        b1=config.adam_beta1, b2=config.adam_beta2, eps=config.adam_eps,
-        mu_dtype=jnp.dtype(config.adam_mu_dtype))
+    # mu/nu storage dtypes are throughput knobs (config.adam_mu_dtype /
+    # config.adam_nu_dtype); plain optax.adam whenever nu stays f32, so
+    # the default path is bit-identical to stock optax.
+    nu_dtype = jnp.dtype(getattr(config, "adam_nu_dtype", "float32"))
+    if nu_dtype == jnp.float32:
+        return optax.adam(
+            learning_rate=config.learning_rate,
+            b1=config.adam_beta1, b2=config.adam_beta2, eps=config.adam_eps,
+            mu_dtype=jnp.dtype(config.adam_mu_dtype))
+    return optax.chain(
+        _scale_by_adam_nu_dtype(
+            b1=config.adam_beta1, b2=config.adam_beta2, eps=config.adam_eps,
+            mu_dtype=jnp.dtype(config.adam_mu_dtype), nu_dtype=nu_dtype),
+        optax.scale(-config.learning_rate))
 
 
 def dropout_rng(config, salt: int = 2) -> jax.Array:

@@ -32,24 +32,35 @@ if REPO not in sys.path:
 from experiments import javagen  # noqa: E402
 
 
-def build_dataset(root: str, log=print) -> str:
-    """Generate + extract + preprocess; returns the dataset prefix."""
+def build_dataset(root: str, language: str = "java", log=print) -> str:
+    """Generate + extract + preprocess; returns the dataset prefix.
+    language="cs" routes through the C# generator (experiments/csgen.py)
+    and the native C# extractor (cpp/c2v-extract-cs) — BASELINE config #3.
+    """
     from code2vec_tpu.data.preprocess import extract_dir, preprocess
 
     corpus = os.path.join(root, "src")
-    log("Generating corpus...")
-    dirs = javagen.generate_corpus(corpus, log=log)
+    log(f"Generating {language} corpus...")
+    if language == "cs":
+        from experiments import csgen
+        dirs = csgen.generate_corpus(corpus, log=log)
+    else:
+        dirs = javagen.generate_corpus(corpus, log=log)
     raws = {}
     for role in ("train", "val", "test"):
         raws[role] = extract_dir(
             dirs[role], os.path.join(root, f"{role}.raw.txt"),
-            num_threads=16, shuffle=(role == "train"))
-    prefix = os.path.join(root, "genjava")
+            language=language, num_threads=16, shuffle=(role == "train"))
+    prefix = os.path.join(root, _prefix_name(language))
     # .train.c2v must pair with "val" for mid-training eval, as the
     # reference trains with --test pointed at the val split (train.sh:13).
     preprocess(raws["train"], raws["val"], raws["test"], prefix,
                max_contexts=200, log=log)
     return prefix
+
+
+def _prefix_name(language: str) -> str:
+    return "gencs" if language == "cs" else "genjava"
 
 
 def target_oov_rate(c2v_path: str, target_vocab) -> float:
@@ -68,7 +79,8 @@ def target_oov_rate(c2v_path: str, target_vocab) -> float:
     return oov / max(total, 1)
 
 
-def run(root: str, epochs: int, patience: int, log=print) -> dict:
+def run(root: str, epochs: int, patience: int, language: str = "java",
+        log=print) -> dict:
     import jax
     import numpy as np
     from code2vec_tpu.config import Config
@@ -76,17 +88,21 @@ def run(root: str, epochs: int, patience: int, log=print) -> dict:
     from code2vec_tpu.training.loop import Trainer
     from code2vec_tpu.training.state import dropout_rng
 
-    prefix = os.path.join(root, "genjava")
+    prefix = os.path.join(root, _prefix_name(language))
     if not os.path.exists(prefix + ".train.c2v"):
-        prefix = build_dataset(root, log=log)
+        prefix = build_dataset(root, language=language, log=log)
 
+    # The ceiling is language-independent: csgen translates javagen's
+    # family output surface-syntactically, never changing which family,
+    # field, style or verb was drawn, so P(name | observable code) — and
+    # therefore the Bayes-optimal scores — are identical (csgen.py doc).
     log("Computing Bayes ceiling (javagen.family_ceiling)...")
     ceiling = javagen.family_ceiling(log=log)
 
     config = Config(
         train_data_path_prefix=prefix,
         test_data_path=prefix + ".val.c2v",
-        model_save_path=os.path.join(root, "model", "genjava"),
+        model_save_path=os.path.join(root, "model", _prefix_name(language)),
         num_train_epochs=epochs,
         # one val point (and checkpoint) per epoch: the convergence curve
         # is the artifact this harness exists to produce. Mid-epoch evals
@@ -149,6 +165,9 @@ def run(root: str, epochs: int, patience: int, log=print) -> dict:
            for role in ("val", "test")}
 
     out = {
+        "language": language,
+        "optimizer": {"adam_mu_dtype": config.adam_mu_dtype,
+                      "adam_nu_dtype": config.adam_nu_dtype},
         "dataset": {
             "train_examples": config.num_train_examples,
             "val_examples": int(np.loadtxt(prefix + ".val.c2v.num_examples"))
@@ -298,13 +317,73 @@ def write_report(results: dict, path: str) -> None:
         "`python experiments/accuracy_bench.py --fresh` (deterministic seed).",
         "",
     ]
+    # keep an existing C# section (written by --language cs) intact
+    cs_section = ""
+    if os.path.exists(path):
+        with open(path) as f:
+            existing = f.read()
+        if _CS_MARKER in existing:
+            cs_section = "\n" + existing[existing.index(_CS_MARKER):]
     with open(path, "w") as f:
-        f.write("\n".join(lines))
+        f.write("\n".join(lines) + cs_section)
+
+
+_CS_MARKER = "## C# end-to-end (BASELINE config #3)"
+
+
+def append_cs_section(results: dict, path: str) -> None:
+    """Append (or replace) the C# section of BENCH_ACCURACY.md."""
+    t = results["test"]
+    d = results["dataset"]
+    c = results["ceiling"]
+    oov = results["target_oov_rate"]
+    vb = results["val_best"] or {}
+    eff_top1 = (1 - oov["test"]) * c["exact_match"]
+    section = [
+        _CS_MARKER,
+        "",
+        "Same harness, C# end to end: generated C# corpus",
+        "(experiments/csgen.py — javagen's families rendered in C#, so the",
+        "same Bayes ceiling applies) -> native C# extractor",
+        "(cpp/c2v-extract-cs; reference:",
+        "CSharpExtractor/Extractor/Extractor.cs:46-99) -> preprocess ->",
+        "train -> eval.",
+        "",
+        f"Dataset: {d['train_examples']} / {d['val_examples']} / "
+        f"{d['test_examples']} examples (train/val/test), target vocab "
+        f"{d['target_vocab']}; target-OOV rate {oov['val']:.3f} (val) / "
+        f"{oov['test']:.3f} (test).",
+        "",
+        f"Trained {results['epochs_trained']} epochs (budget "
+        f"{results['epochs']}, patience {results['patience']}); test uses "
+        f"best-by-val-F1 weights (epoch {results['best_epoch']}).",
+        "",
+        "| metric | test | val best | ceiling | test/ceiling |",
+        "|---|---|---|---|---|",
+        f"| top-1 accuracy | {t['top1']:.4f} | {vb.get('top1', 0):.4f} | "
+        f"{eff_top1:.4f} | {t['top1'] / max(eff_top1, 1e-9):.1%} |",
+        f"| **subtoken F1** | **{t['f1']:.4f}** | {vb.get('f1', 0):.4f} | "
+        f"{c['subtoken_f1_micro']:.4f} | "
+        f"{t['f1'] / c['subtoken_f1_micro']:.1%} |",
+        "",
+        "Raw numbers: `experiments/results/accuracy_cs.json`.",
+        "",
+    ]
+    existing = ""
+    if os.path.exists(path):
+        with open(path) as f:
+            existing = f.read()
+        if _CS_MARKER in existing:
+            existing = existing[:existing.index(_CS_MARKER)].rstrip() + "\n"
+    with open(path, "w") as f:
+        f.write(existing.rstrip() + "\n\n" + "\n".join(section))
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--root", default="/tmp/genjava_bench")
+    p.add_argument("--root", default=None,
+                   help="default: /tmp/genjava_bench or /tmp/gencs_bench")
+    p.add_argument("--language", choices=["java", "cs"], default="java")
     p.add_argument("--epochs", type=int, default=12)
     p.add_argument("--patience", type=int, default=3,
                    help="early stop after this many epochs without val-F1 "
@@ -316,19 +395,28 @@ def main(argv=None):
 
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
+    if args.root is None:
+        args.root = f"/tmp/{_prefix_name(args.language)}_bench"
 
     if args.fresh and os.path.exists(args.root):
         import shutil
         shutil.rmtree(args.root)
     os.makedirs(args.root, exist_ok=True)
 
-    results = run(args.root, args.epochs, args.patience)
+    results = run(args.root, args.epochs, args.patience,
+                  language=args.language)
     os.makedirs(os.path.join(REPO, "experiments", "results"), exist_ok=True)
-    out_json = os.path.join(REPO, "experiments", "results", "accuracy.json")
+    name = "accuracy_cs.json" if args.language == "cs" else "accuracy.json"
+    out_json = os.path.join(REPO, "experiments", "results", name)
     with open(out_json, "w") as f:
         json.dump(results, f, indent=2)
-    write_report(results, os.path.join(REPO, "BENCH_ACCURACY.md"))
-    print(json.dumps({"test_f1": results["test"]["f1"],
+    report = os.path.join(REPO, "BENCH_ACCURACY.md")
+    if args.language == "cs":
+        append_cs_section(results, report)
+    else:
+        write_report(results, report)
+    print(json.dumps({"language": args.language,
+                      "test_f1": results["test"]["f1"],
                       "test_top1": results["test"]["top1"],
                       "val_best_f1": (results["val_best"] or {}).get("f1")}))
 

@@ -1,0 +1,254 @@
+"""Deterministic generator of a realistic C# method-naming corpus.
+
+Reuses experiments/javagen.py's semantic machinery — the Field model,
+the weighted method families and their verb-synonym distributions — and
+renders each generated method in C# instead of Java. The family output
+is a small, closed Java dialect (every construct comes from a family
+template), so the rendering step is an exact finite translation, and
+`_assert_translated` fails loudly if a family ever emits a construct
+the table does not cover.
+
+Because translation changes only surface syntax — never which family,
+field, style or verb was drawn — the conditional name distribution
+given the observable code is identical to javagen's, so
+`javagen.family_ceiling()` is the Bayes ceiling for this corpus too.
+
+Used by experiments/accuracy_bench.py --language cs (BASELINE config #3:
+C# end-to-end through cpp/c2v-extract-cs; reference:
+CSharpExtractor/Extractor/Extractor.cs:46-99).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+from typing import Dict, List, Sequence
+
+from experiments import javagen
+
+# ------------------------------------------------------- dialect translation
+
+# Ordered: multi-token/structural rules before bare-identifier rules.
+_LINE_RULES = [
+    # fam_filter's accumulator: `out` is a reserved keyword in C#, and
+    # its allocation is the one empty-diamond ArrayList in the families
+    (re.compile(r"List<Integer> out = new ArrayList<>\(\);"),
+     "List<int> result = new List<int>();"),
+    (re.compile(r"\bout\.add\("), "result.Add("),
+    (re.compile(r"return out;"), "return result;"),
+    # collections API
+    (re.compile(r"\.add\("), ".Add("),
+    (re.compile(r"\.remove\("), ".Remove("),
+    (re.compile(r"\.clear\(\)"), ".Clear()"),
+    (re.compile(r"\.contains\("), ".Contains("),
+    (re.compile(r"\.equals\("), ".Equals("),
+    (re.compile(r"\.get\((\w+)\)"), r"[\1]"),
+    (re.compile(r"\.put\((\w+), (\w+)\);"), r"[\1] = \2;"),
+    (re.compile(r"(this\.\w+)\.getOrDefault\((\w+), (\w+)\)"),
+     r"\1.ContainsKey(\2) ? \1[\2] : \3"),
+    (re.compile(r"\.size\(\)"), ".Count"),
+    (re.compile(r"\.length"), ".Length"),
+    (re.compile(r"!(this\.\w+)\.isEmpty\(\)"), r"\1.Count > 0"),
+    (re.compile(r"\.isEmpty\(\)"), ".Count == 0"),
+    # strings
+    (re.compile(r"StringBuilder sb = new StringBuilder\(\);"),
+     "var sb = new System.Text.StringBuilder();"),
+    (re.compile(r"\.append\("), ".Append("),
+    (re.compile(r"\.toString\(\)"), ".ToString()"),
+    (re.compile(r"\.trim\(\)"), ".Trim()"),
+    (re.compile(r"Integer\.parseInt"), "int.Parse"),
+    (re.compile(r"Long\.parseLong"), "long.Parse"),
+    (re.compile(r"Double\.parseDouble"), "double.Parse"),
+    # control flow
+    (re.compile(r"for \((\S+) (\w+) : (\S+)\) \{"),
+     r"foreach (\1 \2 in \3) {"),
+    # exceptions / stdlib
+    (re.compile(r"IllegalStateException"), "InvalidOperationException"),
+    (re.compile(r"System\.out\.println"), "Console.WriteLine"),
+    (re.compile(r"System\.nanoTime\(\)"), "DateTime.Now.Ticks"),
+    # allocation (must run before bare-type rules rewrite the generics)
+    (re.compile(r"new ArrayList<Integer>\(\)"), "new List<int>()"),
+    (re.compile(r"new ArrayList<String>\(\)"), "new List<string>()"),
+    (re.compile(r"new HashMap<String, Integer>\(\)"),
+     "new Dictionary<string, int>()"),
+    (re.compile(r"new ArrayList<>\((this\.\w+)\)"), r"new List<int>(\1)"),
+    # types (bare identifiers last)
+    (re.compile(r"\bList<Integer>"), "List<int>"),
+    (re.compile(r"\bList<String>"), "List<string>"),
+    (re.compile(r"\bMap<String, Integer>"), "Dictionary<string, int>"),
+    (re.compile(r"\bInteger\b"), "int"),
+    (re.compile(r"\bString\b"), "string"),
+    (re.compile(r"\bboolean\b"), "bool"),
+    (re.compile(r"\bObject\b"), "object"),
+]
+
+# Java-isms that must not survive translation (the closed-dialect check).
+_JAVAISM = re.compile(
+    r"ArrayList|HashMap|\.size\(\)|\.isEmpty|\.append\(|\.add\(|\.put\(|"
+    r"\.get\(|\bboolean\b|\bString\b|\bInteger\b|\bObject\b|parseInt|"
+    r"IllegalState|System\.out| : this\.")
+
+
+def _translate_line(line: str) -> str:
+    for pat, repl in _LINE_RULES:
+        line = pat.sub(repl, line)
+    return line
+
+
+def _translate_body(body: Sequence[str]) -> List[str]:
+    out = list(body)
+    # fam_lookup's null-checked variant is the one two-line pattern with
+    # no direct C# equivalent: rewrite via TryGetValue.
+    for i, line in enumerate(out[:-1]):
+        m = re.match(r"Integer (\w+) = (this\.\w+)\.get\((\w+)\);", line)
+        if m and re.match(rf"return {m.group(1)} == null \? (\w+) : "
+                          rf"{m.group(1)};", out[i + 1]):
+            default = re.match(rf"return {m.group(1)} == null \? (\w+) :",
+                               out[i + 1]).group(1)
+            out[i] = f"int {m.group(1)};"
+            out[i + 1] = (f"return {m.group(2)}.TryGetValue({m.group(3)}, "
+                          f"out {m.group(1)}) ? {m.group(1)} : {default};")
+    # fam_copy's diamond allocation needs the element type; string lists
+    # are the only non-int case in the families.
+    translated = []
+    for line in out:
+        if "new ArrayList<>(" in line and "string" in _translate_line(
+                line.replace("new ArrayList<>(", "")):
+            line = re.sub(r"new ArrayList<>\((this\.\w+)\)",
+                          r"new List<string>(\1)", line)
+        translated.append(_translate_line(line))
+    return translated
+
+
+def _assert_translated(text: str, context: str) -> None:
+    bad = _JAVAISM.search(text)
+    if bad:
+        raise AssertionError(
+            f"untranslated Java construct {bad.group(0)!r} in {context}: "
+            f"extend csgen._LINE_RULES")
+
+
+# ----------------------------------------------------------------- rendering
+
+def _render_method(name_parts, ret, params, body, rng) -> List[str]:
+    name = javagen.camel(name_parts)
+    mods = rng.choices(["public ", "internal ", "protected ",
+                        "public static "], weights=[70, 15, 10, 5])[0]
+    if "this." in " ".join(body):
+        mods = mods.replace("static ", "")
+    ret = _translate_line(ret)
+    params = _translate_line(params)
+    lines = [f"        {mods}{ret} {name}({params})", "        {"]
+    if rng.random() < 0.08:
+        lines.append("            "
+                     + _translate_line(rng.choice(javagen.NOISE_LINES)))
+    for b in _translate_body(body):
+        lines.append("            " + b)
+    lines.append("        }")
+    return lines
+
+
+def generate_class(rng: random.Random, nouns: List[str], class_name: str,
+                   namespace: str, n_methods: int) -> str:
+    fields = [javagen.Field(rng, nouns) for _ in range(rng.randint(3, 8))]
+    lines = ["using System;", "using System.Collections.Generic;", "",
+             f"namespace {namespace}", "{",
+             f"    public class {class_name}", "    {"]
+    for f in fields:
+        init = f" = {f.default}" if rng.random() < 0.6 else ""
+        mod = rng.choice(["private ", "private ", "private readonly ", ""])
+        if "readonly" in mod and not init:
+            init = f" = {f.default}"
+        decl = _translate_line(f"{f.type} {f.name}{init};")
+        lines.append(f"        {mod}{decl}")
+    lines.append("")
+
+    made = set()
+    weights = [w for w, _ in javagen.FAMILIES]
+    fams = [g for _, g in javagen.FAMILIES]
+    tries = 0
+    count = 0
+    while count < n_methods and tries < n_methods * 12:
+        tries += 1
+        fam = rng.choices(fams, weights=weights)[0]
+        f = rng.choice(fields)
+        out = (fam(f, rng, class_name) if fam is javagen.fam_with
+               else fam(f, rng))
+        if out is None:
+            continue
+        name_parts, ret, params, body = out
+        name = javagen.camel(name_parts)
+        if name in made:
+            continue
+        made.add(name)
+        lines.extend(_render_method(name_parts, ret, params, body, rng))
+        lines.append("")
+        count += 1
+
+    # parser-stress extras mirroring javagen's (lambda field, nested enum)
+    if rng.random() < 0.10:
+        lines += ["        private Action task = () =>", "        {",
+                  "            Console.WriteLine(\"run\");", "        };", ""]
+    if rng.random() < 0.05:
+        lines += ["        enum Mode { FAST, SLOW, AUTO }", ""]
+    lines += ["    }", "}"]
+    text = "\n".join(lines) + "\n"
+    _assert_translated(text, class_name)
+    return text
+
+
+# ------------------------------------------------------------------ projects
+
+def generate_project(out_dir: str, rng: random.Random, project: str,
+                     n_files: int) -> int:
+    nouns = rng.sample(javagen.NOUNS, k=rng.randint(28, 48))
+    weighted = []
+    for i, n in enumerate(nouns):
+        weighted += [n] * max(1, int(10 / (1 + i * 0.35)))
+    proj_dir = os.path.join(out_dir, project)
+    os.makedirs(proj_dir, exist_ok=True)
+    methods = 0
+    for i in range(n_files):
+        cname = javagen.cap(rng.choice(nouns)) + rng.choice(
+            ["Service", "Manager", "Store", "Handler", "Util", "Helper",
+             "Controller", "Repository", "Model", "Builder"]) + str(i)
+        n_methods = rng.randint(5, 18)
+        src = generate_class(rng, weighted, cname, f"Gen.{javagen.cap(project)}",
+                             n_methods)
+        with open(os.path.join(proj_dir, cname + ".cs"), "w") as fh:
+            fh.write(src)
+        methods += src.count("        public ") + src.count(
+            "        protected ") + src.count("        internal ")
+    return methods
+
+
+def generate_corpus(root: str, seed: int = 29, train_files: int = 2400,
+                    val_files: int = 260, test_files: int = 260,
+                    files_per_project: int = 120, log=print) -> Dict[str, str]:
+    """Same corpus shape as javagen.generate_corpus, in C#."""
+    rng = random.Random(seed)
+    roles = {"train": train_files, "val": val_files, "test": test_files}
+    dirs = {}
+    for role, n_files in roles.items():
+        role_dir = os.path.join(root, role)
+        os.makedirs(role_dir, exist_ok=True)
+        remaining = n_files
+        pi = 0
+        total_methods = 0
+        while remaining > 0:
+            n = min(files_per_project, remaining)
+            total_methods += generate_project(
+                role_dir, rng, f"{role}proj{pi}", n)
+            remaining -= n
+            pi += 1
+        log(f"  {role}: {n_files} files, {pi} projects, "
+            f"~{total_methods} methods -> {role_dir}")
+        dirs[role] = role_dir
+    return dirs
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/csgen_corpus"
+    generate_corpus(out)

@@ -1,0 +1,106 @@
+"""MAX_CONTEXTS=500 stress benchmark (BASELINE config #4).
+
+The reference exposes MAX_CONTEXTS (config.py:60, default 200) and the
+long-context question is whether throughput scales gracefully when the
+per-example context set grows 2.5x — the attention softmax, the three
+embedding gathers and the context transform all scale linearly in M
+while the 261K-way classifier does not, so examples/sec should drop by
+clearly less than 2.5x.
+
+Runs the flagship single-chip timing (bench.measure) at 200 and at 500
+contexts on the real TPU, plus a cp=2 context-parallel dryrun of the
+manual shard_map kernels at 500 contexts on 8 virtual CPU devices (the
+cp grad-parity tests in tests/test_sharding.py cover correctness; this
+pins that the cp=2 program compiles and runs at the stress shape).
+
+Writes BENCH_CTX500.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def cp_dryrun_500() -> str:
+    """One manual-kernel train step with cp=2 at 500 contexts on a
+    virtual 8-device CPU mesh, in a clean subprocess (the parent may
+    already hold the TPU backend)."""
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        "jax.config.update('jax_num_cpu_devices',8); "
+        f"import sys; sys.path.insert(0, {REPO!r}); "
+        "import numpy as np, jax.numpy as jnp; "
+        "from code2vec_tpu.config import Config; "
+        "from code2vec_tpu.data.reader import RowBatch; "
+        "from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims; "
+        "from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh; "
+        "from code2vec_tpu.training.state import create_train_state, "
+        "make_optimizer; "
+        "from code2vec_tpu.training.step import TrainStepBuilder, "
+        "device_put_batch; "
+        "plan = MeshPlan(dp=4, tp=1, cp=2); "
+        "config = Config(train_data_path_prefix='u', "
+        "compute_dtype='float32', dp=4, tp=1, cp=2, "
+        "use_manual_tp_kernels=True, train_batch_size=8, max_contexts=500); "
+        "config.verify(); "
+        "dims = ModelDims(token_vocab_size=64, path_vocab_size=32, "
+        "target_vocab_size=32, token_dim=16, path_dim=16); "
+        "mesh = make_mesh(plan); "
+        "module = Code2VecModule(dims=dims, compute_dtype=jnp.float32); "
+        "opt = make_optimizer(config); "
+        "state = create_train_state(module, opt, jax.random.PRNGKey(0), "
+        "mesh=mesh, config=config); "
+        "builder = TrainStepBuilder(module, opt, config, mesh=mesh); "
+        "assert builder.manual; "
+        "step = builder.make_train_step(state); "
+        "rng = np.random.default_rng(0); b, m = 8, 500; "
+        "batch = RowBatch(rng.integers(0,16,(b,m)).astype(np.int32), "
+        "rng.integers(0,16,(b,m)).astype(np.int32), "
+        "rng.integers(0,16,(b,m)).astype(np.int32), "
+        "np.ones((b,m),np.float32), rng.integers(1,16,(b,)).astype(np.int32), "
+        "np.ones((b,),bool)); "
+        "arrays = device_put_batch(batch, mesh); "
+        "state, loss = step(state, *arrays, jax.random.PRNGKey(1)); "
+        "loss = float(loss); "
+        "assert np.isfinite(loss), loss; "
+        "print(f'cp2-ctx500 dryrun OK, loss={loss:.4f}')"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(f"cp=2 dryrun failed:\n{proc.stdout}\n{proc.stderr}")
+    return proc.stdout.strip().splitlines()[-1]
+
+
+def main() -> None:
+    r200 = bench.measure(contexts=200)
+    r500 = bench.measure(contexts=500)
+    dryrun = cp_dryrun_500()
+    out = {
+        "ctx200": r200,
+        "ctx500": r500,
+        "throughput_ratio_500_over_200": round(r500["value"] / r200["value"], 4),
+        "contexts_per_sec_ctx200": round(r200["value"] * 200, 1),
+        "contexts_per_sec_ctx500": round(r500["value"] * 500, 1),
+        "cp2_dryrun": dryrun,
+    }
+    path = os.path.join(REPO, "BENCH_CTX500.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

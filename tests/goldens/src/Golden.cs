@@ -1,0 +1,106 @@
+using System;
+using System.Collections.Generic;
+using System.Linq;
+
+namespace Golden
+{
+    // Fixture exercising the C# extractor's main constructs: fields,
+    // properties, variable pairing across statements, loops (foreach /
+    // for / while), conditionals, ternaries, lambdas, LINQ-style calls,
+    // arrays, string building and a nested type.
+    public class InventoryTracker
+    {
+        private readonly List<int> quantities = new List<int>();
+        private Dictionary<string, int> skuCounts = new Dictionary<string, int>();
+        private double totalValue;
+        private int[] reorderLevels = new int[16];
+        private string label = "";
+
+        public int CountQuantities()
+        {
+            return this.quantities.Count;
+        }
+
+        public void AddQuantity(int quantity)
+        {
+            if (quantity >= 0)
+            {
+                this.quantities.Add(quantity);
+            }
+        }
+
+        public int SumQuantities()
+        {
+            int acc = 0;
+            foreach (int q in this.quantities)
+            {
+                acc += q;
+            }
+            return acc;
+        }
+
+        public int LargestReorder()
+        {
+            int best = this.reorderLevels[0];
+            for (int i = 1; i < this.reorderLevels.Length; i++)
+            {
+                if (this.reorderLevels[i] > best)
+                {
+                    best = this.reorderLevels[i];
+                }
+            }
+            return best;
+        }
+
+        public bool HasSku(string sku)
+        {
+            return this.skuCounts.ContainsKey(sku);
+        }
+
+        public int ResolveSku(string sku)
+        {
+            int value;
+            return this.skuCounts.TryGetValue(sku, out value) ? value : 0;
+        }
+
+        public void ScaleValue(double factor)
+        {
+            this.totalValue *= factor;
+        }
+
+        public string DescribeQuantities()
+        {
+            var sb = new System.Text.StringBuilder();
+            foreach (var q in this.quantities)
+            {
+                sb.Append(q).Append(',');
+            }
+            return sb.ToString();
+        }
+
+        public List<int> FilterPositiveQuantities()
+        {
+            return this.quantities.Where(q => q > 0).ToList();
+        }
+
+        public void ResetAll()
+        {
+            while (this.quantities.Count > 0)
+            {
+                this.quantities.RemoveAt(this.quantities.Count - 1);
+            }
+            this.skuCounts.Clear();
+            this.label = string.Empty;
+        }
+
+        private class Snapshot
+        {
+            public int Total;
+
+            public int ReadTotal()
+            {
+                return this.Total;
+            }
+        }
+    }
+}

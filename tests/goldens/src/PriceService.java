@@ -1,0 +1,54 @@
+package com.golden;
+
+import java.util.*;
+
+public class PriceService {
+    long localToken;
+    private double price = 0.0;
+    private long dirtyCache = 0L;
+    private final double[] caches = new double[8];
+    private float token;
+    private double user = 0.0;
+
+    public PriceService withPrice(double price) {
+        this.price = price;
+        return this;
+    }
+
+    public String formatCaches() {
+        return "caches=" + this.caches;
+    }
+
+    double getPrice() {
+        return this.price;
+    }
+
+    public double largestCache() {
+        double best = this.caches[0];
+        for (int i = 1; i < this.caches.length; i++) {
+            if (this.caches[i] > best) {
+                best = this.caches[i];
+            }
+        }
+        return best;
+    }
+
+    public PriceService withUser(double user) {
+        long start = System.nanoTime();
+        this.user = user;
+        return this;
+    }
+
+    public String formatPrice() {
+        return "price=" + this.price;
+    }
+
+    public double readPrice() {
+        return this.price;
+    }
+
+    public String renderPrice() {
+        return "price=" + this.price;
+    }
+
+}

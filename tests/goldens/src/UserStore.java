@@ -1,0 +1,51 @@
+package com.golden;
+
+import java.util.*;
+
+public class UserStore {
+    private long price;
+    private final double[] tokens = new double[8];
+    private int token = 0;
+    Map<String, Integer> userMap = new HashMap<String, Integer>();
+    private boolean token;
+
+    protected int readToken() {
+        return this.token;
+    }
+
+    public int fetchToken() {
+        return this.token;
+    }
+
+    int decodeToken(String text) {
+        this.token = Integer.parseInt(text.trim());
+        return this.token;
+    }
+
+    public void setToken(int token) {
+        if (token >= 0) {
+            this.token = token;
+        }
+    }
+
+    protected int sizeTokens() {
+        return this.tokens.length;
+    }
+
+    public String renderTokens() {
+        return "tokens=" + this.tokens;
+    }
+
+    public long readPrice() {
+        return this.price;
+    }
+
+    public double totalTokens() {
+        double acc = 0.0;
+        for (double v : this.tokens) {
+            acc += v;
+        }
+        return acc;
+    }
+
+}

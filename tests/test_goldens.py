@@ -1,0 +1,86 @@
+"""Golden-output regression tests for the native extractors.
+
+The unit tests (test_extractor.py / test_cs_extractor.py) pin individual
+grammar rules; these pin the COMPLETE byte-level output of both
+extractors over committed fixture sources, so any grammar or
+normalization change — intended or not — shows up as a reviewable diff
+of `tests/goldens/*.c2v`.
+
+Fixtures:
+- `Input.java` (repo root) — the REPL quickstart fixture;
+- `tests/goldens/src/*.java` — two javagen-generated classes (committed
+  as static sources; regenerating javagen does not move them);
+- `tests/goldens/src/Golden.cs` — hand-written C# exercising variable
+  pairing, loops, lambdas, nested types.
+
+To intentionally re-bless after a deliberate extractor change:
+    C2V_REGEN_GOLDENS=1 python -m pytest tests/test_goldens.py
+then review and commit the diff.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN_DIR = os.path.join(REPO_ROOT, "tests", "goldens")
+SRC_DIR = os.path.join(GOLDEN_DIR, "src")
+JAVA_BIN = os.path.join(REPO_ROOT, "cpp", "build", "c2v-extract")
+CS_BIN = os.path.join(REPO_ROOT, "cpp", "build", "c2v-extract-cs")
+
+CASES = [
+    # (golden file, binary, source path, extra flags)
+    ("Input.java.c2v", JAVA_BIN, os.path.join(REPO_ROOT, "Input.java"), ()),
+    ("PriceService.java.c2v", JAVA_BIN,
+     os.path.join(SRC_DIR, "PriceService.java"), ()),
+    ("UserStore.java.c2v", JAVA_BIN,
+     os.path.join(SRC_DIR, "UserStore.java"), ()),
+    # no_hash keeps one Java golden human-readable (paths as node strings)
+    ("Input.java.nohash.c2v", JAVA_BIN,
+     os.path.join(REPO_ROOT, "Input.java"), ("--no_hash",)),
+    ("Golden.cs.c2v", CS_BIN, os.path.join(SRC_DIR, "Golden.cs"), ()),
+    ("Golden.cs.nohash.c2v", CS_BIN,
+     os.path.join(SRC_DIR, "Golden.cs"), ("--no_hash",)),
+]
+
+
+def _ensure_built():
+    if not (os.path.exists(JAVA_BIN) and os.path.exists(CS_BIN)):
+        rc = subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "cpp")],
+                            capture_output=True, text=True)
+        assert rc.returncode == 0, rc.stderr
+
+
+def _extract(binary, source, extra):
+    if binary is CS_BIN:
+        # mirrors the reference CSharpExtractor CLI (--path, --max_length)
+        cmd = [binary, "--path", source, "--max_length", "8",
+               "--max_width", "2", *extra]
+    else:
+        cmd = [binary, "--max_path_length", "8", "--max_path_width", "2",
+               "--file", source, *extra]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+@pytest.mark.parametrize("golden_name,binary,source,extra", CASES,
+                         ids=[c[0] for c in CASES])
+def test_extractor_matches_golden(golden_name, binary, source, extra):
+    _ensure_built()
+    got = _extract(binary, source, extra)
+    golden_path = os.path.join(GOLDEN_DIR, golden_name)
+    if os.environ.get("C2V_REGEN_GOLDENS"):
+        with open(golden_path, "w") as f:
+            f.write(got)
+    assert os.path.exists(golden_path), (
+        f"{golden_name} missing; run with C2V_REGEN_GOLDENS=1 to bless")
+    with open(golden_path) as f:
+        want = f.read()
+    assert got == want, (
+        f"extractor output for {os.path.basename(source)} diverged from "
+        f"{golden_name}; if the change is deliberate, re-bless with "
+        f"C2V_REGEN_GOLDENS=1 and commit the diff")
+    # non-triviality guard: a silently empty extraction must not pass
+    assert want.strip(), f"golden {golden_name} is empty"

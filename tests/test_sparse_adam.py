@@ -273,3 +273,59 @@ def test_manual_sparse_step_matches_single_device(plan):
         np.testing.assert_allclose(
             np.asarray(new1.params[name]), np.asarray(newN.params[name]),
             rtol=2e-4, atol=2e-5, err_msg=f"param {name} diverged")
+
+
+def test_adam_kwargs_single_source_with_dense_optimizer():
+    """_adam_kwargs (the sparse rows' hyperparameters) must describe
+    exactly the transform make_optimizer builds for the dense subtree:
+    apply both to the same grads for several steps and require
+    bit-identical parameters. Guards against the two drifting apart if
+    make_optimizer ever gains a schedule/clipping wrapper. (Pinned to
+    the f32-nu path, which routes to stock optax.adam; the bf16-nu
+    default path is covered by the nu_dtype test below.)"""
+    config = _config(adam_nu_dtype="float32")
+    kw = TrainStepBuilder._adam_kwargs(
+        type("B", (), {"config": config})())
+    reference = optax.adam(learning_rate=kw["lr"], b1=kw["b1"],
+                           b2=kw["b2"], eps=kw["eps"],
+                           mu_dtype=jnp.dtype(config.adam_mu_dtype))
+    production = make_optimizer(config)
+
+    params = {"w": jnp.linspace(-1.0, 1.0, 12).reshape(3, 4)}
+    s_ref = reference.init(params)
+    s_prod = production.init(params)
+    rng = np.random.default_rng(0)
+    p_ref, p_prod = params, params
+    for _ in range(3):
+        g = {"w": jnp.asarray(rng.standard_normal((3, 4)), jnp.float32)}
+        u_ref, s_ref = reference.update(g, s_ref, p_ref)
+        u_prod, s_prod = production.update(g, s_prod, p_prod)
+        p_ref = optax.apply_updates(p_ref, u_ref)
+        p_prod = optax.apply_updates(p_prod, u_prod)
+    np.testing.assert_array_equal(np.asarray(p_ref["w"]),
+                                  np.asarray(p_prod["w"]))
+
+
+def test_adam_nu_dtype_f32_path_is_stock_and_bf16_tracks_it():
+    """adam_nu_dtype='float32' must route to stock optax.adam (bit
+    parity, already covered above); the bf16-nu transform must track the
+    f32 trajectory within bf16 rounding of the second moment."""
+    cfg32 = _config(adam_nu_dtype="float32")
+    cfg16 = _config(adam_nu_dtype="bfloat16")
+    opt32, opt16 = make_optimizer(cfg32), make_optimizer(cfg16)
+
+    params = {"w": jnp.linspace(-1.0, 1.0, 12).reshape(3, 4)}
+    s32, s16 = opt32.init(params), opt16.init(params)
+    # nu stored in bf16 on the new path
+    leaf16 = jax.tree.leaves(s16)
+    assert any(getattr(l, "dtype", None) == jnp.bfloat16 for l in leaf16)
+    rng = np.random.default_rng(1)
+    p32, p16 = params, params
+    for _ in range(5):
+        g = {"w": jnp.asarray(rng.standard_normal((3, 4)), jnp.float32)}
+        u32, s32 = opt32.update(g, s32, p32)
+        u16, s16 = opt16.update(g, s16, p16)
+        p32 = optax.apply_updates(p32, u32)
+        p16 = optax.apply_updates(p16, u16)
+    np.testing.assert_allclose(np.asarray(p32["w"]), np.asarray(p16["w"]),
+                               rtol=2e-2, atol=2e-4)
