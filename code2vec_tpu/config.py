@@ -26,6 +26,10 @@ class Config:
     # -- training schedule (reference: config.py:46-57) --
     num_train_epochs: int = 20
     save_every_epochs: int = 1
+    # Checkpoint-and-stop on SIGTERM (preempted TPU workers get a grace
+    # window; training/loop.py PreemptionWatcher). No reference analog —
+    # the reference loses the epoch in progress on preemption.
+    save_on_preemption: bool = True
     train_batch_size: int = 1024
     test_batch_size: int = 1024
     top_k_words_considered_during_prediction: int = 10

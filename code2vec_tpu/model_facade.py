@@ -192,18 +192,27 @@ class Code2VecModel:
                           steps_per_epoch_hint=self._steps_per_epoch)
         self.state = trainer.train(self.state, batches, dropout_rng(config))
         self.initial_epoch = trainer.final_epoch
-        if config.is_saving:
+        if trainer.preempted:
+            # The preemption checkpoint is already on disk; a second full
+            # save here could outlive the scheduler's grace window.
+            self.log("Preempted: skipping final save (checkpoint already "
+                     "written by the preemption handler)")
+        elif config.is_saving:
             self.save()
             self.log(f"Model saved in: {config.model_save_path}")
 
     def _make_save_fn(self):
         config = self.config
 
-        def save_fn(state, epoch):
-            path = f"{config.model_save_path}_iter{epoch}"
+        def save_fn(state, epoch, suffix=""):
+            # suffix="_preempt" (preemption checkpoints) keeps the save
+            # from clobbering the clean end-of-epoch _iter<N> artifact
+            # whose metrics the eval log refers to.
+            path = f"{config.model_save_path}_iter{epoch}{suffix}"
             ckpt_mod.save_model(path, state, self.vocabs, config, epoch=epoch)
             self.log(f"Saved after {epoch} epochs in: {path}")
-            self._rotate_epoch_checkpoints()
+            if not suffix:
+                self._rotate_epoch_checkpoints()
 
         return save_fn
 

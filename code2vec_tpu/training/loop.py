@@ -18,6 +18,8 @@ fire exactly once per pass regardless of how many rows the filter drops.
 
 from __future__ import annotations
 
+import signal
+import threading
 import time
 from typing import Callable, Iterable, Optional
 
@@ -34,6 +36,54 @@ from code2vec_tpu.utils.prefetch import DevicePrefetcher
 # ~num_batches_to_log_progress batches, so a heavier weight on the new
 # observation gives a comparable horizon).
 _THROUGHPUT_EMA_ALPHA = 0.5
+
+# Multi-process runs reduce the preemption flag across hosts every this
+# many batches (every batch would put a host collective on the step
+# path); SIGTERM grace windows are tens of seconds, ~10 batches is
+# well under one.
+_PREEMPT_SYNC_EVERY = 10
+
+
+class PreemptionWatcher:
+    """SIGTERM -> checkpoint-and-stop (SURVEY §5 failure detection).
+
+    TPU pods and most cluster schedulers deliver SIGTERM with a grace
+    window before killing a preempted worker. The reference has no
+    preemption story (single-workstation TF, it simply dies and loses
+    the epoch in progress); here the trainer checks the flag at every
+    step boundary and, when set, saves a checkpoint and exits the loop
+    cleanly so `--load` resumes from the interrupted step's epoch.
+    Install is a no-op off the main thread (signals can only be bound
+    there); the previous handler is chained, not clobbered."""
+
+    def __init__(self, log=print):
+        self._requested = False
+        self._log = log
+        self._prev = None
+        self._installed = False
+
+    def install(self) -> "PreemptionWatcher":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        self._prev = signal.signal(signal.SIGTERM, self._handle)
+        self._installed = True
+        return self
+
+    def _handle(self, signum, frame):
+        self._requested = True
+        self._log("SIGTERM received: will checkpoint at the next step "
+                  "boundary and stop")
+        if callable(self._prev):
+            self._prev(signum, frame)
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def uninstall(self) -> None:
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev or signal.SIG_DFL)
+            self._installed = False
 
 
 class Trainer:
@@ -64,6 +114,10 @@ class Trainer:
         # recorded into the final artifact's meta so a later resume
         # continues numbering.
         self.final_epoch = initial_epoch
+        # True when train() exited via a preemption checkpoint; callers
+        # should skip further (slow) post-training saves — the grace
+        # window may not cover a second multi-GB write.
+        self.preempted = False
 
     def _make_tb_writer(self):
         if not self.config.use_tensorboard:
@@ -85,6 +139,7 @@ class Trainer:
         tb = self._make_tb_writer()
 
         batch_num = 0              # batches this run
+        trace_active = False       # profiler trace in flight
         epoch = self.initial_epoch
         batch_in_epoch = 0
         batches_since_eval = 0
@@ -95,6 +150,37 @@ class Trainer:
         last_avg_loss = float("nan")
         prefetcher = DevicePrefetcher(batches, self.mesh,
                                       depth=config.prefetch_batches)
+        watcher = None
+        if getattr(config, "save_on_preemption", True):
+            watcher = PreemptionWatcher(log).install()
+
+        def preemption_agreed(batch_num: int) -> bool:
+            """Do ALL hosts agree to stop now? Single-process: the local
+            flag, checked every step. Multi-process: the flag must be
+            reduced across hosts — SIGTERM lands at different wall times
+            per worker, and a host breaking out of the collective step
+            loop alone would deadlock the others — so every host ORs the
+            flags at the same fixed cadence (batch_num is lockstep)."""
+            if watcher is None:
+                return False
+            if jax.process_count() == 1:
+                return watcher.requested
+            if batch_num % _PREEMPT_SYNC_EVERY != 0:
+                return False
+            from code2vec_tpu.parallel import distributed
+            flag = np.array([1.0 if watcher.requested else 0.0])
+            return bool(distributed.allreduce_host_scalars(flag)[0] > 0)
+
+        def save_preempt(state, epoch):
+            if self.save_fn is None:
+                return
+            import inspect
+            if "suffix" in inspect.signature(self.save_fn).parameters:
+                # distinct name: never clobbers the clean end-of-epoch
+                # artifact the eval log refers to
+                self.save_fn(state, epoch, suffix="_preempt")
+            else:
+                self.save_fn(state, epoch)
 
         def run_eval(state, label):
             if self.evaluate_fn is None:
@@ -108,76 +194,95 @@ class Trainer:
                         tb.scalar(f"eval/{name}", value, step)
                     tb.flush()
 
-        for item in prefetcher:
-            if isinstance(item, EpochEnd):
-                epoch = self.initial_epoch + item.epoch
-                if steps_per_epoch is None:
-                    steps_per_epoch = batch_in_epoch
-                batch_in_epoch = 0
-                batches_since_eval = 0
-                # Absolute-epoch cadence: stable across resumes; the final
-                # epoch always gets a save+eval even off-cadence.
-                if (epoch % config.save_every_epochs == 0
-                        or epoch >= config.num_train_epochs):
-                    if self.save_fn is not None:
-                        self.save_fn(state, epoch)
-                    run_eval(state, f"After {epoch} epochs")
-                    if self.stop_fn is not None and self.stop_fn():
-                        log(f"Early stopping after epoch {epoch}")
-                        break
-                pending_losses = []
-                multi_batch_start = time.time()
-                continue
+        try:
+            for item in prefetcher:
+                if isinstance(item, EpochEnd):
+                    epoch = self.initial_epoch + item.epoch
+                    if steps_per_epoch is None:
+                        steps_per_epoch = batch_in_epoch
+                    batch_in_epoch = 0
+                    batches_since_eval = 0
+                    # Absolute-epoch cadence: stable across resumes; the final
+                    # epoch always gets a save+eval even off-cadence.
+                    if (epoch % config.save_every_epochs == 0
+                            or epoch >= config.num_train_epochs):
+                        if self.save_fn is not None:
+                            self.save_fn(state, epoch)
+                        run_eval(state, f"After {epoch} epochs")
+                        if self.stop_fn is not None and self.stop_fn():
+                            log(f"Early stopping after epoch {epoch}")
+                            break
+                    pending_losses = []
+                    multi_batch_start = time.time()
+                    continue
 
-            arrays, _ = item
-            batch_num += 1
-            batch_in_epoch += 1
-            batches_since_eval += 1
-            if self.profile_dir and batch_num == 10:
-                jax.profiler.start_trace(self.profile_dir)
-            state, loss = self.train_step(state, *arrays, rng)
-            pending_losses.append(loss)
-            if self.profile_dir and batch_num == 20:
-                jax.block_until_ready(loss)
-                jax.profiler.stop_trace()
-                log(f"Wrote profiler trace to {self.profile_dir}")
-            if batch_num % config.num_batches_to_log_progress == 0:
-                # Blocks on the device only here.
-                last_avg_loss = float(np.mean(jax.device_get(pending_losses)))
-                elapsed = time.time() - multi_batch_start
-                n = len(pending_losses) * config.train_batch_size
-                throughput = n / max(elapsed, 1e-9)
-                throughput_ema = (
-                    throughput if throughput_ema is None else
-                    _THROUGHPUT_EMA_ALPHA * throughput
-                    + (1 - _THROUGHPUT_EMA_ALPHA) * throughput_ema)
-                contexts_rate = throughput * config.max_contexts
-                eta = ""
-                if steps_per_epoch:
-                    remaining = max(steps_per_epoch - batch_in_epoch, 0)
-                    eta_s = remaining * config.train_batch_size / max(
-                        throughput_ema, 1e-9)
-                    eta = (f", epoch {epoch + 1}: "
-                           f"{batch_in_epoch}/{steps_per_epoch} batches, "
-                           f"ETA {int(eta_s) // 60}m{int(eta_s) % 60:02d}s")
-                log(f"Average loss at batch {batch_num}: {last_avg_loss:.6f}, "
-                    f"\tthroughput: {throughput:.0f} samples/sec "
-                    f"({contexts_rate / 1e6:.2f}M path-contexts/sec{eta})")
-                if tb is not None:
-                    step = int(np.asarray(jax.device_get(state.step)))
-                    tb.scalar("train/loss", last_avg_loss, step)
-                    tb.scalar("train/examples_per_sec", throughput, step)
-                    tb.flush()
-                pending_losses = []
-                multi_batch_start = time.time()
-            if eval_every and batches_since_eval >= eval_every:
-                # reference: ModelEvaluationCallback fires every
-                # NUM_TRAIN_BATCHES_TO_EVALUATE=1800 train batches
-                # (keras_model.py:326-369, config.py:55).
-                batches_since_eval = 0
-                run_eval(state, f"Mid-epoch (batch {batch_num}) evaluation")
-                pending_losses = []
-                multi_batch_start = time.time()
+                arrays, _ = item
+                batch_num += 1
+                batch_in_epoch += 1
+                batches_since_eval += 1
+                if self.profile_dir and batch_num == 10:
+                    jax.profiler.start_trace(self.profile_dir)
+                    trace_active = True
+                state, loss = self.train_step(state, *arrays, rng)
+                pending_losses.append(loss)
+                if preemption_agreed(batch_num):
+                    # Preemption notice: checkpoint what we have and leave
+                    # cleanly inside the scheduler's grace window. `--load`
+                    # resumes from this epoch's numbering.
+                    if trace_active:
+                        jax.profiler.stop_trace()
+                        trace_active = False
+                    save_preempt(state, epoch)
+                    log(f"Preemption checkpoint saved (epoch {epoch}, "
+                        f"batch {batch_num}); stopping")
+                    self.preempted = True
+                    break
+                if self.profile_dir and batch_num == 20:
+                    jax.block_until_ready(loss)
+                    jax.profiler.stop_trace()
+                    trace_active = False
+                    log(f"Wrote profiler trace to {self.profile_dir}")
+                if batch_num % config.num_batches_to_log_progress == 0:
+                    # Blocks on the device only here.
+                    last_avg_loss = float(np.mean(jax.device_get(pending_losses)))
+                    elapsed = time.time() - multi_batch_start
+                    n = len(pending_losses) * config.train_batch_size
+                    throughput = n / max(elapsed, 1e-9)
+                    throughput_ema = (
+                        throughput if throughput_ema is None else
+                        _THROUGHPUT_EMA_ALPHA * throughput
+                        + (1 - _THROUGHPUT_EMA_ALPHA) * throughput_ema)
+                    contexts_rate = throughput * config.max_contexts
+                    eta = ""
+                    if steps_per_epoch:
+                        remaining = max(steps_per_epoch - batch_in_epoch, 0)
+                        eta_s = remaining * config.train_batch_size / max(
+                            throughput_ema, 1e-9)
+                        eta = (f", epoch {epoch + 1}: "
+                               f"{batch_in_epoch}/{steps_per_epoch} batches, "
+                               f"ETA {int(eta_s) // 60}m{int(eta_s) % 60:02d}s")
+                    log(f"Average loss at batch {batch_num}: {last_avg_loss:.6f}, "
+                        f"\tthroughput: {throughput:.0f} samples/sec "
+                        f"({contexts_rate / 1e6:.2f}M path-contexts/sec{eta})")
+                    if tb is not None:
+                        step = int(np.asarray(jax.device_get(state.step)))
+                        tb.scalar("train/loss", last_avg_loss, step)
+                        tb.scalar("train/examples_per_sec", throughput, step)
+                        tb.flush()
+                    pending_losses = []
+                    multi_batch_start = time.time()
+                if eval_every and batches_since_eval >= eval_every:
+                    # reference: ModelEvaluationCallback fires every
+                    # NUM_TRAIN_BATCHES_TO_EVALUATE=1800 train batches
+                    # (keras_model.py:326-369, config.py:55).
+                    batches_since_eval = 0
+                    run_eval(state, f"Mid-epoch (batch {batch_num}) evaluation")
+                    pending_losses = []
+                    multi_batch_start = time.time()
+
+        finally:
+            if watcher is not None:
+                watcher.uninstall()
 
         log("Done training")
         self.final_epoch = epoch

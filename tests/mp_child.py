@@ -123,6 +123,39 @@ def main():
     np.testing.assert_allclose(float(tr_loss),
                                float(data["expected_train_loss"]), rtol=1e-5)
 
+    # 4. preemption agreement: SIGTERM is delivered ONLY to process 0,
+    # but both hosts must leave the collective step loop at the same
+    # reduce boundary (training/loop.py preemption_agreed) — a lone
+    # host breaking out would deadlock the other.
+    import signal as _signal
+    from code2vec_tpu.data.reader import EpochEnd
+    from code2vec_tpu.training.loop import Trainer
+
+    cfg2 = Config(train_data_path_prefix="unused", train_batch_size=B,
+                  max_contexts=8, num_train_epochs=1, dp=4)
+    steps2, saves2 = [], []
+
+    def stream2():
+        for b in range(40):
+            if b == 5 and pid == 0:
+                os.kill(os.getpid(), _signal.SIGTERM)
+            yield local_batch
+        yield EpochEnd(1)
+
+    def fake_step(s, *a):
+        steps2.append(1)
+        return s, np.float32(1.0)
+
+    class _S:
+        step = np.zeros((), np.int32)
+
+    tr = Trainer(cfg2, fake_step,
+                 save_fn=lambda s, e, suffix="": saves2.append((e, suffix)))
+    tr.train(_S(), stream2(), rng=np.zeros((2,), np.uint32))
+    assert tr.preempted, f"pid {pid}: no preemption agreement reached"
+    assert len(steps2) < 40, f"pid {pid}: ran the whole stream"
+    assert saves2 == [(0, "_preempt")], saves2
+
     if pid == 0:
         with open(out_path, "w") as f:
             json.dump({

@@ -252,3 +252,55 @@ def test_checkpoint_mode_mismatch_is_a_clear_error(tmp_path, tiny_vocabs,
                               released=True)
     restored = ckpt_mod.load_model(rel, state, config=sparse_config)
     assert int(np.asarray(restored.step)) == int(np.asarray(state.step))
+
+
+def test_preemption_sigterm_saves_and_stops(tiny_config):
+    """SIGTERM mid-epoch -> one checkpoint of the in-flight state, clean
+    early exit (PreemptionWatcher; SURVEY §5 failure detection)."""
+    import os as _os
+    import signal as _signal
+
+    tiny_config.num_train_epochs = 3
+    saves, steps = [], []
+
+    def stream():
+        for e in range(3):
+            for b in range(4):
+                if (e, b) == (1, 1):
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+                yield _fake_batch()
+            yield EpochEnd(e + 1)
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, np.float32(1.0)
+
+    def save_fn(state, epoch, suffix=""):
+        saves.append((epoch, suffix))
+
+    trainer = Trainer(tiny_config, train_step, save_fn=save_fn)
+    trainer.train(_State(), stream(), rng=np.zeros((2,), np.uint32))
+
+    # stopped early: well short of the 12 batches in the stream
+    assert len(steps) < 12
+    assert trainer.preempted
+    # the preemption checkpoint gets a distinct suffixed name so the
+    # clean end-of-epoch-1 artifact is never clobbered
+    assert saves[0] == (1, "")            # normal end-of-epoch-1 save
+    assert saves[-1] == (1, "_preempt")   # preemption save during epoch 2
+    assert len(saves) == 2
+    # handler restored: a later SIGTERM must not set any stale flag
+    assert _signal.getsignal(_signal.SIGTERM) in (
+        _signal.SIG_DFL, _signal.default_int_handler, None)
+
+
+def test_preemption_disabled_by_config(tiny_config):
+    """save_on_preemption=False: no handler installed, the run ignores
+    the watcher entirely (SIGTERM would kill the process as before)."""
+    import signal as _signal
+    tiny_config.num_train_epochs = 1
+    tiny_config.save_on_preemption = False
+    prev = _signal.getsignal(_signal.SIGTERM)
+    saves, _ = _run_trainer(tiny_config, _marker_stream(2, 1))
+    assert _signal.getsignal(_signal.SIGTERM) is prev
+    assert saves == [1]
