@@ -1,5 +1,6 @@
 #include "cs_extract.h"
 
+#include <iostream>
 #include <algorithm>
 #include <cctype>
 #include <random>
@@ -300,6 +301,9 @@ std::vector<std::string> CsExtractFromSource(const std::string& code,
                                              const CsExtractOptions& options) {
   CsArena arena;
   CsParseResult parsed = CsParse(code, &arena);
+  for (const std::string& w : parsed.warnings) {
+    std::cerr << "warning: " << w << "\n";
+  }
 
   std::vector<CsNode*> methods;
   CollectMethods(parsed.root, &methods);

@@ -48,6 +48,7 @@ class Parser {
     CsParseResult result;
     result.root = ParseCompilationUnit();
     result.comments = std::move(lexed_.comments);
+    result.warnings = std::move(warnings_);
     return result;
   }
 
@@ -165,11 +166,34 @@ class Parser {
     return Finish(list);
   }
 
-  // Type grammar: (predefined | qualified name) rank-specifiers? `?`
+  // Tuple type `(T1 [name], T2 [name], ...)` (C#7; Roslyn TupleType/
+  // TupleElement). Two+ elements required — a single parenthesized type
+  // is not a type, so speculative callers backtrack correctly.
+  CsNode* ParseTupleTypeBody(int begin) {
+    Next();  // (
+    CsNode* tup = New("TupleType", begin);
+    int elems = 0;
+    do {
+      int eb = Pos();
+      CsNode* el = New("TupleElement", eb);
+      CsAdopt(el, ParseType());
+      if (IsIdent()) AttachIdent(el);
+      Finish(el);
+      CsAdopt(tup, el);
+      ++elems;
+    } while (Accept(","));
+    Expect(")");
+    if (elems < 2) Fail("tuple type needs two or more elements");
+    return Finish(tup);
+  }
+
+  // Type grammar: (predefined | qualified name | tuple) rank-specifiers? `?`
   CsNode* ParseType() {
     int begin = Pos();
     CsNode* t;
-    if (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text)) {
+    if (Is("(")) {
+      t = ParseTupleTypeBody(begin);
+    } else if (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text)) {
       t = New("PredefinedType", begin);
       AttachCurrentAs(t, Tok::kIdent);  // keyword token: leaf via parent
       t->end = PrevEnd();
@@ -462,10 +486,47 @@ class Parser {
     while (!Accept("}")) {
       if (AtEof()) Fail("unterminated type body");
       if (Accept(";")) continue;
-      CsAdopt(decl, ParseTypeOrMember(false));
+      // Per-member recovery: a construct this parser does not cover
+      // (or future C# syntax) skips THAT member — balanced to its `;`
+      // or closing `}` — instead of losing the whole file. The
+      // reference's Roslyn never hard-fails, so graceful degradation
+      // is the parity-preserving behavior here.
+      size_t save = p_;
+      try {
+        CsAdopt(decl, ParseTypeOrMember(false));
+      } catch (const CsParseError& e) {
+        p_ = save;
+        SkipBalancedMember(e.what());
+      }
     }
     Accept(";");
     return Finish(decl);
+  }
+
+  void SkipBalancedMember(const char* why) {
+    // Consume one member's tokens: everything up to a `;` at depth 0 or
+    // through a complete `{...}` group. Starting on the enclosing `}`
+    // means no progress is possible — rethrow rather than loop forever.
+    if (Is("}")) throw CsParseError(why);
+    warnings_.push_back(std::string("skipped unparsable member at offset ")
+                        + std::to_string(Pos()) + ": " + why);
+    int depth = 0;
+    while (!AtEof()) {
+      if (Is("{")) {
+        ++depth;
+      } else if (Is("}")) {
+        if (depth == 0) return;  // enclosing type's close: leave for caller
+        --depth;
+        Next();
+        if (depth == 0) return;  // member body fully consumed
+        continue;
+      } else if (Is(";") && depth == 0) {
+        Next();
+        return;
+      }
+      Next();
+    }
+    Fail("unterminated member while recovering");
   }
 
   CsNode* ParseTypeParameterList() {
@@ -822,6 +883,123 @@ class Parser {
     return Finish(b);
   }
 
+  // ---------------------------------------------------- tuple expressions
+  // `(a, b)`, `(count: 1, name: "x")` (NameColon), and deconstruction
+  // targets `(int a, string b) = ...` (DeclarationExpression with
+  // SingleVariableDesignation) — Roslyn node shapes throughout.
+  CsNode* ParseTupleArgValue() {
+    size_t save = p_;
+    int begin = Pos();
+    try {
+      CsNode* type = ParseType();
+      if (IsIdent()) {
+        CsNode* d = New("DeclarationExpression", begin);
+        CsAdopt(d, type);
+        int db = Pos();
+        CsNode* desig = New("SingleVariableDesignation", db);
+        AttachIdent(desig);
+        Finish(desig);
+        CsAdopt(d, desig);
+        return Finish(d);
+      }
+      p_ = save;
+    } catch (const CsParseError&) {
+      p_ = save;
+    }
+    return ParseExpression();
+  }
+
+  CsNode* ParseTupleArgument() {
+    int ab = Pos();
+    CsNode* a = New("Argument", ab);
+    if (Cur().kind == Tok::kIdent && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == ":") {
+      CsNode* nc = New("NameColon", ab);
+      AttachIdent(nc);
+      Next();  // :
+      Finish(nc);
+      CsAdopt(a, nc);
+    }
+    CsAdopt(a, ParseTupleArgValue());
+    return Finish(a);
+  }
+
+  CsNode* ParseTupleExpressionRest(int begin, CsNode* first) {
+    CsNode* tup = New("TupleExpression", begin);
+    if (first != nullptr) {
+      CsNode* a0 = New("Argument", first->begin);
+      CsAdopt(a0, first);
+      Finish(a0);
+      CsAdopt(tup, a0);  // caller guarantees the `,` follows
+    } else {
+      CsAdopt(tup, ParseTupleArgument());
+    }
+    while (Accept(",")) {
+      CsAdopt(tup, ParseTupleArgument());
+    }
+    Expect(")");
+    return Finish(tup);
+  }
+
+  // ----------------------------------------------------- patterns (C#7/8)
+  // Roslyn-shaped pattern nodes for `case` labels and switch expressions:
+  // DiscardPattern, RelationalPattern, DeclarationPattern (with
+  // SingleVariableDesignation), ConstantPattern. The constant operand is
+  // parsed at shift level so `=>` / `:` / `when` terminate the pattern.
+  CsNode* ParsePattern() {
+    int begin = Pos();
+    if (Cur().kind == Tok::kIdent && Cur().text == "_") {
+      Next();
+      return Finish(New("DiscardPattern", begin));
+    }
+    if (Is("<") || Is("<=") || Is(">") || Is(">=")) {
+      Next();
+      CsNode* p = New("RelationalPattern", begin);
+      CsAdopt(p, ParseShift());
+      return Finish(p);
+    }
+    if (Cur().kind == Tok::kIdent && Cur().text == "var" &&
+        LookAhead(1).kind == Tok::kIdent) {
+      // `var x` — Roslyn kind is VarPattern, not DeclarationPattern
+      Next();
+      CsNode* p = New("VarPattern", begin);
+      int db = Pos();
+      CsNode* desig = New("SingleVariableDesignation", db);
+      AttachIdent(desig);
+      Finish(desig);
+      CsAdopt(p, desig);
+      return Finish(p);
+    }
+    size_t save = p_;
+    try {
+      CsNode* type = ParseType();
+      if (IsIdent() && Cur().text != "when") {
+        CsNode* p = New("DeclarationPattern", begin);
+        CsAdopt(p, type);
+        int db = Pos();
+        CsNode* desig = New("SingleVariableDesignation", db);
+        AttachIdent(desig);
+        Finish(desig);
+        CsAdopt(p, desig);
+        return Finish(p);
+      }
+      p_ = save;
+    } catch (const CsParseError&) {
+      p_ = save;
+    }
+    CsNode* p = New("ConstantPattern", begin);
+    CsAdopt(p, ParseShift());
+    return Finish(p);
+  }
+
+  CsNode* ParseWhenClause() {
+    int wb = Pos();
+    Next();  // when
+    CsNode* w = New("WhenClause", wb);
+    CsAdopt(w, ParseExpression());
+    return Finish(w);
+  }
+
   CsNode* ParseStatement() {
     int begin = Pos();
     if (Is("{")) return ParseBlock();
@@ -915,8 +1093,28 @@ class Parser {
         while (IsKw("case") || IsKw("default")) {
           int lb = Pos();
           if (AcceptKw("case")) {
-            CsNode* label = New("CaseSwitchLabel", lb);
-            CsAdopt(label, ParseExpression());
+            // Constant labels keep the legacy node shape (paths are the
+            // data format; goldens pin it). Pattern labels (C#7: `case
+            // Type v when ...`, `case < 0:`) get the Roslyn pattern
+            // nodes via ParsePattern.
+            size_t save = p_;
+            CsNode* label = nullptr;
+            try {
+              CsNode* expr = ParseExpression();
+              if (Is(":")) {
+                label = New("CaseSwitchLabel", lb);
+                CsAdopt(label, expr);
+              } else {
+                p_ = save;
+              }
+            } catch (const CsParseError&) {
+              p_ = save;
+            }
+            if (label == nullptr) {
+              label = New("CasePatternSwitchLabel", lb);
+              CsAdopt(label, ParsePattern());
+              if (IsKw("when")) CsAdopt(label, ParseWhenClause());
+            }
             Expect(":");
             Finish(label);
             CsAdopt(section, label);
@@ -979,6 +1177,15 @@ class Parser {
     }
     if (IsKw("using")) {
       Next();
+      if (!Is("(")) {
+        // using declaration (C#8): `using var d = expr;` — scoped to the
+        // enclosing block; Roslyn models it as a LocalDeclarationStatement
+        // carrying the using keyword.
+        CsNode* s = New("LocalDeclarationStatement", begin);
+        CsAdopt(s, ParseVariableDeclaration());
+        Expect(";");
+        return Finish(s);
+      }
       CsNode* s = New("UsingStatement", begin);
       Expect("(");
       size_t save = p_;
@@ -1048,6 +1255,38 @@ class Parser {
       CsNode* s = New("LabeledStatement", begin);
       CsAdopt(s, ParseStatement());
       return Finish(s);
+    }
+    // local function (C#7/8): `[static|async|unsafe] Type Name[<T>]
+    // (params) { ... }` or `=> expr;`
+    {
+      size_t save = p_;
+      try {
+        while (IsKw("static") || IsKw("async") || IsKw("unsafe")) Next();
+        CsNode* type = ParseType();
+        if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+            (LookAhead(1).text == "(" || LookAhead(1).text == "<")) {
+          CsNode* s = New("LocalFunctionStatement", begin);
+          CsAdopt(s, type);
+          AttachIdent(s);
+          if (Is("<")) CsAdopt(s, ParseTypeParameterList());
+          CsAdopt(s, ParseParameterList());
+          while (IsKw("where")) CsAdopt(s, ParseConstraintClause());
+          if (Accept("=>")) {
+            int ab = Pos();
+            CsNode* arrow = New("ArrowExpressionClause", ab);
+            CsAdopt(arrow, ParseExpression());
+            Finish(arrow);
+            CsAdopt(s, arrow);
+            Expect(";");
+          } else {
+            CsAdopt(s, ParseBlock());
+          }
+          return Finish(s);
+        }
+        p_ = save;
+      } catch (const CsParseError&) {
+        p_ = save;
+      }
     }
     // local declaration vs expression
     {
@@ -1313,7 +1552,39 @@ class Parser {
   }
 
   CsNode* ParseAdditive() { return BinaryChain(&Parser::ParseMultiplicative, &Parser::OpAdd); }
-  CsNode* ParseMultiplicative() { return BinaryChain(&Parser::ParseUnary, &Parser::OpMul); }
+  CsNode* ParseMultiplicative() { return BinaryChain(&Parser::ParseSwitchExprLevel, &Parser::OpMul); }
+
+  // switch expression (C#8): `expr switch { pattern [when e] => value,
+  // ... }` — Roslyn SwitchExpression/SwitchExpressionArm. Binds tighter
+  // than the binary operators (Roslyn: `a + b switch {...}` is
+  // `a + (b switch {...})`), hence this level just above unary.
+  CsNode* ParseSwitchExprLevel() {
+    int begin = Pos();
+    CsNode* lhs = ParseUnary();
+    while (IsKw("switch") && LookAhead(1).kind == Tok::kPunct &&
+           LookAhead(1).text == "{") {
+      Next();
+      Next();  // {
+      CsNode* e = New("SwitchExpression", begin);
+      CsAdopt(e, lhs);
+      while (!Is("}")) {
+        if (AtEof()) Fail("unterminated switch expression");
+        int ab = Pos();
+        CsNode* arm = New("SwitchExpressionArm", ab);
+        CsAdopt(arm, ParsePattern());
+        if (IsKw("when")) CsAdopt(arm, ParseWhenClause());
+        Expect("=>");
+        CsAdopt(arm, ParseExpression());
+        Finish(arm);
+        CsAdopt(e, arm);
+        if (!Accept(",")) break;
+      }
+      Expect("}");
+      Finish(e);
+      lhs = e;
+    }
+    return lhs;
+  }
 
   CsNode* ParseUnary() {
     int begin = Pos();
@@ -1612,8 +1883,18 @@ class Parser {
     }
     if (Is("(")) {
       Next();
+      // Named-first tuple `(count: 1, ...)`: `ident :` can only start a
+      // named tuple argument in expression position.
+      if (Cur().kind == Tok::kIdent && LookAhead(1).kind == Tok::kPunct &&
+          LookAhead(1).text == ":") {
+        return ParseTupleExpressionRest(begin, nullptr);
+      }
+      CsNode* first = ParseTupleArgValue();
+      if (Is(",")) {
+        return ParseTupleExpressionRest(begin, first);
+      }
       CsNode* e = New("ParenthesizedExpression", begin);
-      CsAdopt(e, ParseExpression());
+      CsAdopt(e, first);
       Expect(")");
       return Finish(e);
     }
@@ -1783,7 +2064,10 @@ class Parser {
   CsNode* ParseTypeNoArray() {
     int begin = Pos();
     CsNode* t;
-    if (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text)) {
+    if (Is("(")) {
+      t = ParseTupleTypeBody(begin);
+      // falls through to the shared `?` suffix handling below
+    } else if (Cur().kind == Tok::kIdent && kPredefinedTypes.count(Cur().text)) {
       t = New("PredefinedType", begin);
       AttachCurrentAs(t, Tok::kIdent);
       t->end = PrevEnd();
@@ -1810,6 +2094,7 @@ class Parser {
   CsArena* arena_;
   CsLexOutput lexed_;
   size_t p_ = 0;
+  std::vector<std::string> warnings_;
 };
 
 }  // namespace

@@ -4,15 +4,18 @@
 // (CSharpSyntaxTree.ParseText, Extractor.cs:170): namespaces, type
 // declarations, members (methods/ctors/properties/fields/events/
 // indexers/operators), the full statement set, and expressions incl.
-// lambdas, conditional access and generics. Intentionally out of scope
-// (throws CsParseError; the driver skips the file like the reference's
-// exception path): LINQ query syntax, unsafe blocks, tuples/patterns
-// (C#7+). Interpolated strings are single tokens (cs_lexer.h).
+// lambdas, conditional access and generics, plus C#7/8 patterns
+// (case patterns, switch expressions, tuples, local functions, using
+// declarations). Constructs still out of scope (LINQ query syntax,
+// unsafe blocks) degrade per-member: the member is skipped with a
+// warning instead of failing the file (the reference's Roslyn never
+// hard-fails). Interpolated strings are single tokens (cs_lexer.h).
 #pragma once
 
 #include <stdexcept>
 #include <string>
 #include <string_view>
+#include <vector>
 
 #include "cs_ast.h"
 
@@ -25,6 +28,9 @@ struct CsParseError : std::runtime_error {
 struct CsParseResult {
   CsNode* root = nullptr;          // CompilationUnit
   std::vector<CsComment> comments; // source order, from the lexer
+  // Members skipped by per-member error recovery (unsupported syntax);
+  // the driver reports these on stderr without failing the file.
+  std::vector<std::string> warnings;
 };
 
 CsParseResult CsParse(std::string_view source, CsArena* arena);

@@ -197,3 +197,142 @@ def test_ofile_append_mode(tmp_path, extractor, cs_file):
                        capture_output=True)
     content = out.read_text().splitlines()
     assert len(content) == 2  # append semantics, like the reference
+
+
+# --------------------------------------------------------- C#7/8 syntax
+# The reference parses with Roslyn (Extractor.cs:170), which accepts all
+# modern C#; these pin the from-scratch parser's coverage of the C#7/8
+# constructs real corpora hit: patterns, switch expressions, tuples,
+# local functions, using declarations — plus per-member recovery for
+# anything still unsupported.
+
+MODERN_CS = """
+using System;
+using System.Collections.Generic;
+namespace N
+{
+    public class Modern
+    {
+        public int MatchShape(object o)
+        {
+            switch (o)
+            {
+                case int i when i > 0: return i;
+                case string s: return s.Length;
+                case 42: return 424;
+                case null: return -1;
+                default: return 0;
+            }
+        }
+
+        public string GradeScore(int x) => x switch
+        {
+            < 0 => "invalid",
+            0 => "zero",
+            _ => "positive"
+        };
+
+        public (int, string) SplitPair(string joined)
+        {
+            var idx = joined.Length / 2;
+            return (idx, joined);
+        }
+
+        public int SumViaHelper(int x)
+        {
+            int Helper(int y) { return y + 1; }
+            return Helper(x) + Helper(x * 2);
+        }
+
+        public void FlushBuffer()
+        {
+            using var stream = new System.IO.MemoryStream();
+            stream.Flush();
+        }
+
+        public (int count, string name) NamePair(string joined)
+        {
+            (int half, int rest) = (joined.Length / 2, 1);
+            return (count: half + rest, name: joined);
+        }
+
+        public int DoubleViaLocal(int x)
+        {
+            static int Twice(int y) { return y * 2; }
+            T Id<T>(T v) { return v; }
+            return Id(Twice(x)) + x switch { 0 => 1, _ => 2 };
+        }
+
+        public int FirstVar(object o)
+        {
+            switch (o) { case var x: return 1; }
+        }
+    }
+}
+"""
+
+
+def test_modern_csharp_constructs(extractor, cs_file):
+    lines = extractor(cs_file(MODERN_CS), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["match|shape", "grade|score", "split|pair",
+                     "sum|via|helper", "flush|buffer", "name|pair",
+                     "double|via|local", "first|var"]
+    by_name = dict(zip(names, lines))
+    # pattern variables and constants feed path contexts
+    assert "DeclarationPattern" in by_name["match|shape"]
+    assert "WhenClause" in by_name["match|shape"]
+    assert "SwitchExpression" in by_name["grade|score"]
+    assert "RelationalPattern" in by_name["grade|score"]
+    assert "TupleType" in by_name["split|pair"]
+    assert "LocalFunctionStatement" in by_name["sum|via|helper"]
+    # plain constant labels keep the legacy node (goldens pin this)
+    assert "CaseSwitchLabel" in by_name["match|shape"]
+    # named tuples + deconstruction (Roslyn NameColon/DeclarationExpression)
+    assert "NameColon" in by_name["name|pair"]
+    assert "DeclarationExpression" in by_name["name|pair"]
+    # static + generic local functions; switch expr binds tighter than `+`
+    assert "LocalFunctionStatement" in by_name["double|via|local"]
+    assert "AddExpression^SwitchExpression" not in by_name["double|via|local"]
+    assert "SwitchExpression" in by_name["double|via|local"]
+    # `case var x` is Roslyn's VarPattern, not DeclarationPattern
+    assert "VarPattern" in by_name["first|var"]
+
+
+def test_per_member_recovery_skips_only_the_bad_member(cs_file):
+    # LINQ query syntax is documented out of scope; it must cost one
+    # member, not the file (the reference's Roslyn never hard-fails).
+    code = """
+using System;
+using System.Linq;
+namespace N
+{
+    public class Mixed
+    {
+        public int CountItems(int[] xs)
+        {
+            return xs.Length;
+        }
+
+        public object QueryItems(int[] xs)
+        {
+            var q = from x in xs where x > 0 select x;
+            return q;
+        }
+
+        public int SumItems(int[] xs)
+        {
+            int acc = 0;
+            foreach (int x in xs) { acc += x; }
+            return acc;
+        }
+    }
+}
+"""
+    proc = subprocess.run([BINARY, "--path", cs_file(code), "--no_hash"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    names = [ln.split(" ", 1)[0] for ln in proc.stdout.splitlines()]
+    assert "count|items" in names
+    assert "sum|items" in names
+    assert "warning: skipped unparsable member" in proc.stderr
