@@ -1,5 +1,7 @@
 #include "extract.h"
 
+#include <iostream>
+
 #include <algorithm>
 #include <cctype>
 #include <sstream>
@@ -428,6 +430,26 @@ std::vector<std::string> ExtractFromSource(const std::string& code,
     } catch (const std::exception& e) {
       last_error = e.what();
     }
+  }
+  // Last resort: re-parse the raw source with per-member recovery, so a
+  // file with a few members in newer-than-alpha.4 syntax yields its
+  // parsable methods instead of nothing (strict attempts above keep the
+  // reference's wrap-retry semantics bit-identical).
+  try {
+    Arena arena;
+    std::vector<std::string> warnings;
+    Node* unit = ParseJava(code, &arena, &warnings, /*recover=*/true);
+    std::vector<std::string> lines = ExtractFromUnit(code, unit, options);
+    if (!lines.empty()) {
+      for (const std::string& w : warnings) {
+        std::cerr << "warning: " << w << "\n";
+      }
+      return lines;
+    }
+  } catch (const std::exception&) {
+    // keep last_error from the strict attempts: the wrapped-attempt
+    // message points at the real defect; the recovery parse of raw
+    // (possibly classless) code fails with a less useful one
   }
   throw ParseError(last_error);
 }

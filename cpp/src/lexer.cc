@@ -139,6 +139,21 @@ std::vector<Token> Lex(std::string_view src) {
       push(kind, start, i);
       continue;
     }
+    // text block `\"\"\"...\"\"\"` (Java 15): one string-literal token
+    if (c == '"' && i + 2 < n && src[i + 1] == '"' && src[i + 2] == '"') {
+      size_t start = i;
+      i += 3;
+      while (i + 2 < n && !(src[i] == '"' && src[i + 1] == '"' &&
+                            src[i + 2] == '"')) {
+        if (src[i] == '\\' && i + 1 < n) i += 2;
+        else ++i;
+      }
+      if (i + 2 >= n) throw LexError("unterminated text block at " +
+                                     std::to_string(start));
+      i += 3;
+      push(Tok::kStringLit, start, i);
+      continue;
+    }
     // char / string literals
     if (c == '\'' || c == '"') {
       size_t start = i;

@@ -65,11 +65,27 @@ class Parser {
     }
     while (!AtEof()) {
       if (Accept(";")) continue;
-      Adopt(cu, ParseTypeDeclaration());
+      if (!recover_) {
+        Adopt(cu, ParseTypeDeclaration());
+        continue;
+      }
+      // recovery: an unparsable top-level declaration (e.g. a sealed
+      // interface) costs itself, not the compilation unit
+      size_t save = p_;
+      try {
+        Adopt(cu, ParseTypeDeclaration());
+      } catch (const ParseError& e) {
+        p_ = save;
+        SkipBalancedMember(e.what());
+        if (Is("}")) Next();  // top level: consume the orphan close
+      }
     }
     cu->end = PrevEnd();
     return cu;
   }
+
+  std::vector<std::string> TakeWarnings() { return std::move(warnings_); }
+  void SetRecover(bool on) { recover_ = on; }
 
  private:
   // ------------------------------------------------------------ tokens
@@ -410,8 +426,48 @@ class Parser {
     while (!Accept("}")) {
       if (AtEof()) Fail("unterminated class body");
       if (Accept(";")) continue;
-      Adopt(decl, ParseMember(decl->name));
+      // Per-member recovery: syntax this parser does not cover (newer
+      // Java than the reference's JavaParser 3.0.0-alpha.4 grammar)
+      // skips THAT member — balanced to its `;` or closing `}` —
+      // instead of failing the whole file.
+      if (!recover_) {
+        Adopt(decl, ParseMember(decl->name));
+        continue;
+      }
+      size_t save = p_;
+      try {
+        Adopt(decl, ParseMember(decl->name));
+      } catch (const ParseError& e) {
+        p_ = save;
+        SkipBalancedMember(e.what());
+      }
     }
+  }
+
+  void SkipBalancedMember(const char* why) {
+    // Consume one member's tokens: up to a `;` at depth 0 or through a
+    // complete `{...}` group. Starting on the enclosing `}` means no
+    // progress is possible — rethrow rather than loop forever.
+    if (Is("}")) throw ParseError(why);
+    warnings_.push_back(std::string("skipped unparsable member at offset ")
+                        + std::to_string(Pos()) + ": " + why);
+    int depth = 0;
+    while (!AtEof()) {
+      if (Is("{")) {
+        ++depth;
+      } else if (Is("}")) {
+        if (depth == 0) return;  // enclosing body's close: leave for caller
+        --depth;
+        Next();
+        if (depth == 0) return;  // member body fully consumed
+        continue;
+      } else if (Is(";") && depth == 0) {
+        Next();
+        return;
+      }
+      Next();
+    }
+    Fail("unterminated member while recovering");
   }
 
   Node* ParseEnumDecl(int begin, std::vector<Node*>& annotations) {
@@ -456,7 +512,17 @@ class Parser {
       while (!Is("}")) {
         if (AtEof()) Fail("unterminated enum body");
         if (Accept(";")) continue;
-        Adopt(decl, ParseMember(decl->name));
+        if (!recover_) {
+          Adopt(decl, ParseMember(decl->name));
+          continue;
+        }
+        size_t save = p_;
+        try {
+          Adopt(decl, ParseMember(decl->name));
+        } catch (const ParseError& e) {
+          p_ = save;
+          SkipBalancedMember(e.what());
+        }
       }
     }
     Expect("}");
@@ -699,6 +765,31 @@ class Parser {
     if (IsKw("do")) return ParseDo();
     if (IsKw("for")) return ParseFor();
     if (IsKw("switch")) return ParseSwitch();
+    // `yield expr;` inside a switch expression. `yield` is contextual:
+    // treat it as a statement only when the NEXT token unambiguously
+    // starts a fresh expression (ident/literal/this/super/new/switch/
+    // true/false/null) — those cannot continue a binary expression, so
+    // plain uses of a variable named yield (`yield = 1`, `yield += 1`,
+    // `yield(..)`, `yield: while..`) stay expressions/labels. Unary
+    // forms (`yield -x;`) are deliberately not claimed: ambiguous with
+    // `yield - x`, and vanishingly rare.
+    if (IsIdent() && Cur().text == "yield") {
+      const Token& nx = LookAhead(1);
+      // keywords (this/super/new/switch/true/false/null) are kIdent
+      // tokens in this lexer, so kIdent covers them
+      bool starts_expr =
+          nx.kind == Tok::kIdent || nx.kind == Tok::kIntLit ||
+          nx.kind == Tok::kLongLit || nx.kind == Tok::kFloatLit ||
+          nx.kind == Tok::kDoubleLit || nx.kind == Tok::kCharLit ||
+          nx.kind == Tok::kStringLit;
+      if (starts_expr) {
+        Next();
+        Node* s = Stmt("YieldStmt", begin);
+        Adopt(s, ParseExpression());
+        Expect(";");
+        return Finish(s);
+      }
+    }
     if (IsKw("try")) return ParseTry();
     if (IsKw("return")) {
       Next();
@@ -890,25 +981,77 @@ class Parser {
     Expect("(");
     Adopt(s, ParseExpression());
     Expect(")");
+    ParseSwitchBodyInto(s);
+    return Finish(s);
+  }
+
+  // Case labels are constant expressions (lambdas cannot legally occur
+  // anywhere inside one); parse with `ident ->` lambda detection off so
+  // the Java 14 arrow form `case FOO ->` does not lambda-parse the
+  // label.
+  Node* ParseCaseLabelExpr() {
+    bool saved = in_case_label_;
+    in_case_label_ = true;
+    Node* e = ParseCaseLabelTernary();
+    in_case_label_ = saved;
+    return e;
+  }
+
+  // Mirrors ParseConditional (then = full expression, else = recurse,
+  // right-associative) but bottoms out at ParseOrOr so the LABEL's own
+  // `:`/`->` terminates the expression: `case F ? 1 : 2:` keeps working.
+  Node* ParseCaseLabelTernary() {
+    int begin = Pos();
+    Node* cond = ParseOrOr();
+    if (!Is("?")) return cond;
+    Next();
+    Node* e = New("ConditionalExpr", begin);
+    Adopt(e, cond);
+    Adopt(e, ParseExpression());
+    Expect(":");
+    Adopt(e, ParseCaseLabelTernary());
+    return Finish(e);
+  }
+
+  void ParseSwitchBodyInto(Node* s) {
     Expect("{");
     while (!Accept("}")) {
       if (AtEof()) Fail("unterminated switch");
       int eb = Pos();
       Node* entry = Stmt("SwitchEntryStmt", eb);
+      bool arrow = false;
       if (AcceptKw("case")) {
-        Adopt(entry, ParseExpression());
-        Expect(":");
+        Adopt(entry, ParseCaseLabelExpr());
+        while (Accept(",")) Adopt(entry, ParseCaseLabelExpr());
+        arrow = Accept("->");
+        if (!arrow) Expect(":");
       } else {
         ExpectKw("default");
-        Expect(":");
+        arrow = Accept("->");
+        if (!arrow) Expect(":");
       }
-      while (!IsKw("case") && !IsKw("default") && !Is("}")) {
-        Adopt(entry, ParseStatement());
+      if (arrow) {
+        // Java 14 arrow entry: one block, throw, or expression
+        if (Is("{")) {
+          Adopt(entry, ParseBlock());
+        } else if (IsKw("throw")) {
+          Adopt(entry, ParseStatement());
+        } else {
+          int xb = Pos();
+          Node* es = Stmt("ExpressionStmt", xb);
+          Adopt(es, ParseExpression());
+          Expect(";");
+          Finish(es);
+          Adopt(entry, es);
+        }
+      } else {
+        while (!IsKw("case") && !IsKw("default") && !Is("}")) {
+          Adopt(entry, ParseStatement());
+        }
       }
       Finish(entry);
       Adopt(s, entry);
     }
-    return Finish(s);
   }
 
   Node* ParseTry() {
@@ -1199,6 +1342,13 @@ class Parser {
         Node* e = New("InstanceOfExpr", begin);
         Adopt(e, lhs);
         Adopt(e, ParseType());
+        if (IsIdent()) {
+          // Java 16 pattern binding `o instanceof String s`: the variable
+          // participates in contexts (no analog in the reference's
+          // JavaParser 3.0.0-alpha.4, which predates patterns)
+          int nb = Pos();
+          Adopt(e, MakeNameExpr(nb, ExpectIdent()));
+        }
         Finish(e);
         lhs = e;
         continue;
@@ -1362,6 +1512,18 @@ class Parser {
 
   Node* ParsePrimary() {
     int begin = Pos();
+    if (IsKw("switch")) {
+      // Java 14 switch expression: same body grammar as the statement,
+      // in expression position; arrow entries or colon entries with
+      // `yield`.
+      Next();
+      Node* e = New("SwitchExpr", begin);
+      Expect("(");
+      Adopt(e, ParseExpression());
+      Expect(")");
+      ParseSwitchBodyInto(e);
+      return Finish(e);
+    }
     Node* e = ParsePrimaryPrefix();
     // suffix chains
     while (true) {
@@ -1525,12 +1687,14 @@ class Parser {
       e->text = "this";
       return Finish(e);
     }
-    // lambdas can start a primary (e.g. as a cast operand)
-    if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+    // lambdas can start a primary (e.g. as a cast operand) — but never
+    // inside a case label (constant expression; `case FOO ->` ambiguity)
+    if (!in_case_label_ && IsIdent() && LookAhead(1).kind == Tok::kPunct &&
         LookAhead(1).text == "->") {
       return ParseLambdaFromSingleParam();
     }
-    if (Is("(") && LambdaAhead()) return ParseLambdaFromParenParams();
+    if (!in_case_label_ && Is("(") && LambdaAhead())
+      return ParseLambdaFromParenParams();
     if (IsKw("super")) {
       Next();
       Node* e = New("SuperExpr", begin);
@@ -1664,15 +1828,22 @@ class Parser {
   }
 
   Arena* arena_;
+  bool recover_ = false;
+  bool in_case_label_ = false;
+  std::vector<std::string> warnings_;
   std::vector<Token> toks_;
   size_t p_ = 0;
 };
 
 }  // namespace
 
-Node* ParseJava(std::string_view source, Arena* arena) {
+Node* ParseJava(std::string_view source, Arena* arena,
+                std::vector<std::string>* warnings, bool recover) {
   Parser parser(source, arena);
-  return parser.ParseCompilationUnit();
+  parser.SetRecover(recover);
+  Node* unit = parser.ParseCompilationUnit();
+  if (warnings != nullptr) *warnings = parser.TakeWarnings();
+  return unit;
 }
 
 }  // namespace c2v

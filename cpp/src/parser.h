@@ -10,6 +10,7 @@
 #include <stdexcept>
 #include <string>
 #include <string_view>
+#include <vector>
 
 #include "ast.h"
 
@@ -19,7 +20,14 @@ struct ParseError : std::runtime_error {
   explicit ParseError(const std::string& m) : std::runtime_error(m) {}
 };
 
-// Parses a full compilation unit. Nodes live in `arena`.
-Node* ParseJava(std::string_view source, Arena* arena);
+// Parses a full compilation unit. Nodes live in `arena`. With
+// `recover` set, a member whose syntax is not covered (newer Java than
+// the alpha.4 grammar) is skipped — balanced to its `;`/closing `}` —
+// and reported through `warnings` instead of failing the parse; strict
+// mode (the default) throws, preserving the reference's wrap-retry
+// semantics (FeatureExtractor.java:51-75).
+Node* ParseJava(std::string_view source, Arena* arena,
+                std::vector<std::string>* warnings = nullptr,
+                bool recover = false);
 
 }  // namespace c2v
