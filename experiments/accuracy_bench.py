@@ -32,20 +32,24 @@ if REPO not in sys.path:
 from experiments import javagen  # noqa: E402
 
 
-def build_dataset(root: str, language: str = "java", log=print) -> str:
+def build_dataset(root: str, language: str = "java", scale: int = 1,
+                  log=print) -> str:
     """Generate + extract + preprocess; returns the dataset prefix.
     language="cs" routes through the C# generator (experiments/csgen.py)
     and the native C# extractor (cpp/c2v-extract-cs) — BASELINE config #3.
+    scale multiplies the generated file counts (data-scaling studies).
     """
     from code2vec_tpu.data.preprocess import extract_dir, preprocess
 
     corpus = os.path.join(root, "src")
-    log(f"Generating {language} corpus...")
+    log(f"Generating {language} corpus (scale {scale})...")
+    sizes = dict(train_files=2400 * scale, val_files=260 * scale,
+                 test_files=260 * scale)
     if language == "cs":
         from experiments import csgen
-        dirs = csgen.generate_corpus(corpus, log=log)
+        dirs = csgen.generate_corpus(corpus, log=log, **sizes)
     else:
-        dirs = javagen.generate_corpus(corpus, log=log)
+        dirs = javagen.generate_corpus(corpus, log=log, **sizes)
     raws = {}
     for role in ("train", "val", "test"):
         raws[role] = extract_dir(
@@ -80,7 +84,7 @@ def target_oov_rate(c2v_path: str, target_vocab) -> float:
 
 
 def run(root: str, epochs: int, patience: int, language: str = "java",
-        log=print) -> dict:
+        scale: int = 1, log=print) -> dict:
     import jax
     import numpy as np
     from code2vec_tpu.config import Config
@@ -89,8 +93,19 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
     from code2vec_tpu.training.state import dropout_rng
 
     prefix = os.path.join(root, _prefix_name(language))
+    scale_marker = prefix + ".scale"
     if not os.path.exists(prefix + ".train.c2v"):
-        prefix = build_dataset(root, language=language, log=log)
+        prefix = build_dataset(root, language=language, scale=scale, log=log)
+        with open(scale_marker, "w") as f:
+            f.write(str(scale))
+    else:
+        cached = (int(open(scale_marker).read())
+                  if os.path.exists(scale_marker) else 1)
+        if cached != scale:
+            raise SystemExit(
+                f"cached corpus at {root} was built at scale {cached}, "
+                f"requested scale {scale}: use --fresh or a different "
+                f"--root so artifacts are never mislabeled")
 
     # The ceiling is language-independent: csgen translates javagen's
     # family output surface-syntactically, never changing which family,
@@ -317,18 +332,22 @@ def write_report(results: dict, path: str) -> None:
         "`python experiments/accuracy_bench.py --fresh` (deterministic seed).",
         "",
     ]
-    # keep an existing C# section (written by --language cs) intact
-    cs_section = ""
+    # keep hand-curated / other-run sections intact: the data-scaling
+    # summary and the C# section survive a scale-1 Java rewrite
+    kept = ""
     if os.path.exists(path):
         with open(path) as f:
             existing = f.read()
-        if _CS_MARKER in existing:
-            cs_section = "\n" + existing[existing.index(_CS_MARKER):]
+        for marker in (_SCALE_MARKER, _CS_MARKER):
+            if marker in existing:
+                kept = "\n" + existing[existing.index(marker):]
+                break
     with open(path, "w") as f:
-        f.write("\n".join(lines) + cs_section)
+        f.write("\n".join(lines) + kept)
 
 
 _CS_MARKER = "## C# end-to-end (BASELINE config #3)"
+_SCALE_MARKER = "## Data scaling: approaching the ceiling"
 
 
 def append_cs_section(results: dict, path: str) -> None:
@@ -388,6 +407,10 @@ def main(argv=None):
     p.add_argument("--patience", type=int, default=3,
                    help="early stop after this many epochs without val-F1 "
                         "improvement (0 disables); reference README.md:87-88")
+    p.add_argument("--scale", type=int, default=1,
+                   help="multiply generated corpus size (data-scaling runs; "
+                        "results go to accuracy_scale<N>.json, the main "
+                        "report is left alone)")
     p.add_argument("--fresh", action="store_true",
                    help="regenerate the corpus from scratch")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
@@ -396,7 +419,8 @@ def main(argv=None):
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     if args.root is None:
-        args.root = f"/tmp/{_prefix_name(args.language)}_bench"
+        suffix = f"_scale{args.scale}" if args.scale != 1 else ""
+        args.root = f"/tmp/{_prefix_name(args.language)}_bench{suffix}"
 
     if args.fresh and os.path.exists(args.root):
         import shutil
@@ -404,14 +428,20 @@ def main(argv=None):
     os.makedirs(args.root, exist_ok=True)
 
     results = run(args.root, args.epochs, args.patience,
-                  language=args.language)
+                  language=args.language, scale=args.scale)
+    results["scale"] = args.scale
     os.makedirs(os.path.join(REPO, "experiments", "results"), exist_ok=True)
     name = "accuracy_cs.json" if args.language == "cs" else "accuracy.json"
+    if args.scale != 1:
+        lang = "_cs" if args.language == "cs" else ""
+        name = f"accuracy{lang}_scale{args.scale}.json"
     out_json = os.path.join(REPO, "experiments", "results", name)
     with open(out_json, "w") as f:
         json.dump(results, f, indent=2)
     report = os.path.join(REPO, "BENCH_ACCURACY.md")
-    if args.language == "cs":
+    if args.scale != 1:
+        pass  # scaling runs: json artifact only; summarized by hand
+    elif args.language == "cs":
         append_cs_section(results, report)
     else:
         write_report(results, report)
