@@ -28,6 +28,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -511,15 +512,56 @@ class TrainStepBuilder:
                        out_shardings=out_sh)
 
 
+@functools.lru_cache(maxsize=8)
+def _fused_unpack(widths: tuple):
+    """Jitted on-device unpack of the single packed transfer buffer.
+    Column spans follow `_batch_arrays` order — the same single source
+    of truth the sharded path uses — so a field add/reorder cannot
+    desync this path alone. Positions 3/4/5 are mask/labels/valid and
+    get their model dtypes back (the pack stores everything as int32;
+    mask is exact 0/1, so the roundtrip is lossless)."""
+    def unpack(rec):
+        outs = []
+        off = 0
+        for w in widths:
+            outs.append(rec[:, off:off + w])
+            off += w
+        src, pth, tgt, mask, labels, valid = outs
+        return (src, pth, tgt, mask.astype(jnp.float32),
+                labels[:, 0], valid[:, 0].astype(bool))
+    return jax.jit(unpack)
+
+
+def _fused_put_batch(batch):
+    """Single-transfer host->device path for the unsharded case: pack all
+    six batch arrays into ONE int32 buffer, move it once, slice on
+    device. Host->device launches are expensive (PCIe command overhead;
+    two orders of magnitude worse over a tunneled dev chip — see
+    BENCH_ROOFLINE.md feed notes), and the step consumes six arrays: one
+    launch instead of six makes real-data training device-bound again.
+    The mask travels as int bits inside the buffer, so this path needs
+    no vocab/pad knowledge."""
+    arrays = _batch_arrays(batch)
+    b = arrays[0].shape[0]
+    cols = [np.asarray(a).reshape(b, -1) for a in arrays]
+    widths = tuple(c.shape[1] for c in cols)
+    rec = np.empty((b, sum(widths)), np.int32)
+    off = 0
+    for c, w in zip(cols, widths):
+        rec[:, off:off + w] = c
+        off += w
+    return _fused_unpack(widths)(jnp.asarray(rec))
+
+
 def device_put_batch(batch, mesh: Optional[Mesh]):
     """Transfer a RowBatch's model arrays to device with their shardings.
     On a multi-host runtime each process contributes its local rows and
     the result is a global sharded array (parallel/distributed.py)."""
-    arrays = _batch_arrays(batch)
     if mesh is None:
-        return tuple(jnp.asarray(a) for a in arrays)
+        return _fused_put_batch(batch)
     if jax.process_count() > 1:
         from code2vec_tpu.parallel import distributed
         return distributed.global_batch_arrays(batch, mesh)
+    arrays = _batch_arrays(batch)
     shardings = tuple(NamedSharding(mesh, s) for s in _batch_spec_tuple())
     return tuple(jax.device_put(a, s) for a, s in zip(arrays, shardings))

@@ -338,10 +338,11 @@ def write_report(results: dict, path: str) -> None:
     if os.path.exists(path):
         with open(path) as f:
             existing = f.read()
-        for marker in (_SCALE_MARKER, _CS_MARKER):
-            if marker in existing:
-                kept = "\n" + existing[existing.index(marker):]
-                break
+        starts = [existing.index(m) for m in (_SCALE_MARKER, _CS_MARKER)
+                  if m in existing]
+        if starts:
+            # slice from the EARLIEST marker so no kept section is lost
+            kept = "\n" + existing[min(starts):]
     with open(path, "w") as f:
         f.write("\n".join(lines) + kept)
 
