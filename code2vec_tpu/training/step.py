@@ -513,13 +513,15 @@ class TrainStepBuilder:
 
 
 @functools.lru_cache(maxsize=8)
-def _fused_unpack(widths: tuple):
+def _fused_unpack(widths: tuple, mesh: Optional[Mesh]):
     """Jitted on-device unpack of the single packed transfer buffer.
     Column spans follow `_batch_arrays` order — the same single source
     of truth the sharded path uses — so a field add/reorder cannot
     desync this path alone. Positions 3/4/5 are mask/labels/valid and
     get their model dtypes back (the pack stores everything as int32;
-    mask is exact 0/1, so the roundtrip is lossless)."""
+    mask is exact 0/1, so the roundtrip is lossless). With a mesh, the
+    buffer arrives batch-sharded and the outputs leave in their model
+    shardings (the ctx-axis reshard happens on device)."""
     def unpack(rec):
         outs = []
         off = 0
@@ -529,12 +531,16 @@ def _fused_unpack(widths: tuple):
         src, pth, tgt, mask, labels, valid = outs
         return (src, pth, tgt, mask.astype(jnp.float32),
                 labels[:, 0], valid[:, 0].astype(bool))
-    return jax.jit(unpack)
+    if mesh is None:
+        return jax.jit(unpack)
+    in_sh = NamedSharding(mesh, P(mesh_lib.AXIS_DATA, None))
+    out_sh = tuple(NamedSharding(mesh, s) for s in _batch_spec_tuple())
+    return jax.jit(unpack, in_shardings=(in_sh,), out_shardings=out_sh)
 
 
-def _fused_put_batch(batch):
-    """Single-transfer host->device path for the unsharded case: pack all
-    six batch arrays into ONE int32 buffer, move it once, slice on
+def _fused_put_batch(batch, mesh: Optional[Mesh] = None):
+    """Single-transfer host->device path for single-process runs: pack
+    all six batch arrays into ONE int32 buffer, move it once, slice on
     device. Host->device launches are expensive (PCIe command overhead;
     two orders of magnitude worse over a tunneled dev chip — see
     BENCH_ROOFLINE.md feed notes), and the step consumes six arrays: one
@@ -550,18 +556,18 @@ def _fused_put_batch(batch):
     for c, w in zip(cols, widths):
         rec[:, off:off + w] = c
         off += w
-    return _fused_unpack(widths)(jnp.asarray(rec))
+    if mesh is None:
+        return _fused_unpack(widths, None)(jnp.asarray(rec))
+    rec_dev = jax.device_put(
+        rec, NamedSharding(mesh, P(mesh_lib.AXIS_DATA, None)))
+    return _fused_unpack(widths, mesh)(rec_dev)
 
 
 def device_put_batch(batch, mesh: Optional[Mesh]):
     """Transfer a RowBatch's model arrays to device with their shardings.
     On a multi-host runtime each process contributes its local rows and
     the result is a global sharded array (parallel/distributed.py)."""
-    if mesh is None:
-        return _fused_put_batch(batch)
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and mesh is not None:
         from code2vec_tpu.parallel import distributed
         return distributed.global_batch_arrays(batch, mesh)
-    arrays = _batch_arrays(batch)
-    shardings = tuple(NamedSharding(mesh, s) for s in _batch_spec_tuple())
-    return tuple(jax.device_put(a, s) for a, s in zip(arrays, shardings))
+    return _fused_put_batch(batch, mesh)
