@@ -538,15 +538,11 @@ def _fused_unpack(widths: tuple, mesh: Optional[Mesh]):
     return jax.jit(unpack, in_shardings=(in_sh,), out_shardings=out_sh)
 
 
-def _fused_put_batch(batch, mesh: Optional[Mesh] = None):
-    """Single-transfer host->device path for single-process runs: pack
-    all six batch arrays into ONE int32 buffer, move it once, slice on
-    device. Host->device launches are expensive (PCIe command overhead;
-    two orders of magnitude worse over a tunneled dev chip — see
-    BENCH_ROOFLINE.md feed notes), and the step consumes six arrays: one
-    launch instead of six makes real-data training device-bound again.
-    The mask travels as int bits inside the buffer, so this path needs
-    no vocab/pad knowledge."""
+def pack_batch_host(batch) -> Tuple["np.ndarray", tuple]:
+    """Host half of the fused feed: pack all six batch arrays into ONE
+    int32 buffer (pure numpy — safe to run on a prefetch worker thread).
+    Column spans follow _batch_arrays order. The mask travels as int
+    bits, so this needs no vocab/pad knowledge."""
     arrays = _batch_arrays(batch)
     b = arrays[0].shape[0]
     cols = [np.asarray(a).reshape(b, -1) for a in arrays]
@@ -556,6 +552,15 @@ def _fused_put_batch(batch, mesh: Optional[Mesh] = None):
     for c, w in zip(cols, widths):
         rec[:, off:off + w] = c
         off += w
+    return rec, widths
+
+
+def _fused_transfer(rec, widths: tuple, mesh: Optional[Mesh]):
+    """Device half of the fused feed: ONE transfer + jitted on-device
+    unpack. Host->device launches are expensive (PCIe command overhead;
+    two orders of magnitude worse over a tunneled dev chip — see
+    BENCH_ROOFLINE.md feed notes); one launch instead of six keeps
+    real-data training device-bound."""
     if mesh is None:
         return _fused_unpack(widths, None)(jnp.asarray(rec))
     rec_dev = jax.device_put(
@@ -563,11 +568,33 @@ def _fused_put_batch(batch, mesh: Optional[Mesh] = None):
     return _fused_unpack(widths, mesh)(rec_dev)
 
 
-def device_put_batch(batch, mesh: Optional[Mesh]):
+def _fused_path_applies(mesh: Optional[Mesh]) -> bool:
+    """The fused single-buffer transfer is used when every device holds
+    a batch-row slice anyway: no mesh, or a data-only mesh. With tp/cp >
+    1 the P(data, None) buffer would be REPLICATED across the model/ctx
+    axes (tp*cp times the bytes of the old per-array sharded puts), so
+    those meshes keep the per-array path."""
+    if mesh is None:
+        return True  # local arrays — correct on any process count
+    if jax.process_count() > 1:
+        return False  # global batch assembly (distributed.py) owns this
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return (shape.get(mesh_lib.AXIS_MODEL, 1) == 1
+            and shape.get(mesh_lib.AXIS_CTX, 1) == 1)
+
+
+def device_put_batch(batch, mesh: Optional[Mesh], packed=None):
     """Transfer a RowBatch's model arrays to device with their shardings.
-    On a multi-host runtime each process contributes its local rows and
-    the result is a global sharded array (parallel/distributed.py)."""
+    `packed` optionally carries a pre-built pack_batch_host result (the
+    prefetcher packs on its worker thread). On a multi-host runtime each
+    process contributes its local rows and the result is a global
+    sharded array (parallel/distributed.py)."""
+    if _fused_path_applies(mesh):
+        rec, widths = packed if packed is not None else pack_batch_host(batch)
+        return _fused_transfer(rec, widths, mesh)
     if jax.process_count() > 1 and mesh is not None:
         from code2vec_tpu.parallel import distributed
         return distributed.global_batch_arrays(batch, mesh)
-    return _fused_put_batch(batch, mesh)
+    arrays = _batch_arrays(batch)
+    shardings = tuple(NamedSharding(mesh, s) for s in _batch_spec_tuple())
+    return tuple(jax.device_put(a, s) for a, s in zip(arrays, shardings))

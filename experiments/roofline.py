@@ -305,18 +305,21 @@ def _write_report(r: dict) -> None:
         "XLA's native gather at ~26M — XLA's emission is already the",
         "better program for this access pattern.",
         "",
-        "## Host feed path (real-data training)",
-        "",
-        "The six per-batch host->device transfers were fused into ONE",
-        "packed int32 buffer unpacked on device (training/step.py",
-        "_fused_put_batch): six transfer launches -> one. Real-data",
-        "training on the tunneled dev chip went from ~5.3K to ~7.2K",
-        "examples/sec; the remaining gap to the synthetic-batch number",
-        "is the tunnel's per-transfer latency floor (~40-80 ms for 4 MB",
-        "— a development-environment artifact; on a real TPU host PCIe",
-        "moves this batch in well under a millisecond and the fused",
-        "path's win is the five saved launches per step).",
-        "",
+        '## Host feed path (real-data training)',
+        '',
+        'The six per-batch host->device transfers were fused into ONE',
+        'packed int32 buffer unpacked on device (training/step.py',
+        'pack_batch_host + _fused_transfer): six transfer launches -> one,',
+        'with the numpy pack running on the prefetch worker thread and all',
+        'runtime interaction kept on the consumer thread (a second thread',
+        'issuing transfers measurably serializes against step dispatches).',
+        'Real-data training on the tunneled dev chip improved from ~5K to',
+        '~7-15K examples/sec — the wide range is the tunnel itself, whose',
+        'per-transfer latency swings from ~3 ms to ~500 ms between runs (a',
+        'development-environment artifact; on a real TPU host PCIe moves',
+        "this batch in well under a millisecond and the fused path's win is",
+        'the five saved launches per step).',
+        '',
         "Raw numbers: run `python experiments/roofline.py` (writes this",
         "file).",
         "",

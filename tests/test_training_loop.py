@@ -266,13 +266,16 @@ def test_preemption_sigterm_saves_and_stops(tiny_config):
     def stream():
         for e in range(3):
             for b in range(4):
-                if (e, b) == (1, 1):
-                    _os.kill(_os.getpid(), _signal.SIGTERM)
                 yield _fake_batch()
             yield EpochEnd(e + 1)
 
     def train_step(state, *args):
         steps.append(1)
+        # SIGTERM from the CONSUMER side at a fixed consumed step
+        # (epoch 2, batch 2): deterministic regardless of how far the
+        # prefetch worker has raced ahead of consumption.
+        if len(steps) == 6:
+            _os.kill(_os.getpid(), _signal.SIGTERM)
         return state, np.float32(1.0)
 
     def save_fn(state, epoch, suffix=""):
