@@ -568,7 +568,7 @@ def _fused_transfer(rec, widths: tuple, mesh: Optional[Mesh]):
     return _fused_unpack(widths, mesh)(rec_dev)
 
 
-def _fused_path_applies(mesh: Optional[Mesh]) -> bool:
+def fused_path_applies(mesh: Optional[Mesh]) -> bool:
     """The fused single-buffer transfer is used when every device holds
     a batch-row slice anyway: no mesh, or a data-only mesh. With tp/cp >
     1 the P(data, None) buffer would be REPLICATED across the model/ctx
@@ -589,9 +589,13 @@ def device_put_batch(batch, mesh: Optional[Mesh], packed=None):
     prefetcher packs on its worker thread). On a multi-host runtime each
     process contributes its local rows and the result is a global
     sharded array (parallel/distributed.py)."""
-    if _fused_path_applies(mesh):
-        rec, widths = packed if packed is not None else pack_batch_host(batch)
+    if packed is not None:
+        # the producer already decided the fused path applies and packed
+        # the buffer — trust it; no second (potentially divergent) check
+        rec, widths = packed
         return _fused_transfer(rec, widths, mesh)
+    if fused_path_applies(mesh):
+        return _fused_transfer(*pack_batch_host(batch), mesh)
     if jax.process_count() > 1 and mesh is not None:
         from code2vec_tpu.parallel import distributed
         return distributed.global_batch_arrays(batch, mesh)

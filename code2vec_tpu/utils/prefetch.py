@@ -11,7 +11,7 @@ from typing import Iterable, Iterator, Optional
 
 from code2vec_tpu.data.reader import EpochEnd
 from code2vec_tpu.training.step import (
-    _fused_path_applies, device_put_batch, pack_batch_host,
+    device_put_batch, fused_path_applies, pack_batch_host,
 )
 
 
@@ -58,7 +58,7 @@ class DevicePrefetcher:
 
     def _worker(self):
         try:
-            pack = _fused_path_applies(self.mesh)
+            pack = fused_path_applies(self.mesh)
             for batch in self.batches:
                 if isinstance(batch, EpochEnd):
                     item = batch

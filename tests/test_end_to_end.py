@@ -144,3 +144,44 @@ def test_release_roundtrip(tmp_path):
     loaded = Code2VecModel(load_config)
     results = loaded.evaluate()
     assert results is not None
+
+
+def test_repl_pipeline_on_input_java(tmp_path):
+    """The interactive REPL's loop body, non-interactively: native
+    extractor bridge over the shipped Input.java -> model.predict ->
+    parse_prediction_results (predictions + attention display rows).
+    reference flow: interactive_predict.py:39-72."""
+    import os
+    from code2vec_tpu.serving.extractor_bridge import PathExtractor
+    from code2vec_tpu.serving.interactive import parse_prediction_results
+
+    prefix = _make_synthetic_dataset(tmp_path)
+    config = Config(
+        train_data_path_prefix=prefix,
+        max_contexts=8, train_batch_size=16, test_batch_size=16,
+        num_train_epochs=1, compute_dtype="float32",
+        num_batches_to_log_progress=1000, shuffle_buffer_size=64,
+        save_every_epochs=1000)
+    model = Code2VecModel(config)
+    model.train()
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    extractor = PathExtractor(config, max_path_length=8, max_path_width=2)
+    lines, hash_to_string = extractor.extract_paths(
+        os.path.join(repo_root, "Input.java"))
+    assert lines, "no methods extracted from Input.java"
+
+    raw = model.predict(lines)
+    oov = model.vocabs.target_vocab.special_words.oov
+    methods = parse_prediction_results(raw, hash_to_string, oov, topk=5)
+    assert len(methods) == len(lines)
+    m = methods[0]
+    # Input.java's method is `f` (reference fixture shape)
+    assert m.original_name
+    assert m.predictions, "no top-k predictions surfaced"
+    assert all(0.0 <= p["probability"] <= 1.0 for p in m.predictions)
+    # attention rows must display READABLE paths (hash inverted)
+    assert m.attention_paths
+    for att in m.attention_paths:
+        assert att["path"].startswith("("), att  # node-string form
+        assert 0.0 <= att["score"] <= 1.0
