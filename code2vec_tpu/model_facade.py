@@ -87,6 +87,10 @@ class Code2VecModel:
                      f"(epoch {self.initial_epoch})")
         self._eval_step = None
         self._predict_step = None
+        # per-variable shape/param dump (reference: tensorflow_model.py:59-63)
+        for name, p in sorted(self.state.params.items()):
+            self.log(f"variable name: {name} -- shape: "
+                     f"{tuple(p.shape)} -- #params: {p.size:,}")
         self.log(f"Model created: {num_params(self.state):,} parameters "
                  f"(mesh dp={config.dp} tp={config.tp} cp={config.cp})")
 

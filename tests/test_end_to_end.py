@@ -152,8 +152,16 @@ def test_repl_pipeline_on_input_java(tmp_path):
     parse_prediction_results (predictions + attention display rows).
     reference flow: interactive_predict.py:39-72."""
     import os
+    import subprocess
     from code2vec_tpu.serving.extractor_bridge import PathExtractor
     from code2vec_tpu.serving.interactive import parse_prediction_results
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(repo_root, "cpp", "build", "c2v-extract")
+    if not os.path.exists(binary):
+        rc = subprocess.run(["make", "-C", os.path.join(repo_root, "cpp")],
+                            capture_output=True, text=True)
+        assert rc.returncode == 0, rc.stderr
 
     prefix = _make_synthetic_dataset(tmp_path)
     config = Config(
@@ -165,7 +173,6 @@ def test_repl_pipeline_on_input_java(tmp_path):
     model = Code2VecModel(config)
     model.train()
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     extractor = PathExtractor(config, max_path_length=8, max_path_width=2)
     lines, hash_to_string = extractor.extract_paths(
         os.path.join(repo_root, "Input.java"))
@@ -176,8 +183,8 @@ def test_repl_pipeline_on_input_java(tmp_path):
     methods = parse_prediction_results(raw, hash_to_string, oov, topk=5)
     assert len(methods) == len(lines)
     m = methods[0]
-    # Input.java's method is `f` (reference fixture shape)
-    assert m.original_name
+    # the shipped Input.java defines `sumValues` (subtokens sum|values)
+    assert m.original_name == "sum|values"
     assert m.predictions, "no top-k predictions surfaced"
     assert all(0.0 <= p["probability"] <= 1.0 for p in m.predictions)
     # attention rows must display READABLE paths (hash inverted)
