@@ -307,3 +307,20 @@ def test_preemption_disabled_by_config(tiny_config):
     saves, _ = _run_trainer(tiny_config, _marker_stream(2, 1))
     assert _signal.getsignal(_signal.SIGTERM) is prev
     assert saves == [1]
+
+
+def test_profiler_hook_writes_trace(tiny_config, tmp_path):
+    """--profile_dir captures a jax.profiler trace between batches 10 and
+    20 (§5 tracing; loop.py profiler hook)."""
+    tiny_config.num_train_epochs = 1
+    profile_dir = str(tmp_path / "trace")
+
+    def train_step(state, *args):
+        return state, np.float32(1.0)
+
+    trainer = Trainer(tiny_config, train_step, profile_dir=profile_dir)
+    trainer.train(_State(), _marker_stream(25, 1), rng=np.zeros((2,), np.uint32))
+
+    import glob as _glob
+    written = _glob.glob(profile_dir + "/**", recursive=True)
+    assert any(os.path.isfile(p) for p in written), written
