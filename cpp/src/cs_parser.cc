@@ -189,6 +189,7 @@ class Parser {
 
   // Type grammar: (predefined | qualified name | tuple) rank-specifiers? `?`
   CsNode* ParseType() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     CsNode* t;
     if (Is("(")) {
@@ -1000,7 +1001,24 @@ class Parser {
     return Finish(w);
   }
 
+  // Recursion-depth guard (see the Java parser's rationale): clean
+  // CsParseError instead of a stack-overflow SIGSEGV on adversarially
+  // nested input; per-member recovery then salvages the rest of the
+  // file.
+  static constexpr int kMaxParseDepth = 800;
+  struct DepthGuard {
+    Parser* p;
+    explicit DepthGuard(Parser* parser) : p(parser) {
+      if (++p->depth_ > kMaxParseDepth) {
+        --p->depth_;
+        p->Fail("nesting too deep");
+      }
+    }
+    ~DepthGuard() { --p->depth_; }
+  };
+
   CsNode* ParseStatement() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     if (Is("{")) return ParseBlock();
     if (Accept(";")) return Finish(New("EmptyStatement", begin));
@@ -1587,6 +1605,7 @@ class Parser {
   }
 
   CsNode* ParseUnary() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     if (Is("-")) { Next(); return UnaryOf(begin, "UnaryMinusExpression"); }
     if (Is("+")) { Next(); return UnaryOf(begin, "UnaryPlusExpression"); }
@@ -1767,6 +1786,7 @@ class Parser {
   }
 
   CsNode* ParseInitializerExpression(const char* kind) {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     Expect("{");
     CsNode* init = New(kind, begin);
@@ -2094,6 +2114,7 @@ class Parser {
   CsArena* arena_;
   CsLexOutput lexed_;
   size_t p_ = 0;
+  int depth_ = 0;
   std::vector<std::string> warnings_;
 };
 

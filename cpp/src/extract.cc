@@ -407,6 +407,58 @@ std::vector<std::string> ExtractFromUnit(const std::string& src, Node* unit,
 
 }  // namespace
 
+// Iterative (explicit-stack) AST depth check: binary-operator chains
+// build deep left-leaning trees without ever recursing in the parser,
+// and the recursive extraction traversal would overflow the C stack on
+// them. Bounded here with a clean error instead.
+static constexpr int kMaxAstDepth = 800;
+
+static void CheckAstDepth(const Node* root) {
+  std::vector<std::pair<const Node*, int>> stack{{root, 1}};
+  while (!stack.empty()) {
+    auto [node, depth] = stack.back();
+    stack.pop_back();
+    if (depth > kMaxAstDepth) throw ParseError("AST too deep to extract");
+    for (const Node* c : node->children) stack.push_back({c, depth + 1});
+  }
+}
+
+// Recovery-path variant: drop only the METHODS whose subtrees are too
+// deep (machine-generated expression chains), keeping the file's other
+// methods extractable; then require the remaining tree to be shallow.
+static void PruneDeepMethods(Node* root, std::vector<std::string>* warnings) {
+  std::vector<Node*> stack{root};
+  while (!stack.empty()) {
+    Node* node = stack.back();
+    stack.pop_back();
+    auto& kids = node->children;
+    for (size_t i = 0; i < kids.size();) {
+      Node* child = kids[i];
+      if (child->type == "MethodDeclaration") {
+        int max_depth = 0;
+        std::vector<std::pair<const Node*, int>> s{{child, 1}};
+        while (!s.empty()) {
+          auto [n, d] = s.back();
+          s.pop_back();
+          if (d > max_depth) max_depth = d;
+          if (max_depth > kMaxAstDepth) break;
+          for (const Node* c : n->children) s.push_back({c, d + 1});
+        }
+        if (max_depth > kMaxAstDepth) {
+          warnings->push_back(
+              "skipped method with too-deep AST at offset "
+              + std::to_string(child->begin));
+          kids.erase(kids.begin() + i);
+          continue;
+        }
+      }
+      stack.push_back(child);
+      ++i;
+    }
+  }
+  CheckAstDepth(root);
+}
+
 std::vector<std::string> ExtractFromSource(const std::string& code,
                                            const ExtractOptions& options) {
   // FeatureExtractor.java:51-75 wrap-retries.
@@ -426,6 +478,7 @@ std::vector<std::string> ExtractFromSource(const std::string& code,
     try {
       Arena arena;
       Node* unit = ParseJava(attempts[a], &arena);
+      CheckAstDepth(unit);
       return ExtractFromUnit(attempts[a], unit, options);
     } catch (const std::exception& e) {
       last_error = e.what();
@@ -439,6 +492,7 @@ std::vector<std::string> ExtractFromSource(const std::string& code,
     Arena arena;
     std::vector<std::string> warnings;
     Node* unit = ParseJava(code, &arena, &warnings, /*recover=*/true);
+    PruneDeepMethods(unit, &warnings);
     std::vector<std::string> lines = ExtractFromUnit(code, unit, options);
     if (!lines.empty()) {
       for (const std::string& w : warnings) {

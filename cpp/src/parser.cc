@@ -213,6 +213,7 @@ class Parser {
   }
 
   Node* ParseAnnotation() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     Expect("@");
     Node* name = ParseQualifiedName();
@@ -261,6 +262,7 @@ class Parser {
   // have dims; reference types (and any array) get the alpha.4
   // ReferenceType wrapper.
   Node* ParseType() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     Node* base;
     if (Cur().kind == Tok::kIdent && IsPrimitiveName(Cur().text)) {
@@ -722,6 +724,7 @@ class Parser {
   }
 
   Node* ParseArrayInitializer() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     Expect("{");
     Node* init = New("ArrayInitializerExpr", begin);
@@ -756,7 +759,25 @@ class Parser {
     return s;
   }
 
+  // Recursion-depth guard: recursive descent on adversarially nested
+  // input (tens of thousands of parens/blocks) overflows the C stack
+  // and SIGSEGVs the extractor; a clean ParseError instead lets the
+  // wrap-retry / per-member recovery machinery handle the file. 800
+  // levels is far beyond real code and far from the ~8 MB stack limit.
+  static constexpr int kMaxParseDepth = 800;
+  struct DepthGuard {
+    Parser* p;
+    explicit DepthGuard(Parser* parser) : p(parser) {
+      if (++p->depth_ > kMaxParseDepth) {
+        --p->depth_;
+        p->Fail("nesting too deep");
+      }
+    }
+    ~DepthGuard() { --p->depth_; }
+  };
+
   Node* ParseStatement() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     if (Is("{")) return ParseBlock();
     if (Accept(";")) return Finish(Stmt("EmptyStmt", begin));
@@ -1408,6 +1429,7 @@ class Parser {
   }
 
   Node* ParseUnary() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     if (Is("+")) {
       Next();
@@ -1828,6 +1850,7 @@ class Parser {
   }
 
   Arena* arena_;
+  int depth_ = 0;
   bool recover_ = false;
   bool in_case_label_ = false;
   std::vector<std::string> warnings_;

@@ -336,3 +336,25 @@ namespace N
     assert "count|items" in names
     assert "sum|items" in names
     assert "warning: skipped unparsable member" in proc.stderr
+
+
+def test_adversarial_nesting_fails_cleanly(cs_file):
+    """Pathological nesting -> clean error or per-member skip, never a
+    SIGSEGV (parser DepthGuard + iterative CsCheckAstDepth)."""
+    cases = {
+        "deep_parens": ("class C { int Keep(int x){return x;} int M() "
+                        "{ return " + "(" * 20000 + "1" + ")" * 20000
+                        + "; } }"),
+        "long_chain": ("class C { int M() { int y = " + "1+" * 100000
+                       + "1; return y; } }"),
+        "deep_ifs": ("class C { void M() { " + "if (true) {" * 10000
+                     + "}" * 10000 + " } }"),
+    }
+    for name, src in cases.items():
+        proc = subprocess.run([BINARY, "--path", cs_file(src, f"{name}.cs")],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode >= 0, f"{name}: died on signal {-proc.returncode}"
+    proc = subprocess.run(
+        [BINARY, "--path", cs_file(cases["deep_parens"], "again.cs")],
+        capture_output=True, text=True, timeout=60)
+    assert "keep" in proc.stdout

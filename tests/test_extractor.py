@@ -280,3 +280,43 @@ public class Mixed {
     names = [ln.split(" ", 1)[0] for ln in proc.stdout.splitlines()]
     assert names == ["keep", "keep|too"]
     assert "warning: skipped unparsable member" in proc.stderr
+
+
+def test_adversarial_nesting_fails_cleanly(tmp_path):
+    """Pathological nesting must produce a clean error (or per-member
+    skip), never a stack-overflow SIGSEGV: a crashed worker loses its
+    whole extraction batch, a clean failure loses one file or member
+    (parser DepthGuard + iterative CheckAstDepth)."""
+    import subprocess as sp
+    cases = {
+        "deep_parens": ("public class C { int keep(int x){return x;} "
+                        "int m() { return " + "(" * 20000 + "1"
+                        + ")" * 20000 + "; } }"),
+        "deep_blocks": ("public class C { void m() { " + "{" * 20000
+                        + "}" * 20000 + " } }"),
+        "long_chain": ("public class C { int m() { int y = "
+                       + "1+" * 100000 + "1; return y; } }"),
+    }
+    for name, src in cases.items():
+        p = tmp_path / f"{name}.java"
+        p.write_text(src)
+        proc = sp.run([BINARY, "--max_path_length", "8",
+                       "--max_path_width", "2", "--file", str(p)],
+                      capture_output=True, text=True, timeout=60)
+        assert proc.returncode >= 0, f"{name}: died on signal {-proc.returncode}"
+    # the recoverable cases salvage the good methods: a too-deep member
+    # costs itself, not the file
+    proc = sp.run([BINARY, "--max_path_length", "8", "--max_path_width", "2",
+                   "--file", str(tmp_path / "deep_parens.java")],
+                  capture_output=True, text=True, timeout=60)
+    assert "keep" in proc.stdout
+    mixed = ("public class C { int keep(int x){return x;} int m() { int y = "
+             + "1+" * 100000 + "1; return y; } int keepToo(int z){return z;} }")
+    p = tmp_path / "mixed.java"
+    p.write_text(mixed)
+    proc = sp.run([BINARY, "--max_path_length", "8", "--max_path_width", "2",
+                   "--file", str(p)], capture_output=True, text=True,
+                  timeout=60)
+    names = [ln.split(" ", 1)[0] for ln in proc.stdout.splitlines()]
+    assert names == ["keep", "keep|too"], names
+    assert "too-deep AST" in proc.stderr
