@@ -297,61 +297,38 @@ struct Variable {
 
 }  // namespace
 
-// Iterative AST depth check — see extract.cc CheckAstDepth rationale.
+// AST depth cap — see extract.cc TruncateDeepSubtrees rationale.
 static constexpr int kMaxAstDepth = 800;
 
-static void CsCheckAstDepth(const CsNode* root) {
-  std::vector<std::pair<const CsNode*, int>> stack{{root, 1}};
+// Truncate ANY subtree at the depth cap (with a warning) instead of
+// failing the file — see extract.cc TruncateDeepSubtrees.
+static void CsTruncateDeepSubtrees(CsNode* root,
+                                   std::vector<std::string>* warnings) {
+  int pruned = 0;
+  std::vector<std::pair<CsNode*, int>> stack{{root, 1}};
   while (!stack.empty()) {
     auto [node, depth] = stack.back();
     stack.pop_back();
-    if (depth > kMaxAstDepth) throw CsParseError("AST too deep to extract");
-    for (const CsNode* c : node->children) stack.push_back({c, depth + 1});
-  }
-}
-
-// Drop only the METHODS whose subtrees are too deep, keeping the rest
-// of the file extractable; then require the remaining tree be shallow
-// (see extract.cc PruneDeepMethods).
-static void CsPruneDeepMethods(CsNode* root,
-                               std::vector<std::string>* warnings) {
-  std::vector<CsNode*> stack{root};
-  while (!stack.empty()) {
-    CsNode* node = stack.back();
-    stack.pop_back();
-    auto& kids = node->children;
-    for (size_t i = 0; i < kids.size();) {
-      CsNode* child = kids[i];
-      if (child->kind == "MethodDeclaration") {
-        int max_depth = 0;
-        std::vector<std::pair<const CsNode*, int>> s{{child, 1}};
-        while (!s.empty()) {
-          auto [n, d] = s.back();
-          s.pop_back();
-          if (d > max_depth) max_depth = d;
-          if (max_depth > kMaxAstDepth) break;
-          for (const CsNode* c : n->children) s.push_back({c, d + 1});
-        }
-        if (max_depth > kMaxAstDepth) {
-          warnings->push_back(
-              "skipped method with too-deep AST at offset "
-              + std::to_string(child->begin));
-          kids.erase(kids.begin() + i);
-          continue;
-        }
+    if (depth >= kMaxAstDepth) {
+      if (!node->children.empty()) {
+        node->children.clear();
+        ++pruned;
       }
-      stack.push_back(child);
-      ++i;
+      continue;
     }
+    for (CsNode* c : node->children) stack.push_back({c, depth + 1});
   }
-  CsCheckAstDepth(root);
+  if (pruned > 0) {
+    warnings->push_back("truncated " + std::to_string(pruned)
+                        + " too-deep AST subtree(s)");
+  }
 }
 
 std::vector<std::string> CsExtractFromSource(const std::string& code,
                                              const CsExtractOptions& options) {
   CsArena arena;
   CsParseResult parsed = CsParse(code, &arena);
-  CsPruneDeepMethods(parsed.root, &parsed.warnings);
+  CsTruncateDeepSubtrees(parsed.root, &parsed.warnings);
   for (const std::string& w : parsed.warnings) {
     std::cerr << "warning: " << w << "\n";
   }

@@ -449,6 +449,7 @@ class Parser {
 
   // type declarations and members share modifier/attribute prefixes
   CsNode* ParseTypeOrMember(bool top_level) {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     std::vector<CsNode*> attrs = ParseAttributeLists();
     SkipModifiers();

@@ -423,40 +423,31 @@ static void CheckAstDepth(const Node* root) {
   }
 }
 
-// Recovery-path variant: drop only the METHODS whose subtrees are too
-// deep (machine-generated expression chains), keeping the file's other
-// methods extractable; then require the remaining tree to be shallow.
-static void PruneDeepMethods(Node* root, std::vector<std::string>* warnings) {
-  std::vector<Node*> stack{root};
+// Recovery-path variant: truncate ANY subtree at the depth cap (with a
+// warning) instead of failing the file — fully general across member
+// kinds (methods, field initializers, nested types) and measured from
+// the root, so the truncated tree always passes CheckAstDepth. Paths
+// through the clipped region vanish; everything else extracts.
+static void TruncateDeepSubtrees(Node* root,
+                                 std::vector<std::string>* warnings) {
+  int pruned = 0;
+  std::vector<std::pair<Node*, int>> stack{{root, 1}};
   while (!stack.empty()) {
-    Node* node = stack.back();
+    auto [node, depth] = stack.back();
     stack.pop_back();
-    auto& kids = node->children;
-    for (size_t i = 0; i < kids.size();) {
-      Node* child = kids[i];
-      if (child->type == "MethodDeclaration") {
-        int max_depth = 0;
-        std::vector<std::pair<const Node*, int>> s{{child, 1}};
-        while (!s.empty()) {
-          auto [n, d] = s.back();
-          s.pop_back();
-          if (d > max_depth) max_depth = d;
-          if (max_depth > kMaxAstDepth) break;
-          for (const Node* c : n->children) s.push_back({c, d + 1});
-        }
-        if (max_depth > kMaxAstDepth) {
-          warnings->push_back(
-              "skipped method with too-deep AST at offset "
-              + std::to_string(child->begin));
-          kids.erase(kids.begin() + i);
-          continue;
-        }
+    if (depth >= kMaxAstDepth) {
+      if (!node->children.empty()) {
+        node->children.clear();
+        ++pruned;
       }
-      stack.push_back(child);
-      ++i;
+      continue;
     }
+    for (Node* c : node->children) stack.push_back({c, depth + 1});
   }
-  CheckAstDepth(root);
+  if (pruned > 0) {
+    warnings->push_back("truncated " + std::to_string(pruned)
+                        + " too-deep AST subtree(s)");
+  }
 }
 
 std::vector<std::string> ExtractFromSource(const std::string& code,
@@ -492,7 +483,7 @@ std::vector<std::string> ExtractFromSource(const std::string& code,
     Arena arena;
     std::vector<std::string> warnings;
     Node* unit = ParseJava(code, &arena, &warnings, /*recover=*/true);
-    PruneDeepMethods(unit, &warnings);
+    TruncateDeepSubtrees(unit, &warnings);
     std::vector<std::string> lines = ExtractFromUnit(code, unit, options);
     if (!lines.empty()) {
       for (const std::string& w : warnings) {

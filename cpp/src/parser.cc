@@ -575,6 +575,7 @@ class Parser {
 
   // One class member (method/ctor/field/initializer/inner type).
   Node* ParseMember(const std::string& enclosing_name) {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     std::vector<Node*> annotations = ParseModifiers();
     if (IsKw("class") || IsKw("interface"))
@@ -1246,6 +1247,7 @@ class Parser {
   }
 
   Node* ParseLambdaFromSingleParam() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     Node* lam = New("LambdaExpr", begin);
     int pb = Pos();
@@ -1259,6 +1261,7 @@ class Parser {
   }
 
   Node* ParseLambdaFromParenParams() {
+    DepthGuard depth_guard(this);
     int begin = Pos();
     Node* lam = New("LambdaExpr", begin);
     Expect("(");

@@ -349,6 +349,10 @@ def test_adversarial_nesting_fails_cleanly(cs_file):
                        + "1; return y; } }"),
         "deep_ifs": ("class C { void M() { " + "if (true) {" * 10000
                      + "}" * 10000 + " } }"),
+        "nested_classes": ("class A {" + " class B {" * 50000
+                           + "}" * 50000 + " }"),
+        "ctor_chain": ("class C { C() { int y = " + "1+" * 100000
+                       + "1; } int Keep(){return 1;} }"),
     }
     for name, src in cases.items():
         proc = subprocess.run([BINARY, "--path", cs_file(src, f"{name}.cs")],

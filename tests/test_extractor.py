@@ -296,6 +296,12 @@ def test_adversarial_nesting_fails_cleanly(tmp_path):
                         + "}" * 20000 + " } }"),
         "long_chain": ("public class C { int m() { int y = "
                        + "1+" * 100000 + "1; return y; } }"),
+        "deep_lambda": ("public class C { Object f = " + "x -> " * 50000
+                        + "null; }"),
+        "nested_classes": ("public class A {" + " class B {" * 50000
+                           + "}" * 50000 + " }"),
+        "field_chain": ("public class C { int x = " + "1+" * 100000
+                        + "1; int keep(){return 1;} }"),
     }
     for name, src in cases.items():
         p = tmp_path / f"{name}.java"
@@ -318,5 +324,12 @@ def test_adversarial_nesting_fails_cleanly(tmp_path):
                    "--file", str(p)], capture_output=True, text=True,
                   timeout=60)
     names = [ln.split(" ", 1)[0] for ln in proc.stdout.splitlines()]
-    assert names == ["keep", "keep|too"], names
-    assert "too-deep AST" in proc.stderr
+    # the deep method's SHALLOW part still extracts (subtree truncated at
+    # the depth cap), and the good methods are untouched
+    assert names == ["keep", "m", "keep|too"], names
+    assert "truncated" in proc.stderr
+    # a deep FIELD initializer must not cost the file's methods either
+    proc = sp.run([BINARY, "--max_path_length", "8", "--max_path_width", "2",
+                   "--file", str(tmp_path / "field_chain.java")],
+                  capture_output=True, text=True, timeout=60)
+    assert "keep" in proc.stdout

@@ -1,0 +1,69 @@
+"""Bounded mutation-fuzz smoke test for both native extractors.
+
+The full campaign (thousands of mutated inputs, byte flips + span
+deletes/duplications + quote/comment injection) runs offline; this
+seeded, bounded version keeps the no-crash property pinned in CI:
+whatever bytes arrive, the extractor must exit cleanly (rc >= 0, no
+signal) within the timeout — a crashed worker loses its whole
+extraction batch, a clean failure loses one file.
+"""
+
+import random
+import subprocess
+
+import pytest
+
+from tests.test_extractor import BINARY as JAVA_BIN
+from tests.test_cs_extractor import BINARY as CS_BIN
+
+SEEDS_JAVA = [
+    'public class A { int f(int n) { return n > 0 ? f(n-1) : 0; } }',
+    'public class B { String s = "esc\\"\\n tail"; int[] a = {1, 2}; }',
+    ('public class C<T extends Comparable<? super T>> '
+     '{ java.util.Map<String, java.util.List<int[]>> m; '
+     'void f() { l: for (;;) break l; } }'),
+]
+SEEDS_CS = [
+    'class A { string S = $"interp {1+1} tail"; int F() => 2; }',
+    ('class B<T> where T : struct { event System.EventHandler E; '
+     'public static implicit operator int(B<T> b) => 0; }'),
+    'class D { string V = @"verbatim ""q"" here"; int this[int i] => i; }',
+]
+
+
+def _mutate(s: str, rng: random.Random) -> bytes:
+    b = bytearray(s.encode())
+    for _ in range(rng.randint(1, 40)):
+        if not b:
+            break
+        op = rng.randrange(4)
+        i = rng.randrange(len(b))
+        if op == 0:
+            b[i] = rng.randrange(256)
+        elif op == 1:
+            del b[i:i + rng.randint(1, 40)]
+        elif op == 2:
+            b[i:i] = bytes(rng.choices(
+                b'(){}[]<>;,."\'\\@#$%&*-=+?:', k=rng.randint(1, 20)))
+        else:
+            j = rng.randrange(len(b))
+            b[i:i] = b[j:j + rng.randint(1, 60)]
+    return bytes(b)
+
+
+@pytest.mark.parametrize("language", ["java", "cs"])
+def test_mutated_inputs_never_crash(language, tmp_path):
+    rng = random.Random(1234 if language == "java" else 5678)
+    seeds = SEEDS_JAVA if language == "java" else SEEDS_CS
+    path = tmp_path / f"fuzz.{language if language == 'cs' else 'java'}"
+    for it in range(40):
+        path.write_bytes(_mutate(rng.choice(seeds), rng))
+        if language == "java":
+            args = [JAVA_BIN, "--max_path_length", "8",
+                    "--max_path_width", "2", "--file", str(path)]
+        else:
+            args = [CS_BIN, "--path", str(path)]
+        proc = subprocess.run(args, capture_output=True, timeout=30)
+        assert proc.returncode >= 0, (
+            f"iter {it}: extractor died on signal {-proc.returncode}; "
+            f"input saved at {path}")
