@@ -86,18 +86,27 @@ def test_train_eval_save_load_predict(tmp_path, use_packed):
     assert int(header[0]) == model.vocabs.token_vocab.size
     assert int(header[1]) == config.token_embeddings_size
 
-    # load into a fresh model and check eval matches
+    # load into a fresh model and check eval matches; also exercise the
+    # code-vector export (reference: tensorflow_model.py:138-139 writes
+    # <test>.vectors, one space-separated vector per evaluated example)
     load_config = Config(
         model_load_path=save_path,
         test_data_path=prefix + ".val.c2v",
         max_contexts=8, test_batch_size=16,
         compute_dtype="float32",
         use_packed_data=use_packed,
+        export_code_vectors=True,
         verbose_mode=0,
     )
     loaded = Code2VecModel(load_config)
     results2 = loaded.evaluate()
     np.testing.assert_allclose(results2.topk_acc, results.topk_acc, atol=1e-6)
+    vectors_path = load_config.test_data_path + ".vectors"
+    assert os.path.exists(vectors_path)
+    rows = open(vectors_path).read().splitlines()
+    assert len(rows) == load_config.num_test_examples
+    assert all(len(r.split()) == 3 * load_config.token_embeddings_size
+               for r in rows)
 
     # predict on a raw line (no filtering)
     line = "unknownname tok0,path0,tok0 tok1,path1,tok1" + " " * 6
