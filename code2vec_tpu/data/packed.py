@@ -152,6 +152,7 @@ class PackedDataset:
         # Host shard: disjoint strided row subset.
         self.row_ids = np.arange(shard_index, n, num_shards)
         self._target_strings: Optional[List[str]] = None
+        self._filtered_cache: dict = {}
 
     def __len__(self) -> int:
         return len(self.row_ids)
@@ -195,7 +196,13 @@ class PackedDataset:
         )
 
     def _filtered_row_ids(self, estimator_action: EstimatorAction) -> np.ndarray:
-        """Apply the reference row filter once, vectorized over the memmap."""
+        """Apply the reference row filter once, vectorized over the memmap.
+        Cached per action: the result is immutable for a given file, and
+        both `steps_per_epoch` and `iter_batches` need it (mid-epoch eval
+        calls both every firing — one O(rows) scan, not two)."""
+        cached = self._filtered_cache.get(estimator_action)
+        if cached is not None:
+            return cached
         m = self.max_contexts
         token_pad = self.vocabs.token_vocab.pad_index
         path_pad = self.vocabs.path_vocab.pad_index
@@ -211,7 +218,10 @@ class PackedDataset:
             if estimator_action.is_train:
                 any_valid &= rec[:, 0] > self.vocabs.target_vocab.oov_index
             keep_chunks.append(rows[any_valid])
-        return np.concatenate(keep_chunks) if keep_chunks else np.empty((0,), np.int64)
+        out = (np.concatenate(keep_chunks) if keep_chunks
+               else np.empty((0,), np.int64))
+        self._filtered_cache[estimator_action] = out
+        return out
 
     def steps_per_epoch(self, batch_size: int,
                         estimator_action: EstimatorAction) -> int:

@@ -212,6 +212,24 @@ def _select_rows(batch: RowBatch, idx: np.ndarray) -> RowBatch:
                        for f in dataclasses.fields(RowBatch)})
 
 
+def invalid_batch(batch_size: int, max_contexts: int) -> RowBatch:
+    """A batch of nothing: every row invalid, every context masked.
+
+    Multi-host eval pads short hosts' streams with these so all hosts
+    run the same number of collective eval steps
+    (parallel/distributed.py lockstep_eval_stream); index 0 is the pad
+    row in every vocab, matching `_pad_rows`' fill."""
+    return RowBatch(
+        source_token_indices=np.zeros((batch_size, max_contexts), np.int32),
+        path_indices=np.zeros((batch_size, max_contexts), np.int32),
+        target_token_indices=np.zeros((batch_size, max_contexts), np.int32),
+        context_valid_mask=np.zeros((batch_size, max_contexts), np.float32),
+        target_index=np.zeros((batch_size,), np.int32),
+        example_valid=np.zeros((batch_size,), bool),
+        target_strings=[""] * batch_size,
+    )
+
+
 def _pad_rows(batch: RowBatch, batch_size: int) -> RowBatch:
     """Pad with invalid rows up to `batch_size` (static shapes under jit)."""
     n = batch.target_index.shape[0]

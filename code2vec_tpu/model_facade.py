@@ -121,14 +121,23 @@ class Code2VecModel:
         return n
 
     def _packed_dataset(self, c2v_path: str) -> PackedDataset:
+        # Memoized: mid-epoch eval opens the test set every firing, and a
+        # fresh PackedDataset would redo the O(rows) filter scan each time.
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = self._packed_cache = {}
+        if c2v_path in cached:
+            return cached[c2v_path]
         packed_path = c2v_path + "b"
         if not os.path.exists(packed_path):
             self.log(f"Packing {c2v_path} -> {packed_path} (one-time)")
             pack_c2v(c2v_path, self.vocabs, self.config.max_contexts,
                      out_path=packed_path)
         shard_index, num_shards = distributed.host_shard()
-        return PackedDataset(packed_path, self.vocabs,
-                             shard_index=shard_index, num_shards=num_shards)
+        ds = PackedDataset(packed_path, self.vocabs,
+                           shard_index=shard_index, num_shards=num_shards)
+        cached[c2v_path] = ds
+        return ds
 
     def _train_batches(self) -> Iterable:
         """Training batch stream with EpochEnd markers at data-pass
@@ -151,13 +160,32 @@ class Code2VecModel:
                      f"to train. Raise --epochs to continue.")
         if config.use_packed_data:
             ds = self._packed_dataset(config.train_data_path)
-            self._steps_per_epoch = ds.steps_per_epoch(
-                batch_size, EstimatorAction.Train)
-            return ds.iter_batches(batch_size,
-                                   EstimatorAction.Train,
-                                   num_epochs=epochs_to_run,
-                                   seed=config.seed,
-                                   yield_epoch_markers=True)
+            local_steps = ds.steps_per_epoch(batch_size, EstimatorAction.Train)
+            batches = ds.iter_batches(batch_size,
+                                      EstimatorAction.Train,
+                                      num_epochs=epochs_to_run,
+                                      seed=config.seed,
+                                      yield_epoch_markers=True)
+            if jax.process_count() > 1:
+                # Lockstep contract: hosts filter their shards
+                # independently, so post-filter batch counts can differ;
+                # every collective in the loop assumes they don't. Agree
+                # the min up front and truncate each host's epochs to it.
+                agreed = distributed.agree_scalar(local_steps, "min")
+                if agreed == 0:
+                    raise RuntimeError(
+                        f"a host's data shard yields zero post-filter "
+                        f"batches (local: {local_steps}); the pod-agreed "
+                        f"step count would be 0 and training would no-op. "
+                        f"Use fewer hosts or a larger dataset.")
+                if agreed != local_steps:
+                    self.log(f"Host feeds {agreed}/{local_steps} local "
+                             f"batches per epoch (pod-agreed minimum)")
+                self._steps_per_epoch = agreed
+                return distributed.lockstep_train_stream(batches, agreed)
+            self._steps_per_epoch = local_steps
+            return batches
+        self._require_single_process("training from raw .c2v text")
         shard_index, num_shards = distributed.host_shard()
         return PathContextReader(self.vocabs, config, EstimatorAction.Train,
                                  shard_index=shard_index,
@@ -166,14 +194,38 @@ class Code2VecModel:
                                  num_epochs=epochs_to_run,
                                  yield_epoch_markers=True)
 
+    def _require_single_process(self, what: str) -> None:
+        """Multi-host training/eval requires packed data: the streaming
+        text reader cannot know its post-filter batch count before the
+        first pass, so the pod-wide lockstep agreement (see
+        `_train_batches`) has nothing to agree on. Packed data is the
+        designed pod path anyway — raw-text parsing in Python would be
+        feed-bound at pod scale."""
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                f"{what} is not supported with multiple processes; "
+                f"pack the dataset first (use_packed_data=True).")
+
     def _eval_batches(self) -> Iterable:
         config = self.config
         batch_size = distributed.local_batch_size(config.test_batch_size)
         if config.use_packed_data:
             ds = self._packed_dataset(config.test_data_path)
-            return ds.iter_batches(batch_size,
-                                   EstimatorAction.Evaluate,
-                                   with_target_strings=True)
+            batches = ds.iter_batches(batch_size,
+                                      EstimatorAction.Evaluate,
+                                      with_target_strings=True)
+            if jax.process_count() > 1:
+                # Lockstep contract (max + pad): every host must drive the
+                # same number of collective eval steps; no real row may be
+                # dropped, so short hosts pad with invalid batches.
+                local = ds.steps_per_epoch(batch_size, EstimatorAction.Evaluate)
+                agreed = distributed.agree_scalar(local, "max")
+                from code2vec_tpu.data.reader import invalid_batch
+                return distributed.lockstep_eval_stream(
+                    batches, agreed,
+                    lambda: invalid_batch(batch_size, config.max_contexts))
+            return batches
+        self._require_single_process("evaluating from raw .c2v text")
         shard_index, num_shards = distributed.host_shard()
         return PathContextReader(self.vocabs, config, EstimatorAction.Evaluate,
                                  shard_index=shard_index,

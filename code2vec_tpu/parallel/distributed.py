@@ -87,6 +87,26 @@ def local_batch_size(global_batch_size: int) -> int:
     return global_batch_size // n
 
 
+def gather_host_array(values) -> "np.ndarray":
+    """All-gather a small 1-D host-side float64 array EXACTLY; returns
+    (num_processes, n) float64, row p = process p's values.
+
+    The gather moves the float64 values as their raw bytes (uint8 view)
+    because `process_allgather` routes through device arrays, which
+    silently downcast float64 -> float32 when jax_enable_x64 is off (the
+    default) — integer-valued counters above 2**24 would lose exactness
+    and large-corpus eval metrics would drift. Bytes are dtype-exact.
+    Single-process: the values as a single row (no collective).
+    """
+    import numpy as np
+    values = np.ascontiguousarray(np.asarray(values, dtype=np.float64))
+    if jax.process_count() == 1:
+        return values[None, :]
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(values.view(np.uint8))
+    return np.ascontiguousarray(np.asarray(gathered)).view(np.float64)
+
+
 def allreduce_host_scalars(values) -> "np.ndarray":
     """Sum a small 1-D host-side float array across all processes.
 
@@ -97,12 +117,87 @@ def allreduce_host_scalars(values) -> "np.ndarray":
     would report. Single-process: identity (no collective compiled).
     """
     import numpy as np
-    values = np.asarray(values, dtype=np.float64)
-    if jax.process_count() == 1:
-        return values
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(values)
-    return np.sum(np.asarray(gathered), axis=0)
+    return np.sum(gather_host_array(values), axis=0)
+
+
+def agree_scalar(value: int, reduce: str = "min") -> int:
+    """Collectively agree on one host-side integer: every process calls
+    with its local value and all receive the same min/max. The train
+    loop agrees its post-filter steps-per-epoch (min: every host can
+    feed that many batches) and the eval loop its batch count (max:
+    short hosts pad with invalid batches) — the collective step loops
+    then run an identical number of iterations on every host, which is
+    the lockstep precondition of every construct that keys on a batch
+    counter (preemption OR-reduce, mid-epoch eval cadence, per-batch
+    eval collectives). Single-process: identity."""
+    import numpy as np
+    gathered = gather_host_array(np.array([float(value)]))[:, 0]
+    return int(gathered.min() if reduce == "min" else gathered.max())
+
+
+def assert_host_agreement(value: int, what: str) -> None:
+    """Collective sanity check: every process must hold the same value.
+    Raises on any host whose view diverges (with all per-host values),
+    turning a would-be collective deadlock into a loud error."""
+    import numpy as np
+    gathered = gather_host_array(np.array([float(value)]))[:, 0]
+    if not np.all(gathered == gathered[0]):
+        raise RuntimeError(
+            f"multi-host desync: {what} differs across processes "
+            f"(per-host values: {[int(v) for v in gathered]}); "
+            f"this would deadlock the pod's collectives.")
+
+
+def lockstep_train_stream(batches, steps_per_epoch: int):
+    """Truncate a marker-bearing train stream to exactly
+    `steps_per_epoch` batches per epoch.
+
+    Each host filters its own strided row shard independently, so raw
+    post-filter batch counts can differ across hosts (a host whose shard
+    holds more OOV-target rows yields fewer batches) — and every batch
+    drives a collective step, so divergent counts deadlock the pod.
+    Callers pass the `agree_scalar(local_steps, "min")` count; batches
+    past it are dropped (the per-epoch reshuffle rotates which rows they
+    are, so no row is starved systematically). NO collective runs in
+    here: this generator is consumed by the DevicePrefetcher's worker
+    thread, and a collective off the main thread would race the step
+    loop's own collectives (preemption OR-reduce, mid-epoch eval) with
+    host-dependent ordering — the Trainer asserts epoch agreement on the
+    consumer side instead (training/loop.py EpochEnd branch)."""
+    from code2vec_tpu.data.reader import EpochEnd
+    count = 0
+    for item in batches:
+        if isinstance(item, EpochEnd):
+            if count < steps_per_epoch:
+                raise RuntimeError(
+                    f"epoch {item.epoch} produced only {count} local "
+                    f"batches but {steps_per_epoch} were collectively "
+                    f"agreed; the dataset shrank under the trainer.")
+            yield item
+            count = 0
+        elif count < steps_per_epoch:
+            count += 1
+            yield item
+        # else: surplus local batch — other hosts are already done with
+        # this epoch; consuming it without yielding keeps the pod in step.
+
+
+def lockstep_eval_stream(batches, num_batches: int, make_pad_batch):
+    """Extend a host's eval stream to exactly `num_batches` batches by
+    appending fully-invalid batches (every row masked out).
+
+    Eval batch counts are agreed with `agree_scalar(local, "max")` so no
+    real row is dropped; hosts with fewer local batches keep feeding the
+    per-step collectives with rows that contribute nothing (the eval
+    step's label mask excludes them from the loss, `example_valid`
+    excludes them from every host-side metric)."""
+    count = 0
+    for batch in batches:
+        count += 1
+        yield batch
+    while count < num_batches:
+        count += 1
+        yield make_pad_batch()
 
 
 def global_batch_arrays(batch, mesh: Mesh):

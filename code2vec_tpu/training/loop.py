@@ -197,6 +197,15 @@ class Trainer:
         try:
             for item in prefetcher:
                 if isinstance(item, EpochEnd):
+                    if jax.process_count() > 1:
+                        # Lockstep sanity check, on the consumer thread so
+                        # it cannot race the step loop's collectives: all
+                        # hosts must be crossing the SAME epoch boundary
+                        # after the SAME number of batches.
+                        from code2vec_tpu.parallel import distributed
+                        distributed.assert_host_agreement(
+                            item.epoch * 1_000_000 + batch_in_epoch,
+                            "epoch boundary (epoch, batches-in-epoch)")
                     epoch = self.initial_epoch + item.epoch
                     if steps_per_epoch is None:
                         steps_per_epoch = batch_in_epoch

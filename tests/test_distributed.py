@@ -50,3 +50,64 @@ def test_global_batch_arrays_matches_device_put():
     for a, b in zip(via_helper, via_put):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert a.sharding == b.sharding
+
+
+def test_gather_host_array_exact_above_2pow24():
+    # Values above 2**24 are not f32-representable; the byte-exact gather
+    # must keep them exact even with jax_enable_x64 off.
+    big = np.array([2.0**24 + 1, 2.0**53 - 1, 3.5])
+    out = distributed.gather_host_array(big)
+    assert out.shape == (1, 3)
+    np.testing.assert_array_equal(out[0], big)
+    np.testing.assert_array_equal(distributed.allreduce_host_scalars(big), big)
+
+
+def test_agree_scalar_and_assert_single_process():
+    assert distributed.agree_scalar(17, "min") == 17
+    assert distributed.agree_scalar(17, "max") == 17
+    distributed.assert_host_agreement(42, "anything")  # never raises solo
+
+
+def test_lockstep_train_stream_truncates_each_epoch():
+    from code2vec_tpu.data.reader import EpochEnd
+
+    def stream():
+        for epoch in (1, 2):
+            for i in range(7 if epoch == 1 else 6):
+                yield ("batch", epoch, i)
+            yield EpochEnd(epoch)
+
+    items = list(distributed.lockstep_train_stream(stream(), 5))
+    batches = [x for x in items if not isinstance(x, EpochEnd)]
+    markers = [x for x in items if isinstance(x, EpochEnd)]
+    assert len(batches) == 10 and [m.epoch for m in markers] == [1, 2]
+    # truncation keeps the FIRST agreed-many batches of each epoch
+    assert batches[:5] == [("batch", 1, i) for i in range(5)]
+    assert batches[5:] == [("batch", 2, i) for i in range(5)]
+
+
+def test_lockstep_train_stream_short_epoch_raises():
+    from code2vec_tpu.data.reader import EpochEnd
+
+    def stream():
+        yield "b0"
+        yield EpochEnd(1)
+
+    with pytest.raises(RuntimeError, match="only 1 local batches"):
+        list(distributed.lockstep_train_stream(stream(), 3))
+
+
+def test_lockstep_eval_stream_pads_with_invalid_batches():
+    from code2vec_tpu.data.reader import invalid_batch
+    real = [_batch(4, 3), _batch(4, 3)]
+    out = list(distributed.lockstep_eval_stream(
+        iter(real), 5, lambda: invalid_batch(4, 3)))
+    assert len(out) == 5
+    assert out[0] is real[0] and out[1] is real[1]
+    for pad in out[2:]:
+        assert not pad.example_valid.any()
+        assert pad.context_valid_mask.sum() == 0
+        assert pad.target_strings == [""] * 4
+    # already-long-enough stream is passed through untouched
+    assert list(distributed.lockstep_eval_stream(
+        iter(real), 2, lambda: 1 / 0)) == real

@@ -70,6 +70,26 @@ def _vocabs():
         max_target_vocab_size=20)
 
 
+def _uneven_rows(dims, n):
+    """N eval rows for the uneven-shard lockstep phase: hosts will split
+    these 10/8, giving host 0 three local batches and host 1 two — the
+    exact post-filter divergence VERDICT flagged as a pod deadlock."""
+    rng = np.random.default_rng(23)
+    src = rng.integers(0, dims.token_vocab_size, (n, M)).astype(np.int32)
+    pth = rng.integers(0, dims.path_vocab_size, (n, M)).astype(np.int32)
+    tgt = rng.integers(0, dims.token_vocab_size, (n, M)).astype(np.int32)
+    mask = (rng.random((n, M)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0
+    labels = rng.integers(2, dims.real_target_vocab_size, (n,)).astype(np.int32)
+    pool = ["w0", "w1", "w2|w3", "w4", "nosuchname", "w5", "w6|w0", "w7",
+            "w8", "w1|w9"]
+    names = [pool[i % len(pool)] for i in range(n)]
+    return RowBatch(
+        source_token_indices=src, path_indices=pth, target_token_indices=tgt,
+        context_valid_mask=mask, target_index=labels,
+        example_valid=np.ones((n,), bool), target_strings=names)
+
+
 def test_two_process_distributed(tmp_path):
     dims, batch = _full_batch()
 
@@ -91,6 +111,18 @@ def test_two_process_distributed(tmp_path):
                           log_path=str(tmp_path / "log_single.txt"))
     expected_eval = evaluator.evaluate(state.params, [batch])
 
+    # ---- parent: single-process expected metrics over the UNEVEN rows
+    # (18 rows; children split 10/8 -> 3 vs 2 local batches). Row-wise
+    # metrics are grouping-invariant, so the parent batches them 8+8+2pad.
+    from code2vec_tpu.data.reader import _pad_rows, _select_rows
+    uneven = _uneven_rows(dims, 18)
+    ev2 = Evaluator(config, _vocabs(), eval_step, mesh=None,
+                    log_path=str(tmp_path / "log_single_uneven.txt"))
+    uneven_batches = [
+        _pad_rows(_select_rows(uneven, np.arange(s, min(s + B, 18))), B)
+        for s in range(0, 18, B)]
+    expected_uneven = ev2.evaluate(state.params, uneven_batches)
+
     # last: the train step donates its state buffers
     train_step = builder.make_train_step(state)
     _, expected_train_loss = train_step(state, *arrays, jax.random.PRNGKey(0))
@@ -102,7 +134,16 @@ def test_two_process_distributed(tmp_path):
              mask=batch.context_valid_mask, labels=batch.target_index,
              valid=batch.example_valid, names=np.array(batch.target_strings),
              expected_loss_sum=expected_loss_sum,
-             expected_train_loss=expected_train_loss)
+             expected_train_loss=expected_train_loss,
+             u_src=uneven.source_token_indices, u_pth=uneven.path_indices,
+             u_tgt=uneven.target_token_indices,
+             u_mask=uneven.context_valid_mask, u_labels=uneven.target_index,
+             u_names=np.array(uneven.target_strings),
+             u_topk=np.array(expected_uneven.topk_acc),
+             u_precision=expected_uneven.subtoken_precision,
+             u_recall=expected_uneven.subtoken_recall,
+             u_f1=expected_uneven.subtoken_f1,
+             u_loss=expected_uneven.loss)
 
     # ---- children: 2 processes, one distributed runtime
     port = _free_port()
