@@ -20,7 +20,7 @@ import numpy as np
 
 from code2vec_tpu.evaluation.metrics import (
     ModelEvaluationResults, SubtokensEvaluationMetric, TargetWordTables,
-    TopKAccuracyEvaluationMetric, first_match_rank,
+    TopKAccuracyEvaluationMetric, batch_prediction_info,
 )
 from code2vec_tpu.training.step import device_put_batch
 
@@ -50,7 +50,16 @@ class Evaluator:
             [np.asarray(blocks[k]) for k in sorted(blocks)], axis=0)
 
     def evaluate(self, params, batches: Iterable,
-                 code_vectors_path: Optional[str] = None) -> ModelEvaluationResults:
+                 code_vectors_path: Optional[str] = None,
+                 prefetch: bool = True) -> ModelEvaluationResults:
+        """Pipelined evaluation: a worker thread parses/packs batches
+        (DevicePrefetcher, same division of labor as the trainer), and
+        the host-side metric update for batch N runs while the device
+        executes batch N+1 — the first host fetch of N's outputs then
+        mostly finds them already computed. `prefetch=False` keeps the
+        strictly serial order (parse -> transfer -> step -> metrics per
+        batch); both paths produce identical results (pinned by
+        tests), the pipelined one just overlaps host and device work."""
         config = self.config
         topk_metric = TopKAccuracyEvaluationMetric(
             config.top_k_words_considered_during_prediction, self.tables)
@@ -68,38 +77,60 @@ class Evaluator:
 
         vectors_file = open(code_vectors_path, "w") if code_vectors_path else None
         log_file = open(self.log_path, "w") if self.log_path else None
+
+        def consume(batch, out):
+            """Host-side bookkeeping for one completed step's outputs."""
+            nonlocal loss_sum, loss_rows, total_predictions, total_batches
+            topk_indices = self._host_rows(out.topk_indices)
+            valid = np.asarray(batch.example_valid)
+            names = batch.target_strings
+            if names is None:
+                # Fall back to vocab words (train-filtered data only has
+                # in-vocab targets, so this is lossless there).
+                names = [self.vocabs.target_vocab.lookup_word(int(i))
+                         for i in batch.target_index]
+            names = [n for n, v in zip(names, valid) if v]
+            rows = topk_indices[valid]
+            # one vectorized pass shared by both metrics and the log
+            info = batch_prediction_info(self.tables, names, rows)
+            topk_metric.update_batch_from_indices(names, rows, info=info)
+            subtoken_metric.update_batch_from_indices(names, rows, info=info)
+            loss_sum += float(out.loss_sum)
+            loss_rows += int(np.sum(
+                valid & (np.asarray(batch.target_index) > oov_floor)))
+            total_predictions += len(names)
+            total_batches += 1
+            if log_file is not None:
+                self._log_predictions(log_file, names, info)
+            if vectors_file is not None:
+                code_vectors = self._host_rows(out.code_vectors)[valid]
+                for vec in code_vectors:
+                    vectors_file.write(" ".join(map(str, vec)) + "\n")
+            if total_batches % config.num_batches_to_log_progress == 0:
+                elapsed = time.time() - start_time
+                config.log(f"Evaluated {total_predictions} examples... "
+                           f"({total_predictions / max(elapsed, 1e-9):.0f} "
+                           f"samples/sec)")
+
         try:
-            for batch in batches:
-                arrays = device_put_batch(batch, self.mesh)
-                out = self.eval_step(params, *arrays)
-                topk_indices = self._host_rows(out.topk_indices)
-                valid = np.asarray(batch.example_valid)
-                names = batch.target_strings
-                if names is None:
-                    # Fall back to vocab words (train-filtered data only has
-                    # in-vocab targets, so this is lossless there).
-                    names = [self.vocabs.target_vocab.lookup_word(int(i))
-                             for i in batch.target_index]
-                names = [n for n, v in zip(names, valid) if v]
-                rows = topk_indices[valid]
-                topk_metric.update_batch_from_indices(names, rows)
-                subtoken_metric.update_batch_from_indices(names, rows)
-                loss_sum += float(out.loss_sum)
-                loss_rows += int(np.sum(
-                    valid & (np.asarray(batch.target_index) > oov_floor)))
-                total_predictions += len(names)
-                total_batches += 1
-                if log_file is not None:
-                    self._log_predictions(log_file, names, rows)
-                if vectors_file is not None:
-                    code_vectors = self._host_rows(out.code_vectors)[valid]
-                    for vec in code_vectors:
-                        vectors_file.write(" ".join(map(str, vec)) + "\n")
-                if total_batches % config.num_batches_to_log_progress == 0:
-                    elapsed = time.time() - start_time
-                    config.log(f"Evaluated {total_predictions} examples... "
-                               f"({total_predictions / max(elapsed, 1e-9):.0f} "
-                               f"samples/sec)")
+            if prefetch:
+                from code2vec_tpu.utils.prefetch import DevicePrefetcher
+                stream = DevicePrefetcher(batches, self.mesh,
+                                          depth=config.prefetch_batches,
+                                          keep_host_batch=True)
+                pending = None
+                for arrays, batch in stream:
+                    out = self.eval_step(params, *arrays)  # async dispatch
+                    if pending is not None:
+                        consume(*pending)  # overlaps the in-flight step
+                    pending = (batch, out)
+                if pending is not None:
+                    consume(*pending)
+            else:
+                for batch in batches:
+                    arrays = device_put_batch(batch, self.mesh)
+                    out = self.eval_step(params, *arrays)
+                    consume(batch, out)
             if log_file is not None:
                 log_file.write(str(topk_metric.topk_correct_predictions) + "\n")
         finally:
@@ -138,17 +169,15 @@ class Evaluator:
             subtoken_f1=subtoken_metric.f1,
             loss=loss_sum / max(loss_rows, 1))
 
-    def _log_predictions(self, log_file, names, topk_rows) -> None:
+    def _log_predictions(self, log_file, names, info) -> None:
         # reference: tensorflow_model.py:410-421
-        for name, row in zip(names, topk_rows):
-            found = first_match_rank(self.tables, name, row)
-            if found is not None:
-                rank, predicted = found
+        for name, rank, idx in zip(names, info.match_rank, info.match_idx):
+            if rank >= 0:
                 if rank == 0:
                     log_file.write(f"Original: {name}, predicted 1st: "
-                                   f"{predicted}\n")
+                                   f"{self.tables.word(int(idx))}\n")
                 else:
                     log_file.write("\t\t predicted correctly at rank: "
                                    f"{rank + 1}\n")
             else:
-                log_file.write(f"No results for predicting: {name}")
+                log_file.write(f"No results for predicting: {name}\n")

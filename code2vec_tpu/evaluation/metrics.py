@@ -73,6 +73,43 @@ class TargetWordTables:
         self._legal: Dict[int, bool] = {}
         self._normalized: Dict[int, str] = {}
         self._subtokens: Dict[int, Counter] = {}
+        self._vec = None
+        self._name_norm_cache: Dict[str, str] = {}
+        self._subtokens_by_name: Dict[str, Counter] = {}
+
+    def vec_arrays(self):
+        """(legal bool (V,), norm_id int (V,), norm->id dict): whole-vocab
+        legality/normalized-form tables for the vectorized batch pass.
+        Built once (~1s for the 261K java14m target vocab), then every
+        batch update is numpy indexing instead of per-row dict lookups —
+        the difference between ~13K and >100K host-side examples/sec."""
+        if self._vec is None:
+            v = self.vocab.size
+            legal = np.zeros(v, bool)
+            norm_id = np.zeros(v, np.int64)
+            norm_to_id: Dict[str, int] = {}
+            for i in range(v):
+                w = self.vocab.lookup_word(i)
+                legal[i] = is_legal_method_name(w, self.oov_word)
+                n = normalize_word(w)
+                norm_id[i] = norm_to_id.setdefault(n, len(norm_to_id))
+            self._vec = (legal, norm_id, norm_to_id)
+        return self._vec
+
+    def normalized_name(self, name: str) -> str:
+        cached = self._name_norm_cache.get(name)
+        if cached is None:
+            cached = self._name_norm_cache[name] = normalize_word(name)
+        return cached
+
+    def subtokens_of_name(self, name: str) -> Counter:
+        """Subtoken Counter for an arbitrary (possibly-OOV) original name;
+        cached — frequent names dominate real corpora."""
+        cached = self._subtokens_by_name.get(name)
+        if cached is None:
+            cached = self._subtokens_by_name[name] = Counter(
+                get_subtokens(name))
+        return cached
 
     def word(self, index: int) -> str:
         return self.vocab.lookup_word(index)
@@ -99,6 +136,50 @@ class TargetWordTables:
         return cached
 
 
+class BatchPredictionInfo(NamedTuple):
+    """One vectorized pass over a (B, k) top-k index batch, shared by both
+    metrics and the per-example audit log so the work happens once.
+
+    match_rank[i]: rank of the first normalized match within the row's
+    LEGAL-filtered prediction list (-1: no match) — the reference's
+    `filtered` rank semantics (tensorflow_model.py:502-508).
+    match_idx[i]: that prediction's vocab index (-1: none).
+    first_legal_idx[i]: the row's prediction for the subtoken metric —
+    first legal word in the top-k (-1: none legal).
+    """
+    match_rank: np.ndarray       # (B,) int
+    match_idx: np.ndarray        # (B,) int
+    first_legal_idx: np.ndarray  # (B,) int
+
+
+def batch_prediction_info(tables: TargetWordTables,
+                          original_names: Sequence[str],
+                          topk_indices: np.ndarray) -> BatchPredictionInfo:
+    legal_arr, norm_id_arr, norm_to_id = tables.vec_arrays()
+    topk = np.asarray(topk_indices)
+    b = topk.shape[0]
+    # indices past the real vocab (padded logit columns) are illegal
+    in_vocab = topk < len(legal_arr)
+    safe = np.minimum(topk, len(legal_arr) - 1)
+    legal = legal_arr[safe] & in_vocab                      # (B, k)
+    orig_ids = np.fromiter(
+        (norm_to_id.get(tables.normalized_name(n), -1) for n in original_names),
+        dtype=np.int64, count=b)
+    match = legal & (norm_id_arr[safe] == orig_ids[:, None])
+    rows = np.arange(b)
+    any_match = match.any(axis=1)
+    j = np.where(any_match, match.argmax(axis=1), 0)
+    # rank within the legal-filtered list = # legal entries strictly
+    # before the match = inclusive-cumsum at the match minus one
+    legal_cum = np.cumsum(legal, axis=1)
+    match_rank = np.where(any_match, legal_cum[rows, j] - 1, -1)
+    match_idx = np.where(any_match, topk[rows, j], -1)
+    any_legal = legal.any(axis=1)
+    j0 = np.where(any_legal, legal.argmax(axis=1), 0)
+    first_legal_idx = np.where(any_legal, topk[rows, j0], -1)
+    return BatchPredictionInfo(match_rank, match_idx, first_legal_idx)
+
+
 class TopKAccuracyEvaluationMetric:
     """reference: tensorflow_model.py:495-512."""
 
@@ -109,20 +190,19 @@ class TopKAccuracyEvaluationMetric:
         self.nr_predictions = 0
 
     def update_batch_from_indices(self, original_names: Sequence[str],
-                                  topk_indices: np.ndarray) -> None:
-        t = self.tables
-        for name, row in zip(original_names, topk_indices):
-            self.nr_predictions += 1
-            normalized_original = normalize_word(name)
-            filtered_rank = 0
-            for idx in row:
-                idx = int(idx)
-                if not t.legal(idx):
-                    continue
-                if t.normalized(idx) == normalized_original:
-                    self.nr_correct_predictions[filtered_rank:self.top_k] += 1
-                    break
-                filtered_rank += 1
+                                  topk_indices: np.ndarray,
+                                  info: Optional[BatchPredictionInfo] = None
+                                  ) -> None:
+        if info is None:
+            info = batch_prediction_info(self.tables, original_names,
+                                         topk_indices)
+        self.nr_predictions += len(original_names)
+        ranks = info.match_rank[(info.match_rank >= 0)
+                                & (info.match_rank < self.top_k)]
+        # each match at rank r increments nr_correct[r:]; summed over the
+        # batch that is the cumulative histogram of ranks
+        hist = np.bincount(ranks, minlength=self.top_k)[:self.top_k]
+        self.nr_correct_predictions += np.cumsum(hist)
 
     @property
     def topk_correct_predictions(self) -> np.ndarray:
@@ -140,19 +220,19 @@ class SubtokensEvaluationMetric:
         self.nr_false_negatives = 0
         self.nr_predictions = 0
 
+    _EMPTY = Counter()
+
     def update_batch_from_indices(self, original_names: Sequence[str],
-                                  topk_indices: np.ndarray) -> None:
+                                  topk_indices: np.ndarray,
+                                  info: Optional[BatchPredictionInfo] = None
+                                  ) -> None:
         t = self.tables
-        for name, row in zip(original_names, topk_indices):
-            prediction_counter: Optional[Counter] = None
-            for idx in row:
-                idx = int(idx)
-                if t.legal(idx):
-                    prediction_counter = t.subtoken_counter(idx)
-                    break
-            original = Counter(get_subtokens(name))
-            if prediction_counter is None:
-                prediction_counter = Counter()
+        if info is None:
+            info = batch_prediction_info(t, original_names, topk_indices)
+        for name, pred_idx in zip(original_names, info.first_legal_idx):
+            prediction_counter = (t.subtoken_counter(int(pred_idx))
+                                  if pred_idx >= 0 else self._EMPTY)
+            original = t.subtokens_of_name(name)
             self.nr_true_positives += sum(
                 c for elem, c in prediction_counter.items() if elem in original)
             self.nr_false_positives += sum(

@@ -1,0 +1,232 @@
+"""Evaluation-path throughput at flagship scale (VERDICT r03 weak #2).
+
+Measures the FULL eval pipeline on real packed data — memmap gather ->
+host pack -> device transfer -> jitted eval step (261K-way logits +
+top-k) -> host metric update (subtoken tp/fp/fn over the 261K-word
+tables) -> per-example audit log — in both the strictly serial order and
+the pipelined one (DevicePrefetcher worker + metrics-overlap-device,
+evaluation/evaluator.py evaluate prefetch=True). The reference's eval
+loop is serial sess.run + python metrics (tensorflow_model.py:114-194).
+
+Data is synthetic-but-real-format: a generated .c2vb with the flagship
+vocab sizes and a .targets sidecar, iterated by the production
+PackedDataset; every byte flows through the same code a real corpus
+would. Writes BENCH_EVAL.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_ROWS = 131_072
+BATCH = 1024
+CONTEXTS = 200
+WORKDIR = "/tmp/eval_bench"
+
+
+def build_vocabs():
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+    from code2vec_tpu.config import Config
+    cfg = Config(train_data_path_prefix="<bench>")
+    # Flagship vocab sizes (reference config.py:61-63 java14m dicts);
+    # multi-subtoken target words so the subtoken metrics do real work.
+    freq = WordFreqDicts(
+        token_to_count={f"tok{i}": 2 for i in range(cfg.max_token_vocab_size)},
+        path_to_count={f"p{i}": 2 for i in range(cfg.max_path_vocab_size)},
+        target_to_count={f"get|field|n{i}": 2
+                         for i in range(cfg.max_target_vocab_size)},
+        num_train_examples=N_ROWS)
+    return Code2VecVocabs.create_from_freq_dicts(
+        freq, max_token_vocab_size=cfg.max_token_vocab_size,
+        max_path_vocab_size=cfg.max_path_vocab_size,
+        max_target_vocab_size=cfg.max_target_vocab_size)
+
+
+def write_packed(vocabs) -> str:
+    """Generate a flagship-shape .c2vb + .targets sidecar directly (the
+    binary layout of data/packed.py), cached across runs."""
+    import numpy as np
+    from code2vec_tpu.data import packed as packed_mod
+
+    os.makedirs(WORKDIR, exist_ok=True)
+    path = os.path.join(WORKDIR, "eval_bench.c2vb")
+    meta_path = path + ".meta.json"
+    fp = packed_mod.vocabs_fingerprint(vocabs)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            if json.load(f).get("vocab_fingerprint") == fp:
+                return path
+    rng = np.random.default_rng(7)
+    tv = vocabs.target_vocab
+    n_targets = tv.size
+    rec = np.empty((N_ROWS, 1 + 3 * CONTEXTS), dtype=np.int32)
+    rec[:, 0] = rng.integers(2, n_targets, N_ROWS)
+    rec[:, 1:1 + CONTEXTS] = rng.integers(
+        2, vocabs.token_vocab.size, (N_ROWS, CONTEXTS))
+    rec[:, 1 + CONTEXTS:1 + 2 * CONTEXTS] = rng.integers(
+        2, vocabs.path_vocab.size, (N_ROWS, CONTEXTS))
+    rec[:, 1 + 2 * CONTEXTS:] = rng.integers(
+        2, vocabs.token_vocab.size, (N_ROWS, CONTEXTS))
+    # realistic sparsity: ~30% of trailing contexts padded out
+    n_pad = rng.integers(0, CONTEXTS // 3, N_ROWS)
+    col = np.arange(CONTEXTS)[None, :]
+    padmask = col >= (CONTEXTS - n_pad)[:, None]
+    for off in (1, 1 + CONTEXTS, 1 + 2 * CONTEXTS):
+        rec[:, off:off + CONTEXTS][padmask] = 0
+    with open(path, "wb") as f:
+        f.write(packed_mod._HEADER.pack(packed_mod._MAGIC,
+                                        packed_mod._VERSION,
+                                        N_ROWS, CONTEXTS))
+        f.write(rec.tobytes())
+    # sidecar: the real word for each row's target, ~3% OOV names mixed
+    # in so the metrics exercise the never-predictable path too
+    words = [tv.lookup_word(int(i)) for i in rec[:, 0]]
+    oov_rows = rng.random(N_ROWS) < 0.03
+    for i in np.flatnonzero(oov_rows):
+        words[i] = "some|unseen|name"
+    with open(path + ".targets", "w") as f:
+        f.write("\n".join(words) + "\n")
+    with open(meta_path, "w") as f:
+        json.dump({"rows": N_ROWS, "max_contexts": CONTEXTS,
+                   "vocab_fingerprint": fp, "source": "synthetic"}, f)
+    return path
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.data.packed import PackedDataset
+    from code2vec_tpu.data.reader import EstimatorAction
+    from code2vec_tpu.evaluation.evaluator import Evaluator
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+    from code2vec_tpu.training.step import TrainStepBuilder
+
+    config = Config(train_data_path_prefix="<bench>",
+                    train_batch_size=BATCH, test_batch_size=BATCH,
+                    max_contexts=CONTEXTS, compute_dtype="bfloat16",
+                    num_batches_to_log_progress=10_000, verbose_mode=0)
+    print("building flagship vocabs + packed data...", file=sys.stderr)
+    vocabs = build_vocabs()
+    path = write_packed(vocabs)
+    ds = PackedDataset(path, vocabs)
+
+    dims = ModelDims(token_vocab_size=config.max_token_vocab_size,
+                     path_vocab_size=config.max_path_vocab_size,
+                     target_vocab_size=config.max_target_vocab_size,
+                     token_dim=config.token_embeddings_size,
+                     path_dim=config.path_embeddings_size)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.bfloat16)
+    opt = make_optimizer(config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(0),
+                               mesh=None, config=config)
+    eval_step = TrainStepBuilder(module, opt, config, mesh=None
+                                 ).make_eval_step(state)
+
+    # one shared Evaluator: its TargetWordTables (and the ~1s vec_arrays
+    # build over the 261K vocab) must not land inside any timed region
+    ev = Evaluator(config, vocabs, eval_step, mesh=None,
+                   log_path=os.path.join(WORKDIR, "eval_log.txt"))
+    ev.tables.vec_arrays()
+
+    def run(prefetch: bool, rows_limit: int) -> dict:
+        n_batches = rows_limit // BATCH
+        batches = ds.iter_batches(BATCH, EstimatorAction.Evaluate,
+                                  with_target_strings=True)
+        import itertools
+        batches = itertools.islice(batches, n_batches)
+        t0 = time.perf_counter()
+        results = ev.evaluate(state.params, batches, prefetch=prefetch)
+        dt = time.perf_counter() - t0
+        n = n_batches * BATCH
+        return {"examples_per_sec": round(n / dt, 1), "rows": n,
+                "seconds": round(dt, 2), "f1": round(results.subtoken_f1, 4)}
+
+    # -- stage A: the jitted eval step alone, device-resident input (the
+    # same methodology as bench.py's train number: what the chip can do)
+    print("timing device eval step...", file=sys.stderr)
+    import numpy as np
+    batch0 = ds.gather(np.arange(BATCH), with_target_strings=True)
+    from code2vec_tpu.training.step import device_put_batch
+    arrays = [jax.block_until_ready(a)
+              for a in device_put_batch(batch0, None)]
+    out0 = eval_step(state.params, *arrays)
+    float(out0.loss_sum)  # compile + completion barrier
+    t0 = time.perf_counter()
+    for _ in range(20):
+        out0 = eval_step(state.params, *arrays)
+    float(out0.loss_sum)
+    step_s = (time.perf_counter() - t0) / 20
+    device_eps = round(BATCH / step_s, 1)
+
+    # -- stage B: host metric+log consumption alone (vectorized pass)
+    print("timing host metrics...", file=sys.stderr)
+    from code2vec_tpu.evaluation.metrics import (
+        SubtokensEvaluationMetric, TargetWordTables,
+        TopKAccuracyEvaluationMetric, batch_prediction_info)
+    tables = TargetWordTables(vocabs.target_vocab)
+    tables.vec_arrays()  # one-time build outside the timing
+    topk_host = np.asarray(out0.topk_indices)
+    names = [batch0.target_strings[i] for i in range(BATCH)]
+    tk = TopKAccuracyEvaluationMetric(
+        config.top_k_words_considered_during_prediction, tables)
+    sub = SubtokensEvaluationMetric(tables)
+    sink = open(os.devnull, "w")
+    t0 = time.perf_counter()
+    reps = 40
+    for _ in range(reps):
+        inf = batch_prediction_info(tables, names, topk_host)
+        tk.update_batch_from_indices(names, topk_host, info=inf)
+        sub.update_batch_from_indices(names, topk_host, info=inf)
+        for name, rank, idx in zip(names, inf.match_rank, inf.match_idx):
+            sink.write(f"{name} {rank} {idx}\n")
+    host_s = (time.perf_counter() - t0) / reps
+    host_eps = round(BATCH / host_s, 1)
+
+    # -- stage C: the full pipeline over real packed data. NOTE: in this
+    # dev environment the chip sits behind the axon tunnel whose
+    # host->device link serializes ~2.5MB batch transfers at 200-450ms
+    # each, so C is tunnel-bound; on a real TPU host (PCIe-attached,
+    # >10GB/s) the pipeline bound is max(stage A, stage B).
+    print("warmup (compile)...", file=sys.stderr)
+    run(True, 4 * BATCH)  # compile + table build outside the timing
+    print("timing serial...", file=sys.stderr)
+    serial = run(False, N_ROWS // 2)
+    print("timing pipelined...", file=sys.stderr)
+    pipelined = run(True, N_ROWS // 2)
+
+    projected = round(BATCH / max(step_s, host_s), 1)
+    out = {
+        "metric": "flagship eval throughput, 1 chip (batch "
+                  f"{BATCH}, {CONTEXTS} ctx, 261K-way top-k + host metrics)",
+        "unit": "examples/sec",
+        "device_eval_step_examples_per_sec": device_eps,
+        "host_metrics_examples_per_sec": host_eps,
+        "pipeline_projection_on_host_examples_per_sec": projected,
+        "end_to_end_over_dev_tunnel": {
+            "serial": serial,
+            "pipelined": pipelined,
+            "pipelined_over_serial": round(
+                pipelined["examples_per_sec"] / serial["examples_per_sec"], 3),
+            "caveat": "axon tunnel host->device link serializes batch "
+                      "transfers (~200-450ms per 2.5MB); real TPU hosts "
+                      "are bounded by the device/host stages above",
+        },
+        "train_throughput_same_chip_see": "BENCH_r03.json",
+    }
+    with open(os.path.join(REPO, "BENCH_EVAL.json"), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

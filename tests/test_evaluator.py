@@ -1,0 +1,85 @@
+"""Pipelined vs serial evaluation must be bit-identical: the
+DevicePrefetcher + overlapped-consume path (evaluator.py evaluate
+prefetch=True) reorders WORK (host metrics for batch N run during batch
+N+1's device step) but must not reorder RESULTS — metrics, the
+per-example audit log, and the exported code vectors all match the
+strictly serial path."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import RowBatch, _pad_rows, _select_rows
+from code2vec_tpu.evaluation.evaluator import Evaluator
+from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+from code2vec_tpu.training.state import create_train_state, make_optimizer
+from code2vec_tpu.training.step import TrainStepBuilder
+from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+
+B, M, N_ROWS = 8, 6, 43  # deliberately not a batch multiple (padded tail)
+
+
+def _vocabs():
+    freq = WordFreqDicts(
+        token_to_count={f"t{i}": 10 for i in range(8)},
+        path_to_count={f"P{i}": 9 for i in range(5)},
+        target_to_count={f"w{i}": 20 - i for i in range(12)},
+        num_train_examples=100)
+    return Code2VecVocabs.create_from_freq_dicts(
+        freq, max_token_vocab_size=30, max_path_vocab_size=20,
+        max_target_vocab_size=20)
+
+
+def _batches(dims):
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, dims.token_vocab_size, (N_ROWS, M)).astype(np.int32)
+    pth = rng.integers(0, dims.path_vocab_size, (N_ROWS, M)).astype(np.int32)
+    tgt = rng.integers(0, dims.token_vocab_size, (N_ROWS, M)).astype(np.int32)
+    mask = (rng.random((N_ROWS, M)) > 0.4).astype(np.float32)
+    mask[:, 0] = 1.0
+    labels = rng.integers(
+        0, dims.real_target_vocab_size, (N_ROWS,)).astype(np.int32)
+    pool = ["w0", "w1", "w2|w3", "nosuchname", "w5|w1", "w7", "w9"]
+    rows = RowBatch(
+        source_token_indices=src, path_indices=pth, target_token_indices=tgt,
+        context_valid_mask=mask, target_index=labels,
+        example_valid=np.ones((N_ROWS,), bool),
+        target_strings=[pool[i % len(pool)] for i in range(N_ROWS)])
+    return [_pad_rows(_select_rows(rows, np.arange(s, min(s + B, N_ROWS))), B)
+            for s in range(0, N_ROWS, B)]
+
+
+def test_prefetched_eval_equals_serial(tmp_path):
+    dims = ModelDims(token_vocab_size=16, path_vocab_size=12,
+                     target_vocab_size=16, token_dim=4, path_dim=4)
+    config = Config(train_data_path_prefix="unused", compute_dtype="float32",
+                    train_batch_size=B, test_batch_size=B, max_contexts=M,
+                    dropout_keep_rate=1.0, verbose_mode=0)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=1.0)
+    opt = make_optimizer(config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(3))
+    eval_step = TrainStepBuilder(module, opt, config, mesh=None
+                                 ).make_eval_step(state, k=3)
+    batches = _batches(dims)
+    results = {}
+    for mode in ("serial", "prefetch"):
+        ev = Evaluator(config, _vocabs(), eval_step, mesh=None,
+                       log_path=str(tmp_path / f"log_{mode}.txt"))
+        results[mode] = ev.evaluate(
+            state.params, list(batches),
+            code_vectors_path=str(tmp_path / f"vec_{mode}.txt"),
+            prefetch=(mode == "prefetch"))
+
+    s, p = results["serial"], results["prefetch"]
+    np.testing.assert_array_equal(s.topk_acc, p.topk_acc)
+    assert s.subtoken_precision == p.subtoken_precision
+    assert s.subtoken_recall == p.subtoken_recall
+    assert s.subtoken_f1 == p.subtoken_f1
+    np.testing.assert_allclose(s.loss, p.loss, rtol=1e-6)
+    # audit log and exported vectors byte-identical, in order
+    assert (tmp_path / "log_serial.txt").read_text() \
+        == (tmp_path / "log_prefetch.txt").read_text()
+    assert (tmp_path / "vec_serial.txt").read_text() \
+        == (tmp_path / "vec_prefetch.txt").read_text()

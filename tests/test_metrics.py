@@ -73,3 +73,44 @@ def test_first_match_rank():
     tables = TargetWordTables(vocab)
     assert first_match_rank(tables, "getName", [0, 2, 3, 1]) == (1, "get|name")
     assert first_match_rank(tables, "nope", [1, 3]) is None
+
+
+def test_batch_prediction_info_matches_per_row_reference():
+    """Differential: the vectorized batch pass must reproduce the naive
+    per-row walk (the pre-vectorization implementation, which is the
+    reference's literal semantics) on random batches that hit every edge
+    case — no legal prediction, no match, match at every rank, OOV
+    names, and out-of-vocab (padded-logit-column) indices."""
+    from code2vec_tpu.common import normalize_word
+    from code2vec_tpu.evaluation.metrics import batch_prediction_info
+
+    words = ["get|name", "setvalue", "BAD_NAME!", "run", "x|y|z", "Get|Name",
+             "a", "b|c", "Weird$", "go"]
+    vocab = _vocab(words)
+    tables = TargetWordTables(vocab)
+    v = vocab.size
+    rng = np.random.default_rng(9)
+    names = ["getName", "setValue", "nosuch", "x|y|z", "GO", "b|c", "zzz"]
+    for trial in range(50):
+        b, k = int(rng.integers(1, 6)), int(rng.integers(1, 8))
+        # sprinkle out-of-vocab indices (padded logit columns)
+        topk = rng.integers(0, v + 2, (b, k))
+        batch_names = [names[i] for i in rng.integers(0, len(names), b)]
+        info = batch_prediction_info(tables, batch_names, topk)
+        for i in range(b):
+            # naive reference walk
+            rank, midx, first_legal = -1, -1, -1
+            filtered = 0
+            for idx in topk[i]:
+                idx = int(idx)
+                if idx >= v or not tables.legal(idx):
+                    continue
+                if first_legal < 0:
+                    first_legal = idx
+                if tables.normalized(idx) == normalize_word(batch_names[i]):
+                    rank, midx = filtered, idx
+                    break
+                filtered += 1
+            assert info.match_rank[i] == rank, (trial, i)
+            assert info.match_idx[i] == midx, (trial, i)
+            assert info.first_legal_idx[i] == first_legal, (trial, i)
