@@ -45,9 +45,12 @@ class Config:
     max_token_vocab_size: int = 1301136
     max_target_vocab_size: int = 261245
     max_path_vocab_size: int = 911417
+    # Reference semantics (config.py:64-66): token/path embedding sizes
+    # default to DEFAULT_EMBEDDINGS_SIZE; set either explicitly to
+    # override just that table. Resolved in __post_init__.
     default_embeddings_size: int = 128
-    token_embeddings_size: int = 128
-    path_embeddings_size: int = 128
+    token_embeddings_size: Optional[int] = None
+    path_embeddings_size: Optional[int] = None
     dropout_keep_rate: float = 0.75
     separate_oov_and_pad: bool = False
 
@@ -132,6 +135,14 @@ class Config:
     # -- filled at runtime (reference: config.py:130-132) --
     num_train_examples: int = 0
     num_test_examples: int = 0
+
+    def __post_init__(self):
+        # reference config.py:64-66: per-table sizes fall back to
+        # DEFAULT_EMBEDDINGS_SIZE unless set explicitly.
+        if self.token_embeddings_size is None:
+            self.token_embeddings_size = self.default_embeddings_size
+        if self.path_embeddings_size is None:
+            self.path_embeddings_size = self.default_embeddings_size
 
     # ---------------------------------------------------------------- derived
 

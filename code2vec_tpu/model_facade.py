@@ -285,6 +285,25 @@ class Code2VecModel:
                        key=epoch_of)
         for stale in paths[:-config.max_to_keep]:
             shutil.rmtree(stale, ignore_errors=True)
+        if paths:
+            # A clean epoch save supersedes any preemption checkpoint from
+            # that epoch or earlier; without this, repeatedly-preempted
+            # long runs accumulate unbounded `_iter<N>_preempt` artifacts
+            # (they carry a non-integer suffix, so the rotation above
+            # never sees them).
+            newest_clean = epoch_of(paths[-1])
+            def preempt_epoch_of(p):
+                tail = p.rsplit("_iter", 1)[1]
+                if not tail.endswith("_preempt"):
+                    return -1
+                try:
+                    return int(tail[:-len("_preempt")])
+                except ValueError:
+                    return -1
+            for p in glob.glob(pattern):
+                e = preempt_epoch_of(p)
+                if 0 <= e <= newest_clean:
+                    shutil.rmtree(p, ignore_errors=True)
 
     # ------------------------------------------------------------ eval
 

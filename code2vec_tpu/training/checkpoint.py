@@ -58,6 +58,12 @@ def save_model(model_save_path: str, state: TrainState, vocabs, config,
             # restore time instead of an opaque Orbax structure mismatch.
             "use_sparse_embedding_update": bool(
                 getattr(config, "use_sparse_embedding_update", False)),
+            # Adam moment dtypes shape the opt_state arrays; a restore
+            # into a template with different dtypes can error or silently
+            # cast depending on the Orbax version, so they're recorded
+            # and checked like the sparse-mode flag above.
+            "adam_mu_dtype": str(getattr(config, "adam_mu_dtype", "float32")),
+            "adam_nu_dtype": str(getattr(config, "adam_nu_dtype", "float32")),
         }, f, indent=2)
     ckptr = ocp.StandardCheckpointer()
     target = {"params": state.params, "step": state.step}
@@ -96,6 +102,19 @@ def load_model(model_load_path: str, state_like: TrainState,
                 f"match, or `--release` the artifact first (a released "
                 f"model carries no optimizer state and loads under either "
                 f"mode).")
+        for knob in ("adam_mu_dtype", "adam_nu_dtype"):
+            saved = meta.get(knob)
+            want = str(getattr(config, knob, "float32"))
+            # artifacts predating this meta entry carry no record (the
+            # default changed over time) — nothing to check against
+            if saved is not None and saved != want:
+                raise ValueError(
+                    f"{base} was saved with {knob}={saved} but this run "
+                    f"has {knob}={want}; the optimizer-moment dtypes "
+                    f"differ and a restore would corrupt or miscast the "
+                    f"moments. Pass --{knob} {saved} to resume this "
+                    f"artifact, or `--release` it first (released models "
+                    f"carry no optimizer state).")
     template = {"params": state_like.params, "step": state_like.step}
     if not meta.get("released", False):
         template["opt_state"] = state_like.opt_state

@@ -804,6 +804,12 @@ class Parser {
           nx.kind == Tok::kLongLit || nx.kind == Tok::kFloatLit ||
           nx.kind == Tok::kDoubleLit || nx.kind == Tok::kCharLit ||
           nx.kind == Tok::kStringLit;
+      // `yield (a + b);` inside a switch body is the statement form too
+      // (JLS 14.21: a statement starting with `yield` is a yield
+      // statement there; JavaParser agrees). Outside a switch body a
+      // leading `(` keeps meaning a call to a method named yield.
+      if (!starts_expr && switch_body_depth_ > 0 && nx.text == "(")
+        starts_expr = true;
       if (starts_expr) {
         Next();
         Node* s = Stmt("YieldStmt", begin);
@@ -1035,8 +1041,15 @@ class Parser {
     return Finish(e);
   }
 
+  struct SwitchBodyGuard {
+    Parser* p;
+    explicit SwitchBodyGuard(Parser* q) : p(q) { ++p->switch_body_depth_; }
+    ~SwitchBodyGuard() { --p->switch_body_depth_; }
+  };
+
   void ParseSwitchBodyInto(Node* s) {
     Expect("{");
+    SwitchBodyGuard switch_guard(this);
     while (!Accept("}")) {
       if (AtEof()) Fail("unterminated switch");
       int eb = Pos();
@@ -1854,6 +1867,7 @@ class Parser {
 
   Arena* arena_;
   int depth_ = 0;
+  int switch_body_depth_ = 0;
   bool recover_ = false;
   bool in_case_label_ = false;
   std::vector<std::string> warnings_;

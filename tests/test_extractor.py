@@ -259,6 +259,35 @@ public class Modern {
     assert any(",s " in ln or " s," in ln for ln in lines) or "s," in lines[3]
 
 
+def test_yield_with_parenthesized_expression(extractor, java_file):
+    """`yield (a + b);` inside a switch body is a YieldStmt (JLS 14.21:
+    a statement starting with `yield` is a yield statement there), while
+    `yield(x)` outside any switch stays a call to a method named yield —
+    the contextual-keyword split JavaParser implements."""
+    code = """
+public class YieldParen {
+    public int parens(int x) {
+        int base = 2;
+        return switch (x) { case 0: yield (x + base); default: yield base; };
+    }
+    public int callOutside(int x) { return yield(x); }
+    public int yield(int v) { return v; }
+}
+"""
+    import subprocess as sp
+    proc = sp.run([BINARY, "--max_path_length", "12", "--max_path_width",
+                   "3", "--file", java_file(code), "--no_hash"],
+                  capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.splitlines()
+    assert [ln.split(" ", 1)[0] for ln in lines] == \
+        ["parens", "call|outside", "yield"]
+    assert "(YieldStmt)_(EnclosedExpr)" in lines[0]
+    assert "MethodCallExpr" not in lines[0].replace("METHOD_NAME", "")
+    assert "(MethodCallExpr0)_(NameExpr0),yield" in lines[1]
+    assert "YieldStmt" not in lines[1]
+
+
 def test_java_per_member_recovery(java_file, extractor, tmp_path):
     import subprocess as sp
     # the middle method uses a Java 21 type-pattern switch case, which
