@@ -58,6 +58,16 @@ def arguments_parser() -> ArgumentParser:
                         help="context-parallel axis size (shards MAX_CONTEXTS)")
     parser.add_argument("--compute_dtype", choices=["bfloat16", "float32"],
                         default="bfloat16")
+    parser.add_argument("--adam_mu_dtype", choices=["bfloat16", "float32"],
+                        default=None,
+                        help="Adam first-moment storage dtype (default: "
+                             "config.py's bfloat16); resuming an artifact "
+                             "saved under a different dtype requires "
+                             "matching it (checkpoint meta is checked)")
+    parser.add_argument("--adam_nu_dtype", choices=["bfloat16", "float32"],
+                        default=None,
+                        help="Adam second-moment storage dtype (see "
+                             "--adam_mu_dtype)")
     parser.add_argument("--batch_size", type=int, default=None)
     parser.add_argument("--test_batch_size", type=int, default=None)
     parser.add_argument("--epochs", type=int, default=None)
@@ -96,6 +106,8 @@ def config_from_args(argv=None) -> Config:
         use_sparse_embedding_update=args.sparse_embedding_update,
         dp=args.dp, tp=args.tp, cp=args.cp,
         compute_dtype=args.compute_dtype,
+        **{knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype")
+           if (value := getattr(args, knob)) is not None},
         seed=args.seed,
         use_packed_data=not args.no_packed_data,
         use_manual_tp_kernels=not args.gspmd,

@@ -10,11 +10,14 @@ one Python module. Run-once and I/O-bound, so Python is the right tool
 
 from __future__ import annotations
 
+import math
 import os
 import pickle
 import random
+import shutil
 import subprocess
 import sys
+import tempfile
 from collections import Counter
 from typing import Dict, Optional, Tuple
 
@@ -201,6 +204,18 @@ def _extractor_command(extractor: str, language: str, target_flag: str,
             "--threads", str(num_threads)]
 
 
+def _child_targets(source_dir: str, language: str):
+    """Extraction units under `source_dir`: subdirectories and loose
+    source files of the target language, sorted for determinism. Shared
+    by the sequential retry descent and the parallel project pool so
+    both extract the same file set."""
+    suffix = ".java" if language == "java" else ".cs"
+    return [os.path.join(source_dir, name)
+            for name in sorted(os.listdir(source_dir))
+            if os.path.isdir(os.path.join(source_dir, name))
+            or name.endswith(suffix)]
+
+
 def _run_extractor_tree(out, extractor: str, language: str, target: str,
                         max_path_length: int, max_path_width: int,
                         num_threads: int, timeout: Optional[float],
@@ -232,11 +247,7 @@ def _run_extractor_tree(out, extractor: str, language: str, target: str,
 
     def descend() -> int:
         skipped = 0
-        for name in sorted(os.listdir(target)):
-            child = os.path.join(target, name)
-            if not (os.path.isdir(child) or child.endswith(
-                    ".java" if language == "java" else ".cs")):
-                continue
+        for child in _child_targets(target, language):
             skipped += _run_extractor_tree(
                 out, extractor, language, child, max_path_length,
                 max_path_width, num_threads, timeout, log, _retrying=True)
@@ -273,11 +284,52 @@ def _run_extractor_tree(out, extractor: str, language: str, target: str,
     return 0
 
 
+def _extract_tree_parallel(out, extractor: str, language: str,
+                           source_dir: str, max_path_length: int,
+                           max_path_width: int, num_threads: int,
+                           timeout: Optional[float], num_workers: int,
+                           log) -> int:
+    """Project-level extraction parallelism: a pool of `num_workers`
+    workers over the top-level entries of `source_dir` — the reference
+    driver's `multiprocessing.Pool(4)` over project dirs
+    (reference: JavaExtractor/extract.py:61-76). Threads suffice here
+    (each worker blocks in a `subprocess.run` of the internally-threaded
+    native extractor); every child keeps the same kill-timer +
+    per-child-retry protection, spilled to its own file and concatenated
+    in deterministic (sorted) order. Returns total skipped targets."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    children = _child_targets(source_dir, language)
+    if not children:
+        return 0
+    # spill next to the output file, not the system /tmp (often a small
+    # tmpfs; the corpora this pipeline targets run to tens of GB)
+    out_dir = os.path.dirname(getattr(out, "name", "") or "") or "."
+    spill_dir = tempfile.mkdtemp(prefix="c2v_extract_", dir=out_dir)
+
+    def extract_child(item) -> int:
+        index, child = item
+        with open(os.path.join(spill_dir, f"s{index:06d}"), "w+b") as spill:
+            return _run_extractor_tree(
+                spill, extractor, language, child, max_path_length,
+                max_path_width, num_threads, timeout, log)
+
+    try:
+        with ThreadPoolExecutor(max_workers=num_workers) as pool:
+            skipped = sum(pool.map(extract_child, enumerate(children)))
+        for index in range(len(children)):
+            with open(os.path.join(spill_dir, f"s{index:06d}"), "rb") as f:
+                shutil.copyfileobj(f, out, 16 * 1024 * 1024)
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+    return skipped
+
+
 def extract_dir(source_dir: str, out_path: str, language: str = "java",
                 max_path_length: int = 8, max_path_width: int = 2,
                 num_threads: int = 32, shuffle: bool = False,
                 seed: int = 0, timeout: Optional[float] = 600.0,
-                log=print) -> str:
+                num_workers: int = 1, log=print) -> str:
     """Run the native AST path extractor over a source tree, writing raw
     context lines to `out_path` (optionally shuffled, as the reference
     pipes the train split through `shuf`, preprocess.sh:42-48). A hung
@@ -285,27 +337,124 @@ def extract_dir(source_dir: str, out_path: str, language: str = "java",
     subdirectory/file (reference: JavaExtractor/extract.py:38-58 — whose
     `Timer(600000, kill)` is in seconds, ~7 days, so its kill-timer never
     fires in practice; 600s here keeps the protection real and matches
-    the CLI's --extract_timeout default).
+    the CLI's --extract_timeout default). `num_workers > 1` extracts
+    top-level children of `source_dir` concurrently, the reference
+    driver's project-level `Pool(4)` (JavaExtractor/extract.py:61-76).
     """
     extractor = _native_extractor(language)
     log(f"Extracting {source_dir} -> {out_path} ({language})")
     with open(out_path + ".tmp", "wb") as out:
-        skipped = _run_extractor_tree(
-            out, extractor, language, source_dir, max_path_length,
-            max_path_width, num_threads, timeout, log)
+        if num_workers > 1 and os.path.isdir(source_dir):
+            skipped = _extract_tree_parallel(
+                out, extractor, language, source_dir, max_path_length,
+                max_path_width, num_threads, timeout, num_workers, log)
+        else:
+            skipped = _run_extractor_tree(
+                out, extractor, language, source_dir, max_path_length,
+                max_path_width, num_threads, timeout, log)
         if skipped:
             log(f"  {skipped} targets skipped after timeout/failure")
     if shuffle:
         # like the reference's `| shuf`: whole-file shuffle of the raw
         # train split (training also reshuffles per epoch from the
         # packed dataset, so this only decorrelates the histogram pass)
-        with open(out_path + ".tmp", "r") as f:
-            lines = f.readlines()
-        random.Random(seed).shuffle(lines)
-        with open(out_path + ".tmp", "w") as f:
-            f.writelines(lines)
+        external_shuffle(out_path + ".tmp", seed=seed, log=log)
     os.replace(out_path + ".tmp", out_path)
     return out_path
+
+
+def external_shuffle(path: str, seed: int = 0,
+                     mem_budget_bytes: int = 1 << 30,
+                     tmp_dir: Optional[str] = None, log=print) -> str:
+    """Uniform in-place line shuffle of `path` in bounded memory.
+
+    The reference pipes the raw train split through `shuf`
+    (reference: preprocess.sh:44-48) and its docs size the extracted
+    java14m corpus at ~32 GB (reference: README.md:69-75) — far past
+    what a `readlines()` shuffle can hold. Two passes, `shuf`-style
+    statistics in O(mem_budget) RAM:
+
+      1. deal each line to one of K spill buckets, the bucket drawn
+         iid uniformly per line;
+      2. load each bucket (≈ file_size/K bytes), shuffle it in RAM,
+         and append buckets to the output in order.
+
+    Dealing iid-uniform buckets then permuting uniformly within each
+    is exactly a uniform random permutation of the whole file (it is
+    sorting by an iid uniform key whose high bits are the bucket id),
+    so the result is statistically identical to `shuf`, at ~2x file
+    size of extra disk and ~file_size/K peak RAM.
+
+    Files at or under half of `mem_budget_bytes` take the direct
+    in-memory path (a loaded file costs ~2x its bytes in line objects,
+    so the halved threshold is what actually honors the budget).
+    Deterministic for a fixed (seed, file, budget). Returns `path`.
+    """
+    size = os.path.getsize(path)
+    rng = random.Random(seed)
+    if size <= mem_budget_bytes // 2:
+        with open(path, "rb") as f:
+            lines = f.readlines()
+        if lines and not lines[-1].endswith(b"\n"):
+            # `shuf` newline-terminates every output line; without this a
+            # final unterminated line would merge into its successor.
+            lines[-1] += b"\n"
+        rng.shuffle(lines)
+        with open(path, "wb") as f:
+            f.writelines(lines)
+        return path
+
+    # Bucket target well under the budget: Python str/list overhead plus
+    # the shuffle's index churn make a loaded bucket cost ~2x its bytes.
+    # n_buckets is capped so open fds and write-buffer RAM stay bounded;
+    # a bucket that still exceeds the budget (inputs > ~128x the budget)
+    # is shuffled recursively instead of loaded, so the memory bound
+    # holds at any input size.
+    n_buckets = min(512, max(2, math.ceil(size / (mem_budget_bytes // 4))))
+    buffering = max(64 * 1024, min(4 * 1024 * 1024,
+                                   mem_budget_bytes // (4 * n_buckets)))
+    work_dir = tempfile.mkdtemp(prefix="c2v_shuf_",
+                                dir=tmp_dir or os.path.dirname(path) or ".")
+    log(f"  external shuffle: {size / 1e9:.2f} GB across {n_buckets} "
+        f"spill buckets ({work_dir})")
+    try:
+        buckets = []
+        try:
+            for i in range(n_buckets):
+                buckets.append(open(os.path.join(work_dir, f"b{i:05d}"),
+                                    "wb", buffering=buffering))
+            with open(path, "rb", buffering=16 * 1024 * 1024) as f:
+                for line in f:
+                    if not line.endswith(b"\n"):
+                        line += b"\n"  # shuf-style: terminate the last line
+                    buckets[rng.randrange(n_buckets)].write(line)
+        finally:
+            for b in buckets:
+                b.close()
+        out_tmp = path + ".shuf"
+        with open(out_tmp, "wb", buffering=16 * 1024 * 1024) as out:
+            for i in range(n_buckets):
+                bucket_path = os.path.join(work_dir, f"b{i:05d}")
+                if os.path.getsize(bucket_path) > mem_budget_bytes // 2:
+                    # still over budget: permute the bucket recursively
+                    # (uniform within the bucket is all pass 2 needs),
+                    # then stream it through without loading
+                    external_shuffle(bucket_path,
+                                     seed=rng.randrange(1 << 63),
+                                     mem_budget_bytes=mem_budget_bytes,
+                                     tmp_dir=work_dir, log=log)
+                    with open(bucket_path, "rb") as f:
+                        shutil.copyfileobj(f, out, 16 * 1024 * 1024)
+                else:
+                    with open(bucket_path, "rb") as f:
+                        lines = f.readlines()
+                    rng.shuffle(lines)
+                    out.writelines(lines)
+                os.unlink(bucket_path)  # free disk before the next load
+        os.replace(out_tmp, path)
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return path
 
 
 def main(argv=None) -> None:
@@ -340,6 +489,10 @@ def main(argv=None) -> None:
     parser.add_argument("--path_vocab_size", type=int, default=911417)
     parser.add_argument("--target_vocab_size", type=int, default=261245)
     parser.add_argument("--num_threads", type=int, default=32)
+    parser.add_argument("--num_workers", type=int, default=4,
+                        help="concurrent top-level project extractions "
+                             "(reference driver: Pool(4), "
+                             "JavaExtractor/extract.py:61-76)")
     parser.add_argument("--extract_timeout", type=float, default=600.0,
                         help="seconds before a hung extraction is killed "
                              "and retried per subdirectory/file")
@@ -370,7 +523,8 @@ def main(argv=None) -> None:
                 language=args.language, max_path_length=args.max_path_length,
                 max_path_width=args.max_path_width,
                 num_threads=args.num_threads, shuffle=role == "train",
-                seed=args.seed, timeout=args.extract_timeout)
+                seed=args.seed, timeout=args.extract_timeout,
+                num_workers=args.num_workers)
     else:
         raws = {"train": args.train_raw, "val": args.val_raw,
                 "test": args.test_raw}

@@ -79,8 +79,12 @@ class Code2VecModel:
         # the checkpoint name; here it is carried in the artifact meta).
         self.initial_epoch = 0
         if config.is_loading:
+            # --release discards the optimizer state, so it loads
+            # params-only and must not run the optimizer layout/dtype
+            # guards (it is their advertised escape hatch)
             self.state = ckpt_mod.load_model(config.model_load_path,
-                                             self.state, config=config)
+                                             self.state, config=config,
+                                             params_only=config.release)
             meta = ckpt_mod.load_model_meta(config.model_load_path)
             self.initial_epoch = int(meta.get("epoch", 0))
             self.log(f"Loaded model weights from {config.model_load_path} "

@@ -83,12 +83,27 @@ def load_model_meta(model_load_path: str) -> dict:
 
 
 def load_model(model_load_path: str, state_like: TrainState,
-               config=None) -> TrainState:
+               config=None, params_only: bool = False) -> TrainState:
     """Restore a standalone artifact saved by `save_model`. `state_like`
     provides structure/shardings; released artifacts keep `state_like`'s
-    (fresh) optimizer state."""
+    (fresh) optimizer state. `params_only` restores just params+step and
+    never touches the saved optimizer state — the `--release` path, which
+    must load artifacts regardless of their optimizer layout/dtypes (it
+    is the advertised escape hatch for every optimizer-mismatch error
+    below, so it cannot itself run those checks)."""
     base = _abs(model_load_path)
     meta = load_model_meta(base)
+    if params_only:
+        template = {"params": state_like.params, "step": state_like.step}
+        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            restored = ckptr.restore(
+                os.path.join(base, _STATE_DIR),
+                args=ocp.args.PyTreeRestore(item=template,
+                                            restore_args=restore_args,
+                                            partial_restore=True))
+        return TrainState(step=restored["step"], params=restored["params"],
+                          opt_state=state_like.opt_state)
     if config is not None and not meta.get("released", False):
         saved_sparse = bool(meta.get("use_sparse_embedding_update", False))
         want_sparse = bool(getattr(config, "use_sparse_embedding_update",
@@ -130,7 +145,9 @@ def load_model(model_load_path: str, state_like: TrainState,
 def release_model(model_load_path: str, model_save_path: Optional[str],
                   state_like: TrainState, vocabs, config) -> str:
     """Load a trainable artifact and re-save it weights-only
-    (reference: tensorflow_model.py:131-135 saves `<load>.release`)."""
-    state = load_model(model_load_path, state_like)
+    (reference: tensorflow_model.py:131-135 saves `<load>.release`).
+    Loads params-only: releasing discards the optimizer state, so a
+    saved-vs-current optimizer layout/dtype mismatch must not block it."""
+    state = load_model(model_load_path, state_like, params_only=True)
     out = model_save_path or model_load_path
     return save_model(out, state, vocabs, config, released=True)

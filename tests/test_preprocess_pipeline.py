@@ -194,3 +194,133 @@ def test_extract_retry_skips_crashing_children(tmp_path):
     assert skipped == 1
     assert out.read_text().count("\n") == 1
     assert any("failed on" in m and "Crash.java" in m for m in logs)
+
+
+def test_external_shuffle_is_a_permutation(tmp_path):
+    """The spill-bucket external shuffle (forced via a tiny memory budget)
+    emits exactly the input lines, reordered, deterministically per seed —
+    the `| shuf` contract (reference: preprocess.sh:44-48) in bounded RAM."""
+    path = tmp_path / "raw.txt"
+    lines = [f"method{i} " + "x" * 40 + "\n" for i in range(1000)]
+    path.write_text("".join(lines))
+    logs = []
+    pp.external_shuffle(str(path), seed=1, mem_budget_bytes=4096,
+                        log=logs.append)
+    first = path.read_text().splitlines(keepends=True)
+    assert sorted(first) == sorted(lines)
+    assert first != lines  # vanishingly unlikely to be identity
+    assert any("spill buckets" in m for m in logs), logs
+    assert not list(tmp_path.glob("c2v_shuf_*")), "spill dir not cleaned"
+
+    # deterministic: same seed reproduces the same permutation
+    path.write_text("".join(lines))
+    pp.external_shuffle(str(path), seed=1, mem_budget_bytes=4096,
+                        log=lambda *_: None)
+    assert path.read_text().splitlines(keepends=True) == first
+
+    # a different seed produces a different permutation
+    path.write_text("".join(lines))
+    pp.external_shuffle(str(path), seed=2, mem_budget_bytes=4096,
+                        log=lambda *_: None)
+    assert path.read_text().splitlines(keepends=True) != first
+
+
+def test_external_shuffle_small_file_in_memory(tmp_path):
+    """Files within the budget take the direct in-memory path; an
+    unterminated final line gains a newline (shuf semantics) instead of
+    merging with its shuffled successor."""
+    path = tmp_path / "raw.txt"
+    path.write_text("a 1\nb 2\nc 3")  # no trailing newline
+    pp.external_shuffle(str(path), seed=0, log=lambda *_: None)
+    out = path.read_text()
+    assert sorted(out.splitlines()) == ["a 1", "b 2", "c 3"]
+    assert out.endswith("\n")
+
+
+def test_external_shuffle_unterminated_last_line_external_path(tmp_path):
+    path = tmp_path / "raw.txt"
+    lines = [f"m{i} " + "y" * 30 for i in range(300)]
+    path.write_text("\n".join(lines))  # last line unterminated
+    pp.external_shuffle(str(path), seed=3, mem_budget_bytes=2048,
+                        log=lambda *_: None)
+    assert sorted(path.read_text().splitlines()) == sorted(lines)
+
+
+def test_parallel_extraction_matches_sequential(tmp_path):
+    """num_workers>1 extracts top-level projects concurrently (reference
+    driver: multiprocessing.Pool(4), JavaExtractor/extract.py:61-76) and
+    must produce the same multiset of context lines as one sequential
+    whole-tree extraction."""
+    tree = tmp_path / "tree"
+    for proj in ("p1", "p2", "p3"):
+        d = tree / proj
+        d.mkdir(parents=True)
+        (d / "Calc.java").write_text(JAVA_A)
+        (d / "Greeter.java").write_text(JAVA_B)
+    seq = tmp_path / "seq.txt"
+    par = tmp_path / "par.txt"
+    pp.extract_dir(str(tree), str(seq), num_threads=1, num_workers=1,
+                   log=lambda *_: None)
+    pp.extract_dir(str(tree), str(par), num_threads=1, num_workers=3,
+                   log=lambda *_: None)
+    seq_lines = sorted(seq.read_text().splitlines())
+    par_lines = sorted(par.read_text().splitlines())
+    assert seq_lines == par_lines
+    assert len(seq_lines) >= 9  # 3 projects x 3 methods
+
+
+def test_parallel_extraction_keeps_retry_protection(tmp_path):
+    """Each parallel worker retains the kill-timer + per-child retry:
+    a project with one hanging file still yields its other files."""
+    import stat
+
+    fake = tmp_path / "fake-extract"
+    fake.write_text(
+        "#!/bin/sh\n"
+        "while [ $# -gt 0 ]; do\n"
+        "  case $1 in\n"
+        "    --dir) case $2 in *bad*) sleep 30;; *) echo \"m a,$2,b\";; "
+        "esac; shift;;\n"
+        "    --file) case $2 in *Hang.java) sleep 30;; "
+        "*) echo \"m a,$2,b\";; esac; shift;;\n"
+        "  esac\n"
+        "  shift\n"
+        "done\n")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    tree = tmp_path / "tree"
+    good = tree / "good"
+    bad = tree / "bad"
+    good.mkdir(parents=True)
+    bad.mkdir()
+    (good / "A.java").write_text("class A {}")
+    (bad / "Hang.java").write_text("class H {}")
+    (bad / "B.java").write_text("class B {}")
+
+    logs = []
+    out = tmp_path / "out.txt"
+    with open(out, "wb") as f:
+        skipped = pp._extract_tree_parallel(
+            f, str(fake), "java", str(tree), 8, 2, 1, timeout=1.0,
+            num_workers=2, log=logs.append)
+    lines = out.read_text().splitlines()
+    assert skipped == 1  # Hang.java, after bad/'s dir-level timeout descent
+    assert any("good" in ln for ln in lines)
+    assert any("B.java" in ln for ln in lines)
+    assert all("Hang" not in ln for ln in lines)
+
+
+def test_external_shuffle_recursive_oversized_buckets(tmp_path):
+    """When the input is so large relative to the budget that even capped
+    buckets exceed it, buckets are shuffled recursively and streamed —
+    the memory bound holds at any input size. Forced here with a tiny
+    budget so every bucket overflows."""
+    path = tmp_path / "raw.txt"
+    lines = [f"m{i} " + "z" * 44 + "\n" for i in range(24000)]
+    path.write_text("".join(lines))
+    pp.external_shuffle(str(path), seed=7, mem_budget_bytes=2048,
+                        log=lambda *_: None)
+    out = path.read_text().splitlines(keepends=True)
+    assert sorted(out) == sorted(lines)
+    assert out != lines
+    assert not list(tmp_path.glob("c2v_shuf_*"))

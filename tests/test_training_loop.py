@@ -324,3 +324,41 @@ def test_profiler_hook_writes_trace(tiny_config, tmp_path):
     import glob as _glob
     written = _glob.glob(profile_dir + "/**", recursive=True)
     assert any(os.path.isfile(p) for p in written), written
+
+
+def test_release_loads_params_only_across_optimizer_mismatch(
+        tmp_path, tiny_vocabs, tiny_config):
+    """--release is the advertised escape hatch for every optimizer
+    layout/dtype mismatch error, so its load path must not run those
+    guards: a params-only load succeeds across both a sparse-mode and an
+    Adam-dtype mismatch, and the released artifact then loads anywhere."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training import checkpoint as ckpt_mod
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+
+    tiny_config.compute_dtype = "float32"
+    tiny_config.adam_mu_dtype = "float32"
+    dims = ModelDims.from_config_and_vocabs(tiny_config, tiny_vocabs)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32)
+    opt = make_optimizer(tiny_config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(0),
+                               config=tiny_config)
+    path = str(tmp_path / "model")
+    ckpt_mod.save_model(path, state, tiny_vocabs, tiny_config, epoch=2)
+
+    mismatched = dataclasses.replace(tiny_config, adam_mu_dtype="bfloat16",
+                                     use_sparse_embedding_update=True)
+    # the guarded (resume) path rejects it...
+    with pytest.raises(ValueError):
+        ckpt_mod.load_model(path, state, config=mismatched)
+    # ...while the release path loads params-only and re-saves weights-only
+    rel = ckpt_mod.release_model(path, str(tmp_path / "out"), state,
+                                 tiny_vocabs, mismatched)
+    assert ckpt_mod.load_model_meta(rel)["released"] is True
+    restored = ckpt_mod.load_model(rel, state, config=mismatched)
+    tok = "token_embedding"
+    np.testing.assert_array_equal(np.asarray(restored.params[tok]),
+                                  np.asarray(state.params[tok]))
