@@ -1403,7 +1403,176 @@ class Parser {
   // ------------------------------------------------------ expressions
   CsNode* ParseExpression() { return ParseAssignment(); }
 
+  // ------------------------------------------------------ LINQ queries
+  // Query expressions are a non-assignment-expression alternative in the
+  // C# grammar, so they hook in at assignment level. Node shapes follow
+  // the Roslyn trees the reference consumes whole
+  // (CSharpExtractor/CSharpExtractor/Extractor/Tree.cs:100-204):
+  // QueryExpression{FromClause, QueryBody}; QueryBody{(From|Let|Where|
+  // Join|OrderBy)Clause*, Select|Group, QueryContinuation?}; orderings
+  // are AscendingOrdering/DescendingOrdering. Range variables are
+  // attached identifier tokens (leaves), like every Roslyn identifier.
+
+  // `from` begins a query iff `from [type] identifier in` follows.
+  // The type prefix is scanned at angle/bracket depth so an identifier
+  // merely named `from` (e.g. `from + 1`, `M(from)`) cannot misfire:
+  // no expression continuation places the keyword `in` after an
+  // identifier at depth 0. Tuple types in the from/join type slot are
+  // not recognized (rare; such members fall to error recovery).
+  bool QueryAhead() {
+    int angle = 0, square = 0;
+    bool prev_plain_ident = false;
+    for (size_t k = 1; k < 64; ++k) {
+      const CsToken& t = LookAhead(k);
+      if (t.kind == Tok::kIdent) {
+        if (t.text == "in" && angle == 0 && square == 0)
+          return prev_plain_ident;
+        if (IsCsKeyword(t.text) && !kPredefinedTypes.count(t.text))
+          return false;
+        prev_plain_ident = !IsCsKeyword(t.text);
+        continue;
+      }
+      if (t.kind != Tok::kPunct) return false;
+      prev_plain_ident = false;
+      std::string_view p = t.text;
+      if (p == "<") ++angle;
+      else if (p == ">") { if (--angle < 0) return false; }
+      else if (p == "[") ++square;
+      else if (p == "]") { if (--square < 0) return false; }
+      else if (p == "." || p == "?") continue;
+      else if (p == ",") { if (angle == 0 && square == 0) return false; }
+      else return false;
+    }
+    return false;
+  }
+
+  bool KwAt(size_t k, std::string_view t) const {
+    return LookAhead(k).kind == Tok::kIdent && LookAhead(k).text == t;
+  }
+
+  CsNode* ParseQueryExpression() {
+    DepthGuard depth_guard(this);
+    int begin = Pos();
+    CsNode* q = New("QueryExpression", begin);
+    CsAdopt(q, ParseFromClause());
+    CsAdopt(q, ParseQueryBody());
+    return Finish(q);
+  }
+
+  CsNode* ParseFromClause() {
+    int begin = Pos();
+    ExpectKw("from");
+    CsNode* c = New("FromClause", begin);
+    if (!(IsIdent() && KwAt(1, "in")))
+      CsAdopt(c, ParseType());  // `from T x in e`
+    AttachIdent(c);             // range variable
+    ExpectKw("in");
+    CsAdopt(c, ParseExpression());
+    return Finish(c);
+  }
+
+  CsNode* ParseQueryBody() {
+    // guards the `into` continuation chain, which recurses here without
+    // passing through any other guarded production
+    DepthGuard depth_guard(this);
+    int begin = Pos();
+    CsNode* body = New("QueryBody", begin);
+    while (true) {
+      if (IsKw("from") && QueryAhead()) CsAdopt(body, ParseFromClause());
+      else if (IsKw("let")) CsAdopt(body, ParseLetClause());
+      else if (IsKw("where")) CsAdopt(body, ParseWhereClause());
+      else if (IsKw("join")) CsAdopt(body, ParseJoinClause());
+      else if (IsKw("orderby")) CsAdopt(body, ParseOrderByClause());
+      else break;
+    }
+    if (IsKw("select")) {
+      int sb = Pos();
+      Next();
+      CsNode* sel = New("SelectClause", sb);
+      CsAdopt(sel, ParseExpression());
+      CsAdopt(body, Finish(sel));
+    } else if (IsKw("group")) {
+      int gb = Pos();
+      Next();
+      CsNode* grp = New("GroupClause", gb);
+      CsAdopt(grp, ParseExpression());
+      ExpectKw("by");
+      CsAdopt(grp, ParseExpression());
+      CsAdopt(body, Finish(grp));
+    } else {
+      Fail("expected `select` or `group` in query body");
+    }
+    if (IsKw("into")) {
+      int ib = Pos();
+      Next();
+      CsNode* cont = New("QueryContinuation", ib);
+      AttachIdent(cont);
+      CsAdopt(cont, ParseQueryBody());
+      CsAdopt(body, Finish(cont));
+    }
+    return Finish(body);
+  }
+
+  CsNode* ParseLetClause() {
+    int begin = Pos();
+    ExpectKw("let");
+    CsNode* c = New("LetClause", begin);
+    AttachIdent(c);
+    Expect("=");
+    CsAdopt(c, ParseExpression());
+    return Finish(c);
+  }
+
+  CsNode* ParseWhereClause() {
+    int begin = Pos();
+    ExpectKw("where");
+    CsNode* c = New("WhereClause", begin);
+    CsAdopt(c, ParseExpression());
+    return Finish(c);
+  }
+
+  CsNode* ParseJoinClause() {
+    int begin = Pos();
+    ExpectKw("join");
+    CsNode* c = New("JoinClause", begin);
+    if (!(IsIdent() && KwAt(1, "in")))
+      CsAdopt(c, ParseType());  // `join T x in e ...`
+    AttachIdent(c);
+    ExpectKw("in");
+    CsAdopt(c, ParseExpression());
+    ExpectKw("on");
+    CsAdopt(c, ParseExpression());
+    ExpectKw("equals");
+    CsAdopt(c, ParseExpression());
+    if (IsKw("into")) {
+      int ib = Pos();
+      Next();
+      CsNode* into = New("JoinIntoClause", ib);
+      AttachIdent(into);
+      CsAdopt(c, Finish(into));
+    }
+    return Finish(c);
+  }
+
+  CsNode* ParseOrderByClause() {
+    int begin = Pos();
+    ExpectKw("orderby");
+    CsNode* c = New("OrderByClause", begin);
+    do {
+      int ob = Pos();
+      CsNode* expr = ParseExpression();
+      const char* kind = "AscendingOrdering";  // Roslyn default kind
+      if (IsKw("ascending")) Next();
+      else if (IsKw("descending")) { kind = "DescendingOrdering"; Next(); }
+      CsNode* ord = New(kind, ob);
+      CsAdopt(ord, expr);
+      CsAdopt(c, Finish(ord));
+    } while (Accept(","));
+    return Finish(c);
+  }
+
   CsNode* ParseAssignment() {
+    if (IsKw("from") && QueryAhead()) return ParseQueryExpression();
     int begin = Pos();
     CsNode* lhs = ParseConditional();
     std::string_view t = Cur().kind == Tok::kPunct ? Cur().text

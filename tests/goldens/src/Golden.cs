@@ -83,6 +83,16 @@ namespace Golden
             return this.quantities.Where(q => q > 0).ToList();
         }
 
+
+        public List<string> TopSkuNames(int minCount)
+        {
+            var top = from pair in this.skuCounts
+                      where pair.Value >= minCount
+                      orderby pair.Value descending, pair.Key
+                      select pair.Key;
+            return top.ToList();
+        }
+
         public void ResetAll()
         {
             while (this.quantities.Count > 0)

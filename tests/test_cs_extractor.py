@@ -300,8 +300,8 @@ def test_modern_csharp_constructs(extractor, cs_file):
 
 
 def test_per_member_recovery_skips_only_the_bad_member(cs_file):
-    # LINQ query syntax is documented out of scope; it must cost one
-    # member, not the file (the reference's Roslyn never hard-fails).
+    # An unparsable member must cost one member, not the file (the
+    # reference's Roslyn never hard-fails).
     code = """
 using System;
 using System.Linq;
@@ -314,9 +314,9 @@ namespace N
             return xs.Length;
         }
 
-        public object QueryItems(int[] xs)
+        public object BrokenItems(int[] xs)
         {
-            var q = from x in xs where x > 0 select x;
+            var q = xs |> ??! select;
             return q;
         }
 
@@ -338,6 +338,66 @@ namespace N
     assert "warning: skipped unparsable member" in proc.stderr
 
 
+LINQ_CS = """
+using System;
+using System.Linq;
+using System.Collections.Generic;
+public class Queries
+{
+    public List<string> AdultNames(List<Person> people)
+    {
+        var names = from p in people
+                    where p.Age >= 18
+                    orderby p.Name ascending, p.Age descending
+                    select p.Name;
+        return names.ToList();
+    }
+    public IEnumerable<int> JoinTotals(List<Item> items, List<Price> prices)
+    {
+        return from Item i in items
+               join Price pr in prices on i.Id equals pr.ItemId into g
+               from pp in g
+               let twice = pp.Value * 2
+               select twice + 1;
+    }
+    public object ByCity(List<Person> people)
+    {
+        return from p in people
+               group p by p.City into cityGroup
+               select cityGroup.Key;
+    }
+    public int NotAQuery(int from)
+    {
+        int x = from + 1;
+        return from - x;
+    }
+}
+"""
+
+
+def test_linq_query_expressions(extractor, cs_file):
+    """Query expressions parse whole into Roslyn-kind nodes (reference
+    consumes full Roslyn trees, CSharpExtractor/Extractor/Tree.cs:100-204);
+    an identifier merely named `from` must not trigger the query path."""
+    lines = extractor(cs_file(LINQ_CS), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["adult|names", "join|totals", "by|city", "not|a|query"]
+    by_name = dict(zip(names, lines))
+    for kind in ("QueryExpression", "FromClause", "QueryBody",
+                 "WhereClause", "OrderByClause", "AscendingOrdering",
+                 "DescendingOrdering", "SelectClause"):
+        assert kind in by_name["adult|names"], kind
+    for kind in ("JoinClause", "JoinIntoClause", "LetClause"):
+        assert kind in by_name["join|totals"], kind
+    for kind in ("GroupClause", "QueryContinuation"):
+        assert kind in by_name["by|city"], kind
+    # range variables are identifier leaves: `p` pairs into contexts
+    assert ",p " in by_name["adult|names"] or " p," in by_name["adult|names"]
+    # `from` used as a plain identifier stays an ordinary expression
+    assert "QueryExpression" not in by_name["not|a|query"]
+    assert "SubtractExpression" in by_name["not|a|query"]
+
+
 def test_adversarial_nesting_fails_cleanly(cs_file):
     """Pathological nesting -> clean error or per-member skip, never a
     SIGSEGV (parser DepthGuard + iterative CsCheckAstDepth)."""
@@ -353,6 +413,12 @@ def test_adversarial_nesting_fails_cleanly(cs_file):
                            + "}" * 50000 + " }"),
         "ctor_chain": ("class C { C() { int y = " + "1+" * 100000
                        + "1; } int Keep(){return 1;} }"),
+        # each `into` recurses ParseQueryBody once; must trip the
+        # DepthGuard, not the native stack
+        "query_into_chain": ("class C { object M(int[] xs) { var q = "
+                             "from x in xs select x "
+                             + "into a select a " * 100000
+                             + "; return q; } int Keep(){return 1;} }"),
     }
     for name, src in cases.items():
         proc = subprocess.run([BINARY, "--path", cs_file(src, f"{name}.cs")],

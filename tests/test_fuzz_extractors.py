@@ -28,6 +28,9 @@ SEEDS_CS = [
     ('class B<T> where T : struct { event System.EventHandler E; '
      'public static implicit operator int(B<T> b) => 0; }'),
     'class D { string V = @"verbatim ""q"" here"; int this[int i] => i; }',
+    ('class E { object Q(int[] xs, int[] ys) => from x in xs '
+     'join y in ys on x equals y into g orderby x descending '
+     'let z = x + 1 group z by x into h select h.Key; }'),
 ]
 
 
