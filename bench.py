@@ -75,16 +75,19 @@ def _synthetic_batch(dims, b=BATCH, m=CONTEXTS):
                  for x in (src, pth, tgt, mask, labels, valid))
 
 
-def measure(batch_size: int = BATCH, contexts: int = CONTEXTS) -> dict:
+def measure(batch_size: int = BATCH, contexts: int = CONTEXTS,
+            target_vocab: int | None = None) -> dict:
     """Time the flagship train step; returns the result dict (the JSON
     contract's fields). Parameterized so experiments (e.g. the
-    MAX_CONTEXTS=500 stress config, BASELINE config #4) reuse the same
-    timing methodology."""
+    MAX_CONTEXTS=500 + enlarged-target-vocab stress config, BASELINE
+    config #4) reuse the same timing methodology."""
     from code2vec_tpu.config import Config
 
     config = Config(train_data_path_prefix="<bench>",
                     train_batch_size=batch_size, max_contexts=contexts,
                     compute_dtype="bfloat16")
+    if target_vocab is not None:
+        config.max_target_vocab_size = target_vocab
     from code2vec_tpu.training.state import dropout_rng
     state, train_step, dims = _build(config)
     batch = _synthetic_batch(dims, batch_size, contexts)
@@ -103,10 +106,14 @@ def measure(batch_size: int = BATCH, contexts: int = CONTEXTS) -> dict:
     float(loss)
     dt = time.perf_counter() - t0
 
+    import jax
+
     examples_per_sec = TIMED_STEPS * batch_size / dt
+    n_params = sum(p.size
+                   for p in jax.tree_util.tree_leaves(state.params)) // 10**6
     return {
         "metric": "java14m-scale train throughput, 1 chip "
-                  f"(batch {batch_size}, {contexts} ctx, 385M params, "
+                  f"(batch {batch_size}, {contexts} ctx, {n_params}M params, "
                   f"{config.compute_dtype})",
         "value": round(examples_per_sec, 1),
         "unit": "examples/sec",

@@ -30,11 +30,27 @@ if REPO not in sys.path:
 import bench  # noqa: E402
 
 
-def cp_dryrun_500() -> str:
-    """One manual-kernel train step with cp=2 at 500 contexts on a
-    virtual 8-device CPU mesh, in a clean subprocess (the parent may
-    already hold the TPU backend)."""
-    code = (
+def cp_dryrun_500(tp: int = 1, cp: int = 2, sparse: bool = False) -> str:
+    """One manual-kernel train step at 500 contexts on a virtual
+    8-device CPU mesh, in a clean subprocess (the parent may already
+    hold the TPU backend). tp/cp parameterized so the combined
+    BASELINE-config-#4 stressors (ctx500 x row-sharded tables x
+    context sharding, dense and sparse-Adam) are all exercised."""
+    code = _dryrun_code(tp=tp, cp=cp, sparse=sparse)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"tp{tp}cp{cp}{' sparse' if sparse else ''} dryrun failed:\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return proc.stdout.strip().splitlines()[-1]
+
+
+def _dryrun_code(tp: int, cp: int, sparse: bool) -> str:
+    dp = 8 // (tp * cp)
+    return (
         "import jax; jax.config.update('jax_platforms','cpu'); "
         "jax.config.update('jax_num_cpu_devices',8); "
         f"import sys; sys.path.insert(0, {REPO!r}); "
@@ -47,9 +63,10 @@ def cp_dryrun_500() -> str:
         "make_optimizer; "
         "from code2vec_tpu.training.step import TrainStepBuilder, "
         "device_put_batch; "
-        "plan = MeshPlan(dp=4, tp=1, cp=2); "
+        f"plan = MeshPlan(dp={dp}, tp={tp}, cp={cp}); "
         "config = Config(train_data_path_prefix='u', "
-        "compute_dtype='float32', dp=4, tp=1, cp=2, "
+        f"compute_dtype='float32', dp={dp}, tp={tp}, cp={cp}, "
+        f"use_sparse_embedding_update={sparse}, "
         "use_manual_tp_kernels=True, train_batch_size=8, max_contexts=500); "
         "config.verify(); "
         "dims = ModelDims(token_vocab_size=64, path_vocab_size=32, "
@@ -72,28 +89,34 @@ def cp_dryrun_500() -> str:
         "state, loss = step(state, *arrays, jax.random.PRNGKey(1)); "
         "loss = float(loss); "
         "assert np.isfinite(loss), loss; "
-        "print(f'cp2-ctx500 dryrun OK, loss={loss:.4f}')"
+        f"print(f'tp{tp}cp{cp}{'-sparse' if sparse else ''}-ctx500 "
+        "dryrun OK, loss={loss:.4f}')"
     )
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    proc = subprocess.run([sys.executable, "-c", code], env=env,
-                          capture_output=True, text=True, timeout=600)
-    if proc.returncode != 0:
-        raise RuntimeError(f"cp=2 dryrun failed:\n{proc.stdout}\n{proc.stderr}")
-    return proc.stdout.strip().splitlines()[-1]
+
+
+# 2x the reference's 261,245-entry target vocabulary: the 261,245-way
+# softmax becomes 522,490-way and the model grows ~100M params (~483M).
+BIG_TARGET_VOCAB = 522_490
 
 
 def main() -> None:
     r200 = bench.measure(contexts=200)
     r500 = bench.measure(contexts=500)
+    r500big = bench.measure(contexts=500, target_vocab=BIG_TARGET_VOCAB)
     dryrun = cp_dryrun_500()
+    dryrun_tp2cp2 = cp_dryrun_500(tp=2, cp=2)
+    dryrun_tp2cp2_sparse = cp_dryrun_500(tp=2, cp=2, sparse=True)
     out = {
         "ctx200": r200,
         "ctx500": r500,
+        "ctx500_big_target_vocab": r500big,
+        "big_target_vocab": BIG_TARGET_VOCAB,
         "throughput_ratio_500_over_200": round(r500["value"] / r200["value"], 4),
         "contexts_per_sec_ctx200": round(r200["value"] * 200, 1),
         "contexts_per_sec_ctx500": round(r500["value"] * 500, 1),
         "cp2_dryrun": dryrun,
+        "tp2cp2_dryrun": dryrun_tp2cp2,
+        "tp2cp2_sparse_dryrun": dryrun_tp2cp2_sparse,
     }
     path = os.path.join(REPO, "BENCH_CTX500.json")
     with open(path, "w") as f:
