@@ -1,0 +1,336 @@
+"""Preprocessing at java14m scale: ≥10M methods through shuffle ->
+histograms/sampling -> pack, in bounded memory.
+
+The reference sizes its pipeline for the 32 GB extracted java14m corpus
+(reference: README.md:69-75) and runs the raw train split through
+`shuf` + three awk histogram passes + preprocess.py sampling
+(reference: preprocess.sh:42-63). This bench proves the repo's
+equivalents handle that scale on one host: it synthesizes a multi-GB
+raw extractor-output corpus with java14m-like statistics (Zipf token/
+path/target draws over reference-sized vocabularies — 1.3M tokens,
+911K paths, 261K targets; method context counts lognormal around the
+corpus's observed shape), then drives each production phase in its own
+subprocess, recording wall time, lines/sec, and peak RSS:
+
+  generate -> external_shuffle (data/preprocess.py) -> preprocess
+  (histograms + vocab truncation + in-vocab sampling + dict pickling)
+  -> vocab build + pack_c2v (.c2vb memmap, data/packed.py)
+
+Writes `experiments/results/preprocess_scale.json` and refreshes
+`BENCH_PREPROCESS.md`. Usage:
+
+    python experiments/preprocess_bench.py [--methods 10000000]
+        [--root /root/pp_bench] [--mem_budget_gb 1.0]
+
+(`--methods 20000` for a quick smoke run; the committed numbers use the
+default 10M.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TOKEN_VOCAB = 1_301_136   # reference preprocess.sh:14-16 (java14m sizes)
+PATH_VOCAB = 911_417
+TARGET_VOCAB = 261_245
+
+_VERBS = ("get set is has add remove create build read write find count "
+          "sum total merge update delete init load store apply reset "
+          "compute parse format copy clear check make run open close "
+          "send push pop peek next prev map fold scan test").split()
+_NOUNS = ("value item node list name index count size user price order "
+          "key token path entry buffer cache state config result file "
+          "line word record field table row column batch stream event "
+          "task queue stack group label flag mode kind type id").split()
+
+
+def _zipf_ranks(rng, n_items: int, count: int, a: float = 1.3):
+    """`count` Zipf-ish ranks in [0, n_items): numpy's zipfian tail
+    clipped into range (rejection would be slow; clipping keeps the
+    head-heavy shape that matters for histogram/truncation realism)."""
+    import numpy as np
+    draws = rng.zipf(a, size=count)
+    return np.minimum(draws - 1, n_items - 1)
+
+
+def generate(root: str, n_methods: int, seed: int = 0, log=print) -> dict:
+    """Synthesize train/val/test raw splits; returns paths + stats.
+    Contexts are drawn from a pre-rendered pool (pool size caps distinct
+    context strings, as real corpora repeat contexts heavily); targets
+    come from a verb|noun|noun pool shaped like split method names."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    t0 = time.time()
+
+    pool_size = min(2_000_000, max(50_000, n_methods // 5))
+    toks = _zipf_ranks(rng, TOKEN_VOCAB, 2 * pool_size)
+    paths = _zipf_ranks(rng, PATH_VOCAB, pool_size)
+    pool = [f"t{a},p{c},t{b}" for a, b, c
+            in zip(toks[:pool_size], toks[pool_size:], paths)]
+    del toks, paths
+
+    name_pool_size = min(400_000, max(5_000, n_methods // 25))
+    v = rng.integers(0, len(_VERBS), name_pool_size)
+    n1 = rng.integers(0, len(_NOUNS), name_pool_size)
+    n2 = rng.integers(0, len(_NOUNS), name_pool_size)
+    names = [f"{_VERBS[a]}|{_NOUNS[b]}|{_NOUNS[c]}"
+             for a, b, c in zip(v, n1, n2)]
+    del v, n1, n2
+    log(f"  pools ready: {pool_size:,} contexts, {name_pool_size:,} names "
+        f"({time.time() - t0:.0f}s)")
+
+    os.makedirs(root, exist_ok=True)
+    splits = {"train": n_methods,
+              "val": max(1000, n_methods // 50),
+              "test": max(1000, n_methods // 50)}
+    out = {}
+    total_bytes = 0
+    for role, n in splits.items():
+        path = os.path.join(root, f"{role}.raw.txt")
+        out[role] = path
+        chunk = 65_536
+        with open(path, "w", buffering=16 * 1024 * 1024) as f:
+            done = 0
+            while done < n:
+                m = min(chunk, n - done)
+                # lognormal context counts, clipped to [1, 600]: most
+                # methods are small, a tail overflows max_contexts=200
+                # so the sampling tiers actually engage
+                ks = np.clip(rng.lognormal(3.1, 0.8, m).astype(np.int64),
+                             1, 600)
+                idx = rng.integers(0, pool_size, int(ks.sum()))
+                name_idx = rng.integers(0, name_pool_size, m)
+                pos = 0
+                rows = []
+                for j in range(m):
+                    k = int(ks[j])
+                    rows.append(names[name_idx[j]] + " " + " ".join(
+                        pool[i] for i in idx[pos:pos + k]))
+                    pos += k
+                f.write("\n".join(rows))
+                f.write("\n")
+                done += m
+        total_bytes += os.path.getsize(path)
+        log(f"  {role}: {n:,} methods, "
+            f"{os.path.getsize(path) / 1e9:.2f} GB")
+    meta = {"paths": out, "gen_wall_s": round(time.time() - t0, 1),
+            "total_bytes": total_bytes, "methods": splits}
+    with open(os.path.join(root, "gen_meta.json"), "w") as f:
+        json.dump(meta, f)  # lets --reuse resume after an interrupted run
+    return meta
+
+
+# ------------------------------------------------------- phase children
+# Each phase runs in its own subprocess so ru_maxrss is that phase's
+# peak, not the generator's.
+
+def _child_shuffle(args) -> dict:
+    from code2vec_tpu.data.preprocess import external_shuffle
+    t0 = time.time()
+    external_shuffle(args.input, seed=0,
+                     mem_budget_bytes=int(args.mem_budget_gb * (1 << 30)),
+                     log=lambda m: print(m, file=sys.stderr))
+    return {"wall_s": round(time.time() - t0, 1)}
+
+
+def _child_preprocess(args) -> dict:
+    from code2vec_tpu.data.preprocess import preprocess
+    t0 = time.time()
+    preprocess(args.input, args.val, args.test, args.output,
+               max_contexts=200, word_vocab_size=TOKEN_VOCAB,
+               path_vocab_size=PATH_VOCAB, target_vocab_size=TARGET_VOCAB,
+               log=lambda m: print(m, file=sys.stderr))
+    return {"wall_s": round(time.time() - t0, 1)}
+
+
+def _child_pack(args) -> dict:
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.data.packed import pack_c2v
+    from code2vec_tpu.vocab import Code2VecVocabs
+    t0 = time.time()
+    config = Config(train_data_path_prefix=args.output)
+    vocabs = Code2VecVocabs.load_or_create(config)
+    tv = time.time() - t0
+    pack_c2v(args.output + ".train.c2v", vocabs, 200)
+    return {"wall_s": round(time.time() - t0, 1),
+            "vocab_build_s": round(tv, 1)}
+
+
+def _run_phase(name: str, argv: list, log=print) -> dict:
+    log(f"[{name}] ...")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--phase", name] + argv,
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"phase {name} failed:\n{proc.stderr[-4000:]}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    log(f"[{name}] {result}")
+    return result
+
+
+def write_report(results: dict, path: str) -> None:
+    d = results
+    ph = d["phases"]
+    lines = [
+        "# BENCH_PREPROCESS: offline preprocessing at java14m scale",
+        "",
+        "The reference pipeline is sized for the 32 GB extracted java14m",
+        "corpus (reference README.md:69-75): raw extractor output is piped",
+        "through `shuf`, three awk histogram passes, and preprocess.py's",
+        "context sampling (preprocess.sh:42-63). This bench drives the",
+        "repo's equivalents over a synthesized raw corpus with java14m-like",
+        "statistics (Zipf draws over the reference vocab sizes: 1.3M",
+        "tokens / 911K paths / 261K targets) and records each production",
+        "phase's wall time, throughput, and peak RSS — every phase runs in",
+        "bounded memory regardless of corpus size (the external shuffle",
+        "spills to disk buckets; histograms hold only vocab-sized dicts).",
+        "",
+        f"Corpus: **{d['methods']['train']:,} train methods** "
+        f"({d['total_bytes'] / 1e9:.2f} GB raw across splits), generated "
+        f"in {d['gen_wall_s']}s.",
+        "",
+        "| phase | wall | lines/sec | MB/sec | peak RSS |",
+        "|---|---|---|---|---|",
+    ]
+    train_n = d["methods"]["train"]
+    train_b = d["train_bytes"]
+    all_n = sum(d["methods"].values())
+    # per-phase work: preprocess reads the train split twice (histograms,
+    # then sampling) plus val/test once each; pack reads the sampled .c2v
+    phase_work = {
+        "shuffle": (train_n, train_b),
+        "preprocess": (train_n * 2 + (all_n - train_n),
+                       train_b * 2 + (d["total_bytes"] - train_b)),
+        "pack": (train_n, d["c2v_bytes"]),
+    }
+    for name in ("shuffle", "preprocess", "pack"):
+        p = ph[name]
+        n_lines, n_bytes = phase_work[name]
+        lines.append(
+            f"| {name} | {p['wall_s']}s | "
+            f"{n_lines / max(p['wall_s'], 1e-9):,.0f} | "
+            f"{n_bytes / 1e6 / max(p['wall_s'], 1e-9):,.0f} | "
+            f"{p['max_rss_gb']:.2f} GB |")
+    lines += [
+        "",
+        "(preprocess counts all three splits' lines; shuffle/pack count",
+        "the train split. The shuffle's peak RSS stays near the configured",
+        f"budget of {d['mem_budget_gb']} GB — the round-3 `readlines()`",
+        "implementation would have needed the whole raw split in RAM.)",
+        "",
+        f"Packed train split: `{d['packed_bytes'] / 1e9:.2f}` GB of int32",
+        "memmap (+targets sidecar), ready for the zero-copy training path.",
+        "",
+        "Raw numbers: `experiments/results/preprocess_scale.json`.",
+        "Reproduce: `python experiments/preprocess_bench.py` (deterministic",
+        "seed; ~15 min of measured phases on one core, dominated by the",
+        "histogram and sampling passes that the reference runs as",
+        "awk/python too).",
+        "",
+    ]
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--methods", type=int, default=10_000_000)
+    p.add_argument("--root", default="/root/pp_bench")
+    p.add_argument("--mem_budget_gb", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the generated corpus (default: delete "
+                        "artifacts afterwards to reclaim disk)")
+    p.add_argument("--reuse", action="store_true",
+                   help="reuse an already-generated corpus at --root "
+                        "(resume after an interrupted run)")
+    # internal: phase children
+    p.add_argument("--phase", choices=["shuffle", "preprocess", "pack"])
+    p.add_argument("--input")
+    p.add_argument("--val")
+    p.add_argument("--test")
+    p.add_argument("--output")
+    args = p.parse_args(argv)
+
+    if args.phase:
+        result = {"shuffle": _child_shuffle, "preprocess": _child_preprocess,
+                  "pack": _child_pack}[args.phase](args)
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        result["max_rss_gb"] = round(rss_kb / (1 << 20), 3)
+        print(json.dumps(result))
+        return
+
+    log = print
+    created_root = not os.path.exists(args.root)
+    meta_path = os.path.join(args.root, "gen_meta.json")
+    if args.reuse and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            gen = json.load(f)
+        log(f"Reusing generated corpus at {args.root} "
+            f"({gen['methods']['train']:,} train methods)")
+    else:
+        log(f"Generating {args.methods:,}-method raw corpus at "
+            f"{args.root}...")
+        gen = generate(args.root, args.methods, seed=args.seed, log=log)
+    train_raw = gen["paths"]["train"]
+    output = os.path.join(args.root, "java14m_like")
+
+    phases = {}
+    phases["shuffle"] = _run_phase(
+        "shuffle", ["--input", train_raw,
+                    "--mem_budget_gb", str(args.mem_budget_gb)], log=log)
+    phases["preprocess"] = _run_phase(
+        "preprocess", ["--input", train_raw, "--val", gen["paths"]["val"],
+                       "--test", gen["paths"]["test"],
+                       "--output", output], log=log)
+    phases["pack"] = _run_phase("pack", ["--output", output], log=log)
+
+    results = {
+        "methods": gen["methods"],
+        "gen_wall_s": gen["gen_wall_s"],
+        "total_bytes": gen["total_bytes"],
+        "train_bytes": os.path.getsize(train_raw),
+        "c2v_bytes": os.path.getsize(output + ".train.c2v"),
+        "packed_bytes": os.path.getsize(output + ".train.c2vb"),
+        "mem_budget_gb": args.mem_budget_gb,
+        "vocab_sizes": {"tokens": TOKEN_VOCAB, "paths": PATH_VOCAB,
+                        "targets": TARGET_VOCAB},
+        "phases": phases,
+    }
+    os.makedirs(os.path.join(REPO, "experiments", "results"), exist_ok=True)
+    with open(os.path.join(REPO, "experiments", "results",
+                           "preprocess_scale.json"), "w") as f:
+        json.dump(results, f, indent=2)
+    if args.methods >= 10_000_000:
+        write_report(results, os.path.join(REPO, "BENCH_PREPROCESS.md"))
+    if not args.keep:
+        if created_root:
+            import shutil
+            shutil.rmtree(args.root, ignore_errors=True)
+        else:
+            # pre-existing --root may hold unrelated data: delete only
+            # the artifacts this bench created
+            import glob
+            for pattern in ("train.raw.txt*", "val.raw.txt*",
+                            "test.raw.txt*", "java14m_like.*",
+                            "gen_meta.json"):
+                for f in glob.glob(os.path.join(args.root, pattern)):
+                    os.unlink(f)
+    print(json.dumps({"methods": args.methods,
+                      "phases": {k: v["wall_s"] for k, v in phases.items()},
+                      "peak_rss_gb": {k: v["max_rss_gb"]
+                                      for k, v in phases.items()}}))
+
+
+if __name__ == "__main__":
+    main()
