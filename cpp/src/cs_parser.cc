@@ -890,6 +890,10 @@ class Parser {
   // targets `(int a, string b) = ...` (DeclarationExpression with
   // SingleVariableDesignation) — Roslyn node shapes throughout.
   CsNode* ParseTupleArgValue() {
+    // a parenthesized query `(from v in ...)` would otherwise be eaten
+    // by the declaration-expression speculation below (`from` parses as
+    // a type, `v` as its designation)
+    if (IsKw("from") && QueryAhead()) return ParseExpression();
     size_t save = p_;
     int begin = Pos();
     try {

@@ -54,7 +54,8 @@ def build_dataset(root: str, language: str = "java", scale: int = 1,
     for role in ("train", "val", "test"):
         raws[role] = extract_dir(
             dirs[role], os.path.join(root, f"{role}.raw.txt"),
-            language=language, num_threads=16, shuffle=(role == "train"))
+            language=language, num_threads=16, shuffle=(role == "train"),
+            num_workers=min(4, os.cpu_count() or 1))
     prefix = os.path.join(root, _prefix_name(language))
     # .train.c2v must pair with "val" for mid-training eval, as the
     # reference trains with --test pointed at the val split (train.sh:13).

@@ -98,6 +98,21 @@ def _translate_line(line: str) -> str:
 
 def _translate_body(body: Sequence[str]) -> List[str]:
     out = list(body)
+    # fam_filter renders as a LINQ query expression — idiomatic C# for
+    # exactly this shape, and it exercises the extractor's query-syntax
+    # grammar in the end-to-end pipeline. The translation is a
+    # deterministic, injective function of the same (field, cond) draw
+    # the Java loop renders, so the conditional name distribution — and
+    # therefore the Bayes ceiling — is unchanged.
+    for i in range(len(out) - 6):
+        m = re.match(r"for \(int v : (this\.\w+)\) \{", out[i + 1])
+        c = re.match(r"    if \((.+)\) \{", out[i + 2])
+        if (out[i] == "List<Integer> out = new ArrayList<>();" and m and c
+                and out[i + 3] == "        out.add(v);"
+                and out[i + 4:i + 7] == ["    }", "}", "return out;"]):
+            out[i:i + 7] = [f"return (from v in {m.group(1)} "
+                            f"where {c.group(1)} select v).ToList();"]
+            break
     # fam_lookup's null-checked variant is the one two-line pattern with
     # no direct C# equivalent: rewrite via TryGetValue.
     for i, line in enumerate(out[:-1]):

@@ -371,6 +371,10 @@ public class Queries
         int x = from + 1;
         return from - x;
     }
+    public List<int> ParenQuery(List<int> xs)
+    {
+        return (from v in xs where v < 10 select v).ToList();
+    }
 }
 """
 
@@ -381,7 +385,8 @@ def test_linq_query_expressions(extractor, cs_file):
     an identifier merely named `from` must not trigger the query path."""
     lines = extractor(cs_file(LINQ_CS), "--no_hash")
     names = [ln.split(" ", 1)[0] for ln in lines]
-    assert names == ["adult|names", "join|totals", "by|city", "not|a|query"]
+    assert names == ["adult|names", "join|totals", "by|city", "not|a|query",
+                     "paren|query"]
     by_name = dict(zip(names, lines))
     for kind in ("QueryExpression", "FromClause", "QueryBody",
                  "WhereClause", "OrderByClause", "AscendingOrdering",
@@ -396,6 +401,9 @@ def test_linq_query_expressions(extractor, cs_file):
     # `from` used as a plain identifier stays an ordinary expression
     assert "QueryExpression" not in by_name["not|a|query"]
     assert "SubtractExpression" in by_name["not|a|query"]
+    # `(from v in ...)` must survive the declaration-expression
+    # speculation in the parenthesized/tuple argument path
+    assert "QueryExpression" in by_name["paren|query"]
 
 
 def test_adversarial_nesting_fails_cleanly(cs_file):
