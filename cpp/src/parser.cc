@@ -193,6 +193,14 @@ class Parser {
         // `default` only a modifier inside interfaces; as a statement
         // keyword it appears in switch which never reaches here.
         Next();
+      } else if (SealedModifierAhead()) {
+        // sealed / non-sealed (Java 17): contextual, consumed like the
+        // other modifiers (alpha.4 drops modifiers from the tree)
+        if (IsKw("non")) {
+          Next();
+          Next();
+        }
+        Next();
       } else if (Is("@") && !(LookAhead(1).kind == Tok::kIdent &&
                               LookAhead(1).text == "interface")) {
         annotations.push_back(ParseAnnotation());
@@ -201,6 +209,28 @@ class Parser {
       }
     }
     return annotations;
+  }
+
+  // `sealed` only acts as a modifier when more modifiers or a type
+  // keyword follow (so a type/variable merely named `sealed` — legal
+  // pre-17 Java — cannot misfire); `non-sealed` lexes as three tokens.
+  bool SealedModifierAhead() const {
+    size_t k = 0;
+    if (IsKw("non") && LookAhead(1).kind == Tok::kPunct &&
+        LookAhead(1).text == "-" && LookAhead(2).kind == Tok::kIdent &&
+        LookAhead(2).text == "sealed") {
+      k = 3;
+    } else if (IsKw("sealed")) {
+      k = 1;
+    } else {
+      return false;
+    }
+    const Token& after = LookAhead(k);
+    if (after.kind != Tok::kIdent) return after.kind == Tok::kPunct &&
+                                          after.text == "@";
+    return IsModifierName(after.text) || after.text == "class" ||
+           after.text == "interface" || after.text == "record" ||
+           after.text == "sealed" || after.text == "non";
   }
 
   std::vector<Node*> ParseAnnotations() {
@@ -385,12 +415,46 @@ class Parser {
     if (IsKw("class") || IsKw("interface"))
       return ParseClassOrInterfaceDecl(begin, annotations);
     if (IsKw("enum")) return ParseEnumDecl(begin, annotations);
+    if (RecordAhead()) return ParseRecordDecl(begin, annotations);
     if (Is("@")) {  // @interface
       Next();
       ExpectKw("interface");
       return ParseAnnotationDecl(begin, annotations);
     }
     Fail("expected type declaration");
+  }
+
+  // `record` is contextual (Java 16): it starts a record declaration
+  // only when followed by an identifier and a `(` or `<`; anywhere
+  // else it stays an ordinary identifier.
+  bool RecordAhead() const {
+    return IsKw("record") && LookAhead(1).kind == Tok::kIdent &&
+           LookAhead(2).kind == Tok::kPunct &&
+           (LookAhead(2).text == "(" || LookAhead(2).text == "<");
+  }
+
+  // Record declaration (Java 16). The reference's JavaParser
+  // 3.0.0-alpha.4 predates records entirely; kinds follow modern
+  // JavaParser (RecordDeclaration, components as Parameters) the same
+  // way the other beyond-alpha.4 constructs do.
+  Node* ParseRecordDecl(int begin, std::vector<Node*>& annotations) {
+    Next();  // record
+    Node* decl = New("RecordDeclaration", begin);
+    for (Node* a : annotations) Adopt(decl, a);
+    int nb = Pos();
+    decl->name = ExpectIdent();
+    Adopt(decl, MakeNameExpr(nb, decl->name));
+    if (Is("<")) {
+      for (Node* tp : ParseTypeParameters()) Adopt(decl, tp);
+    }
+    ParseParamsInto(decl);  // record components
+    if (AcceptKw("implements")) {
+      do {
+        Adopt(decl, ParseClassOrInterfaceType());
+      } while (Accept(","));
+    }
+    ParseClassBody(decl);
+    return Finish(decl);
   }
 
   Node* ParseClassOrInterfaceDecl(int begin, std::vector<Node*>& annotations) {
@@ -415,6 +479,11 @@ class Parser {
       } while (Accept(","));
     }
     if (AcceptKw("implements")) {
+      do {
+        Adopt(decl, ParseClassOrInterfaceType());
+      } while (Accept(","));
+    }
+    if (AcceptKw("permits")) {  // sealed types (Java 17)
       do {
         Adopt(decl, ParseClassOrInterfaceType());
       } while (Accept(","));
@@ -551,6 +620,10 @@ class Parser {
         Adopt(decl, ParseEnumDecl(mb, mann));
         continue;
       }
+      if (RecordAhead()) {
+        Adopt(decl, ParseRecordDecl(mb, mann));
+        continue;
+      }
       // annotation member: Type name() default value;  |  field
       size_t save = p_;
       Node* type = TryParseType();
@@ -581,6 +654,7 @@ class Parser {
     if (IsKw("class") || IsKw("interface"))
       return ParseClassOrInterfaceDecl(begin, annotations);
     if (IsKw("enum")) return ParseEnumDecl(begin, annotations);
+    if (RecordAhead()) return ParseRecordDecl(begin, annotations);
     if (Is("@")) {
       Next();
       ExpectKw("interface");
@@ -595,6 +669,17 @@ class Parser {
     // generic method/ctor type parameters
     std::vector<Node*> type_params;
     if (Is("<")) type_params = ParseTypeParameters();
+    // compact record constructor: `Name { ... }` (Java 16)
+    if (IsIdent() && Cur().text == enclosing_name &&
+        LookAhead(1).kind == Tok::kPunct && LookAhead(1).text == "{") {
+      Node* ctor = New("CompactConstructorDeclaration", begin);
+      for (Node* a : annotations) Adopt(ctor, a);
+      int nb = Pos();
+      ctor->name = ExpectIdent();
+      Adopt(ctor, MakeNameExpr(nb, ctor->name));
+      Adopt(ctor, ParseBlock());
+      return Finish(ctor);
+    }
     // constructor?
     if (IsIdent() && Cur().text == enclosing_name &&
         LookAhead(1).kind == Tok::kPunct && LookAhead(1).text == "(") {
@@ -881,13 +966,18 @@ class Parser {
       Expect(";");
       return Finish(s);
     }
-    // local class
+    // local class / local record (Java 16)
     {
       size_t save = p_;
       std::vector<Node*> annotations = ParseModifiers();
       if (IsKw("class") || IsKw("interface")) {
         Node* s = Stmt("TypeDeclarationStmt", begin);
         Adopt(s, ParseClassOrInterfaceDecl(begin, annotations));
+        return Finish(s);
+      }
+      if (RecordAhead()) {
+        Node* s = Stmt("TypeDeclarationStmt", begin);
+        Adopt(s, ParseRecordDecl(begin, annotations));
         return Finish(s);
       }
       p_ = save;

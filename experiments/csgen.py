@@ -31,8 +31,10 @@ from experiments import javagen
 
 # Ordered: multi-token/structural rules before bare-identifier rules.
 _LINE_RULES = [
-    # fam_filter's accumulator: `out` is a reserved keyword in C#, and
-    # its allocation is the one empty-diamond ArrayList in the families
+    # fam_filter's accumulator (`out` is a reserved keyword in C#).
+    # Normally unreachable — _translate_body splices the whole filter
+    # body into a LINQ query first — but kept as the safety net should
+    # the family template and the splice pattern ever drift apart.
     (re.compile(r"List<Integer> out = new ArrayList<>\(\);"),
      "List<int> result = new List<int>();"),
     (re.compile(r"\bout\.add\("), "result.Add("),
@@ -167,7 +169,8 @@ def _render_method(name_parts, ret, params, body, rng) -> List[str]:
 def generate_class(rng: random.Random, nouns: List[str], class_name: str,
                    namespace: str, n_methods: int) -> str:
     fields = [javagen.Field(rng, nouns) for _ in range(rng.randint(3, 8))]
-    lines = ["using System;", "using System.Collections.Generic;", "",
+    lines = ["using System;", "using System.Collections.Generic;",
+             "using System.Linq;", "",
              f"namespace {namespace}", "{",
              f"    public class {class_name}", "    {"]
     for f in fields:

@@ -259,6 +259,79 @@ public class Modern {
     assert any(",s " in ln or " s," in ln for ln in lines) or "s," in lines[3]
 
 
+def test_records_and_sealed_types(extractor, java_file):
+    """Records (Java 16) and sealed types (Java 17) parse whole — the
+    reference's JavaParser alpha.4 predates both, so kinds follow modern
+    JavaParser (RecordDeclaration, CompactConstructorDeclaration) like
+    the other beyond-alpha.4 constructs; `record`/`sealed` stay usable
+    as plain identifiers."""
+    code = """
+public sealed interface Shape permits Circle, Square {
+    double area();
+}
+
+record Point(int x, int y) implements Comparable<Point> {
+    Point {
+        if (x < 0) { throw new IllegalArgumentException("x"); }
+    }
+    public int manhattan() { return Math.abs(x) + Math.abs(y); }
+    public int compareTo(Point other) {
+        return this.manhattan() - other.manhattan();
+    }
+}
+
+final class Keeper {
+    int record = 3;
+    int useRecordAsName(int sealed) { int non = record - sealed; return non; }
+}
+"""
+    lines = extractor(java_file(code))
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["manhattan", "compare|to", "use|record|as|name"]
+    # record component identifiers participate in contexts
+    assert any(",x " in ln or " x," in ln for ln in lines)
+
+
+def test_nested_record_in_class(extractor, java_file):
+    code = """
+public class Outer {
+    private record Pair(String key, int value) {
+        public String render() { return key + "=" + value; }
+    }
+    public String show() { return new Pair("a", 1).render(); }
+}
+"""
+    lines = extractor(java_file(code))
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["render", "show"]
+
+
+def test_local_record_in_method_body(extractor, java_file):
+    """A local record (Java 16) must not cost the enclosing method."""
+    code = """
+public class C {
+    public int useLocal() {
+        record Local(int x, int y) { int sum() { return x + y; } }
+        return new Local(1, 2).sum();
+    }
+    int keep() { return 1; }
+}
+"""
+    lines = extractor(java_file(code))
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["use|local", "sum", "keep"]
+
+
+def test_record_inside_annotation_decl(extractor, java_file):
+    code = """
+@interface Outer {
+    record R(int x) { int half() { return x / 2; } }
+}
+"""
+    lines = extractor(java_file(code))
+    assert [ln.split(" ", 1)[0] for ln in lines] == ["half"]
+
+
 def test_yield_with_parenthesized_expression(extractor, java_file):
     """`yield (a + b);` inside a switch body is a YieldStmt (JLS 14.21:
     a statement starting with `yield` is a yield statement there), while

@@ -22,6 +22,9 @@ SEEDS_JAVA = [
     ('public class C<T extends Comparable<? super T>> '
      '{ java.util.Map<String, java.util.List<int[]>> m; '
      'void f() { l: for (;;) break l; } }'),
+    ('sealed interface S permits R {} '
+     'record R(int a, String b) implements S { '
+     'R { if (a < 0) { a = 0; } } int twice() { return a * 2; } }'),
 ]
 SEEDS_CS = [
     'class A { string S = $"interp {1+1} tail"; int F() => 2; }',
