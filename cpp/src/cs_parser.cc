@@ -455,10 +455,78 @@ class Parser {
     SkipModifiers();
     if (IsKw("class") || IsKw("struct") || IsKw("interface"))
       return ParseTypeDeclaration(begin, attrs);
+    if (CsRecordAhead()) return ParseRecordDeclaration(begin, attrs);
     if (IsKw("enum")) return ParseEnumDeclaration(begin, attrs);
     if (IsKw("delegate")) return ParseDelegateDeclaration(begin, attrs);
     if (top_level) Fail("expected type declaration");
     return ParseMemberRest(begin, attrs);
+  }
+
+  // `record` (C#9/10) is contextual: it starts a record type only when
+  // followed by `class`/`struct` or by a name that looks like a type
+  // header — so fields/locals/parameters merely named `record` (legal
+  // pre-C#9) keep parsing as ordinary identifiers.
+  bool CsRecordAhead() const {
+    if (!IsKw("record")) return false;
+    const CsToken& t1 = LookAhead(1);
+    if (t1.kind != Tok::kIdent) return false;
+    if (t1.text == "class" || t1.text == "struct") return true;
+    if (IsCsKeyword(t1.text)) return false;
+    const CsToken& t2 = LookAhead(2);
+    return t2.kind == Tok::kPunct &&
+           (t2.text == "(" || t2.text == "{" || t2.text == "<" ||
+            t2.text == ":" || t2.text == ";");
+  }
+
+  // Record types with primary constructors (Roslyn RecordDeclaration /
+  // RecordStructDeclaration; the components are a ParameterList child,
+  // base types with arguments are PrimaryConstructorBaseType). The
+  // reference consumes these via Roslyn's own trees, so parsing them
+  // whole is the parity-preserving behavior.
+  CsNode* ParseRecordDeclaration(int begin, std::vector<CsNode*>& attrs) {
+    Next();  // record
+    const char* kind = "RecordDeclaration";
+    if (IsKw("struct")) {
+      kind = "RecordStructDeclaration";
+      Next();
+    } else if (IsKw("class")) {
+      Next();
+    }
+    CsNode* decl = New(kind, begin);
+    for (CsNode* a : attrs) CsAdopt(decl, a);
+    AttachIdent(decl);
+    if (Is("<")) CsAdopt(decl, ParseTypeParameterList());
+    if (Is("(")) CsAdopt(decl, ParseParameterList());
+    ParseBaseListInto(decl, /*allow_primary_ctor_args=*/true);
+    while (IsKw("where")) CsAdopt(decl, ParseConstraintClause());
+    if (Accept(";")) return Finish(decl);  // body-less positional record
+    ParseTypeBody(decl);
+    return Finish(decl);
+  }
+
+  // `: Base1, I2, ...`; with allow_primary_ctor_args, `: Base(args)`
+  // becomes PrimaryConstructorBaseType (record primary-ctor forwarding).
+  void ParseBaseListInto(CsNode* decl, bool allow_primary_ctor_args) {
+    if (!Accept(":")) return;
+    int bb = Pos();
+    CsNode* bases = New("BaseList", bb);
+    do {
+      int sb = Pos();
+      CsNode* type = ParseType();
+      CsNode* base;
+      if (allow_primary_ctor_args && Is("(")) {
+        base = New("PrimaryConstructorBaseType", sb);
+        CsAdopt(base, type);
+        CsAdopt(base, ParseArgumentList());
+      } else {
+        base = New("SimpleBaseType", sb);
+        CsAdopt(base, type);
+      }
+      Finish(base);
+      CsAdopt(bases, base);
+    } while (Accept(","));
+    Finish(bases);
+    CsAdopt(decl, bases);
   }
 
   CsNode* ParseTypeDeclaration(int begin, std::vector<CsNode*>& attrs) {
@@ -470,20 +538,13 @@ class Parser {
     for (CsNode* a : attrs) CsAdopt(decl, a);
     AttachIdent(decl);
     if (Is("<")) CsAdopt(decl, ParseTypeParameterList());
-    if (Accept(":")) {
-      int bb = Pos();
-      CsNode* bases = New("BaseList", bb);
-      do {
-        int sb = Pos();
-        CsNode* base = New("SimpleBaseType", sb);
-        CsAdopt(base, ParseType());
-        Finish(base);
-        CsAdopt(bases, base);
-      } while (Accept(","));
-      Finish(bases);
-      CsAdopt(decl, bases);
-    }
+    ParseBaseListInto(decl, /*allow_primary_ctor_args=*/false);
     while (IsKw("where")) CsAdopt(decl, ParseConstraintClause());
+    ParseTypeBody(decl);
+    return Finish(decl);
+  }
+
+  void ParseTypeBody(CsNode* decl) {
     Expect("{");
     while (!Accept("}")) {
       if (AtEof()) Fail("unterminated type body");
@@ -502,7 +563,6 @@ class Parser {
       }
     }
     Accept(";");
-    return Finish(decl);
   }
 
   void SkipBalancedMember(const char* why) {

@@ -406,6 +406,41 @@ def test_linq_query_expressions(extractor, cs_file):
     assert "QueryExpression" in by_name["paren|query"]
 
 
+def test_csharp_records(extractor, cs_file):
+    """C#9/10 record types parse whole (Roslyn RecordDeclaration /
+    RecordStructDeclaration with primary-constructor ParameterList);
+    `record` stays usable as an ordinary identifier."""
+    code = """
+using System;
+public record Person(string Name, int Age)
+{
+    public string Display() { return Name + ":" + Age; }
+}
+public record Student(string Name, int Age, string School)
+    : Person(Name, Age)
+{
+    public string Tag() { return School + "/" + Display(); }
+}
+public record struct Pt(int X, int Y)
+{
+    public int Dot(Pt o) { return X * o.X + Y * o.Y; }
+}
+public record Empty(int Value);
+public class Keep
+{
+    int record = 1;
+    int UseIt(int record) { return record + 1; }
+}
+"""
+    lines = extractor(cs_file(code), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["display", "tag", "dot", "use|it"]
+    by_name = dict(zip(names, lines))
+    # component identifiers used in bodies feed contexts as usual
+    assert ",name " in by_name["display"] or " name," in by_name["display"]
+    assert "school" in by_name["tag"]
+
+
 def test_adversarial_nesting_fails_cleanly(cs_file):
     """Pathological nesting -> clean error or per-member skip, never a
     SIGSEGV (parser DepthGuard + iterative CsCheckAstDepth)."""

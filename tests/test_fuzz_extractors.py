@@ -34,6 +34,8 @@ SEEDS_CS = [
     ('class E { object Q(int[] xs, int[] ys) => from x in xs '
      'join y in ys on x equals y into g orderby x descending '
      'let z = x + 1 group z by x into h select h.Key; }'),
+    ('record Base(string N); record Kid(string N, int A) : Base(N) '
+     '{ public int Twice() => A * 2; } record struct P(int X);'),
 ]
 
 
