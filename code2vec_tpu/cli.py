@@ -82,6 +82,10 @@ def arguments_parser() -> ArgumentParser:
                         help="touched-rows (lazy) Adam for the token/path "
                              "tables; wins at pod scale with the manual TP "
                              "kernels (see config.py)")
+    parser.add_argument("--rss_limit_gb", type=float, default=0.0,
+                        help="checkpoint-and-stop (like SIGTERM "
+                             "preemption) when process peak RSS crosses "
+                             "this many GB; 0 disables")
     parser.add_argument("--profile_dir", metavar="DIR",
                         help="write a jax.profiler trace of train batches "
                              "10-20 to DIR (TensorBoard/Perfetto viewable)")
@@ -111,6 +115,7 @@ def config_from_args(argv=None) -> Config:
         seed=args.seed,
         use_packed_data=not args.no_packed_data,
         use_manual_tp_kernels=not args.gspmd,
+        rss_limit_gb=args.rss_limit_gb,
         profile_dir=args.profile_dir,
     )
     if args.batch_size:

@@ -30,6 +30,11 @@ class Config:
     # window; training/loop.py PreemptionWatcher). No reference analog —
     # the reference loses the epoch in progress on preemption.
     save_on_preemption: bool = True
+    # Host-memory watchdog: when process peak RSS crosses this many GB
+    # the trainer checkpoints and stops via the same path as SIGTERM
+    # (clean resumable stop instead of a kernel OOM kill mid-epoch).
+    # 0 disables. No reference analog.
+    rss_limit_gb: float = 0.0
     train_batch_size: int = 1024
     test_batch_size: int = 1024
     top_k_words_considered_during_prediction: int = 10
@@ -260,6 +265,8 @@ class Config:
                                           "unsafe_rbg"):
             raise ValueError(
                 "dropout_prng_impl must be rbg, threefry2x32 or unsafe_rbg.")
+        if self.rss_limit_gb < 0:
+            raise ValueError("rss_limit_gb must be >= 0 (0 disables).")
 
     # ---------------------------------------------------------------- logging
 

@@ -18,7 +18,9 @@ fire exactly once per pass regardless of how many rows the filter drops.
 
 from __future__ import annotations
 
+import resource
 import signal
+import sys
 import threading
 import time
 from typing import Callable, Iterable, Optional
@@ -42,6 +44,20 @@ _THROUGHPUT_EMA_ALPHA = 0.5
 # path); SIGTERM grace windows are tens of seconds, ~10 batches is
 # well under one.
 _PREEMPT_SYNC_EVERY = 10
+
+_PAGE_SIZE = resource.getpagesize()
+
+
+def current_rss_bytes() -> int:
+    """Current (not peak) resident set size. /proc/self/statm on Linux;
+    falls back to getrusage peak elsewhere (ru_maxrss is KB on Linux,
+    bytes on macOS)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak if sys.platform == "darwin" else peak * 1024
 
 
 class PreemptionWatcher:
@@ -153,22 +169,52 @@ class Trainer:
         watcher = None
         if getattr(config, "save_on_preemption", True):
             watcher = PreemptionWatcher(log).install()
+        # Host-memory watchdog (SURVEY §5 failure detection, same family
+        # as SIGTERM): when process peak RSS crosses the configured
+        # limit, ride the preemption path — checkpoint and stop cleanly
+        # instead of dying to the kernel OOM killer mid-epoch. Motivated
+        # by a real kill: a leaky host->device transfer stack (the axon
+        # dev tunnel) grew a 64x-scale run to 130 GB; with a limit the
+        # run would have saved and resumed instead of losing the epoch.
+        rss_limit_bytes = int(
+            float(getattr(config, "rss_limit_gb", 0.0) or 0.0) * (1 << 30))
+        rss_tripped = False
+
+        def local_stop_flag() -> bool:
+            """SIGTERM received, or current RSS over the limit (sticky
+            once tripped, so the multi-host OR below keeps agreeing on
+            every later poll; current — not peak — RSS, so a transient
+            startup spike below the limit cannot permanently trip a
+            resume cycle)."""
+            nonlocal rss_tripped
+            if watcher is not None and watcher.requested:
+                return True
+            if rss_limit_bytes > 0 and not rss_tripped:
+                rss = current_rss_bytes()
+                if rss > rss_limit_bytes:
+                    rss_tripped = True
+                    log(f"Host RSS {rss / (1 << 30):.2f} GB exceeds "
+                        f"rss_limit_gb="
+                        f"{rss_limit_bytes / (1 << 30):.2f}: will "
+                        f"checkpoint at the next step boundary and stop")
+            return rss_tripped
 
         def preemption_agreed(batch_num: int) -> bool:
             """Do ALL hosts agree to stop now? Single-process: the local
             flag, checked every step. Multi-process: the flag must be
-            reduced across hosts — SIGTERM lands at different wall times
-            per worker, and a host breaking out of the collective step
-            loop alone would deadlock the others — so every host ORs the
-            flags at the same fixed cadence (batch_num is lockstep)."""
-            if watcher is None:
+            reduced across hosts — SIGTERM/RSS pressure lands at
+            different wall times per worker, and a host breaking out of
+            the collective step loop alone would deadlock the others —
+            so every host ORs the flags at the same fixed cadence
+            (batch_num is lockstep)."""
+            if watcher is None and rss_limit_bytes <= 0:
                 return False
             if jax.process_count() == 1:
-                return watcher.requested
+                return local_stop_flag()
             if batch_num % _PREEMPT_SYNC_EVERY != 0:
                 return False
             from code2vec_tpu.parallel import distributed
-            flag = np.array([1.0 if watcher.requested else 0.0])
+            flag = np.array([1.0 if local_stop_flag() else 0.0])
             return bool(distributed.allreduce_host_scalars(flag)[0] > 0)
 
         def save_preempt(state, epoch):

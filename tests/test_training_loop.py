@@ -362,3 +362,56 @@ def test_release_loads_params_only_across_optimizer_mismatch(
     tok = "token_embedding"
     np.testing.assert_array_equal(np.asarray(restored.params[tok]),
                                   np.asarray(state.params[tok]))
+
+
+def test_rss_limit_checkpoints_and_stops(tiny_config):
+    """Peak RSS over config.rss_limit_gb -> same clean checkpoint-and-
+    stop as a SIGTERM preemption (host-memory watchdog; turns a kernel
+    OOM kill into a resumable stop)."""
+    tiny_config.num_train_epochs = 3
+    # any real process has peak RSS far above 1 MB: trips immediately
+    tiny_config.rss_limit_gb = 0.001
+    saves, steps = [], []
+
+    def stream():
+        for e in range(3):
+            for b in range(4):
+                yield _fake_batch()
+            yield EpochEnd(e + 1)
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, np.float32(1.0)
+
+    def save_fn(state, epoch, suffix=""):
+        saves.append((epoch, suffix))
+
+    logs = []
+    tiny_config.log = logs.append
+    trainer = Trainer(tiny_config, train_step, save_fn=save_fn)
+    trainer.train(_State(), stream(), rng=np.zeros((2,), np.uint32))
+
+    assert len(steps) == 1  # tripped at the first step boundary
+    assert trainer.preempted
+    assert saves == [(0, "_preempt")]
+    assert any("exceeds rss_limit_gb" in m for m in logs)
+
+
+def test_rss_limit_disabled_by_default(tiny_config):
+    """rss_limit_gb=0 (default): the watchdog never fires."""
+    tiny_config.num_train_epochs = 1
+    steps = []
+
+    def stream():
+        for b in range(4):
+            yield _fake_batch()
+        yield EpochEnd(1)
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, np.float32(1.0)
+
+    trainer = Trainer(tiny_config, train_step)
+    trainer.train(_State(), stream(), rng=np.zeros((2,), np.uint32))
+    assert len(steps) == 4
+    assert not trainer.preempted
