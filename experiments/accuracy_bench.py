@@ -85,7 +85,8 @@ def target_oov_rate(c2v_path: str, target_vocab) -> float:
 
 
 def run(root: str, epochs: int, patience: int, language: str = "java",
-        scale: int = 1, sparse: bool = False, log=print) -> dict:
+        scale: int = 1, sparse: bool = False, rss_limit_gb: float = 100.0,
+        log=print) -> dict:
     import jax
     import numpy as np
     from code2vec_tpu.config import Config
@@ -134,6 +135,13 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
         # contract as dense, proven here end to end rather than only by
         # the unit-level touched-row parity tests.
         use_sparse_embedding_update=sparse,
+        # Host-memory watchdog: the axon dev tunnel's client leaks host
+        # RAM ~1:1 with bytes transferred (see the 64x artifact's
+        # provenance note); a long scale run checkpoints and stops
+        # cleanly at this bound instead of dying to the OOM killer.
+        # A tripped run is recorded as rss_preempted in the artifact
+        # and never rewrites the report (truncated != converged).
+        rss_limit_gb=rss_limit_gb,
     )
     model = Code2VecModel(config)
 
@@ -187,6 +195,10 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
 
     out = {
         "language": language,
+        # True when the run was truncated by the host-memory watchdog
+        # (or SIGTERM): such an artifact is an undertrained point and
+        # must never be presented as a converged one.
+        "rss_preempted": bool(trainer.preempted),
         "optimizer": {"adam_mu_dtype": config.adam_mu_dtype,
                       "adam_nu_dtype": config.adam_nu_dtype,
                       "sparse_embedding_update": sparse},
@@ -437,6 +449,11 @@ def main(argv=None):
                         "for the embedding tables; results go to "
                         "accuracy[_...]_sparse.json, the main report is "
                         "left alone")
+    p.add_argument("--rss_limit_gb", type=float, default=100.0,
+                   help="checkpoint-and-stop when host RSS crosses this "
+                        "(the axon dev tunnel leaks RAM per transfer; "
+                        "a tripped run is marked rss_preempted and never "
+                        "rewrites the report); 0 disables")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
     args = p.parse_args(argv)
 
@@ -453,7 +470,8 @@ def main(argv=None):
 
     results = run(args.root, args.epochs, args.patience,
                   language=args.language, scale=args.scale,
-                  sparse=args.sparse_embedding_update)
+                  sparse=args.sparse_embedding_update,
+                  rss_limit_gb=args.rss_limit_gb)
     results["scale"] = args.scale
     os.makedirs(os.path.join(REPO, "experiments", "results"), exist_ok=True)
     name = "accuracy_cs.json" if args.language == "cs" else "accuracy.json"
@@ -466,7 +484,12 @@ def main(argv=None):
     with open(out_json, "w") as f:
         json.dump(results, f, indent=2)
     report = os.path.join(REPO, "BENCH_ACCURACY.md")
-    if args.scale != 1 or args.sparse_embedding_update:
+    if results["rss_preempted"]:
+        # truncated run: json (with its marker) only — an undertrained
+        # point must never rewrite the report as if converged
+        print("WARNING: run truncated by the host-memory watchdog; "
+              "report not rewritten", file=sys.stderr)
+    elif args.scale != 1 or args.sparse_embedding_update:
         pass  # scaling/sparse runs: json artifact only; summarized by hand
     elif args.language == "cs":
         append_cs_section(results, report)
