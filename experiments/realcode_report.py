@@ -1,0 +1,201 @@
+"""Run the native extractors over REAL (non-generated) code and report.
+
+The only real-world source trees mounted in this environment are the
+reference implementation's own extractors: ~860 LoC of Java
+(JavaExtractor/JPredict/src/main/java/JavaExtractor, minus the
+non-compiled Test.java fixture) and ~934 LoC of C#
+(CSharpExtractor/CSharpExtractor/Extractor, minus the non-compiled
+Temp.cs scratch file). Everything accuracy-related elsewhere in this
+repo runs on generated corpora; this script is the committed evidence of
+extractor behavior on code written by humans: parse rate, method counts
+cross-checked against the declarations in the sources, context volume,
+and any crashes or stderr-reported skips.
+
+Method-count ground truth: the expectations below were established by
+reading every file (see REALCODE.md). The reference extracts *methods*
+only — constructors are excluded (Java: FunctionVisitor.java:22-31
+visits MethodDeclaration nodes; C#: Extractor.cs:173-176 descends into
+MethodDeclarationSyntax) — so files containing only fields/constructors
+legitimately yield zero.
+
+Usage: python experiments/realcode_report.py  (writes REALCODE.md)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+JAVA_ROOT = os.path.join(
+    REF, "JavaExtractor/JPredict/src/main/java/JavaExtractor")
+CS_ROOT = os.path.join(REF, "CSharpExtractor/CSharpExtractor/Extractor")
+
+# method-name multiset expected per file (normalized, subtoken-joined),
+# read off the declarations in each source file. A mismatch means the
+# parser silently skipped (or hallucinated) a member on real code.
+EXPECTED_JAVA = {
+    "FeaturesEntities/ProgramRelation.java": [
+        "set|no|hash", "to|string", "get|path", "get|source", "get|target",
+        "get|hashed|path"],
+    "FeaturesEntities/ProgramFeatures.java": [
+        "to|string", "add|feature", "is|empty", "delete|all|paths",
+        "get|name", "get|features"],
+    "FeaturesEntities/ProgramNode.java": [],      # ctor only
+    "FeaturesEntities/Property.java": [
+        "get|raw|type", "get|type", "get|name"],
+    "FeatureExtractor.java": [
+        "extract|features", "parse|file|with|retries",
+        "generate|path|features", "generate|path|features|for|function",
+        "get|tree|stack", "generate|path", "saturate|child|id"],
+    "Visitors/FunctionVisitor.java": [
+        "visit", "visit|method", "get|method|length", "get|method|contents"],
+    "Visitors/LeavesCollectorVisitor.java": [
+        "process", "is|generic|parent", "has|no|children", "is|not|comment",
+        "get|leaves", "get|child|id"],
+    "ExtractFeaturesTask.java": [
+        "call", "process|file", "extract|single|file", "features|to|string"],
+    "Common/Common.java": [
+        "normalize|name", "is|method", "is|method", "split|to|subtokens"],
+    "Common/MethodContent.java": ["get|leaves", "get|name", "get|length"],
+    "Common/CommandLineValues.java": [],          # ctors + @Option fields
+    "App.java": ["main", "extract|dir"],
+}
+
+EXPECTED_CS = {
+    "Tree/Tree.cs": [
+        "is|scope|ender", "visit", "get|root", "equals", "get|hash|code",
+        "is|leaf|token", "to|dot"],
+    "Program.cs": ["extract|single|file", "main"],
+    "Variable.cs": [
+        "get|hash|code", "is|literal", "is|method|name",
+        "create|from|method"],
+    "PathFinder.cs": [
+        "get|depth", "first|ancestor", "collect|path|to|parent",
+        "find|path"],
+    "Utilities.cs": [
+        "choose",               # Choose2 -> digits stripped by NormalizeName
+        "reservoir|sample", "weak|concat", "split|to|subtokens",
+        "normalize|name"],
+    "Extractor.cs": [
+        "path|nodes|to|string", "get|truncated|child|id", "path|to|string",
+        "get|internal|paths", "split|name|unless|empty", "extract",
+        "maybe|hash"],
+}
+
+
+def run_extractor(cmd) -> tuple:
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    return proc.returncode, lines, proc.stderr.strip()
+
+
+def survey(language: str, root: str, expected: dict, make_cmd) -> dict:
+    rows, problems = [], []
+    for rel in sorted(expected):
+        path = os.path.join(root, rel)
+        rc, lines, err = run_extractor(make_cmd(path))
+        names = [ln.split(" ", 1)[0] for ln in lines]
+        contexts = [len(ln.split()) - 1 for ln in lines]
+        ok = rc == 0 and sorted(names) == sorted(expected[rel]) and not err
+        if rc != 0:
+            problems.append(f"{rel}: exit code {rc} ({err[:200]})")
+        elif err:
+            problems.append(f"{rel}: stderr: {err[:200]}")
+        elif sorted(names) != sorted(expected[rel]):
+            missing = set(expected[rel]) - set(names)
+            extra = set(names) - set(expected[rel])
+            problems.append(f"{rel}: missing={sorted(missing)} "
+                            f"extra={sorted(extra)}")
+        rows.append({
+            "file": rel, "rc": rc, "methods": len(lines),
+            "expected": len(expected[rel]), "contexts": sum(contexts),
+            "ok": ok})
+    total_m = sum(r["methods"] for r in rows)
+    total_c = sum(r["contexts"] for r in rows)
+    return {"language": language, "rows": rows, "problems": problems,
+            "files": len(rows),
+            "files_parsed": sum(r["rc"] == 0 for r in rows),
+            "methods": total_m, "contexts": total_c,
+            "contexts_per_method": total_c / max(total_m, 1)}
+
+
+def main() -> int:
+    java = survey(
+        "Java", JAVA_ROOT, EXPECTED_JAVA,
+        lambda p: [os.path.join(REPO, "cpp/build/c2v-extract"),
+                   "--max_path_length", "8", "--max_path_width", "2",
+                   "--file", p, "--no_hash"])
+    cs = survey(
+        "C#", CS_ROOT, EXPECTED_CS,
+        lambda p: [os.path.join(REPO, "cpp/build/c2v-extract-cs"),
+                   "--path", p, "--no_hash"])
+
+    # Hashed mode (the production default) must also parse everything.
+    hashed_problems = []
+    for rel in sorted(EXPECTED_JAVA):
+        rc, lines, err = run_extractor(
+            [os.path.join(REPO, "cpp/build/c2v-extract"),
+             "--max_path_length", "8", "--max_path_width", "2",
+             "--file", os.path.join(JAVA_ROOT, rel)])
+        if rc != 0 or len(lines) != len(EXPECTED_JAVA[rel]):
+            hashed_problems.append(f"java {rel}: rc={rc} methods={len(lines)}")
+    for rel in sorted(EXPECTED_CS):
+        rc, lines, err = run_extractor(
+            [os.path.join(REPO, "cpp/build/c2v-extract-cs"),
+             "--path", os.path.join(CS_ROOT, rel)])
+        if rc != 0 or len(lines) != len(EXPECTED_CS[rel]):
+            hashed_problems.append(f"cs {rel}: rc={rc} methods={len(lines)}")
+
+    out = os.path.join(REPO, "REALCODE.md")
+    with open(out, "w") as f:
+        f.write(
+            "# Native extractors on real code\n\n"
+            "Generated by `python experiments/realcode_report.py`. The only\n"
+            "non-generated source trees in this offline environment are the\n"
+            "reference implementation's own extractors; this is the committed\n"
+            "record of running our from-scratch C++ parsers over them, with\n"
+            "per-file method names cross-checked against the declarations in\n"
+            "the sources (constructors excluded, as the reference does —\n"
+            "FunctionVisitor.java:22-31, Extractor.cs:173-176).\n\n")
+        for s in (java, cs):
+            f.write(f"## {s['language']} "
+                    f"({'JavaExtractor' if s['language'] == 'Java' else 'CSharpExtractor'} sources)\n\n")
+            f.write("| file | methods (expected) | contexts | status |\n")
+            f.write("|---|---|---|---|\n")
+            for r in s["rows"]:
+                f.write(f"| {r['file']} | {r['methods']} ({r['expected']}) "
+                        f"| {r['contexts']} | "
+                        f"{'ok' if r['ok'] else 'MISMATCH'} |\n")
+            f.write(
+                f"\n**{s['files_parsed']}/{s['files']} files parsed, "
+                f"{s['methods']} methods, {s['contexts']} contexts "
+                f"({s['contexts_per_method']:.1f}/method), "
+                f"{len(s['problems'])} problems.**\n\n")
+            if s["problems"]:
+                for p in s["problems"]:
+                    f.write(f"- PROBLEM: {p}\n")
+                f.write("\n")
+        f.write("## Hashed mode (production default)\n\n")
+        if hashed_problems:
+            for p in hashed_problems:
+                f.write(f"- PROBLEM: {p}\n")
+        else:
+            f.write("Same parse + method counts with path hashing on "
+                    "(every file, both languages).\n")
+
+    print(f"wrote {out}")
+    nproblems = (len(java["problems"]) + len(cs["problems"])
+                 + len(hashed_problems))
+    for s in (java, cs):
+        for p in s["problems"]:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+    for p in hashed_problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    return 1 if nproblems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
