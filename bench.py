@@ -11,8 +11,13 @@ one V100 (reference: README.md:69,127) => ~4,700 examples/sec. BASELINE.json
 asks for >=10x on a v5e-16 pod; this script reports single-chip
 examples/sec, so vs_baseline is the per-chip speedup over one V100.
 
-Prints exactly ONE JSON line:
+Prints exactly ONE JSON line with the driver-contract fields
   {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+plus variance fields (value_min/value_max/n_windows/steps_per_window —
+`value` is the median of n_windows timed windows), the touched-rows
+sparse-Adam counterpart numbers (sparse_adam_*), and a
+`flagship_default` note recording which optimizer config the headline
+number stands for and why.
 """
 
 from __future__ import annotations
@@ -32,6 +37,8 @@ BATCH = 1024
 CONTEXTS = 200
 WARMUP_STEPS = 3
 TIMED_STEPS = 20
+N_WINDOWS = 5  # median-of-5: single-window numbers swung ±5% round to
+#                round over the tunneled dev chip (VERDICT r4 weak #3)
 
 
 def _build(config):
@@ -76,16 +83,25 @@ def _synthetic_batch(dims, b=BATCH, m=CONTEXTS):
 
 
 def measure(batch_size: int = BATCH, contexts: int = CONTEXTS,
-            target_vocab: int | None = None) -> dict:
+            target_vocab: int | None = None, n_windows: int = N_WINDOWS,
+            sparse: bool = False) -> dict:
     """Time the flagship train step; returns the result dict (the JSON
     contract's fields). Parameterized so experiments (e.g. the
     MAX_CONTEXTS=500 + enlarged-target-vocab stress config, BASELINE
-    config #4) reuse the same timing methodology."""
+    config #4) reuse the same timing methodology.
+
+    Variance handling: `n_windows` independent timed windows of
+    TIMED_STEPS each; `value` is the MEDIAN window's examples/sec, with
+    the min/max spread reported alongside (`value_min`/`value_max`).
+    The dev-chip tunnel adds 3-500 ms latency swings, so a single window
+    is only good to ~±5% — smaller than real round-over-round deltas we
+    care about."""
     from code2vec_tpu.config import Config
 
     config = Config(train_data_path_prefix="<bench>",
                     train_batch_size=batch_size, max_contexts=contexts,
-                    compute_dtype="bfloat16")
+                    compute_dtype="bfloat16",
+                    use_sparse_embedding_update=sparse)
     if target_vocab is not None:
         config.max_target_vocab_size = target_vocab
     from code2vec_tpu.training.state import dropout_rng
@@ -98,31 +114,54 @@ def measure(batch_size: int = BATCH, contexts: int = CONTEXTS,
     float(loss)  # host fetch: the only reliable completion barrier over the
     #              axon tunnel, where block_until_ready can return early.
 
-    t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
-        state, loss = train_step(state, *batch, rng)
-    # The final loss transitively depends on every prior donated-state
-    # update, so fetching it forces the full 20-step chain.
-    float(loss)
-    dt = time.perf_counter() - t0
+    window_rates = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        for _ in range(TIMED_STEPS):
+            state, loss = train_step(state, *batch, rng)
+        # The final loss transitively depends on every prior donated-state
+        # update, so fetching it forces the full window's step chain.
+        float(loss)
+        dt = time.perf_counter() - t0
+        window_rates.append(TIMED_STEPS * batch_size / dt)
+    window_rates.sort()
+    examples_per_sec = window_rates[len(window_rates) // 2]
 
     import jax
 
-    examples_per_sec = TIMED_STEPS * batch_size / dt
     n_params = sum(p.size
                    for p in jax.tree_util.tree_leaves(state.params)) // 10**6
     return {
         "metric": "java14m-scale train throughput, 1 chip "
                   f"(batch {batch_size}, {contexts} ctx, {n_params}M params, "
-                  f"{config.compute_dtype})",
+                  f"{config.compute_dtype}"
+                  f"{', sparse adam' if sparse else ''})",
         "value": round(examples_per_sec, 1),
         "unit": "examples/sec",
         "vs_baseline": round(examples_per_sec / V100_EXAMPLES_PER_SEC, 3),
+        "value_min": round(window_rates[0], 1),
+        "value_max": round(window_rates[-1], 1),
+        "n_windows": n_windows,
+        "steps_per_window": TIMED_STEPS,
     }
 
 
 def main() -> None:
-    print(json.dumps(measure()))
+    result = measure()
+    # Secondary: the touched-rows sparse-Adam step (the advertised
+    # pod-scale optimizer, config.use_sparse_embedding_update). Recorded
+    # here so its single-chip cost/benefit is a committed number, not a
+    # commit-message claim. Dense Adam stays the single-chip flagship
+    # default: it is the reference-faithful optimizer
+    # (tensorflow_model.py:231), while sparse-Adam's win is the multi-chip
+    # (ids,rows) gradient exchange replacing table-shaped psums
+    # (training/step.py _make_manual_sparse_train_step).
+    sparse_result = measure(sparse=True)
+    result["sparse_adam_examples_per_sec"] = sparse_result["value"]
+    result["sparse_adam_min"] = sparse_result["value_min"]
+    result["sparse_adam_max"] = sparse_result["value_max"]
+    result["flagship_default"] = "dense adam (reference-faithful; sparse is the pod-scale opt-in)"
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

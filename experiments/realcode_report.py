@@ -87,7 +87,14 @@ EXPECTED_CS = {
 
 
 def run_extractor(cmd) -> tuple:
-    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    """(rc, stdout lines, stderr). Launch failures and hangs come back as
+    rc=-1 problems instead of aborting the whole report — a crashing file
+    is exactly the evidence this script exists to record."""
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return -1, [], f"{type(e).__name__}: {e}"
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     return proc.returncode, lines, proc.stderr.strip()
 
@@ -99,20 +106,24 @@ def survey(language: str, root: str, expected: dict, make_cmd) -> dict:
         rc, lines, err = run_extractor(make_cmd(path))
         names = [ln.split(" ", 1)[0] for ln in lines]
         contexts = [len(ln.split()) - 1 for ln in lines]
-        ok = rc == 0 and sorted(names) == sorted(expected[rel]) and not err
         if rc != 0:
+            status = "ERROR"
             problems.append(f"{rel}: exit code {rc} ({err[:200]})")
-        elif err:
-            problems.append(f"{rel}: stderr: {err[:200]}")
         elif sorted(names) != sorted(expected[rel]):
+            status = "MISMATCH"
             missing = set(expected[rel]) - set(names)
             extra = set(names) - set(expected[rel])
             problems.append(f"{rel}: missing={sorted(missing)} "
                             f"extra={sorted(extra)}")
+        elif err:
+            status = "STDERR"
+            problems.append(f"{rel}: stderr: {err[:200]}")
+        else:
+            status = "ok"
         rows.append({
             "file": rel, "rc": rc, "methods": len(lines),
             "expected": len(expected[rel]), "contexts": sum(contexts),
-            "ok": ok})
+            "status": status})
     total_m = sum(r["methods"] for r in rows)
     total_c = sum(r["contexts"] for r in rows)
     return {"language": language, "rows": rows, "problems": problems,
@@ -122,32 +133,32 @@ def survey(language: str, root: str, expected: dict, make_cmd) -> dict:
             "contexts_per_method": total_c / max(total_m, 1)}
 
 
-def main() -> int:
-    java = survey(
-        "Java", JAVA_ROOT, EXPECTED_JAVA,
-        lambda p: [os.path.join(REPO, "cpp/build/c2v-extract"),
-                   "--max_path_length", "8", "--max_path_width", "2",
-                   "--file", p, "--no_hash"])
-    cs = survey(
-        "C#", CS_ROOT, EXPECTED_CS,
-        lambda p: [os.path.join(REPO, "cpp/build/c2v-extract-cs"),
-                   "--path", p, "--no_hash"])
-
-    # Hashed mode (the production default) must also parse everything.
-    hashed_problems = []
-    for rel in sorted(EXPECTED_JAVA):
-        rc, lines, err = run_extractor(
-            [os.path.join(REPO, "cpp/build/c2v-extract"),
+def java_cmd(path, no_hash: bool):
+    return ([os.path.join(REPO, "cpp/build/c2v-extract"),
              "--max_path_length", "8", "--max_path_width", "2",
-             "--file", os.path.join(JAVA_ROOT, rel)])
-        if rc != 0 or len(lines) != len(EXPECTED_JAVA[rel]):
-            hashed_problems.append(f"java {rel}: rc={rc} methods={len(lines)}")
-    for rel in sorted(EXPECTED_CS):
-        rc, lines, err = run_extractor(
-            [os.path.join(REPO, "cpp/build/c2v-extract-cs"),
-             "--path", os.path.join(CS_ROOT, rel)])
-        if rc != 0 or len(lines) != len(EXPECTED_CS[rel]):
-            hashed_problems.append(f"cs {rel}: rc={rc} methods={len(lines)}")
+             "--file", path] + (["--no_hash"] if no_hash else []))
+
+
+def cs_cmd(path, no_hash: bool):
+    return ([os.path.join(REPO, "cpp/build/c2v-extract-cs"),
+             "--path", path] + (["--no_hash"] if no_hash else []))
+
+
+def main() -> int:
+    java = survey("Java", JAVA_ROOT, EXPECTED_JAVA,
+                  lambda p: java_cmd(p, no_hash=True))
+    cs = survey("C#", CS_ROOT, EXPECTED_CS,
+                lambda p: cs_cmd(p, no_hash=True))
+
+    # Hashed mode (the production default) through the SAME survey —
+    # method names (column 1) are unhashed in either mode, so the
+    # name-multiset cross-check applies unchanged.
+    java_hashed = survey("Java", JAVA_ROOT, EXPECTED_JAVA,
+                         lambda p: java_cmd(p, no_hash=False))
+    cs_hashed = survey("C#", CS_ROOT, EXPECTED_CS,
+                       lambda p: cs_cmd(p, no_hash=False))
+    hashed_problems = (["java " + p for p in java_hashed["problems"]]
+                       + ["cs " + p for p in cs_hashed["problems"]])
 
     out = os.path.join(REPO, "REALCODE.md")
     with open(out, "w") as f:
@@ -167,8 +178,7 @@ def main() -> int:
             f.write("|---|---|---|---|\n")
             for r in s["rows"]:
                 f.write(f"| {r['file']} | {r['methods']} ({r['expected']}) "
-                        f"| {r['contexts']} | "
-                        f"{'ok' if r['ok'] else 'MISMATCH'} |\n")
+                        f"| {r['contexts']} | {r['status']} |\n")
             f.write(
                 f"\n**{s['files_parsed']}/{s['files']} files parsed, "
                 f"{s['methods']} methods, {s['contexts']} contexts "
