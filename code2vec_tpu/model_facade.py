@@ -280,33 +280,20 @@ class Code2VecModel:
         # reference keeps MAX_TO_KEEP epoch checkpoints (config.py:57).
         config = self.config
         pattern = f"{config.model_save_path}_iter*"
-        def epoch_of(p):
-            try:
-                return int(p.rsplit("_iter", 1)[1])
-            except ValueError:
-                return -1
-        paths = sorted((p for p in glob.glob(pattern) if epoch_of(p) >= 0),
-                       key=epoch_of)
-        for stale in paths[:-config.max_to_keep]:
+        parsed = {p: ckpt_mod.parse_iter_name(p)
+                  for p in glob.glob(pattern)}
+        clean = sorted((p for p, v in parsed.items()
+                        if v is not None and not v[1]),
+                       key=lambda p: parsed[p][0])
+        for stale in clean[:-config.max_to_keep]:
             shutil.rmtree(stale, ignore_errors=True)
-        if paths:
+        if clean:
             # A clean epoch save supersedes any preemption checkpoint from
             # that epoch or earlier; without this, repeatedly-preempted
-            # long runs accumulate unbounded `_iter<N>_preempt` artifacts
-            # (they carry a non-integer suffix, so the rotation above
-            # never sees them).
-            newest_clean = epoch_of(paths[-1])
-            def preempt_epoch_of(p):
-                tail = p.rsplit("_iter", 1)[1]
-                if not tail.endswith("_preempt"):
-                    return -1
-                try:
-                    return int(tail[:-len("_preempt")])
-                except ValueError:
-                    return -1
-            for p in glob.glob(pattern):
-                e = preempt_epoch_of(p)
-                if 0 <= e <= newest_clean:
+            # long runs accumulate unbounded `_iter<N>_preempt` artifacts.
+            newest_clean = parsed[clean[-1]][0]
+            for p, v in parsed.items():
+                if v is not None and v[1] and v[0] <= newest_clean:
                     shutil.rmtree(p, ignore_errors=True)
 
     # ------------------------------------------------------------ eval

@@ -34,6 +34,39 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+def parse_iter_name(path: str):
+    """Parse a `<base>_iter<N>[_preempt]` artifact path into
+    (epoch, is_preempt), or None if the tail is not of that form. Single
+    source of truth for the epoch-checkpoint naming convention (written
+    by model_facade's save_fn; consumed by rotation and resume)."""
+    if "_iter" not in path:
+        return None
+    tail = path.rsplit("_iter", 1)[1]
+    preempt = tail.endswith("_preempt")
+    if preempt:
+        tail = tail[: -len("_preempt")]
+    try:
+        return int(tail), preempt
+    except ValueError:
+        return None
+
+
+def latest_checkpoint(save_base: str):
+    """Newest `<save_base>_iter<N>[_preempt]` artifact path (None if no
+    artifacts exist). At equal N the preemption artifact wins: it was
+    written mid-epoch N+1, so its params are strictly more trained than
+    the clean end-of-epoch-N save."""
+    import glob
+    best = None  # ((epoch, is_preempt), path)
+    for p in glob.glob(save_base + "_iter*"):
+        parsed = parse_iter_name(p)
+        if parsed is None:
+            continue
+        if best is None or parsed > best[0]:
+            best = (parsed, p)
+    return best[1] if best else None
+
+
 def save_model(model_save_path: str, state: TrainState, vocabs, config,
                epoch: int = 0, released: bool = False) -> str:
     """Save a standalone model artifact at `<model_save_path>` (a directory

@@ -33,23 +33,31 @@ from experiments import javagen  # noqa: E402
 
 
 def build_dataset(root: str, language: str = "java", scale: int = 1,
+                  ident_scale: int = 1, literal_rate: float = 0.0,
                   log=print) -> str:
     """Generate + extract + preprocess; returns the dataset prefix.
     language="cs" routes through the C# generator (experiments/csgen.py)
     and the native C# extractor (cpp/c2v-extract-cs) — BASELINE config #3.
-    scale multiplies the generated file counts (data-scaling studies).
+    scale multiplies the generated file counts (data-scaling studies);
+    ident_scale/literal_rate widen the identifier space
+    (javagen.expand_nouns) for flagship-shape vocab runs.
     """
     from code2vec_tpu.data.preprocess import extract_dir, preprocess
 
     corpus = os.path.join(root, "src")
-    log(f"Generating {language} corpus (scale {scale})...")
+    log(f"Generating {language} corpus (scale {scale}, "
+        f"ident_scale {ident_scale}, literal_rate {literal_rate})...")
     sizes = dict(train_files=2400 * scale, val_files=260 * scale,
                  test_files=260 * scale)
     if language == "cs":
+        if ident_scale != 1 or literal_rate:
+            raise SystemExit("ident_scale/literal_rate are implemented for "
+                             "the Java generator only")
         from experiments import csgen
         dirs = csgen.generate_corpus(corpus, log=log, **sizes)
     else:
-        dirs = javagen.generate_corpus(corpus, log=log, **sizes)
+        dirs = javagen.generate_corpus(corpus, log=log, ident_scale=ident_scale,
+                                       literal_rate=literal_rate, **sizes)
     raws = {}
     for role in ("train", "val", "test"):
         raws[role] = extract_dir(
@@ -66,6 +74,11 @@ def build_dataset(root: str, language: str = "java", scale: int = 1,
 
 def _prefix_name(language: str) -> str:
     return "gencs" if language == "cs" else "genjava"
+
+
+def _latest_checkpoint(save_base: str):
+    from code2vec_tpu.training.checkpoint import latest_checkpoint
+    return latest_checkpoint(save_base)
 
 
 def target_oov_rate(c2v_path: str, target_vocab) -> float:
@@ -85,8 +98,9 @@ def target_oov_rate(c2v_path: str, target_vocab) -> float:
 
 
 def run(root: str, epochs: int, patience: int, language: str = "java",
-        scale: int = 1, sparse: bool = False, rss_limit_gb: float = 100.0,
-        log=print) -> dict:
+        scale: int = 1, ident_scale: int = 1, literal_rate: float = 0.0,
+        sparse: bool = False, rss_limit_gb: float = 100.0,
+        resume: bool = False, log=print) -> dict:
     import jax
     import numpy as np
     from code2vec_tpu.config import Config
@@ -96,17 +110,26 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
 
     prefix = os.path.join(root, _prefix_name(language))
     scale_marker = prefix + ".scale"
+    shape = {"scale": scale, "ident_scale": ident_scale,
+             "literal_rate": literal_rate}
     if not os.path.exists(prefix + ".train.c2v"):
-        prefix = build_dataset(root, language=language, scale=scale, log=log)
+        prefix = build_dataset(root, language=language, scale=scale,
+                               ident_scale=ident_scale,
+                               literal_rate=literal_rate, log=log)
         with open(scale_marker, "w") as f:
-            f.write(str(scale))
+            json.dump(shape, f)
     else:
-        cached = (int(open(scale_marker).read())
-                  if os.path.exists(scale_marker) else 1)
-        if cached != scale:
+        cached = {"scale": 1, "ident_scale": 1, "literal_rate": 0.0}
+        if os.path.exists(scale_marker):
+            raw = open(scale_marker).read()
+            try:
+                cached.update(json.loads(raw))
+            except json.JSONDecodeError:   # pre-round-5 plain-int marker
+                cached["scale"] = int(raw)
+        if cached != shape:
             raise SystemExit(
-                f"cached corpus at {root} was built at scale {cached}, "
-                f"requested scale {scale}: use --fresh or a different "
+                f"cached corpus at {root} was built with {cached}, "
+                f"requested {shape}: use --fresh or a different "
                 f"--root so artifacts are never mislabeled")
 
     # The ceiling is language-independent: csgen translates javagen's
@@ -116,10 +139,39 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
     log("Computing Bayes ceiling (javagen.family_ceiling)...")
     ceiling = javagen.family_ceiling(log=log)
 
+    save_base = os.path.join(root, "model", _prefix_name(language))
+    # Phase-resume support: the axon dev tunnel leaks host RAM per
+    # transferred batch (see rss watchdog note below), so a flagship-shape
+    # run cannot finish in one process. Each phase trains until the
+    # watchdog (or the epoch budget / patience) stops it; phase state
+    # (curve + best-so-far + patience counter) persists here and the next
+    # `--resume` invocation continues from the newest checkpoint with a
+    # fresh process (and a fresh leak budget).
+    phase_state_path = os.path.join(
+        root, f"phase_state{'_sparse' if sparse else ''}.json")
+    phase = {"curve": [], "best_f1": -1.0, "best_epoch": 0, "since": 0,
+             "wall_s": 0.0, "n_phases": 1}
+    load_path = None
+    if resume:
+        # phase_state only exists once an epoch completed; a run can trip
+        # the watchdog mid-epoch-1 and leave just an _iter0_preempt
+        # checkpoint, which must still be picked up.
+        if os.path.exists(phase_state_path):
+            with open(phase_state_path) as f:
+                phase.update(json.load(f))
+        phase["n_phases"] = phase.get("n_phases", 1) + 1
+        load_path = _latest_checkpoint(save_base)
+        if load_path is None:
+            raise SystemExit(f"--resume: no checkpoint under {save_base}")
+        log(f"Resuming phase {phase['n_phases']}: {len(phase['curve'])} "
+            f"epochs recorded, best F1 {phase['best_f1']:.4f} @ epoch "
+            f"{phase['best_epoch']}, loading {load_path}")
+
     config = Config(
         train_data_path_prefix=prefix,
         test_data_path=prefix + ".val.c2v",
-        model_save_path=os.path.join(root, "model", _prefix_name(language)),
+        model_save_path=save_base,
+        model_load_path=load_path,
         num_train_epochs=epochs,
         # one val point (and checkpoint) per epoch: the convergence curve
         # is the artifact this harness exists to produce. Mid-epoch evals
@@ -145,22 +197,39 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
     )
     model = Code2VecModel(config)
 
-    curve = []
+    curve = phase["curve"]
+    prior_epochs = model.initial_epoch
+    if resume and len(curve) != prior_epochs:
+        raise SystemExit(
+            f"phase state records {len(curve)} evaluated epochs but the "
+            f"loaded checkpoint is at epoch {prior_epochs}; the model dir "
+            f"and {phase_state_path} are out of sync")
     t0 = time.time()
     # Best-by-val-F1 params, the reference's "train past the best epoch,
-    # keep the best checkpoint" workflow (README.md:87-88). At generated-
-    # corpus vocab sizes a host copy is a few hundred MB at most.
-    best = {"f1": -1.0, "params": None, "epoch": 0, "since": 0}
+    # keep the best checkpoint" workflow (README.md:87-88). In-RAM copy
+    # for the common case; when the best epoch belongs to an earlier
+    # phase, its `_iter<N>` checkpoint is loaded for the test eval
+    # instead (max_to_keep=10 keeps it alive for any patience <= 9).
+    best = {"f1": phase["best_f1"], "params": None,
+            "epoch": phase["best_epoch"], "since": phase["since"]}
+
+    base_wall = phase["wall_s"]  # completed earlier phases' wall time
 
     def eval_and_record(state):
         results = model._evaluate_with_params(state.params)
-        curve.append(_metrics_dict(results, wall_s=round(time.time() - t0, 1)))
+        wall = round(base_wall + time.time() - t0, 1)
+        curve.append(_metrics_dict(results, wall_s=wall))
         f1 = float(results.subtoken_f1)
         if f1 > best["f1"]:
             best.update(f1=f1, params=jax.device_get(state.params),
                         epoch=len(curve), since=0)
         else:
             best["since"] += 1
+        phase.update(curve=curve, best_f1=best["f1"],
+                     best_epoch=best["epoch"], since=best["since"],
+                     wall_s=wall)
+        with open(phase_state_path, "w") as f:
+            json.dump(phase, f)
         return results
 
     def should_stop():
@@ -173,6 +242,7 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
     trainer = Trainer(config, train_step, mesh=model.mesh,
                       evaluate_fn=eval_and_record,
                       save_fn=model._make_save_fn() if config.is_saving else None,
+                      initial_epoch=model.initial_epoch,
                       steps_per_epoch_hint=model._steps_per_epoch,
                       stop_fn=should_stop)
     model.state = trainer.train(model.state, batches, dropout_rng(config))
@@ -184,6 +254,17 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
     # comparing an undertrained val point against a later-epoch test run.
     test_params = (best["params"] if best["params"] is not None
                    else model.state.params)
+    if best["params"] is None and best["epoch"] > 0 and not trainer.preempted:
+        # best epoch belongs to an earlier phase: restore its checkpoint
+        from code2vec_tpu.training import checkpoint as ckpt_mod
+        path = f"{save_base}_iter{best['epoch']}"
+        if os.path.isdir(path):
+            log(f"Loading best-by-val-F1 weights from {path}")
+            test_params = ckpt_mod.load_model(
+                path, model.state, params_only=True).params
+        else:
+            log(f"WARNING: best checkpoint {path} rotated away; "
+                f"test eval uses final weights")
     model.config.test_data_path = prefix + ".test.c2v"
     model.config.num_test_examples = model._count_examples(
         model.config.test_data_path)
@@ -215,7 +296,8 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
         "epochs_trained": trainer.final_epoch,
         "best_epoch": best["epoch"],
         "patience": patience,
-        "train_wall_s": round(time.time() - t0, 1),
+        "train_wall_s": round(base_wall + time.time() - t0, 1),
+        "phases": phase.get("n_phases", 1),
         "target_oov_rate": oov,
         "ceiling": ceiling,
         "val_curve": curve,
@@ -442,6 +524,22 @@ def main(argv=None):
                    help="multiply generated corpus size (data-scaling runs; "
                         "results go to accuracy_scale<N>.json, the main "
                         "report is left alone)")
+    p.add_argument("--ident_scale", type=int, default=1,
+                   help="widen the generator's identifier space "
+                        "(javagen.expand_nouns): ~80*N nouns; flagship-"
+                        "shape vocab runs")
+    p.add_argument("--literal_rate", type=float, default=0.0,
+                   help="probability of a distinct string-literal log line "
+                        "per method (drives token-vocab size like real "
+                        "corpora's literal tail)")
+    p.add_argument("--tag", default=None,
+                   help="artifact name override: results go to "
+                        "accuracy_<tag>.json and never rewrite the main "
+                        "report")
+    p.add_argument("--resume", action="store_true",
+                   help="continue a previous (watchdog-truncated) run of "
+                        "the same root from its newest checkpoint; exit "
+                        "code 3 means 'truncated again, resume once more'")
     p.add_argument("--fresh", action="store_true",
                    help="regenerate the corpus from scratch")
     p.add_argument("--sparse_embedding_update", action="store_true",
@@ -470,9 +568,14 @@ def main(argv=None):
 
     results = run(args.root, args.epochs, args.patience,
                   language=args.language, scale=args.scale,
+                  ident_scale=args.ident_scale,
+                  literal_rate=args.literal_rate,
                   sparse=args.sparse_embedding_update,
-                  rss_limit_gb=args.rss_limit_gb)
+                  rss_limit_gb=args.rss_limit_gb,
+                  resume=args.resume)
     results["scale"] = args.scale
+    results["ident_scale"] = args.ident_scale
+    results["literal_rate"] = args.literal_rate
     os.makedirs(os.path.join(REPO, "experiments", "results"), exist_ok=True)
     name = "accuracy_cs.json" if args.language == "cs" else "accuracy.json"
     if args.scale != 1:
@@ -480,6 +583,8 @@ def main(argv=None):
         name = f"accuracy{lang}_scale{args.scale}.json"
     if args.sparse_embedding_update:
         name = name.replace(".json", "_sparse.json")
+    if args.tag:
+        name = f"accuracy_{args.tag}.json"
     out_json = os.path.join(REPO, "experiments", "results", name)
     with open(out_json, "w") as f:
         json.dump(results, f, indent=2)
@@ -488,9 +593,11 @@ def main(argv=None):
         # truncated run: json (with its marker) only — an undertrained
         # point must never rewrite the report as if converged
         print("WARNING: run truncated by the host-memory watchdog; "
-              "report not rewritten", file=sys.stderr)
-    elif args.scale != 1 or args.sparse_embedding_update:
-        pass  # scaling/sparse runs: json artifact only; summarized by hand
+              "report not rewritten (exit 3: relaunch with --resume)",
+              file=sys.stderr)
+    elif args.scale != 1 or args.sparse_embedding_update or args.tag:
+        pass  # scaling/sparse/tagged runs: json artifact only;
+        #       summarized by hand
     elif args.language == "cs":
         append_cs_section(results, report)
     else:
@@ -499,6 +606,8 @@ def main(argv=None):
                       "test_f1": results["test"]["f1"],
                       "test_top1": results["test"]["top1"],
                       "val_best_f1": (results["val_best"] or {}).get("f1")}))
+    if results["rss_preempted"]:
+        sys.exit(3)
 
 
 if __name__ == "__main__":

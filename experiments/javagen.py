@@ -27,6 +27,7 @@ corpus-scale robustness test for the native extractor (cpp/c2v-extract).
 
 from __future__ import annotations
 
+import math
 import os
 import random
 from typing import Callable, Dict, List, Sequence, Tuple
@@ -407,9 +408,45 @@ NOISE_LINES = [
 ]
 
 
+def expand_nouns(ident_scale: int, seed: int = 5) -> List[str]:
+    """Deterministically expand the 80-noun base pool to ~80*ident_scale
+    single-word nouns by compounding base words (userProfile-style
+    identifiers, lowercased to one subtoken). This is the identifier-space
+    lever for flagship-shape vocab studies: token/target vocab sizes are
+    driven by how many distinct identifier spellings exist in the corpus,
+    not by how many files are generated. The family/verb machinery — and
+    therefore the Bayes ceiling — is untouched: which family/verb is
+    drawn never depends on the noun spelling."""
+    if ident_scale <= 1:
+        return list(NOUNS)
+    rng = random.Random(seed)
+    pool = list(NOUNS)
+    seen = set(pool)
+    target = 80 * ident_scale
+    misses = 0
+    while len(pool) < target:
+        a, b = rng.choice(NOUNS), rng.choice(NOUNS)
+        if a == b:
+            continue
+        w = a + b
+        # Two-noun compounds top out at ~82*81; past ~60% occupancy the
+        # rejection rate climbs, so widen to triples instead of crawling
+        # (and at very large targets, hanging) on pair collisions.
+        if w in seen:
+            misses += 1
+            if misses > 8:
+                w = a + b + rng.choice(NOUNS)
+        if w not in seen:
+            seen.add(w)
+            pool.append(w)
+            misses = 0
+    return pool
+
+
 # ----------------------------------------------------------------- rendering
 
-def _render_method(name_parts, ret, params, body, rng) -> List[str]:
+def _render_method(name_parts, ret, params, body, rng,
+                   literal_pool=None, literal_rate=0.0) -> List[str]:
     name = camel(name_parts)
     mods = rng.choices(["public ", "", "protected ", "public static "],
                        weights=[70, 15, 10, 5])[0]
@@ -418,6 +455,14 @@ def _render_method(name_parts, ret, params, body, rng) -> List[str]:
     lines = [f"    {mods}{ret} {name}({params}) {{"]
     if rng.random() < 0.08:
         lines.append("        " + rng.choice(NOISE_LINES))
+    if literal_pool and rng.random() < literal_rate:
+        # Distinct-ish log-message literals: real corpora carry a long
+        # tail of string-literal leaf tokens (java14m's 1.3M token vocab
+        # is mostly such a tail); each 3-word draw from a large pool is
+        # a new spelling w.h.p., so literal_rate directly dials how many
+        # distinct token-vocab rows the corpus produces.
+        words = " ".join(rng.choice(literal_pool) for _ in range(3))
+        lines.append(f'        System.out.println("{words}");')
     for b in body:
         lines.append("        " + b)
     lines.append("    }")
@@ -425,7 +470,8 @@ def _render_method(name_parts, ret, params, body, rng) -> List[str]:
 
 
 def generate_class(rng: random.Random, nouns: List[str], class_name: str,
-                   package: str, n_methods: int) -> str:
+                   package: str, n_methods: int,
+                   literal_pool=None, literal_rate=0.0) -> str:
     fields = [Field(rng, nouns) for _ in range(rng.randint(3, 8))]
     lines = [f"package {package};", "",
              "import java.util.*;", ""]
@@ -457,7 +503,9 @@ def generate_class(rng: random.Random, nouns: List[str], class_name: str,
         if name in made:
             continue
         made.add(name)
-        lines.extend(_render_method(name_parts, ret, params, body, rng))
+        lines.extend(_render_method(name_parts, ret, params, body, rng,
+                                    literal_pool=literal_pool,
+                                    literal_rate=literal_rate))
         lines.append("")
         count += 1
 
@@ -474,10 +522,19 @@ def generate_class(rng: random.Random, nouns: List[str], class_name: str,
 # ------------------------------------------------------------------ projects
 
 def generate_project(out_dir: str, rng: random.Random, project: str,
-                     n_files: int) -> int:
+                     n_files: int, noun_pool: List[str] = None,
+                     literal_pool=None, literal_rate: float = 0.0) -> int:
     """Write one project's files; returns the number of methods written.
     Each project samples its own noun sub-vocabulary + frequency skew."""
-    nouns = rng.sample(NOUNS, k=rng.randint(28, 48))
+    pool = noun_pool if noun_pool is not None else NOUNS
+    # per-project domain size grows sublinearly with the global pool:
+    # projects stay domain-focused while corpus-wide identifier coverage
+    # scales with the pool
+    k_lo, k_hi = 28, 48
+    if len(pool) > len(NOUNS):
+        widen = max(1, math.isqrt(round(len(pool) / len(NOUNS))))
+        k_lo, k_hi = k_lo * widen, k_hi * widen
+    nouns = rng.sample(pool, k=min(rng.randint(k_lo, k_hi), len(pool)))
     # Zipf-ish per-project noun weights: hot nouns dominate like real code
     weighted = []
     for i, n in enumerate(nouns):
@@ -490,7 +547,9 @@ def generate_project(out_dir: str, rng: random.Random, project: str,
             ["Service", "Manager", "Store", "Handler", "Util", "Helper",
              "Controller", "Repository", "Model", "Builder"]) + str(i)
         n_methods = rng.randint(5, 18)
-        src = generate_class(rng, weighted, cname, f"com.gen.{project}", n_methods)
+        src = generate_class(rng, weighted, cname, f"com.gen.{project}",
+                             n_methods, literal_pool=literal_pool,
+                             literal_rate=literal_rate)
         with open(os.path.join(proj_dir, cname + ".java"), "w") as fh:
             fh.write(src)
         methods += src.count("    public ") + src.count("    protected ")
@@ -499,10 +558,16 @@ def generate_project(out_dir: str, rng: random.Random, project: str,
 
 def generate_corpus(root: str, seed: int = 17, train_files: int = 2400,
                     val_files: int = 260, test_files: int = 260,
-                    files_per_project: int = 120, log=print) -> Dict[str, str]:
+                    files_per_project: int = 120, ident_scale: int = 1,
+                    literal_rate: float = 0.0, log=print) -> Dict[str, str]:
     """Generate train/val/test project trees under `root`. Returns the
-    role -> directory mapping."""
+    role -> directory mapping. `ident_scale`/`literal_rate` scale the
+    identifier space (see expand_nouns / _render_method) for
+    flagship-shape vocab studies; the defaults reproduce the historical
+    corpora byte-for-byte."""
     rng = random.Random(seed)
+    noun_pool = expand_nouns(ident_scale)
+    literal_pool = noun_pool if literal_rate > 0 else None
     roles = {"train": train_files, "val": val_files, "test": test_files}
     dirs = {}
     for role, n_files in roles.items():
@@ -514,7 +579,8 @@ def generate_corpus(root: str, seed: int = 17, train_files: int = 2400,
         while remaining > 0:
             n = min(files_per_project, remaining)
             total_methods += generate_project(
-                role_dir, rng, f"{role}proj{pi}", n)
+                role_dir, rng, f"{role}proj{pi}", n, noun_pool=noun_pool,
+                literal_pool=literal_pool, literal_rate=literal_rate)
             remaining -= n
             pi += 1
         log(f"  {role}: {n_files} files, {pi} projects, "
