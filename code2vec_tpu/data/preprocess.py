@@ -302,6 +302,11 @@ def _extract_tree_parallel(out, extractor: str, language: str,
     children = _child_targets(source_dir, language)
     if not children:
         return 0
+    # Don't oversubscribe the host: num_workers concurrent extractors x
+    # num_threads each would run workers*threads native threads (the
+    # reference's Pool(4) drove single-threaded JVMs). Split the thread
+    # budget across the workers that will actually run concurrently.
+    num_threads = max(1, num_threads // min(num_workers, len(children)))
     # spill next to the output file, not the system /tmp (often a small
     # tmpfs; the corpora this pipeline targets run to tens of GB)
     out_dir = os.path.dirname(getattr(out, "name", "") or "") or "."
@@ -492,7 +497,10 @@ def main(argv=None) -> None:
     parser.add_argument("--num_workers", type=int, default=4,
                         help="concurrent top-level project extractions "
                              "(reference driver: Pool(4), "
-                             "JavaExtractor/extract.py:61-76)")
+                             "JavaExtractor/extract.py:61-76); the "
+                             "--num_threads budget is divided across "
+                             "workers so workers*threads never "
+                             "oversubscribes the host")
     parser.add_argument("--extract_timeout", type=float, default=600.0,
                         help="seconds before a hung extraction is killed "
                              "and retried per subdirectory/file")

@@ -80,7 +80,161 @@ char UnescapeChar(std::string_view s, size_t* i) {
 
 bool IsCsKeyword(std::string_view word) { return kCsKeywords.count(word) > 0; }
 
-CsLexOutput CsLex(std::string_view src) {
+namespace {
+
+// Interpolated strings nest recursively (hole -> sub-lex -> hole ...);
+// every recursive path below carries a depth and throws past this bound
+// so adversarial nesting becomes a clean per-file lex error instead of
+// stack exhaustion (the parser's DepthGuard sits above the lexer and
+// cannot protect it).
+constexpr int kMaxInterpDepth = 64;
+
+// Skip a string/char literal (with optional @/$ prefix run) starting at
+// src[i]; returns the index just past it. Used only to scan PAST nested
+// literals while finding an interpolation hole's end — nested
+// interpolated strings recurse through their own holes.
+size_t SkipStringLike(std::string_view src, size_t i, int depth);
+
+// Scan an interpolation hole whose '{' is at src[i-1]. Returns the index
+// of the matching top-level '}' (npos if unterminated) and the indices
+// of the first top-level ',' (alignment) and ':' (format) — `::` never
+// counts. Depth tracks (), [], {} only: C# requires parentheses around
+// conditional expressions in holes, so a top-level ':' is always the
+// format clause; commas inside a BARE top-level generic type mention
+// (`{Foo<int,string>.Bar}`) misdetect as alignment — see
+// cpp/DEVIATIONS.md.
+size_t ScanHole(std::string_view src, size_t i, size_t* comma,
+                size_t* colon, int rec_depth, bool outer_verbatim) {
+  if (rec_depth > kMaxInterpDepth)
+    throw CsLexError("interpolated string nesting too deep");
+  *comma = *colon = std::string_view::npos;
+  int depth = 0;
+  const size_t n = src.size();
+  while (i < n) {
+    char c = src[i];
+    if (c == '"' || c == '\'' || ((c == '@' || c == '$') && i + 1 < n &&
+                                  (src[i + 1] == '"' || src[i + 1] == '@' ||
+                                   src[i + 1] == '$'))) {
+      size_t next = SkipStringLike(src, i, rec_depth + 1);
+      if (next == i) ++i;
+      else i = next;
+      continue;
+    }
+    if (c == '(' || c == '[' || c == '{') ++depth;
+    else if (c == ')' || c == ']') --depth;
+    else if (c == '}') {
+      if (depth == 0) return i;
+      --depth;
+    } else if (c == ',' && depth == 0) {
+      if (*comma == std::string_view::npos) *comma = i;
+    } else if (c == ':' && depth == 0) {
+      if (i + 1 < n && src[i + 1] == ':') { i += 2; continue; }
+      if (i > 0 && src[i - 1] == ':') { ++i; continue; }
+      *colon = i;
+      // Everything after a top-level ':' is literal format text; `}}`
+      // is an escaped `}` inside it, a single `}` ends the hole. If the
+      // enclosing string's terminating quote arrives before a clean
+      // close (`$"{x:N}}t"`), fall back to first-`}`-ends-hole so the
+      // method degrades instead of the whole file mis-scanning.
+      size_t k = i + 1;
+      size_t first_close = std::string_view::npos;
+      while (k < n) {
+        char fc = src[k];
+        if (fc == '}') {
+          if (k + 1 < n && src[k + 1] == '}') {
+            if (first_close == std::string_view::npos) first_close = k;
+            k += 2;
+            continue;
+          }
+          return k;
+        }
+        if (fc == '"') {
+          if (outer_verbatim && k + 1 < n && src[k + 1] == '"') {
+            k += 2;
+            continue;
+          }
+          break;  // enclosing string ends: reinterpret via fallback
+        }
+        ++k;
+      }
+      return first_close;
+    }
+    ++i;
+  }
+  return std::string_view::npos;
+}
+
+size_t SkipStringLike(std::string_view src, size_t i, int depth) {
+  if (depth > kMaxInterpDepth)
+    throw CsLexError("interpolated string nesting too deep");
+  const size_t n = src.size();
+  bool verbatim = false, interpolated = false;
+  size_t j = i;
+  while (j < n && (src[j] == '@' || src[j] == '$')) {
+    verbatim |= src[j] == '@';
+    interpolated |= src[j] == '$';
+    ++j;
+  }
+  if (j >= n) return j;
+  char q = src[j];
+  if (q != '"' && q != '\'') return i;  // @identifier etc.: not a literal
+  size_t k = j + 1;
+  while (k < n) {
+    char c = src[k];
+    if (c == q) {
+      if (verbatim && q == '"' && k + 1 < n && src[k + 1] == '"') {
+        k += 2;
+        continue;
+      }
+      return k + 1;
+    }
+    if (interpolated && c == '{') {
+      if (k + 1 < n && src[k + 1] == '{') { k += 2; continue; }
+      size_t comma, colon;
+      size_t close = ScanHole(src, k + 1, &comma, &colon, depth + 1,
+                              verbatim);
+      if (close == std::string_view::npos) return n;
+      k = close + 1;
+      continue;
+    }
+    if (interpolated && c == '}' && k + 1 < n && src[k + 1] == '}') {
+      k += 2;
+      continue;
+    }
+    if (!verbatim && c == '\\' && k + 1 < n) { k += 2; continue; }
+    ++k;
+  }
+  return n;
+}
+
+// Unescape `}}` / `{{` in an interpolation format specifier's raw text.
+std::string UnescapeFormatText(std::string_view raw) {
+  std::string out;
+  out.reserve(raw.size());
+  for (size_t k = 0; k < raw.size(); ++k) {
+    out.push_back(raw[k]);
+    if (k + 1 < raw.size() &&
+        ((raw[k] == '}' && raw[k + 1] == '}') ||
+         (raw[k] == '{' && raw[k + 1] == '{')))
+      ++k;
+  }
+  return out;
+}
+
+}  // namespace
+
+namespace {
+// Internal entry carrying the interpolation recursion depth (holes are
+// sub-lexed by recursive calls; the public CsLex starts at 0).
+CsLexOutput CsLexImpl(std::string_view src, int interp_depth);
+}  // namespace
+
+CsLexOutput CsLex(std::string_view src) { return CsLexImpl(src, 0); }
+
+namespace {
+CsLexOutput CsLexImpl(std::string_view src, int interp_depth) {
+  if (interp_depth > kMaxInterpDepth)
+    throw CsLexError("interpolated string nesting too deep");
   CsLexOutput out;
   size_t i = 0;
   const size_t n = src.size();
@@ -142,6 +296,116 @@ CsLexOutput CsLex(std::string_view src) {
         interpolated |= src[j] == '$';
         ++j;
       }
+      if (j < n && src[j] == '"' && interpolated) {
+        // Interpolated string: emit synthetic `$"` ... `"$` markers with
+        // text segments as kString tokens and each hole's expression
+        // sub-lexed INLINE (recursively: nested $-strings just work), so
+        // the parser builds Roslyn's InterpolatedStringExpression /
+        // Interpolation shape and the holes' leaf tokens feed contexts.
+        size_t start = i;
+        // canonical `$"` spelling in .text regardless of prefix order
+        // ($@"/@$"): the parser matches markers by .text (static
+        // literal, so the view outlives the token)
+        out.tokens.push_back(CsToken{CsTok::kPunct,
+                                     std::string_view("$\""), "$\"",
+                                     static_cast<int>(start),
+                                     static_cast<int>(j + 1)});
+        i = j + 1;
+        std::string text;
+        size_t text_start = i;
+        auto flush_text = [&](size_t endpos) {
+          if (!text.empty())
+            push(CsTok::kString, text_start, endpos, std::move(text));
+          text.clear();
+        };
+        auto splice = [&](size_t from, size_t to) {
+          CsLexOutput sub = CsLexImpl(src.substr(from, to - from),
+                                      interp_depth + 1);
+          for (CsToken& t : sub.tokens) {
+            if (t.kind == CsTok::kEof) break;
+            t.pos += static_cast<int>(from);
+            t.end += static_cast<int>(from);
+            out.tokens.push_back(std::move(t));
+          }
+          // hole comments are trivia; dropped like Roslyn's
+        };
+        for (;;) {
+          if (i >= n) throw CsLexError("unterminated interpolated string");
+          char ch = src[i];
+          if (ch == '"') {
+            if (verbatim && i + 1 < n && src[i + 1] == '"') {
+              text.push_back('"');
+              i += 2;
+              continue;
+            }
+            flush_text(i);
+            out.tokens.push_back(CsToken{CsTok::kPunct,
+                                         std::string_view("\"$"), "\"$",
+                                         static_cast<int>(i),
+                                         static_cast<int>(i + 1)});
+            ++i;
+            break;
+          }
+          if (ch == '{') {
+            if (i + 1 < n && src[i + 1] == '{') {
+              text.push_back('{');
+              i += 2;
+              continue;
+            }
+            flush_text(i);
+            push(CsTok::kPunct, i, i + 1, "{");
+            size_t comma, colon;
+            size_t close = ScanHole(src, i + 1, &comma, &colon,
+                                    interp_depth + 1, verbatim);
+            if (close == std::string_view::npos)
+              throw CsLexError("unterminated interpolation hole");
+            size_t expr_end = close;
+            if (comma != std::string_view::npos) expr_end = comma;
+            if (colon != std::string_view::npos && colon < expr_end)
+              expr_end = colon;
+            splice(i + 1, expr_end);
+            if (comma != std::string_view::npos) {
+              push(CsTok::kPunct, comma, comma + 1, ",");
+              size_t align_end =
+                  colon != std::string_view::npos ? colon : close;
+              splice(comma + 1, align_end);
+            }
+            if (colon != std::string_view::npos) {
+              push(CsTok::kPunct, colon, colon + 1, ":");
+              push(CsTok::kString, colon + 1, close,
+                   UnescapeFormatText(
+                       src.substr(colon + 1, close - colon - 1)));
+            }
+            push(CsTok::kPunct, close, close + 1, "}");
+            i = close + 1;
+            text_start = i;
+            continue;
+          }
+          if (ch == '}') {
+            if (i + 1 < n && src[i + 1] == '}') {
+              text.push_back('}');
+              i += 2;
+              continue;
+            }
+            // Roslyn errors on a lone `}` in interpolated text; we keep
+            // it as literal text so one malformed string degrades to
+            // slightly-off text instead of losing the whole file
+            // (graceful-degradation policy, cpp/DEVIATIONS.md C3).
+            text.push_back('}');
+            ++i;
+            continue;
+          }
+          if (!verbatim && ch == '\\' && i + 1 < n) {
+            ++i;
+            text.push_back(UnescapeChar(src, &i));
+            continue;
+          }
+          if (!verbatim && ch == '\n') throw CsLexError("newline in string");
+          text.push_back(ch);
+          ++i;
+        }
+        continue;
+      }
       if (j < n && src[j] == '"') {
         size_t start = i;
         i = j + 1;
@@ -176,7 +440,6 @@ CsLexOutput CsLex(std::string_view src) {
           if (i >= n) throw CsLexError("unterminated string");
           ++i;
         }
-        (void)interpolated;  // single-token approximation of $-strings
         push(CsTok::kString, start, i, std::move(value));
         continue;
       }
@@ -288,5 +551,6 @@ CsLexOutput CsLex(std::string_view src) {
                                static_cast<int>(n), static_cast<int>(n)});
   return out;
 }
+}  // namespace
 
 }  // namespace c2v

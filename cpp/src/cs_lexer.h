@@ -1,11 +1,13 @@
 // C# lexer for the native path-context extractor (C# pipeline).
 //
 // Differences from the Java lexer: verbatim strings (@"..." with ""
-// escapes), interpolated strings ($"..." lexed as single string tokens —
-// documented divergence from Roslyn's InterpolatedStringExpression),
-// @identifiers, numeric suffixes (u/l/ul/f/d/m), preprocessor directive
-// lines (dropped), and comments are RETAINED (the reference emits
-// comment contexts per method, Extractor.cs:204-218).
+// escapes), interpolated strings ($"..." emitted as synthetic `$"`/`"$`
+// punct markers with text runs as string tokens and each hole's
+// expression sub-lexed inline, so the parser can build Roslyn's
+// InterpolatedStringExpression/Interpolation shape), @identifiers,
+// numeric suffixes (u/l/ul/f/d/m), preprocessor directive lines
+// (dropped), and comments are RETAINED (the reference emits comment
+// contexts per method, Extractor.cs:204-218).
 #pragma once
 
 #include <cstdint>

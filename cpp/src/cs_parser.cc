@@ -1482,7 +1482,11 @@ class Parser {
   // merely named `from` (e.g. `from + 1`, `M(from)`) cannot misfire:
   // no expression continuation places the keyword `in` after an
   // identifier at depth 0. Tuple types in the from/join type slot are
-  // not recognized (rare; such members fall to error recovery).
+  // not recognized (rare; such members fall to error recovery), and
+  // the scan is bounded at 64 lookahead tokens — a query whose explicit
+  // type prefix alone exceeds that also falls to per-member skip
+  // recovery (one lost method, not a lost file). Both limits are
+  // entries in cpp/DEVIATIONS.md.
   bool QueryAhead() {
     int angle = 0, square = 0;
     bool prev_plain_ident = false;
@@ -1876,6 +1880,7 @@ class Parser {
       bool operand_start =
           IsIdent() || Cur().kind == Tok::kNumeric ||
           Cur().kind == Tok::kString || Cur().kind == Tok::kChar ||
+          Is("$\"") ||
           Is("(") || Is("!") || Is("~") || IsKw("new") || IsKw("this") ||
           IsKw("base") || IsKw("true") || IsKw("false") || IsKw("null") ||
           IsKw("typeof") || IsKw("default") ||
@@ -2039,8 +2044,53 @@ class Parser {
     return Finish(init);
   }
 
+  // `$"text{expr[,align][:format]}..."` — Roslyn shape: an
+  // InterpolatedStringExpression whose children are
+  // InterpolatedStringText nodes (text runs as tokens) and Interpolation
+  // nodes holding the hole's REAL expression subtree (plus optional
+  // InterpolationAlignmentClause / InterpolationFormatClause), so
+  // `$"{user.Name}"` feeds `user`/`Name` leaves into path contexts
+  // instead of one opaque string token. The lexer supplies synthetic
+  // `$"` / `"$` markers with the holes sub-lexed inline (cs_lexer.cc).
+  CsNode* ParseInterpolatedString() {
+    int begin = Pos();
+    CsNode* e = New("InterpolatedStringExpression", begin);
+    Next();  // $"
+    while (!(Cur().kind == Tok::kPunct && Cur().text == "\"$")) {
+      if (Cur().kind == Tok::kString) {
+        CsNode* t = New("InterpolatedStringText", Pos());
+        AttachCurrentAs(t, Tok::kString);
+        CsAdopt(e, Finish(t));
+        continue;
+      }
+      if (Is("{")) {
+        int hb = Pos();
+        Next();
+        CsNode* hole = New("Interpolation", hb);
+        CsAdopt(hole, ParseExpression());
+        if (Accept(",")) {
+          CsNode* al = New("InterpolationAlignmentClause", Pos());
+          CsAdopt(al, ParseExpression());
+          CsAdopt(hole, Finish(al));
+        }
+        if (Accept(":")) {
+          CsNode* fc = New("InterpolationFormatClause", Pos());
+          if (Cur().kind == Tok::kString) AttachCurrentAs(fc, Tok::kString);
+          CsAdopt(hole, Finish(fc));
+        }
+        Expect("}");
+        CsAdopt(e, Finish(hole));
+        continue;
+      }
+      Fail("malformed interpolated string");
+    }
+    Next();  // "$
+    return Finish(e);
+  }
+
   CsNode* ParsePrimaryPrefix() {
     int begin = Pos();
+    if (Is("$\"")) return ParseInterpolatedString();
     switch (Cur().kind) {
       case Tok::kNumeric: {
         CsNode* e = New("NumericLiteralExpression", begin);

@@ -265,8 +265,11 @@ def main(argv=None):
     if args.phase:
         result = {"shuffle": _child_shuffle, "preprocess": _child_preprocess,
                   "pack": _child_pack}[args.phase](args)
-        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        result["max_rss_gb"] = round(rss_kb / (1 << 20), 3)
+        # ru_maxrss is KB on Linux but BYTES on macOS (same dual-unit
+        # handling as training/loop.py current_rss_bytes).
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rss_bytes = peak if sys.platform == "darwin" else peak * 1024
+        result["max_rss_gb"] = round(rss_bytes / (1 << 30), 3)
         print(json.dumps(result))
         return
 

@@ -431,14 +431,61 @@ public class Keep
     int record = 1;
     int UseIt(int record) { return record + 1; }
 }
+public class Edge
+{
+    // `record r;` is AMBIGUOUS for pre-C#9 sources that had a type
+    // named `record`; C#9+ compilers resolve the ambiguity toward the
+    // contextual keyword (declaring a type named `record` is itself a
+    // C#9 warning), so this parses as a body-less nested record named
+    // `r`, not a field — pinned here, entry in cpp/DEVIATIONS.md.
+    record r;
+    // ...while an initializer makes it unambiguous: a field again.
+    record q = null;
+    int After() { return 2; }
+}
 """
     lines = extractor(cs_file(code), "--no_hash")
     names = [ln.split(" ", 1)[0] for ln in lines]
-    assert names == ["display", "tag", "dot", "use|it"]
+    assert names == ["display", "tag", "dot", "use|it", "after"]
     by_name = dict(zip(names, lines))
     # component identifiers used in bodies feed contexts as usual
     assert ",name " in by_name["display"] or " name," in by_name["display"]
     assert "school" in by_name["tag"]
+
+
+def test_interpolated_string_holes(extractor, cs_file):
+    """$-string holes are REAL sub-expressions (Roslyn: Interpolation
+    nodes under InterpolatedStringExpression, with alignment/format
+    clauses), not one opaque token — `$"{user.Name}"` must feed `name`
+    into path contexts. Covers: member-access holes, alignment+format
+    (`{x,8:F2}`), `{{`/`}}` escapes, nested $-strings inside holes, and
+    verbatim-interpolated `$@"..."` with `""` escapes."""
+    code = """
+public class C
+{
+    string Greet(User user) { return $"hi {user.Name}, owe {user.Balance,8:F2}"; }
+    string Nested(Order o) { return $"n {(o.Fine ? $"ok {o.Id}" : "bad")}"; }
+    string Esc(int n) { return $"{{lit}} {n:000} t"; }
+    string Verb(string p) { return $@"pre ""{p}"" post"; }
+}
+"""
+    lines = extractor(cs_file(code), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["greet", "nested", "esc", "verb"]
+    by_name = dict(zip(names, lines))
+    # hole leaves reach contexts with Roslyn-shaped path nodes
+    assert "Interpolation" in by_name["greet"]
+    assert "InterpolatedStringExpression" in by_name["greet"]
+    assert ",name " in by_name["greet"] or " name," in by_name["greet"]
+    assert "balance" in by_name["greet"]
+    assert "InterpolationAlignmentClause" in by_name["greet"]
+    assert "InterpolationFormatClause" in by_name["greet"]
+    # nested $-string inside a hole: inner hole's leaf present
+    assert ",id " in by_name["nested"] or " id," in by_name["nested"]
+    # {{...}} stays literal text; format text is a leaf, not parsed code
+    assert "lit" in by_name["esc"]
+    # verbatim-interpolated: "" escapes survive, hole leaf present
+    assert ",p " in by_name["verb"] or " p," in by_name["verb"]
 
 
 def test_adversarial_nesting_fails_cleanly(cs_file):

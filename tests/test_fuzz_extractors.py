@@ -28,6 +28,8 @@ SEEDS_JAVA = [
 ]
 SEEDS_CS = [
     'class A { string S = $"interp {1+1} tail"; int F() => 2; }',
+    ('class A2 { string G(User u) => $"x {u.Name,-8:F2} y '
+     '{(u.Ok ? $@"in ""{u.Id}"" {{esc}}" : "no")} z"; }'),
     ('class B<T> where T : struct { event System.EventHandler E; '
      'public static implicit operator int(B<T> b) => 0; }'),
     'class D { string V = @"verbatim ""q"" here"; int this[int i] => i; }',
