@@ -76,9 +76,24 @@ def _prefix_name(language: str) -> str:
     return "gencs" if language == "cs" else "genjava"
 
 
-def _latest_checkpoint(save_base: str):
-    from code2vec_tpu.training.checkpoint import latest_checkpoint
-    return latest_checkpoint(save_base)
+def _resume_checkpoint(save_base: str, epochs_evaluated: int):
+    """Newest `_iter<N>[_preempt]` artifact with N <= epochs_evaluated —
+    i.e. the last EVALUATED epoch. A run can die between the end-of-epoch
+    save and the eval record (e.g. a wedged device transfer during the
+    eval), leaving a checkpoint one epoch ahead of the curve; resuming
+    from it would desynchronize curve indexing, so that orphan epoch is
+    retrained instead. At equal N the preemption artifact wins (it is
+    strictly more trained, mid-epoch N+1)."""
+    import glob as _glob
+    from code2vec_tpu.training.checkpoint import parse_iter_name
+    best = None  # ((epoch, is_preempt), path)
+    for p in _glob.glob(save_base + "_iter*"):
+        parsed = parse_iter_name(p)
+        if parsed is None or parsed[0] > epochs_evaluated:
+            continue
+        if best is None or parsed > best[0]:
+            best = (parsed, p)
+    return best[1] if best else None
 
 
 def target_oov_rate(c2v_path: str, target_vocab) -> float:
@@ -160,9 +175,11 @@ def run(root: str, epochs: int, patience: int, language: str = "java",
             with open(phase_state_path) as f:
                 phase.update(json.load(f))
         phase["n_phases"] = phase.get("n_phases", 1) + 1
-        load_path = _latest_checkpoint(save_base)
+        load_path = _resume_checkpoint(save_base, len(phase["curve"]))
         if load_path is None:
-            raise SystemExit(f"--resume: no checkpoint under {save_base}")
+            raise SystemExit(f"--resume: no checkpoint under {save_base} "
+                             f"at or before evaluated epoch "
+                             f"{len(phase['curve'])}")
         log(f"Resuming phase {phase['n_phases']}: {len(phase['curve'])} "
             f"epochs recorded, best F1 {phase['best_f1']:.4f} @ epoch "
             f"{phase['best_epoch']}, loading {load_path}")
