@@ -958,7 +958,12 @@ class Parser {
     int begin = Pos();
     try {
       CsNode* type = ParseType();
-      if (IsIdent()) {
+      // Declaration only if the designation ends the tuple element —
+      // same follow-set rule as the `out T x` path. Without it,
+      // `(c ? x : y)` speculates `c?` + designation `x` and the
+      // conditional's `:` then fails the whole member.
+      if (IsIdent() && LookAhead(1).kind == Tok::kPunct &&
+          (LookAhead(1).text == "," || LookAhead(1).text == ")")) {
         CsNode* d = New("DeclarationExpression", begin);
         CsAdopt(d, type);
         int db = Pos();

@@ -453,6 +453,31 @@ public class Edge
     assert "school" in by_name["tag"]
 
 
+def test_parenthesized_conditional_with_bare_ident(extractor, cs_file):
+    """`(c ? x : y)` — a bare-identifier condition must not be eaten by
+    the tuple-element declaration speculation (`c?` nullable type +
+    designation `x`), which used to fail the member at the `:`. Found by
+    the round-5 structure-aware interpolation fuzzer; the fix requires
+    the designation to END the tuple element (follow set `,`/`)`), same
+    rule as the `out T x` path."""
+    code = """
+public class C
+{
+    object A(bool c, User user) { return (c ? user.Name : 61); }
+    string B(bool c, int x, int y) { return $"{(c ? x : y),4}"; }
+    void D() { (int a, string b) = GetPair(); Use(a, b); }
+}
+"""
+    lines = extractor(cs_file(code), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["a", "b", "d"]
+    by_name = dict(zip(names, lines))
+    assert "ConditionalExpression" in by_name["a"]
+    assert "name" in by_name["a"]
+    assert "ConditionalExpression" in by_name["b"]
+    assert "DeclarationExpression" in by_name["d"]  # real deconstruction
+
+
 def test_interpolated_string_holes(extractor, cs_file):
     """$-string holes are REAL sub-expressions (Roslyn: Interpolation
     nodes under InterpolatedStringExpression, with alignment/format
