@@ -1,0 +1,100 @@
+"""Append/refresh the flagship-shape section of BENCH_ACCURACY.md from
+experiments/results/accuracy_flagship.json (the phase-resumed sparse-Adam
+run at >200M params / 1M-token vocab; VERDICT r4 next-round item #3).
+
+Usage: python experiments/flagship_report.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MARKER = "## Flagship shape: the pod config learns"
+
+
+def main() -> None:
+    path = os.path.join(REPO, "experiments", "results",
+                        "accuracy_flagship.json")
+    with open(path) as f:
+        r = json.load(f)
+    if r.get("rss_preempted"):
+        raise SystemExit("artifact is truncated (rss_preempted); refusing "
+                         "to write a report from an undertrained point")
+    d, t, c, oov = r["dataset"], r["test"], r["ceiling"], r["target_oov_rate"]
+    vb = r["val_best"] or {}
+    eff_top1 = (1 - oov["test"]) * c["exact_match"]
+    total_params = (d["token_vocab"] * 128 + d["path_vocab"] * 128
+                    + d["target_vocab"] * 384 + 384 * 384 + 384)
+    section = [
+        MARKER,
+        "",
+        "The round-4 verdict asked for proof that flagship-ORDER tables",
+        "*learn*, not just stream: every prior accuracy point topped out at",
+        "~11K-token / ~99K-target vocabs and ~40M params. This run scales the",
+        "generator's identifier space itself (`javagen.expand_nouns` +",
+        "string-literal tail, `--ident_scale 40 --literal_rate 0.6`) at",
+        "`--scale 72`, trains with the POD optimizer config",
+        "(`--sparse_embedding_update`, touched-rows Adam) under the RSS",
+        "watchdog, and rode the phase-resume path across axon-tunnel",
+        f"truncations ({r.get('phases', 1)} phases).",
+        "",
+        "| | this run | reference java14m (config.py:61-63) |",
+        "|---|---|---|",
+        f"| token vocab | {d['token_vocab']:,} | 1,301,136 |",
+        f"| path vocab | {d['path_vocab']:,} | 911,417 |",
+        f"| target vocab | {d['target_vocab']:,} | 261,245 |",
+        f"| params | {total_params / 1e6:.0f}M | ~385M |",
+        f"| train examples | {d['train_examples']:,} | ~14M |",
+        "",
+        f"Trained {r['epochs_trained']} epochs (budget {r['epochs']},"
+        f" patience {r['patience']}, {r['train_wall_s']:.0f}s wall across"
+        f" phases); test metrics use best-by-val-F1 weights (epoch"
+        f" {r['best_epoch']}).",
+        "",
+        "| metric | test | val best | ceiling | test/ceiling |",
+        "|---|---|---|---|---|",
+        f"| top-1 accuracy | {t['top1']:.4f} | {vb.get('top1', 0):.4f} | "
+        f"{eff_top1:.4f} | {t['top1'] / max(eff_top1, 1e-9):.1%} |",
+        f"| top-5 accuracy | {t['top5']:.4f} | {vb.get('top5', 0):.4f} | "
+        f"{(1 - oov['test']) * c['top5']:.4f} | "
+        f"{t['top5'] / max((1 - oov['test']) * c['top5'], 1e-9):.1%} |",
+        f"| **subtoken F1** | **{t['f1']:.4f}** | {vb.get('f1', 0):.4f} | "
+        f"{c['subtoken_f1_micro']:.4f} | "
+        f"{t['f1'] / c['subtoken_f1_micro']:.1%} |",
+        "",
+        f"Target-OOV rate {oov['val']:.3f} (val) / {oov['test']:.3f} (test):",
+        "the widened identifier space makes cross-project names much rarer",
+        "than at small scale, so the OOV-adjusted top-1 ceiling is the",
+        "honest denominator (same adjustment as the scaling table above).",
+        "The F1 ceiling is unadjusted (conservative; subtokens of OOV names",
+        "remain partially predictable).",
+        "",
+        "Validation F1 by epoch: "
+        + " ".join(f"{e['f1']:.4f}" for e in r["val_curve"]) + ".",
+        "",
+        "Raw numbers: `experiments/results/accuracy_flagship.json`.",
+        "",
+    ]
+    report = os.path.join(REPO, "BENCH_ACCURACY.md")
+    with open(report) as f:
+        existing = f.read()
+    if MARKER in existing:
+        start = existing.index(MARKER)
+        rest = existing[start + len(MARKER):]
+        nxt = rest.find("\n## ")
+        tail = rest[nxt + 1:] if nxt != -1 else ""
+        existing = existing[:start].rstrip() + "\n"
+        body = existing + "\n" + "\n".join(section)
+        if tail:
+            body = body.rstrip() + "\n\n" + tail
+    else:
+        body = existing.rstrip() + "\n\n" + "\n".join(section)
+    with open(report, "w") as f:
+        f.write(body)
+    print(f"wrote flagship section to {report}")
+
+
+if __name__ == "__main__":
+    main()
