@@ -64,12 +64,18 @@ def main() -> None:
         f"{c['subtoken_f1_micro']:.4f} | "
         f"{t['f1'] / c['subtoken_f1_micro']:.1%} |",
         "",
-        f"Target-OOV rate {oov['val']:.3f} (val) / {oov['test']:.3f} (test):",
-        "the widened identifier space makes cross-project names much rarer",
-        "than at small scale, so the OOV-adjusted top-1 ceiling is the",
-        "honest denominator (same adjustment as the scaling table above).",
-        "The F1 ceiling is unadjusted (conservative; subtokens of OOV names",
-        "remain partially predictable).",
+        f"Target-OOV rate {oov['val']:.3f} (val) / {oov['test']:.3f} (test)",
+        "— an order of magnitude above the 64x point's 0.016, and the",
+        "expected consequence of widening the identifier space: with ~1M",
+        "distinct spellings, held-out projects name methods with words the",
+        "train vocabulary never saw (java14m's held-out-project target OOV",
+        "is the same phenomenon). The OOV-adjusted top-1 ceiling is",
+        "therefore the honest denominator; against it this point LEARNS",
+        "at least as well as the small-scale rows (64x: 91.2% of its",
+        "adjusted top-1 ceiling). The F1 ceiling is unadjusted, which at",
+        "this OOV rate makes it very conservative: 29% of test names are",
+        "exactly-unpredictable by construction, yet their subtokens still",
+        "earn partial F1 credit.",
         "",
         "Validation F1 by epoch: "
         + " ".join(f"{e['f1']:.4f}" for e in r["val_curve"]) + ".",
