@@ -1,0 +1,36 @@
+"""Phase-split the sparse train step cost: isolate sort+dedup, moment
+gather/update, and scatters at flagship shapes on the real chip."""
+import sys, time
+sys.path.insert(0, '/root/repo')
+import jax, jax.numpy as jnp
+import numpy as np
+from code2vec_tpu.training.sparse_adam import combine_duplicate_rows, sparse_adam_rows, init_slots
+
+V, d = 1_301_136, 128
+N = 1024 * 200 * 2   # token ids per step (src+tgt)
+rng = jax.random.PRNGKey(0)
+table = jax.random.normal(rng, (V, d), jnp.float32)
+slots = init_slots(table, jnp.bfloat16)
+ids = jax.random.randint(rng, (N,), 0, V, jnp.int32)
+grads = jax.random.normal(rng, (N, d), jnp.float32)
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args); jax.tree.map(lambda x: x.block_until_ready(), out)
+    # host fetch barrier
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    leaf = jax.tree.leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)).ravel()[0] if leaf.ndim else leaf)
+    return (time.perf_counter() - t0) / reps * 1000
+
+sort_only = jax.jit(lambda i: jnp.argsort(i))
+print("argsort ids:            %.2f ms" % timeit(sort_only, ids))
+dedup = jax.jit(combine_duplicate_rows)
+print("combine_duplicate_rows: %.2f ms" % timeit(dedup, ids, grads))
+gather = jax.jit(lambda t, i: jnp.take(t, i, axis=0, mode="clip"))
+print("gather 409K rows f32:   %.2f ms" % timeit(gather, table, ids))
+scat = jax.jit(lambda t, i, g: t.at[i].add(g, mode="drop"))
+print("scatter-add 409K f32:   %.2f ms" % timeit(scat, table, ids, grads))
+full = jax.jit(lambda t, s, i, g: sparse_adam_rows(t, s, i, g, t=jnp.int32(5), lr=1e-3, b1=0.9, b2=0.999, eps=1e-8))
+print("full sparse_adam_rows:  %.2f ms" % timeit(full, table, slots, ids, grads))
