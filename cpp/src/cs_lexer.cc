@@ -104,7 +104,8 @@ size_t SkipStringLike(std::string_view src, size_t i, int depth);
 // (`{Foo<int,string>.Bar}`) misdetect as alignment — see
 // cpp/DEVIATIONS.md.
 size_t ScanHole(std::string_view src, size_t i, size_t* comma,
-                size_t* colon, int rec_depth, bool outer_verbatim) {
+                size_t* colon, int rec_depth, bool outer_verbatim,
+                int outer_raw_nq = 0) {
   if (rec_depth > kMaxInterpDepth)
     throw CsLexError("interpolated string nesting too deep");
   *comma = *colon = std::string_view::npos;
@@ -149,6 +150,14 @@ size_t ScanHole(std::string_view src, size_t i, size_t* comma,
           return k;
         }
         if (fc == '"') {
+          if (outer_raw_nq > 0) {
+            // raw outer string: quote runs shorter than the delimiter
+            // are legal format content; a full run ends the string
+            size_t r = 0;
+            while (k + r < n && src[k + r] == '"') ++r;
+            if (static_cast<int>(r) < outer_raw_nq) { k += r; continue; }
+            break;
+          }
           if (outer_verbatim && k + 1 < n && src[k + 1] == '"') {
             k += 2;
             continue;
@@ -168,16 +177,49 @@ size_t SkipStringLike(std::string_view src, size_t i, int depth) {
   if (depth > kMaxInterpDepth)
     throw CsLexError("interpolated string nesting too deep");
   const size_t n = src.size();
-  bool verbatim = false, interpolated = false;
+  bool verbatim = false;
+  int dollars = 0;
   size_t j = i;
   while (j < n && (src[j] == '@' || src[j] == '$')) {
     verbatim |= src[j] == '@';
-    interpolated |= src[j] == '$';
+    dollars += src[j] == '$';
     ++j;
   }
   if (j >= n) return j;
   char q = src[j];
   if (q != '"' && q != '\'') return i;  // @identifier etc.: not a literal
+  // C#11 raw string (3+ quote delimiter): quote runs shorter than the
+  // delimiter are content; with a $-prefix, `{`-runs of >= dollars
+  // braces open holes (scanned recursively).
+  size_t nq = 0;
+  while (j + nq < n && src[j + nq] == '"') ++nq;
+  if (nq >= 3 && !verbatim) {
+    size_t k = j + nq;
+    while (k < n) {
+      char c = src[k];
+      if (c == '"') {
+        size_t r = 0;
+        while (k + r < n && src[k + r] == '"') ++r;
+        if (r >= nq) return k + r;
+        k += r;
+        continue;
+      }
+      if (dollars > 0 && c == '{') {
+        size_t b = 0;
+        while (k + b < n && src[k + b] == '{') ++b;
+        if (b < static_cast<size_t>(dollars)) { k += b; continue; }
+        size_t comma, colon;
+        size_t close = ScanHole(src, k + b, &comma, &colon, depth + 1,
+                                false, static_cast<int>(nq));
+        if (close == std::string_view::npos) return n;
+        k = close + dollars;
+        continue;
+      }
+      ++k;
+    }
+    return n;
+  }
+  bool interpolated = dollars > 0;
   size_t k = j + 1;
   while (k < n) {
     char c = src[k];
@@ -205,6 +247,65 @@ size_t SkipStringLike(std::string_view src, size_t i, int depth) {
     ++k;
   }
   return n;
+}
+
+// Find the end of a C#11 raw-string body whose opening run of `nq`
+// quotes ends at src[i-1]. Returns the index just past the CLOSING
+// quote run and sets [*cb, *ce) to the content span. Content may hold
+// quote runs shorter than nq; in a run of r >= nq quotes the first
+// r-nq stay content (graceful superset of Roslyn's exactly-nq rule).
+size_t ScanRawBody(std::string_view src, size_t i, int nq,
+                   size_t* cb, size_t* ce) {
+  const size_t n = src.size();
+  *cb = i;
+  while (i < n) {
+    if (src[i] != '"') { ++i; continue; }
+    size_t r = 0;
+    while (i + r < n && src[i + r] == '"') ++r;
+    if (static_cast<int>(r) >= nq) {
+      *ce = i + (r - nq);
+      return i + r;
+    }
+    i += r;
+  }
+  throw CsLexError("unterminated raw string literal");
+}
+
+// Roslyn's raw-string dedent: multi-line bodies drop the first (empty)
+// line and the closing delimiter's line, and strip the closing line's
+// indentation from every remaining line. Non-conforming bodies are
+// returned as-is (graceful degradation).
+std::string DedentRawBody(std::string_view body) {
+  size_t nl = body.find('\n');
+  if (nl == std::string_view::npos) return std::string(body);
+  std::string_view first = body.substr(0, nl);
+  if (!first.empty() && first.back() == '\r') first.remove_suffix(1);
+  if (first.find_first_not_of(" \t") != std::string_view::npos)
+    return std::string(body);  // content on the opening line: as-is
+  size_t last_nl = body.rfind('\n');
+  std::string_view indent = body.substr(last_nl + 1);
+  if (indent.find_first_not_of(" \t") != std::string_view::npos)
+    return std::string(body);  // closing line not pure indentation
+  std::string_view inner = body.substr(nl + 1, last_nl - nl - 1);
+  if (!inner.empty() && inner.back() == '\r') inner.remove_suffix(1);
+  std::string out;
+  out.reserve(inner.size());
+  size_t pos = 0;
+  while (pos <= inner.size()) {
+    size_t end = inner.find('\n', pos);
+    std::string_view line = inner.substr(
+        pos, end == std::string_view::npos ? inner.size() - pos
+                                           : end - pos);
+    std::string_view l = line;
+    if (l.size() >= indent.size() &&
+        l.substr(0, indent.size()) == indent)
+      l = l.substr(indent.size());
+    out.append(l);
+    if (end == std::string_view::npos) break;
+    out.push_back('\n');
+    pos = end + 1;
+  }
+  return out;
 }
 
 // Unescape `}}` / `{{` in an interpolation format specifier's raw text.
@@ -246,6 +347,41 @@ CsLexOutput CsLexImpl(std::string_view src, int interp_depth) {
     out.tokens.push_back(CsToken{k, src.substr(start, end - start),
                                  std::move(value), static_cast<int>(start),
                                  static_cast<int>(end)});
+  };
+  // Sub-lex an interpolation hole's expression source and splice its
+  // tokens inline (positions shifted to the enclosing file).
+  auto splice = [&](size_t from, size_t to) {
+    CsLexOutput sub = CsLexImpl(src.substr(from, to - from),
+                                interp_depth + 1);
+    for (CsToken& t : sub.tokens) {
+      if (t.kind == CsTok::kEof) break;
+      t.pos += static_cast<int>(from);
+      t.end += static_cast<int>(from);
+      out.tokens.push_back(std::move(t));
+    }
+    // hole comments are trivia; dropped like Roslyn's
+  };
+  // Emit one hole's tokens — expr [`,` align] [`:` format] — from a
+  // ScanHole result. ONE implementation for the regular and raw
+  // interpolated-string branches (the enclosing `{`/`}` markers differ
+  // in width and stay with the callers).
+  auto emit_hole_parts = [&](size_t expr_start, size_t close,
+                             size_t comma, size_t colon) {
+    size_t expr_end = close;
+    if (comma != std::string_view::npos) expr_end = comma;
+    if (colon != std::string_view::npos && colon < expr_end)
+      expr_end = colon;
+    splice(expr_start, expr_end);
+    if (comma != std::string_view::npos) {
+      push(CsTok::kPunct, comma, comma + 1, ",");
+      size_t align_end = colon != std::string_view::npos ? colon : close;
+      splice(comma + 1, align_end);
+    }
+    if (colon != std::string_view::npos) {
+      push(CsTok::kPunct, colon, colon + 1, ":");
+      push(CsTok::kString, colon + 1, close,
+           UnescapeFormatText(src.substr(colon + 1, close - colon - 1)));
+    }
   };
 
   while (i < n) {
@@ -296,6 +432,95 @@ CsLexOutput CsLexImpl(std::string_view src, int interp_depth) {
         interpolated |= src[j] == '$';
         ++j;
       }
+      size_t nq_raw = 0;
+      while (j + nq_raw < n && src[j + nq_raw] == '"') ++nq_raw;
+      // `@` excludes the raw form: `@$"""..."` is a verbatim
+      // interpolated string whose text STARTS with an escaped quote
+      // (`""`), exactly how Roslyn reads it.
+      if (nq_raw >= 3 && interpolated && !verbatim) {
+        // C#11 interpolated raw string: `$$..."""text{{hole}}..."""` —
+        // dollar count = brace count of holes; shorter brace runs are
+        // literal text; no escapes inside. Emits the same synthetic
+        // `$"` / `"$` markers as the regular interpolated path, so the
+        // parser is oblivious to the raw form.
+        int dollars = 0;
+        for (size_t p = i; p < j; ++p) dollars += src[p] == '$';
+        size_t start = i;
+        out.tokens.push_back(CsToken{CsTok::kPunct,
+                                     std::string_view("$\""), "$\"",
+                                     static_cast<int>(start),
+                                     static_cast<int>(j + nq_raw)});
+        i = j + nq_raw;
+        std::string text;
+        size_t text_start = i;
+        auto flush_text = [&](size_t endpos) {
+          if (!text.empty())
+            push(CsTok::kString, text_start, endpos, std::move(text));
+          text.clear();
+        };
+        for (;;) {
+          if (i >= n) throw CsLexError("unterminated raw string literal");
+          char ch = src[i];
+          if (ch == '"') {
+            size_t r = 0;
+            while (i + r < n && src[i + r] == '"') ++r;
+            if (r >= nq_raw) {
+              text.append(r - nq_raw, '"');
+              flush_text(i + (r - nq_raw));
+              out.tokens.push_back(CsToken{
+                  CsTok::kPunct, std::string_view("\"$"), "\"$",
+                  static_cast<int>(i + (r - nq_raw)),
+                  static_cast<int>(i + r)});
+              i += r;
+              break;
+            }
+            text.append(r, '"');
+            i += r;
+            continue;
+          }
+          if (ch == '{') {
+            size_t b = 0;
+            while (i + b < n && src[i + b] == '{') ++b;
+            if (b < static_cast<size_t>(dollars)) {
+              text.append(b, '{');
+              i += b;
+              continue;
+            }
+            text.append(b - dollars, '{');
+            flush_text(i + (b - dollars));
+            out.tokens.push_back(CsToken{
+                CsTok::kPunct, std::string_view("{"), "{",
+                static_cast<int>(i + (b - dollars)),
+                static_cast<int>(i + b)});
+            size_t comma, colon;
+            size_t close = ScanHole(src, i + b, &comma, &colon,
+                                    interp_depth + 1, false,
+                                    static_cast<int>(nq_raw));
+            if (close == std::string_view::npos)
+              throw CsLexError("unterminated interpolation hole");
+            emit_hole_parts(i + b, close, comma, colon);
+            size_t cr = 0;
+            while (close + cr < n && src[close + cr] == '}') ++cr;
+            if (cr < static_cast<size_t>(dollars))
+              throw CsLexError("interpolation hole closed with too few "
+                               "braces for its raw-string marker");
+            out.tokens.push_back(CsToken{
+                CsTok::kPunct, std::string_view("}"), "}",
+                static_cast<int>(close),
+                static_cast<int>(close + dollars)});
+            i = close + dollars;
+            text_start = i;
+            continue;
+          }
+          text.push_back(ch);  // raw strings have no escapes
+          ++i;
+        }
+        continue;
+      }
+      if (nq_raw >= 3) {
+        // `@"""` etc. — verbatim marker on a raw string is invalid C#;
+        // fall through to the graceful paths below.
+      }
       if (j < n && src[j] == '"' && interpolated) {
         // Interpolated string: emit synthetic `$"` ... `"$` markers with
         // text segments as kString tokens and each hole's expression
@@ -317,17 +542,6 @@ CsLexOutput CsLexImpl(std::string_view src, int interp_depth) {
           if (!text.empty())
             push(CsTok::kString, text_start, endpos, std::move(text));
           text.clear();
-        };
-        auto splice = [&](size_t from, size_t to) {
-          CsLexOutput sub = CsLexImpl(src.substr(from, to - from),
-                                      interp_depth + 1);
-          for (CsToken& t : sub.tokens) {
-            if (t.kind == CsTok::kEof) break;
-            t.pos += static_cast<int>(from);
-            t.end += static_cast<int>(from);
-            out.tokens.push_back(std::move(t));
-          }
-          // hole comments are trivia; dropped like Roslyn's
         };
         for (;;) {
           if (i >= n) throw CsLexError("unterminated interpolated string");
@@ -359,23 +573,7 @@ CsLexOutput CsLexImpl(std::string_view src, int interp_depth) {
                                     interp_depth + 1, verbatim);
             if (close == std::string_view::npos)
               throw CsLexError("unterminated interpolation hole");
-            size_t expr_end = close;
-            if (comma != std::string_view::npos) expr_end = comma;
-            if (colon != std::string_view::npos && colon < expr_end)
-              expr_end = colon;
-            splice(i + 1, expr_end);
-            if (comma != std::string_view::npos) {
-              push(CsTok::kPunct, comma, comma + 1, ",");
-              size_t align_end =
-                  colon != std::string_view::npos ? colon : close;
-              splice(comma + 1, align_end);
-            }
-            if (colon != std::string_view::npos) {
-              push(CsTok::kPunct, colon, colon + 1, ":");
-              push(CsTok::kString, colon + 1, close,
-                   UnescapeFormatText(
-                       src.substr(colon + 1, close - colon - 1)));
-            }
+            emit_hole_parts(i + 1, close, comma, colon);
             push(CsTok::kPunct, close, close + 1, "}");
             i = close + 1;
             text_start = i;
@@ -506,6 +704,18 @@ CsLexOutput CsLexImpl(std::string_view src, int interp_depth) {
       if (i >= n) throw CsLexError("unterminated char literal");
       ++i;
       push(CsTok::kChar, start, i, std::move(value));
+      continue;
+    }
+    if (c == '"' && i + 2 < n && src[i + 1] == '"' && src[i + 2] == '"') {
+      // C#11 raw string literal `"""..."""` (3+ quote delimiter,
+      // no escapes, multi-line with closing-line dedent).
+      size_t start = i;
+      int nq = 0;
+      while (i < n && src[i] == '"') { ++nq; ++i; }
+      size_t cb, ce;
+      i = ScanRawBody(src, i, nq, &cb, &ce);
+      push(CsTok::kString, start, i,
+           DedentRawBody(src.substr(cb, ce - cb)));
       continue;
     }
     if (c == '"') {

@@ -513,6 +513,42 @@ public class C
     assert ",p " in by_name["verb"] or " p," in by_name["verb"]
 
 
+def test_raw_string_literals(extractor, cs_file):
+    """C#11 raw strings: `\"\"\"...\"\"\"` (no escapes, inner quotes
+    legal, multi-line with closing-line dedent) and interpolated raw
+    `$\"\"\"`/`$$\"\"\"` where the dollar count sets the hole's brace
+    count; shorter brace runs stay literal text."""
+    code = '''
+public class C
+{
+    string Plain() { return """hello "quoted" raw"""; }
+    string Multi()
+    {
+        return """
+            line one
+            line two
+            """;
+    }
+    string Interp(User u) { return $"""val {u.Name} end"""; }
+    string Dollar(User u) { return $$"""lit {brace} hole {{u.Id}} end"""; }
+    int After() { return 7; }
+}
+'''
+    lines = extractor(cs_file(code), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["plain", "multi", "interp", "dollar", "after"]
+    by_name = dict(zip(names, lines))
+    assert "hello|quoted|raw" in by_name["plain"]
+    # dedent: closing-line indentation stripped, inner newline kept
+    assert "line|one|line|two" in by_name["multi"]
+    # interpolated raw: hole leaves reach contexts
+    assert "Interpolation" in by_name["interp"]
+    assert ",name " in by_name["interp"] or " name," in by_name["interp"]
+    # $$: single-brace runs are TEXT, double-brace runs are holes
+    assert ",id " in by_name["dollar"] or " id," in by_name["dollar"]
+    assert "brace" in by_name["dollar"]
+
+
 def test_adversarial_nesting_fails_cleanly(cs_file):
     """Pathological nesting -> clean error or per-member skip, never a
     SIGSEGV (parser DepthGuard + iterative CsCheckAstDepth)."""

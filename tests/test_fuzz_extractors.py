@@ -30,6 +30,8 @@ SEEDS_CS = [
     'class A { string S = $"interp {1+1} tail"; int F() => 2; }',
     ('class A2 { string G(User u) => $"x {u.Name,-8:F2} y '
      '{(u.Ok ? $@"in ""{u.Id}"" {{esc}}" : "no")} z"; }'),
+    ('class A3 { string R() => """raw "q" body"""; '
+     'string S(User u) => $$"""t {b} {{u.Id}} e"""; }'),
     ('class B<T> where T : struct { event System.EventHandler E; '
      'public static implicit operator int(B<T> b) => 0; }'),
     'class D { string V = @"verbatim ""q"" here"; int this[int i] => i; }',
@@ -77,3 +79,63 @@ def test_mutated_inputs_never_crash(language, tmp_path):
         assert proc.returncode >= 0, (
             f"iter {it}: extractor died on signal {-proc.returncode}; "
             f"input saved at {path}")
+
+
+# ---- structure-aware interpolated-string fuzz (bounded CI version) ----
+#
+# Unlike the byte-mutation fuzz above (no-crash only), this generates
+# VALID nested $-strings — holes with member accesses, calls, ternaries,
+# alignments, format clauses, verbatim/raw nesting — and requires them
+# to PARSE (both generated methods extracted). The offline 12K-case
+# campaign of this generator found two real parser bugs in round 5
+# (tuple-element declaration speculation eating `(c ? x : y)`, and
+# `@$"""` misread as a raw string), so the full-parse property is pinned
+# here, not just crash-freedom.
+
+def _gen_expr(rng, depth):
+    c = rng.randrange(6 if depth < 3 else 4)
+    if c == 0:
+        return rng.choice(["x", "user.Name", "a.B.C", "f(x)", "xs[i]"])
+    if c == 1:
+        return str(rng.randrange(100))
+    if c == 2:
+        return f"({_gen_expr(rng, depth + 1)} + {_gen_expr(rng, depth + 1)})"
+    if c == 3:
+        return '"lit"'
+    if c == 4:
+        return _gen_interp(rng, depth + 1)
+    return f"(c ? {_gen_expr(rng, depth + 1)} : {_gen_expr(rng, depth + 1)})"
+
+
+def _gen_interp(rng, depth):
+    verbatim = rng.random() < 0.25
+    q = ('$@"' if (verbatim and rng.random() < 0.5)
+         else ('@$"' if verbatim else '$"'))
+    parts = []
+    for _ in range(rng.randrange(4)):
+        parts.append(rng.choice(
+            ["txt", "a b", "{{", "}}", '""' if verbatim else "\\n", ""]))
+        hole = _gen_expr(rng, depth)
+        if rng.random() < 0.3:
+            hole += f",{rng.randrange(20)}"
+        if rng.random() < 0.3:
+            hole += ":" + rng.choice(["F2", "000", "N}}q", "x{{y"])
+        parts.append("{" + hole + "}")
+    parts.append(rng.choice(["tail", ""]))
+    return q + "".join(parts) + '"'
+
+
+def test_generated_interpolations_parse(tmp_path):
+    rng = random.Random(424)
+    path = tmp_path / "interp.cs"
+    for it in range(300):
+        s = _gen_interp(rng, 0)
+        code = (f"class C {{ string M() {{ return {s}; }} "
+                f"int K() {{ return 1; }} }}")
+        path.write_text(code)
+        proc = subprocess.run([CS_BIN, "--path", str(path), "--no_hash"],
+                              capture_output=True, timeout=30, text=True)
+        assert proc.returncode == 0, (it, code, proc.stderr)
+        names = [ln.split(" ", 1)[0]
+                 for ln in proc.stdout.splitlines() if ln.strip()]
+        assert names == ["m", "k"], (it, code, names, proc.stderr[:200])
