@@ -482,8 +482,10 @@ def append_cs_section(results: dict, path: str) -> None:
         "",
         "Same harness, C# end to end: generated C# corpus",
         "(experiments/csgen.py — javagen's families rendered in C#, so the",
-        "same Bayes ceiling applies) -> native C# extractor",
-        "(cpp/c2v-extract-cs; reference:",
+        "same Bayes ceiling applies; since round 5 the describe family",
+        "renders as an interpolated string, so the extractor's",
+        "InterpolatedStringExpression path is exercised corpus-wide) ->",
+        "native C# extractor (cpp/c2v-extract-cs; reference:",
         "CSharpExtractor/Extractor/Extractor.cs:46-99) -> preprocess ->",
         "train -> eval.",
         "",

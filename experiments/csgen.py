@@ -54,6 +54,13 @@ _LINE_RULES = [
     (re.compile(r"!(this\.\w+)\.isEmpty\(\)"), r"\1.Count > 0"),
     (re.compile(r"\.isEmpty\(\)"), ".Count == 0"),
     # strings
+    # fam_describe's scalar arm becomes the idiomatic C# interpolated
+    # string — the extractor's InterpolatedStringExpression path is then
+    # exercised by the full corpus pipeline, not only by unit tests. The
+    # observable identifiers are unchanged (the field name appears either
+    # way), so the Bayes ceiling is untouched.
+    (re.compile(r'return "(\w+)=" \+ this\.(\w+);'),
+     r'return $"\1={this.\2}";'),
     (re.compile(r"StringBuilder sb = new StringBuilder\(\);"),
      "var sb = new System.Text.StringBuilder();"),
     (re.compile(r"\.append\("), ".Append("),
