@@ -223,7 +223,7 @@ def main() -> None:
                       "transfers (~200-450ms per 2.5MB); real TPU hosts "
                       "are bounded by the device/host stages above",
         },
-        "train_throughput_same_chip_see": "BENCH_r03.json",
+        "train_throughput_same_chip_see": "latest BENCH_r<N>.json (driver-recorded bench.py run)",
     }
     with open(os.path.join(REPO, "BENCH_EVAL.json"), "w") as f:
         json.dump(out, f, indent=2)
