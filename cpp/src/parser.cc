@@ -1599,6 +1599,7 @@ class Parser {
           Cur().kind == Tok::kStringLit || Is("(") || Is("!") || Is("~") ||
           IsKw("new") || IsKw("this") || IsKw("super") || IsKw("true") ||
           IsKw("false") || IsKw("null") ||
+          IsKw("switch") ||  // Java 14 switch EXPRESSION as cast operand
           (Cur().kind == Tok::kIdent && IsPrimitiveName(Cur().text));
       if (primitive) operand_start = operand_start || Is("+") || Is("-") ||
                                      Is("++") || Is("--");

@@ -361,6 +361,25 @@ public class YieldParen {
     assert "YieldStmt" not in lines[1]
 
 
+def test_cast_of_switch_expression(extractor, java_file):
+    """`(int) switch (k) {...}` — a switch EXPRESSION is a legal cast
+    operand (Java 14); TryParseCast's operand-start set must admit the
+    `switch` keyword. Found by the round-5 structure-aware Java fuzzer
+    (438/8000 generated methods previously lost to skip recovery)."""
+    code = """
+public class CastSwitch {
+    int k;
+    int prim() { return (int) switch (k) { case 1 -> 1; default -> 0; }; }
+    Object ref() { return (Object) switch (k) { case 1 -> "a"; default -> "b"; }; }
+    int keep() { return 1; }
+}
+"""
+    lines = extractor(java_file(code), "--no_hash")
+    names = [ln.split(" ", 1)[0] for ln in lines]
+    assert names == ["prim", "ref", "keep"]
+    assert "CastExpr" in lines[0] and "SwitchExpr" in lines[0]
+
+
 def test_java_per_member_recovery(java_file, extractor, tmp_path):
     import subprocess as sp
     # the middle method uses a Java 21 type-pattern switch case, which

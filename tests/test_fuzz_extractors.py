@@ -125,6 +125,75 @@ def _gen_interp(rng, depth):
     return q + "".join(parts) + '"'
 
 
+def _gen_java_expr(rng, d):
+    c = rng.randrange(8 if d < 3 else 5)
+    if c == 0:
+        return rng.choice(["x", "this.a", "u.name", "f(x)", "xs[i]", "A.B.c"])
+    if c == 1:
+        return str(rng.randrange(100))
+    if c == 2:
+        return f"({_gen_java_expr(rng, d + 1)} + {_gen_java_expr(rng, d + 1)})"
+    if c == 3:
+        return '"lit"'
+    if c == 4:
+        return (f"(c ? {_gen_java_expr(rng, d + 1)} : "
+                f"{_gen_java_expr(rng, d + 1)})")
+    if c == 5:
+        return (f"((java.util.List<String>) "
+                f"{_gen_java_expr(rng, d + 1)}).size()")
+    if c == 6:
+        return (f"switch (k) {{ case 1 -> {_gen_java_expr(rng, d + 1)}; "
+                f"default -> {_gen_java_expr(rng, d + 1)}; }}")
+    return f"xs.stream().map(v -> {_gen_java_expr(rng, d + 1)}).count()"
+
+
+def _gen_java_stmt(rng, d):
+    c = rng.randrange(7)
+    if c == 0:
+        return f"int q{d} = (int) ({_gen_java_expr(rng, d)});"
+    if c == 1:
+        return f"if (o instanceof String s{d}) {{ use(s{d}); }}"
+    if c == 2:
+        return (f"for (int i{d} = 0; i{d} < 3; i{d}++) "
+                f"{{ use({_gen_java_expr(rng, d)}); }}")
+    if c == 3:
+        return f"var t{d} = {_gen_java_expr(rng, d)};"
+    if c == 4:
+        return ('String tb = """\n        text block "quoted"\n'
+                '        """;')
+    if c == 5:
+        return (f"int r{d} = switch (k) {{ case 1: yield (int) "
+                f"({_gen_java_expr(rng, d)}); default: yield 0; }};")
+    return f"use({_gen_java_expr(rng, d)});"
+
+
+def test_generated_java_methods_parse(tmp_path):
+    """Structure-aware Java fuzz, full-parse property: generated methods
+    mix casts, ternaries, switch expressions (incl. as cast operands and
+    with colon+yield bodies), instanceof patterns, text blocks, lambdas
+    and generic casts — every method must extract. The offline 8K-case
+    campaign of this generator found the cast-of-switch-expression gap
+    in round 5 (tests/test_extractor.py::test_cast_of_switch_expression)."""
+    rng = random.Random(99)
+    path = tmp_path / "gen.java"
+    for it in range(200):
+        body = "\n        ".join(
+            _gen_java_stmt(rng, 0) for _ in range(rng.randint(1, 4)))
+        code = ("public class C {\n"
+                "    int k; Object o; int[] xs; U u; boolean c; int x;\n"
+                f"    void m() {{\n        {body}\n    }}\n"
+                "    int keep() { return 1; }\n}\n")
+        path.write_text(code)
+        proc = subprocess.run(
+            [JAVA_BIN, "--max_path_length", "8", "--max_path_width", "2",
+             "--file", str(path), "--no_hash"],
+            capture_output=True, timeout=30, text=True)
+        assert proc.returncode == 0, (it, code, proc.stderr)
+        names = [ln.split(" ", 1)[0]
+                 for ln in proc.stdout.splitlines() if ln.strip()]
+        assert names == ["m", "keep"], (it, code, names, proc.stderr[:200])
+
+
 def test_generated_interpolations_parse(tmp_path):
     rng = random.Random(424)
     path = tmp_path / "interp.cs"
