@@ -11,4 +11,15 @@ Orbax checkpoints — not a translation of the reference's TF graphs.
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# Sharding-invariant PRNG: the sharded kernels assume a dropout pattern
+# that is bit-identical whether the batch lives on one device or a mesh
+# (newer jax makes this the only behavior; jax < 0.5 defaults the flag
+# off, which would make GSPMD runs diverge from single-device parity).
+try:
+    _jax.config.update("jax_threefry_partitionable", True)
+except Exception:  # flag retired (always-on) in newer jax
+    pass
+
 from code2vec_tpu.config import Config  # noqa: F401

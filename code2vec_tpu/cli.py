@@ -86,6 +86,17 @@ def arguments_parser() -> ArgumentParser:
                         help="checkpoint-and-stop (like SIGTERM "
                              "preemption) when process peak RSS crosses "
                              "this many GB; 0 disables")
+    parser.add_argument("--on_nonfinite_loss", choices=["halt", "warn"],
+                        default=None,
+                        help="what to do when a log-window average loss "
+                             "is NaN/Inf: halt (default; checkpoint via "
+                             "the preemption path and exit nonzero) or "
+                             "warn (log and continue)")
+    parser.add_argument("--extractor_timeout", dest="extractor_timeout_s",
+                        type=float, default=None, metavar="SECONDS",
+                        help="kill a hung serving-side path-extractor "
+                             "child after this many seconds (default: "
+                             "config.py's 120; 0 disables)")
     parser.add_argument("--profile_dir", metavar="DIR",
                         help="write a jax.profiler trace of train batches "
                              "10-20 to DIR (TensorBoard/Perfetto viewable)")
@@ -110,7 +121,9 @@ def config_from_args(argv=None) -> Config:
         use_sparse_embedding_update=args.sparse_embedding_update,
         dp=args.dp, tp=args.tp, cp=args.cp,
         compute_dtype=args.compute_dtype,
-        **{knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype")
+        **{knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
+                                    "on_nonfinite_loss",
+                                    "extractor_timeout_s")
            if (value := getattr(args, knob)) is not None},
         seed=args.seed,
         use_packed_data=not args.no_packed_data,

@@ -35,6 +35,18 @@ class Config:
     # (clean resumable stop instead of a kernel OOM kill mid-epoch).
     # 0 disables. No reference analog.
     rss_limit_gb: float = 0.0
+    # Non-finite-loss sentinel policy (training/loop.py): "halt"
+    # checkpoints via the preemption save path and exits nonzero the
+    # first time a log-window average loss is NaN/Inf; "warn" logs and
+    # keeps going. No reference analog — a diverged reference run just
+    # prints NaN losses forever.
+    on_nonfinite_loss: str = "halt"
+    # Seconds before a hung serving-side path extraction is killed
+    # (serving/extractor_bridge.py). The offline preprocess pipeline has
+    # its own kill-timer (data/preprocess.py); this covers the
+    # interactive/serving bridge, where one wedged extractor child would
+    # otherwise hang the predict request forever. 0 disables.
+    extractor_timeout_s: float = 120.0
     train_batch_size: int = 1024
     test_batch_size: int = 1024
     top_k_words_considered_during_prediction: int = 10
@@ -267,6 +279,11 @@ class Config:
                 "dropout_prng_impl must be rbg, threefry2x32 or unsafe_rbg.")
         if self.rss_limit_gb < 0:
             raise ValueError("rss_limit_gb must be >= 0 (0 disables).")
+        if self.on_nonfinite_loss not in ("halt", "warn"):
+            raise ValueError("on_nonfinite_loss must be halt or warn.")
+        if self.extractor_timeout_s < 0:
+            raise ValueError(
+                "extractor_timeout_s must be >= 0 (0 disables).")
 
     # ---------------------------------------------------------------- logging
 

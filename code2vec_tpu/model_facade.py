@@ -54,6 +54,18 @@ class Code2VecModel:
         config.verify()
         self.log = config.log
         self.log("Creating code2vec TPU model")
+        if config.is_loading:
+            # `--load` accepts either a concrete artifact directory or a
+            # save base: a base resolves to the newest artifact that
+            # PASSES its integrity check (walking past any half-written
+            # casualty of a mid-save kill). Resolved before vocab
+            # loading — dictionaries.bin comes from the same directory.
+            resolved = ckpt_mod.resolve_load_path(config.model_load_path,
+                                                  log=self.log)
+            if resolved != os.path.abspath(config.model_load_path):
+                self.log(f"Resolved --load {config.model_load_path} -> "
+                         f"{resolved}")
+            config.model_load_path = resolved
         # Full hyperparameter dump at model creation (reference:
         # model_base.py:61-68 logs every config field).
         for name, value in sorted(config.items()):
@@ -280,20 +292,62 @@ class Code2VecModel:
         # reference keeps MAX_TO_KEEP epoch checkpoints (config.py:57).
         config = self.config
         pattern = f"{config.model_save_path}_iter*"
-        parsed = {p: ckpt_mod.parse_iter_name(p)
-                  for p in glob.glob(pattern)}
+        # Sweep orphaned commit-protocol dirs (`.tmp-<pid>` staging /
+        # `.old-<pid>` backups) left by killed saves — but never another
+        # LIVE process's in-flight staging dir. A complete orphan whose
+        # final name sits empty (kill landed between the swap renames)
+        # is promoted back rather than deleted; `.tmp-` dirs go first so
+        # the NEWER state wins the slot over its `.old-` predecessor.
+        orphans = [p for p in glob.glob(pattern)
+                   if ckpt_mod.is_staging_path(p)
+                   and not ckpt_mod.staging_owner_alive(p)]
+        for p in sorted(orphans,
+                        key=lambda p: ckpt_mod.BACKUP_INFIX in os.path.basename(p)):
+            if ckpt_mod.reclaim_orphan(p, log=self.log) == "removed":
+                self.log(f"Swept orphaned checkpoint staging dir {p}")
+        paths = glob.glob(pattern)  # re-glob: promotion adds artifacts
+        parsed = {p: ckpt_mod.parse_iter_name(p) for p in paths}
+
+        valid_cache: Dict[str, bool] = {}
+
+        def is_valid(p: str) -> bool:
+            if p not in valid_cache:
+                try:
+                    ckpt_mod.verify_checkpoint(p)
+                    valid_cache[p] = True
+                except ckpt_mod.CheckpointIntegrityError:
+                    valid_cache[p] = False
+            return valid_cache[p]
+
         clean = sorted((p for p, v in parsed.items()
                         if v is not None and not v[1]),
                        key=lambda p: parsed[p][0])
-        for stale in clean[:-config.max_to_keep]:
+        victims = clean[:-config.max_to_keep] if config.max_to_keep else []
+        retained = clean[len(victims):]
+        if victims and not any(is_valid(p) for p in retained):
+            # Never delete the only valid artifact: if every retained
+            # checkpoint fails its integrity check (disk rot, torn
+            # writes), keep the newest victim that still verifies —
+            # losing rotation hygiene beats losing the run.
+            for p in reversed(victims):
+                if is_valid(p):
+                    self.log(f"Rotation keeping over-quota checkpoint {p}:"
+                             f" it is the only one passing verification")
+                    victims.remove(p)
+                    break
+        for stale in victims:
             shutil.rmtree(stale, ignore_errors=True)
-        if clean:
-            # A clean epoch save supersedes any preemption checkpoint from
-            # that epoch or earlier; without this, repeatedly-preempted
-            # long runs accumulate unbounded `_iter<N>_preempt` artifacts.
-            newest_clean = parsed[clean[-1]][0]
+        # A clean epoch save supersedes any preemption checkpoint from
+        # that epoch or earlier; without this, repeatedly-preempted
+        # long runs accumulate unbounded `_iter<N>_preempt` artifacts.
+        # Only a clean artifact that VERIFIES supersedes: deleting a
+        # preempt checkpoint on the say-so of a corrupt newer save could
+        # delete the only loadable state.
+        newest_valid_clean = next(
+            (parsed[p][0] for p in reversed(clean) if is_valid(p)), None)
+        if newest_valid_clean is not None:
             for p, v in parsed.items():
-                if v is not None and v[1] and v[0] <= newest_clean:
+                if v is not None and v[1] and v[0] <= newest_valid_clean:
                     shutil.rmtree(p, ignore_errors=True)
 
     # ------------------------------------------------------------ eval

@@ -16,12 +16,19 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from code2vec_tpu.common import java_string_hashcode
 
 DEFAULT_JAR_PATH = "JavaExtractor/JPredict/target/JavaExtractor-0.0.1-SNAPSHOT.jar"
 NATIVE_EXTRACTOR_ENV = "C2V_NATIVE_EXTRACTOR"
+
+
+class ExtractionTimeout(ValueError):
+    """A hung extractor child was killed after the configured timeout.
+    Subclasses ValueError so every existing extraction-failure handler
+    (e.g. the interactive REPL's catch-print-continue) treats a timeout
+    like any other failed extraction instead of crashing the session."""
 
 
 def _native_extractor_path() -> str:
@@ -35,11 +42,19 @@ def _native_extractor_path() -> str:
 
 class PathExtractor:
     def __init__(self, config, jar_path: str = DEFAULT_JAR_PATH,
-                 max_path_length: int = 8, max_path_width: int = 2):
+                 max_path_length: int = 8, max_path_width: int = 2,
+                 timeout: Optional[float] = None):
         self.config = config
         self.jar_path = jar_path
         self.max_path_length = max_path_length
         self.max_path_width = max_path_width
+        # The offline preprocess pipeline kills hung extractions after a
+        # timeout (data/preprocess.py); the serving bridge needs the same
+        # or one wedged child hangs the predict request forever. None
+        # defers to config.extractor_timeout_s; <= 0 disables.
+        if timeout is None:
+            timeout = float(getattr(config, "extractor_timeout_s", 120.0))
+        self.timeout = timeout if timeout > 0 else None
 
     def _build_command(self, path: str) -> List[str]:
         native = _native_extractor_path()
@@ -60,8 +75,24 @@ class PathExtractor:
         command = self._build_command(path)
         process = subprocess.Popen(command, stdout=subprocess.PIPE,
                                    stderr=subprocess.PIPE)
-        out, err = process.communicate()
+        try:
+            out, err = process.communicate(timeout=self.timeout)
+        except subprocess.TimeoutExpired:
+            process.kill()
+            out, err = process.communicate()
+            raise ExtractionTimeout(
+                f"path extraction of {path} exceeded {self.timeout:g}s "
+                f"and was killed; partial stderr: "
+                f"{err.decode(errors='replace').strip()!r}")
         output = out.decode().splitlines()
+        if process.returncode != 0:
+            # Surface stderr even when the child produced some stdout —
+            # a nonzero exit means the extraction is incomplete and the
+            # partial output must not be silently served.
+            raise ValueError(
+                f"extractor exited with code {process.returncode} on "
+                f"{path} ({len(output)} stdout lines discarded); stderr: "
+                f"{err.decode(errors='replace').strip()!r}")
         if len(output) == 0:
             raise ValueError(err.decode())
         hash_to_string: Dict[str, str] = {}

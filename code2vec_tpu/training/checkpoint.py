@@ -12,33 +12,113 @@ Reference behavior being reproduced (TPU-natively, not with TF Savers):
 
 Orbax gives async, sharded, multi-host-safe saves (SURVEY.md §5 plan:
 preemption-tolerant checkpointing for TPU pods).
+
+Crash-atomic commit protocol (no reference analog — the reference loses
+work on any failure; here the preemption path itself must survive a kill
+landing mid-save, since a grace window that expires during `save_model`
+would otherwise leave a half-written `_iter<N>` directory that the next
+`--load` resume picks by name and dies on):
+
+1. every file is written into a `<base>.tmp-<pid>` staging directory;
+2. a manifest (file list + sizes, sha256 of `dictionaries.bin` and the
+   meta JSON, an Orbax-completion marker) is recorded LAST, after
+   `wait_until_finished`, so its presence certifies the whole artifact;
+3. the staging dir is `os.rename`d into place — atomic on POSIX, so a
+   crash leaves either the old artifact or the new one, never a blend;
+4. orphaned staging dirs from killed saves are swept by checkpoint
+   rotation (model_facade._rotate_epoch_checkpoints).
+
+Restore is integrity-verified: `verify_checkpoint` re-checks the
+manifest, `latest_valid_checkpoint` walks newest -> oldest past any
+candidate that fails it, and `load_model` verifies before handing the
+directory to Orbax so truncation fails fast with a named file instead of
+an opaque pytree error deep in the restore.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Optional
+import shutil
+from typing import Callable, Optional
 
 import numpy as np
 import orbax.checkpoint as ocp
 
 from code2vec_tpu.training.state import TrainState
+from code2vec_tpu.utils.faults import fault_point
 
 _STATE_DIR = "state"
 _META_NAME = "code2vec_meta.json"
+MANIFEST_NAME = "code2vec_manifest.json"
+MANIFEST_FORMAT = 1
 RELEASED_SUFFIX = ".release"
+# Commit-protocol working dirs: `.tmp-<pid>` is the staging dir a save
+# builds in; `.old-<pid>` briefly holds the previous artifact while a
+# same-path overwrite swaps the new one in.
+STAGING_INFIX = ".tmp-"
+BACKUP_INFIX = ".old-"
+
+# Small files worth a full content hash in the manifest. The Orbax state
+# files are covered by existence+size only — hashing multi-GB shards on
+# every save/probe would dominate checkpoint time, and Orbax already
+# checksums its own payloads internally.
+_HASHED_FILES = ("dictionaries.bin", _META_NAME)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """An artifact failed its manifest/structure check. The message names
+    the offending file so a truncated/corrupt checkpoint is diagnosable
+    without spelunking Orbax internals."""
 
 
 def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+def is_staging_path(path: str) -> bool:
+    """True for commit-protocol working dirs (`<base>.tmp-<pid>` staging,
+    `<base>.old-<pid>` overwrite backups) that must never be treated as
+    artifacts."""
+    name = os.path.basename(path.rstrip(os.sep))
+    return STAGING_INFIX in name or BACKUP_INFIX in name
+
+
+def staging_owner_alive(path: str) -> bool:
+    """Does the process that created this staging/backup dir still run?
+    Used by the sweeper so a concurrent save's in-flight staging dir is
+    left alone while leftovers of killed saves are reclaimed. Unparseable
+    names are treated as orphaned."""
+    name = os.path.basename(path.rstrip(os.sep))
+    for infix in (STAGING_INFIX, BACKUP_INFIX):
+        if infix in name:
+            tail = name.rsplit(infix, 1)[1]
+            break
+    else:
+        return False
+    try:
+        pid = int(tail)
+    except ValueError:
+        return False
+    if pid == os.getpid():
+        return True
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by another user
+
+
 def parse_iter_name(path: str):
     """Parse a `<base>_iter<N>[_preempt]` artifact path into
     (epoch, is_preempt), or None if the tail is not of that form. Single
     source of truth for the epoch-checkpoint naming convention (written
-    by model_facade's save_fn; consumed by rotation and resume)."""
+    by model_facade's save_fn; consumed by rotation and resume). Staging
+    dirs (`..._iter<N>.tmp-<pid>`) parse as None, so every consumer
+    ignores them for free."""
     if "_iter" not in path:
         return None
     tail = path.rsplit("_iter", 1)[1]
@@ -51,31 +131,252 @@ def parse_iter_name(path: str):
         return None
 
 
-def latest_checkpoint(save_base: str):
-    """Newest `<save_base>_iter<N>[_preempt]` artifact path (None if no
-    artifacts exist). At equal N the preemption artifact wins: it was
-    written mid-epoch N+1, so its params are strictly more trained than
-    the clean end-of-epoch-N save."""
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record a directory entry (the rename commit). Best-effort:
+    some filesystems refuse O_RDONLY fsync on directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_manifest(base: str, epoch: int, released: bool) -> None:
+    """Record every file in the (staged) artifact with its size, plus
+    content hashes for the small sidecars. Written last: its presence is
+    the Orbax-completion marker — `save_model` only writes it after
+    `wait_until_finished`, so a manifest-bearing directory is a fully
+    flushed artifact."""
+    files = {}
+    for root, _dirs, names in os.walk(base):
+        for name in names:
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, base)
+            if rel == MANIFEST_NAME:
+                continue
+            entry = {"size": os.path.getsize(p)}
+            if rel in _HASHED_FILES:
+                entry["sha256"] = _sha256_file(p)
+            files[rel] = entry
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "epoch": epoch,
+        "released": released,
+        "orbax_complete": True,
+        "files": files,
+    }
+    path = os.path.join(base, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _commit_staging(staging: str, base: str) -> None:
+    """Atomically promote a fully written staging dir to the final path.
+    Overwrites swap through a `.old-<pid>` backup so there is never a
+    moment with no artifact at `base`; a kill mid-swap leaves the backup
+    for the sweeper and the verifier-guided fallback to sort out."""
+    fault_point("checkpoint_commit")
+    if os.path.isdir(base):
+        backup = f"{base}{BACKUP_INFIX}{os.getpid()}"
+        if os.path.isdir(backup):
+            shutil.rmtree(backup)
+        os.rename(base, backup)
+        # A kill in this window leaves NOTHING at `base` but two intact
+        # copies (`.tmp-` new, `.old-` previous); the sweeper promotes
+        # whichever verifies (reclaim_orphan) instead of deleting them.
+        fault_point("checkpoint_swap")
+        os.rename(staging, base)
+        shutil.rmtree(backup, ignore_errors=True)
+    else:
+        os.rename(staging, base)
+    _fsync_dir(os.path.dirname(base) or ".")
+
+
+def reclaim_orphan(path: str,
+                   log: Optional[Callable[[str], None]] = None) -> str:
+    """Reclaim one orphaned commit-protocol dir (a `.tmp-`/`.old-` whose
+    owning process is gone). If the final name is unoccupied and the
+    orphan passes verification — the kill-between-swap-renames window
+    leaves exactly that — it is PROMOTED back via rename (a complete
+    artifact must never be deleted while its slot sits empty); anything
+    else is removed. Returns "promoted" or "removed"."""
+    dirpart, name = os.path.split(os.path.abspath(path.rstrip(os.sep)))
+    for infix in (STAGING_INFIX, BACKUP_INFIX):
+        if infix in name:
+            base = os.path.join(dirpart, name.rsplit(infix, 1)[0])
+            break
+    else:
+        return "removed"  # not a commit-protocol dir; caller filtered wrong
+    if not os.path.exists(base):
+        try:
+            verify_checkpoint(path)
+        except CheckpointIntegrityError:
+            pass
+        else:
+            os.rename(path, base)
+            _fsync_dir(dirpart)
+            if log is not None:
+                log(f"Promoted orphaned-but-complete checkpoint {path} "
+                    f"back to {base} (save was killed mid-commit)")
+            return "promoted"
+    shutil.rmtree(path, ignore_errors=True)
+    return "removed"
+
+
+def verify_checkpoint(model_path: str) -> dict:
+    """Probe an artifact against its manifest; returns the parsed meta on
+    success, raises CheckpointIntegrityError naming the first offending
+    file otherwise. Cheap by design (stat per file, hash only the small
+    sidecars), so resume can probe a fallback chain and rotation can
+    re-check candidates without meaningful cost.
+
+    Pre-manifest (legacy) artifacts get a structural probe instead:
+    required files present, meta parseable, Orbax state dir non-empty —
+    enough to reject the blatant half-writes the old layout could leave.
+    """
+    base = _abs(model_path)
+    if not os.path.isdir(base):
+        raise CheckpointIntegrityError(f"{base}: not a directory")
+    manifest_path = os.path.join(base, MANIFEST_NAME)
+    if not os.path.isfile(manifest_path):
+        return _verify_legacy(base)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"{manifest_path}: unreadable or corrupt manifest ({e})")
+    if not isinstance(manifest, dict) or not isinstance(
+            manifest.get("files"), dict):
+        raise CheckpointIntegrityError(
+            f"{manifest_path}: malformed manifest (no file table)")
+    if not manifest.get("orbax_complete"):
+        raise CheckpointIntegrityError(
+            f"{manifest_path}: Orbax completion marker missing — the save "
+            f"was interrupted before wait_until_finished")
+    for rel, entry in manifest["files"].items():
+        p = os.path.join(base, rel)
+        if not os.path.isfile(p):
+            raise CheckpointIntegrityError(f"{p}: listed in manifest but missing")
+        size = os.path.getsize(p)
+        if size != entry.get("size"):
+            raise CheckpointIntegrityError(
+                f"{p}: size {size} != manifest size {entry.get('size')} "
+                f"(truncated or partially written)")
+        want_hash = entry.get("sha256")
+        if want_hash and _sha256_file(p) != want_hash:
+            raise CheckpointIntegrityError(
+                f"{p}: sha256 mismatch against manifest (corrupt)")
+    return _load_meta_checked(base)
+
+
+def _verify_legacy(base: str) -> dict:
+    for rel in ("dictionaries.bin", _META_NAME):
+        if not os.path.isfile(os.path.join(base, rel)):
+            raise CheckpointIntegrityError(
+                f"{os.path.join(base, rel)}: required file missing "
+                f"(no manifest to consult; pre-manifest artifact)")
+    meta = _load_meta_checked(base)
+    state_dir = os.path.join(base, _STATE_DIR)
+    if not os.path.isdir(state_dir) or not os.listdir(state_dir):
+        raise CheckpointIntegrityError(
+            f"{state_dir}: Orbax state directory missing or empty")
+    return meta
+
+
+def _load_meta_checked(base: str) -> dict:
+    meta_path = os.path.join(base, _META_NAME)
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"{meta_path}: unreadable or corrupt meta ({e})")
+
+
+def latest_valid_checkpoint(save_base: str,
+                            log: Optional[Callable[[str], None]] = None):
+    """Newest `<save_base>_iter<N>[_preempt]` artifact that PASSES its
+    integrity check (None if no candidate does). Walks newest -> oldest
+    past corrupt/partial artifacts, logging each skip, so a save killed
+    mid-write (or a disk that ate a file) costs at most the epochs since
+    the last valid artifact instead of the whole run.
+
+    At equal N the preemption artifact wins: it was written mid-epoch
+    N+1, so its params are strictly more trained than the clean
+    end-of-epoch-N save."""
     import glob
-    best = None  # ((epoch, is_preempt), path)
+    candidates = []  # ((epoch, is_preempt), path)
     for p in glob.glob(save_base + "_iter*"):
         parsed = parse_iter_name(p)
         if parsed is None:
             continue
-        if best is None or parsed > best[0]:
-            best = (parsed, p)
-    return best[1] if best else None
+        candidates.append((parsed, p))
+    for _parsed, path in sorted(candidates, reverse=True):
+        try:
+            verify_checkpoint(path)
+            return path
+        except CheckpointIntegrityError as e:
+            if log is not None:
+                log(f"Skipping corrupt/partial checkpoint {path}: {e}")
+    return None
+
+
+# Back-compat name: the pre-manifest API returned the newest artifact by
+# name alone; every caller now gets the verified walk.
+latest_checkpoint = latest_valid_checkpoint
+
+
+def resolve_load_path(model_load_path: str,
+                      log: Optional[Callable[[str], None]] = None) -> str:
+    """Resolve a `--load` argument: a concrete artifact directory is
+    returned as-is; anything else is treated as a save base and resolved
+    to its newest VALID `_iter<N>` artifact, so resuming after a crash
+    never requires the operator to guess which directory survived."""
+    base = _abs(model_load_path)
+    if os.path.isdir(base) and (
+            os.path.isfile(os.path.join(base, _META_NAME))
+            or os.path.isfile(os.path.join(base, MANIFEST_NAME))):
+        return base
+    found = latest_valid_checkpoint(base, log=log)
+    return found if found is not None else base
 
 
 def save_model(model_save_path: str, state: TrainState, vocabs, config,
                epoch: int = 0, released: bool = False) -> str:
     """Save a standalone model artifact at `<model_save_path>` (a directory
     is created): Orbax state + `dictionaries.bin` + config meta. Mirrors
-    `Code2VecModelBase.save` (model_base.py:102-109)."""
+    `Code2VecModelBase.save` (model_base.py:102-109).
+
+    Crash-atomic: everything lands in a `.tmp-<pid>` staging dir, the
+    manifest is recorded last, and the staging dir is renamed into place
+    (see the commit protocol in the module docstring). The `save` fault
+    points between the steps are inert in production and let
+    tests/test_chaos.py kill the save at every interesting boundary."""
     base = _abs(model_save_path) + (RELEASED_SUFFIX if released else "")
-    os.makedirs(base, exist_ok=True)
-    vocabs.save(os.path.join(base, "dictionaries.bin"))
-    with open(os.path.join(base, _META_NAME), "w") as f:
+    staging = f"{base}{STAGING_INFIX}{os.getpid()}"
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)  # leftover from a failed save by this pid
+    os.makedirs(staging)
+    fault_point("save")   # 1: staging created, nothing written
+    vocabs.save(os.path.join(staging, "dictionaries.bin"))
+    fault_point("save")   # 2: vocab written, meta missing
+    with open(os.path.join(staging, _META_NAME), "w") as f:
         json.dump({
             "released": released,
             "epoch": epoch,
@@ -98,14 +399,19 @@ def save_model(model_save_path: str, state: TrainState, vocabs, config,
             "adam_mu_dtype": str(getattr(config, "adam_mu_dtype", "float32")),
             "adam_nu_dtype": str(getattr(config, "adam_nu_dtype", "float32")),
         }, f, indent=2)
+    fault_point("save")   # 3: meta written, Orbax state missing
     ckptr = ocp.StandardCheckpointer()
     target = {"params": state.params, "step": state.step}
     if not released:
         target["opt_state"] = state.opt_state
-    state_dir = os.path.join(base, _STATE_DIR)
+    state_dir = os.path.join(staging, _STATE_DIR)
     ckptr.save(state_dir, target, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
+    fault_point("save")   # 4: Orbax flushed, manifest missing
+    _write_manifest(staging, epoch, released)
+    fault_point("save")   # 5: fully staged, not yet committed
+    _commit_staging(staging, base)
     return base
 
 
@@ -123,18 +429,30 @@ def load_model(model_load_path: str, state_like: TrainState,
     never touches the saved optimizer state — the `--release` path, which
     must load artifacts regardless of their optimizer layout/dtypes (it
     is the advertised escape hatch for every optimizer-mismatch error
-    below, so it cannot itself run those checks)."""
+    below, so it cannot itself run those checks).
+
+    The artifact is manifest-verified FIRST, so a truncated or
+    half-written directory fails fast with the offending file named
+    instead of surfacing as an opaque Orbax pytree error mid-restore."""
     base = _abs(model_load_path)
-    meta = load_model_meta(base)
+    meta = verify_checkpoint(base)
     if params_only:
         template = {"params": state_like.params, "step": state_like.step}
         restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        try:
+            restore = ocp.args.PyTreeRestore(item=template,
+                                             restore_args=restore_args,
+                                             partial_restore=True)
+        except TypeError:
+            # orbax < 0.6 has no partial_restore kwarg; empty `transforms`
+            # is that vintage's way to restore a subtree of the saved item
+            # (drop the artifact's opt_state, keep params+step).
+            restore = ocp.args.PyTreeRestore(item=template,
+                                             restore_args=restore_args,
+                                             transforms={})
         with ocp.PyTreeCheckpointer() as ckptr:
-            restored = ckptr.restore(
-                os.path.join(base, _STATE_DIR),
-                args=ocp.args.PyTreeRestore(item=template,
-                                            restore_args=restore_args,
-                                            partial_restore=True))
+            restored = ckptr.restore(os.path.join(base, _STATE_DIR),
+                                     args=restore)
         return TrainState(step=restored["step"], params=restored["params"],
                           opt_state=state_like.opt_state)
     if config is not None and not meta.get("released", False):

@@ -48,6 +48,13 @@ _PREEMPT_SYNC_EVERY = 10
 _PAGE_SIZE = resource.getpagesize()
 
 
+class NonFiniteLossError(RuntimeError):
+    """Raised by the trainer's non-finite-loss sentinel under the `halt`
+    policy, AFTER a preemption-style checkpoint has been written. Lets
+    the process exit nonzero (a pod scheduler restarts/alerts) while
+    `--load` can still resume from the last finite state."""
+
+
 def current_rss_bytes() -> int:
     """Current (not peak) resident set size. /proc/self/statm on Linux;
     falls back to getrusage peak elsewhere (ru_maxrss is KB on Linux,
@@ -217,14 +224,14 @@ class Trainer:
             flag = np.array([1.0 if local_stop_flag() else 0.0])
             return bool(distributed.allreduce_host_scalars(flag)[0] > 0)
 
-        def save_preempt(state, epoch):
+        def save_preempt(state, epoch, suffix="_preempt"):
             if self.save_fn is None:
                 return
             import inspect
             if "suffix" in inspect.signature(self.save_fn).parameters:
                 # distinct name: never clobbers the clean end-of-epoch
                 # artifact the eval log refers to
-                self.save_fn(state, epoch, suffix="_preempt")
+                self.save_fn(state, epoch, suffix=suffix)
             else:
                 self.save_fn(state, epoch)
 
@@ -300,6 +307,43 @@ class Trainer:
                 if batch_num % config.num_batches_to_log_progress == 0:
                     # Blocks on the device only here.
                     last_avg_loss = float(np.mean(jax.device_get(pending_losses)))
+                    if not np.isfinite(last_avg_loss):
+                        # NaN/Inf sentinel: the log boundary is the one
+                        # place the host already blocks on losses, so the
+                        # check adds no synchronization. A diverged run
+                        # must never silently burn a pod-day computing
+                        # NaNs (config.on_nonfinite_loss: halt|warn).
+                        policy = getattr(config, "on_nonfinite_loss",
+                                         "halt")
+                        log(f"Non-finite average loss ({last_avg_loss}) "
+                            f"at batch {batch_num} (epoch {epoch}); "
+                            f"policy: {policy}")
+                        if policy == "halt":
+                            if trace_active:
+                                jax.profiler.stop_trace()
+                                trace_active = False
+                            # Checkpoint through the preemption save path
+                            # but under a `_nanhalt` suffix: the poisoned
+                            # params are preserved for post-mortem, yet
+                            # the name is invisible to resume resolution
+                            # and rotation (parse_iter_name -> None), so
+                            # a scheduler auto-restarting with
+                            # `--load <base>` resumes the last FINITE
+                            # artifact instead of crash-looping on the
+                            # NaN state.
+                            save_preempt(state, epoch, suffix="_nanhalt")
+                            self.preempted = True
+                            self.final_epoch = epoch
+                            raise NonFiniteLossError(
+                                f"average training loss became "
+                                f"{last_avg_loss} at batch {batch_num} "
+                                f"(epoch {epoch}); poisoned state kept "
+                                f"in an _iter{epoch}_nanhalt artifact "
+                                f"for post-mortem (excluded from "
+                                f"resume). `--load` resumes the last "
+                                f"clean artifact; rerun with "
+                                f"--on_nonfinite_loss warn to push "
+                                f"through.")
                     elapsed = time.time() - multi_batch_start
                     n = len(pending_losses) * config.train_batch_size
                     throughput = n / max(elapsed, 1e-9)
@@ -336,6 +380,17 @@ class Trainer:
                     multi_batch_start = time.time()
 
         finally:
+            if trace_active:
+                # An exception between start_trace and the batch-20 stop
+                # must not leak an open trace (it would poison any later
+                # profiler use in this process and lose the collected
+                # events). Suppress errors: never mask the original
+                # exception with a profiler teardown failure.
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                trace_active = False
             if watcher is not None:
                 watcher.uninstall()
 

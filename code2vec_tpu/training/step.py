@@ -44,6 +44,31 @@ from code2vec_tpu.training.state import (
     TrainState, split_sparse_dense, state_spec_tree, uses_sparse_update,
 )
 
+# jax < 0.5 ships shard_map under jax.experimental only, and its
+# replication-check kwarg there is `check_rep` (later renamed check_vma).
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    import inspect as _inspect
+
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    _HAS_CHECK_VMA = ("check_vma" in
+                      _inspect.signature(_experimental_shard_map).parameters)
+
+    def _shard_map(f, **kw):
+        if "check_vma" in kw and not _HAS_CHECK_VMA:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _experimental_shard_map(f, **kw)
+
+
+def _axis_size(axis_name):
+    """jax.lax.axis_size for jax versions that predate it (psum of 1 over
+    the axis is the classic spelling; constant-folded by XLA)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
 
 class EvalOutputs(NamedTuple):
     topk_values: jax.Array    # (B, k) f32
@@ -196,12 +221,26 @@ class TrainStepBuilder:
             tok_grads = jnp.concatenate([
                 g_src.reshape(-1, tok_table.shape[1]),
                 g_tgt.reshape(-1, tok_table.shape[1])])
+            path_ids = pth.reshape(-1)
+            path_grads = g_path.reshape(-1, path_table.shape[1])
+            if self.mesh is not None:
+                # Pin the (ids, grad-rows) exchange to replicated before
+                # the sort/segment/scatter chain: this is the documented
+                # GSPMD sparse exchange (rows, not tables), and making it
+                # explicit keeps the partitioner from splitting the
+                # duplicate-combining sort across shards — older XLA
+                # versions partition that chain incorrectly (duplicate
+                # rows double-apply) when left to sharding propagation.
+                rep = NamedSharding(self.mesh, P())
+                tok_ids, tok_grads, path_ids, path_grads = (
+                    jax.lax.with_sharding_constraint(x, rep)
+                    for x in (tok_ids, tok_grads, path_ids, path_grads))
             new_tok, tok_slots = sparse_adam_rows(
                 tok_table, slots["token_embedding"], tok_ids, tok_grads,
                 t=t, **adam)
             new_path, path_slots = sparse_adam_rows(
-                path_table, slots["path_embedding"], pth.reshape(-1),
-                g_path.reshape(-1, path_table.shape[1]), t=t, **adam)
+                path_table, slots["path_embedding"], path_ids,
+                path_grads, t=t, **adam)
 
             params = dict(new_dense, token_embedding=new_tok,
                           path_embedding=new_path)
@@ -269,7 +308,7 @@ class TrainStepBuilder:
         ce = ce * valid.astype(jnp.float32)
         local_sum = jnp.sum(ce)
         total = jax.lax.psum(local_sum, AXIS_DATA)
-        global_batch = labels.shape[0] * jax.lax.axis_size(AXIS_DATA)
+        global_batch = labels.shape[0] * _axis_size(AXIS_DATA)
         return total / global_batch, local_logits
 
     def _mask_padded_target_cols(self, local_logits):
@@ -314,7 +353,7 @@ class TrainStepBuilder:
             return TrainState(step=state.step + 1, params=params,
                               opt_state=opt_state), loss
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(state_specs,) + batch_specs + (P(),),
             out_specs=(state_specs, P()),
@@ -422,7 +461,7 @@ class TrainStepBuilder:
             return TrainState(step=t, params=params,
                               opt_state=opt_state), loss
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(state_specs,) + batch_specs + (P(),),
             out_specs=(state_specs, P()),
@@ -500,7 +539,7 @@ class TrainStepBuilder:
         out_specs = EvalOutputs(
             P(AXIS_DATA, None), P(AXIS_DATA, None), P(AXIS_DATA, None),
             P(AXIS_DATA, AXIS_CTX), P())
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(param_specs,) + batch_specs, out_specs=out_specs,
             check_vma=False)

@@ -1,0 +1,108 @@
+"""Fault-injection hook points for chaos testing (tests/test_chaos.py).
+
+Production code calls `fault_point("name")` at the places a crash is
+interesting (e.g. between each file written during a checkpoint save).
+The hooks are inert — zero work beyond one dict truthiness check — unless
+the `C2V_FAULTS` environment variable (or an explicit `reset(spec)` call
+in-process) arms them.
+
+Spec grammar (comma-separated):
+
+    C2V_FAULTS="<point>[@N][=<action>][,<point2>...]"
+
+- `<point>`  — the fault-point name passed to `fault_point`.
+- `@N`       — trigger on the Nth *hit* of that point (1-based; default 1).
+               Hits are counted per point name across the whole process,
+               so `save@3=exit` kills the process at the third `save`
+               hook crossed since arming.
+- `<action>` — `raise` (default): raise `FaultInjected`, unwinding like
+               an in-flight exception; `exit`: `os._exit(FAULT_EXIT_CODE)`,
+               a hard kill with no cleanup handlers — the closest
+               in-process stand-in for SIGKILL / power loss.
+
+The spec is parsed lazily on the first `fault_point` call and cached;
+subprocess tests set the env var before the interpreter starts, and
+in-process tests use `reset("...")` / `reset(None)` to (re)arm or disarm.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+FAULTS_ENV = "C2V_FAULTS"
+# Distinctive exit code so a test can tell an injected kill from a
+# genuine crash in the code under test.
+FAULT_EXIT_CODE = 43
+
+_ACTIONS = ("raise", "exit")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed `raise`-action fault point."""
+
+
+class FaultSpecError(ValueError):
+    """A C2V_FAULTS spec that cannot be parsed (fail loud: a typo'd spec
+    silently injecting nothing would invalidate the chaos test)."""
+
+
+# point name -> (trigger hit number, action); None = not parsed yet,
+# {} = parsed and disarmed (the zero-cost steady state).
+_spec: Optional[Dict[str, Tuple[int, str]]] = None
+_hits: Dict[str, int] = {}
+
+
+def _parse(raw: str) -> Dict[str, Tuple[int, str]]:
+    spec: Dict[str, Tuple[int, str]] = {}
+    for clause in filter(None, (c.strip() for c in raw.split(","))):
+        point, _, action = clause.partition("=")
+        action = action or "raise"
+        if action not in _ACTIONS:
+            raise FaultSpecError(
+                f"bad {FAULTS_ENV} clause {clause!r}: action {action!r} "
+                f"not in {_ACTIONS}")
+        point, _, nth = point.partition("@")
+        try:
+            n = int(nth) if nth else 1
+        except ValueError:
+            raise FaultSpecError(
+                f"bad {FAULTS_ENV} clause {clause!r}: hit count {nth!r} "
+                f"is not an integer")
+        if not point or n < 1:
+            raise FaultSpecError(f"bad {FAULTS_ENV} clause {clause!r}")
+        spec[point] = (n, action)
+    return spec
+
+
+def reset(spec: Optional[str] = "") -> None:
+    """(Re)arm the fault points. `reset("save@2=raise")` arms in-process
+    (tests); `reset()` or `reset("")` re-reads the environment on the
+    next hit; `reset(None)` disarms outright."""
+    global _spec
+    _hits.clear()
+    if spec is None:
+        _spec = {}
+    elif spec == "":
+        _spec = None  # lazy re-read of the env var
+    else:
+        _spec = _parse(spec)
+
+
+def fault_point(name: str) -> None:
+    """Cross a named fault point. No-op (one dict check) unless armed."""
+    global _spec
+    if _spec is None:
+        _spec = _parse(os.environ.get(FAULTS_ENV, ""))
+    if not _spec:
+        return
+    armed = _spec.get(name)
+    if armed is None:
+        return
+    _hits[name] = _hits.get(name, 0) + 1
+    n, action = armed
+    if _hits[name] != n:
+        return
+    if action == "exit":
+        os._exit(FAULT_EXIT_CODE)
+    raise FaultInjected(f"injected fault at point {name!r} (hit {n})")

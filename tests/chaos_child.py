@@ -1,0 +1,156 @@
+"""Child process + shared fixtures for tests/test_chaos.py — NOT a pytest
+module.
+
+Subcommands (parent runs `python chaos_child.py <cmd> ...` and inspects
+the exit code, stdout markers, and the on-disk checkpoint state):
+
+- `save-seq <base> <n> [fault_spec]` — save deterministic artifacts
+  `<base>_iter1..n`; when `fault_spec` is given it is armed immediately
+  before the LAST save, so saves 1..n-1 commit cleanly and save n dies
+  at the injected point (`exit` action = os._exit, the in-process
+  stand-in for SIGKILL landing mid-save). The parent then asserts the
+  resume chain falls back to `_iter<n-1>` with bit-equal params.
+  A fault can also arrive via the C2V_FAULTS env var (then it counts
+  hits from the very first save — used with n=1).
+
+- `train <workdir> <save_base>` — real facade training on a tiny
+  synthetic dataset with per-epoch checkpoints, running until killed.
+  Prints `CHAOS_TRAIN_STARTED` once training begins. The parent waits
+  for the first committed artifact, sends SIGTERM, and expects the
+  preemption path to write `_iter<N>_preempt` and exit 0.
+
+The deterministic-state builders live here (not in the test module) so
+both the child process and the in-process tests construct bit-identical
+pytrees from the same code.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+
+def build_vocabs():
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+    freq = WordFreqDicts(
+        token_to_count={"foo": 10, "bar": 8, "baz": 5, "qux": 2},
+        path_to_count={"P1": 9, "P2": 7, "P3": 3},
+        target_to_count={"get|name": 6, "set|value": 4, "run": 2},
+        num_train_examples=100,
+    )
+    return Code2VecVocabs.create_from_freq_dicts(
+        freq, max_token_vocab_size=10, max_path_vocab_size=10,
+        max_target_vocab_size=10)
+
+
+def build_config():
+    from code2vec_tpu.config import Config
+    return Config(max_contexts=4, default_embeddings_size=8)
+
+
+def build_state(epoch: int):
+    """A tiny TrainState whose every leaf is a pure function of `epoch`,
+    so the parent can reconstruct the exact arrays any artifact must
+    restore to (the bit-equality oracle for the resume chain)."""
+    from code2vec_tpu.training.state import TrainState
+    rng = np.random.RandomState(1000 + epoch)
+    params = {
+        "token_embedding": rng.randn(6, 8).astype(np.float32),
+        "path_embedding": rng.randn(5, 8).astype(np.float32),
+        "target_embedding": rng.randn(4, 24).astype(np.float32),
+    }
+    opt_state = {
+        "mu": {k: (0.1 * v).astype(np.float32) for k, v in params.items()},
+        "nu": {k: (v * v).astype(np.float32) for k, v in params.items()},
+        "count": np.asarray(epoch * 7, np.int32),
+    }
+    return TrainState(step=np.asarray(epoch * 10, np.int32),
+                      params=params, opt_state=opt_state)
+
+
+def cmd_save_seq(base: str, n: int, fault_spec: str) -> None:
+    from code2vec_tpu.training import checkpoint as ckpt_mod
+    from code2vec_tpu.utils import faults
+    vocabs = build_vocabs()
+    config = build_config()
+    for epoch in range(1, n + 1):
+        if fault_spec and epoch == n:
+            faults.reset(fault_spec)
+        ckpt_mod.save_model(f"{base}_iter{epoch}", build_state(epoch),
+                            vocabs, config, epoch=epoch)
+        print(f"CHAOS_SAVED {epoch}", flush=True)
+
+
+def make_synthetic_dataset(dirname: str, n_rows: int = 64,
+                           max_contexts: int = 8, seed: int = 0) -> str:
+    """Tiny learnable dataset in the .c2v text layout (same shape as
+    tests/test_end_to_end.py's, smaller)."""
+    import pickle
+    import random
+    rng = random.Random(seed)
+    tokens = [f"tok{i}" for i in range(8)]
+    paths = [f"path{i}" for i in range(4)]
+    targets = [f"name|t{i}" for i in range(4)]
+    rows = []
+    for _ in range(n_rows):
+        t = rng.randrange(len(targets))
+        contexts = [f"{tokens[t * 2 + rng.randrange(2)]},{rng.choice(paths)},"
+                    f"{tokens[t * 2]}"
+                    for _ in range(rng.randint(3, max_contexts))]
+        pad = " " * (max_contexts - len(contexts))
+        rows.append(f"{targets[t]} " + " ".join(contexts) + pad)
+    prefix = os.path.join(dirname, "chaos")
+    with open(prefix + ".train.c2v", "w") as f:
+        f.write("\n".join(rows) + "\n")
+    with open(prefix + ".dict.c2v", "wb") as f:
+        pickle.dump({w: 10 for w in tokens}, f)
+        pickle.dump({p: 10 for p in paths}, f)
+        pickle.dump({t: 10 for t in targets}, f)
+        pickle.dump(len(rows), f)
+    return prefix
+
+
+def cmd_train(workdir: str, save_base: str) -> None:
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_facade import Code2VecModel
+    prefix = make_synthetic_dataset(workdir)
+    config = Config(
+        train_data_path_prefix=prefix,
+        model_save_path=save_base,
+        max_contexts=8,
+        default_embeddings_size=16,
+        train_batch_size=16,
+        num_train_epochs=100000,   # run until SIGTERMed
+        num_batches_to_log_progress=1000000,
+        compute_dtype="float32",
+        use_packed_data=False,
+        shuffle_buffer_size=64,
+        save_every_epochs=1,
+        verbose_mode=0,
+    )
+    model = Code2VecModel(config)
+    print("CHAOS_TRAIN_STARTED", flush=True)
+    model.train()
+    print("CHAOS_TRAIN_DONE", flush=True)
+
+
+def main() -> None:
+    cmd = sys.argv[1]
+    if cmd == "save-seq":
+        cmd_save_seq(sys.argv[2], int(sys.argv[3]),
+                     sys.argv[4] if len(sys.argv) > 4 else "")
+    elif cmd == "train":
+        cmd_train(sys.argv[2], sys.argv[3])
+    else:
+        raise SystemExit(f"unknown chaos_child command: {cmd!r}")
+    print("CHAOS_CHILD_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

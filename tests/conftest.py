@@ -17,7 +17,14 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS
+    # --xla_force_host_platform_device_count fallback above covers it
+    # (the CPU backend reads the flag at its lazy initialization, which
+    # has not happened yet at conftest-import time).
+    pass
 
 import sys
 

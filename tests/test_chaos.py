@@ -1,0 +1,636 @@
+"""Fault-injection chaos harness for the crash-atomic checkpoint
+lifecycle (training/checkpoint.py commit protocol, utils/faults.py hook
+points) and the failure-handling paths around it.
+
+The contract under test: a save killed at ANY point — between any two
+files, after Orbax flushed but before the manifest, staged but not yet
+committed, or hard-killed by the OS — leaves the resume chain able to
+load the newest VALID artifact with bit-equal params, and
+`latest_valid_checkpoint` never returns a directory that fails its
+manifest check. Plus: the SIGTERM preemption path end-to-end in a real
+subprocess, the NaN/Inf loss sentinel, the profiler-trace leak fix, the
+rotation safety rules, and the serving extractor timeout.
+
+Most tests here are fast (in-process fault injection on tiny states) and
+run in tier-1; everything carries the `chaos` marker so the kill tests
+can be selected (`-m chaos`) or skipped (`-m 'not chaos'`) as a group.
+"""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import EpochEnd, RowBatch
+from code2vec_tpu.training import checkpoint as ckpt_mod
+from code2vec_tpu.training.loop import NonFiniteLossError, Trainer
+from code2vec_tpu.utils import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+import chaos_child  # noqa: E402
+
+CHILD = os.path.join(HERE, "chaos_child.py")
+
+pytestmark = pytest.mark.chaos
+
+# Number of `save` fault points save_model crosses per call (staging
+# created / vocab written / meta written / Orbax flushed / fully staged).
+SAVE_FAULT_POINTS = 5
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No test may leak an armed fault spec into the rest of the suite."""
+    yield
+    faults.reset(None)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return chaos_child.build_vocabs(), chaos_child.build_config()
+
+
+def _save(base, epoch, tiny):
+    vocabs, config = tiny
+    return ckpt_mod.save_model(f"{base}_iter{epoch}",
+                               chaos_child.build_state(epoch),
+                               vocabs, config, epoch=epoch)
+
+
+def _assert_restores_bit_equal(path, epoch):
+    """The oracle: `path` must restore exactly the arrays `build_state`
+    produced for `epoch` (save/restore is lossless, so any difference
+    means the fallback chain landed on the wrong or a damaged artifact)."""
+    expected = chaos_child.build_state(epoch)
+    restored = ckpt_mod.load_model(path, chaos_child.build_state(0))
+    assert int(np.asarray(restored.step)) == epoch * 10
+    for name, arr in expected.params.items():
+        np.testing.assert_array_equal(np.asarray(restored.params[name]), arr)
+
+
+def _run_child(args, env=None, timeout=300):
+    proc = subprocess.run([sys.executable, CHILD, *args],
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, env=env, timeout=timeout)
+    return proc.returncode, proc.stdout
+
+
+# ------------------------------------------------------------- faults.py
+
+def test_fault_point_is_noop_when_unarmed():
+    faults.reset(None)
+    for _ in range(3):
+        faults.fault_point("save")  # must not raise
+
+
+def test_fault_hit_counting_fires_exactly_once():
+    faults.reset("p@3=raise")
+    faults.fault_point("p")
+    faults.fault_point("p")
+    with pytest.raises(faults.FaultInjected):
+        faults.fault_point("p")
+    faults.fault_point("p")  # hit 4 != 3: armed points fire exactly once
+    faults.fault_point("other")  # unarmed point untouched
+
+
+def test_fault_spec_errors_are_loud():
+    # a typo'd spec silently injecting nothing would invalidate the test
+    # that set it, so parsing fails loudly
+    for bad in ("save@x=raise", "save=explode", "@2=raise", "save@0"):
+        with pytest.raises(faults.FaultSpecError):
+            faults.reset(bad)
+
+
+# ---------------------------------------- crash-at-file-K during a save
+
+@pytest.mark.parametrize("k", list(range(1, SAVE_FAULT_POINTS + 1)))
+def test_crash_at_file_k_falls_back_to_previous_artifact(tmp_path, tiny, k):
+    """A save interrupted at every file boundary: the final `_iter2` name
+    must never exist half-written, and resume lands on `_iter1` with
+    bit-equal params."""
+    vocabs, config = tiny
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    faults.reset(f"save@{k}=raise")
+    with pytest.raises(faults.FaultInjected):
+        ckpt_mod.save_model(f"{base}_iter2", chaos_child.build_state(2),
+                            vocabs, config, epoch=2)
+    faults.reset(None)
+    # the atomic commit never exposes a partial dir at the final name
+    assert not os.path.exists(f"{base}_iter2")
+    # only the staging dir is left behind, for the sweeper
+    leftovers = [p for p in glob.glob(base + "_iter2*")]
+    assert all(ckpt_mod.is_staging_path(p) for p in leftovers)
+    found = ckpt_mod.latest_valid_checkpoint(base)
+    assert found == f"{base}_iter1"
+    _assert_restores_bit_equal(found, 1)
+
+
+def test_crash_between_rename_and_cleanup(tmp_path, tiny):
+    """Kill at the commit fault point itself (staged, rename pending):
+    the new artifact is fully staged but not promoted — the previous one
+    must still win."""
+    vocabs, config = tiny
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    faults.reset("checkpoint_commit=raise")
+    with pytest.raises(faults.FaultInjected):
+        ckpt_mod.save_model(f"{base}_iter2", chaos_child.build_state(2),
+                            vocabs, config, epoch=2)
+    faults.reset(None)
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+    _assert_restores_bit_equal(f"{base}_iter1", 1)
+
+
+def test_kill_between_swap_renames_recovered_by_sweeper(tmp_path, tiny):
+    """The one commit window where the final name is EMPTY: an overwrite
+    save killed after `base -> .old` but before `.tmp -> base`. Both
+    copies are intact; the sweeper must promote the newer (.tmp) one
+    back instead of deleting two valid artifacts."""
+    vocabs, config = tiny
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    faults.reset("checkpoint_swap=raise")
+    with pytest.raises(faults.FaultInjected):
+        ckpt_mod.save_model(f"{base}_iter1", chaos_child.build_state(5),
+                            vocabs, config, epoch=1)
+    faults.reset(None)
+    assert not os.path.exists(f"{base}_iter1")  # the empty-slot window
+    # the injected raise keeps THIS process alive, so hand the leftovers
+    # to a dead pid — the on-disk state a real kill would leave
+    for p in glob.glob(base + "_iter1.*"):
+        os.rename(p, p.rsplit("-", 1)[0] + "-999999999")
+    _facade_shim(Config(model_save_path=base, max_to_keep=5,
+                        train_data_path_prefix="x"))._rotate_epoch_checkpoints()
+    assert os.path.exists(f"{base}_iter1")
+    # the NEW (fully staged) state won the slot, not the .old backup
+    _assert_restores_bit_equal(f"{base}_iter1", 5)
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+    # and no commit-protocol leftovers remain
+    assert not [p for p in glob.glob(base + "*")
+                if ckpt_mod.is_staging_path(p)]
+
+
+def test_interrupted_save_can_be_retried_in_same_process(tmp_path, tiny):
+    """A failed save leaves its staging dir; the SAME process retrying
+    the save (e.g. the next epoch boundary) must succeed, not trip over
+    its own leftovers."""
+    vocabs, config = tiny
+    base = str(tmp_path / "m")
+    faults.reset("save@2=raise")
+    with pytest.raises(faults.FaultInjected):
+        _save(base, 1, tiny)
+    faults.reset(None)
+    _save(base, 1, tiny)  # retry: must overwrite the stale staging dir
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+    _assert_restores_bit_equal(f"{base}_iter1", 1)
+
+
+def test_overwrite_commit_swaps_atomically(tmp_path, tiny):
+    """Re-saving to an existing path goes through the backup swap; the
+    committed artifact carries the NEW state and no `.old-` backup
+    lingers."""
+    base = str(tmp_path / "m")
+    path = _save(base, 1, tiny)
+    vocabs, config = tiny
+    ckpt_mod.save_model(f"{base}_iter1", chaos_child.build_state(3),
+                        vocabs, config, epoch=3)
+    _assert_restores_bit_equal(path, 3)
+    assert not [p for p in glob.glob(base + "*")
+                if ckpt_mod.BACKUP_INFIX in os.path.basename(p)]
+
+
+# -------------------------------------- hard kills (subprocess, os._exit)
+
+@pytest.mark.parametrize("k", [2, 4, 5])
+def test_hard_kill_during_save_subprocess(tmp_path, k):
+    """os._exit at file boundary K of the second save — the closest
+    in-process stand-in for SIGKILL/power loss (no unwinding, no cleanup
+    handlers). The child's first save committed; resume must land on it
+    bit-equal."""
+    base = str(tmp_path / "m")
+    rc, out = _run_child(["save-seq", base, "2", f"save@{k}=exit"])
+    assert rc == faults.FAULT_EXIT_CODE, out
+    assert "CHAOS_SAVED 1" in out
+    assert "CHAOS_SAVED 2" not in out
+    assert not os.path.exists(f"{base}_iter2")
+    found = ckpt_mod.latest_valid_checkpoint(base)
+    assert found == f"{base}_iter1"
+    _assert_restores_bit_equal(found, 1)
+
+
+def test_env_var_fault_kill_first_save_leaves_no_valid_artifact(tmp_path):
+    """The env-var arming path (C2V_FAULTS set before the interpreter
+    starts): the only save dies fully staged but uncommitted, so there is
+    NO valid artifact — and latest_valid_checkpoint says so instead of
+    returning the staging dir."""
+    base = str(tmp_path / "m")
+    env = {**os.environ, faults.FAULTS_ENV: "save@5=exit"}
+    rc, out = _run_child(["save-seq", base, "1"], env=env)
+    assert rc == faults.FAULT_EXIT_CODE, out
+    staged = glob.glob(base + "_iter1*")
+    assert staged and all(ckpt_mod.is_staging_path(p) for p in staged)
+    assert ckpt_mod.latest_valid_checkpoint(base) is None
+
+
+# ------------------------------- integrity verification + fallback chain
+
+def _a_state_file(artifact):
+    """Largest file under the artifact's Orbax state dir."""
+    files = [p for p in glob.glob(os.path.join(artifact, "state", "**"),
+                                  recursive=True) if os.path.isfile(p)]
+    assert files
+    return max(files, key=os.path.getsize)
+
+
+def test_truncated_state_file_fails_fast_with_named_file(tmp_path, tiny):
+    base = str(tmp_path / "m")
+    path = _save(base, 1, tiny)
+    victim = _a_state_file(path)
+    with open(victim, "r+b") as f:
+        f.truncate(max(os.path.getsize(victim) // 2, 1))
+    with pytest.raises(ckpt_mod.CheckpointIntegrityError) as ei:
+        ckpt_mod.load_model(path, chaos_child.build_state(0))
+    # fails fast naming the truncated file, not an opaque pytree error
+    assert os.path.basename(victim) in str(ei.value)
+    assert "truncated" in str(ei.value)
+
+
+def test_deleted_state_file_detected_and_skipped(tmp_path, tiny):
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    newest = _save(base, 2, tiny)
+    os.remove(_a_state_file(newest))
+    skips = []
+    found = ckpt_mod.latest_valid_checkpoint(base, log=skips.append)
+    assert found == f"{base}_iter1"
+    assert any("Skipping corrupt/partial checkpoint" in m for m in skips)
+    _assert_restores_bit_equal(found, 1)
+
+
+def test_corrupt_manifest_skipped(tmp_path, tiny):
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    newest = _save(base, 2, tiny)
+    with open(os.path.join(newest, ckpt_mod.MANIFEST_NAME), "w") as f:
+        f.write("{ not json")
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+
+
+def test_bitflip_in_dictionaries_caught_by_checksum(tmp_path, tiny):
+    """Same-size corruption (a flipped byte) is invisible to size checks;
+    the sha256 in the manifest catches it."""
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    newest = _save(base, 2, tiny)
+    dict_path = os.path.join(newest, "dictionaries.bin")
+    data = bytearray(open(dict_path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(dict_path, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(ckpt_mod.CheckpointIntegrityError) as ei:
+        ckpt_mod.verify_checkpoint(newest)
+    assert "sha256 mismatch" in str(ei.value)
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+
+
+def test_legacy_artifact_without_manifest_still_loads(tmp_path, tiny):
+    """Pre-manifest artifacts (older saves) pass the structural probe and
+    remain loadable; a half-written legacy dir does not."""
+    base = str(tmp_path / "m")
+    path = _save(base, 1, tiny)
+    os.remove(os.path.join(path, ckpt_mod.MANIFEST_NAME))
+    assert ckpt_mod.latest_valid_checkpoint(base) == path
+    _assert_restores_bit_equal(path, 1)
+    # gut it down to the half-write the old layout could leave
+    os.remove(os.path.join(path, "code2vec_meta.json"))
+    assert ckpt_mod.latest_valid_checkpoint(base) is None
+
+
+def test_preempt_artifact_preferred_at_equal_epoch(tmp_path, tiny):
+    """At equal N the `_preempt` artifact wins (mid-epoch-N+1 params are
+    strictly more trained) — but only while it verifies."""
+    vocabs, config = tiny
+    base = str(tmp_path / "m")
+    _save(base, 2, tiny)
+    preempt = ckpt_mod.save_model(f"{base}_iter2_preempt",
+                                  chaos_child.build_state(3),
+                                  vocabs, config, epoch=2)
+    assert ckpt_mod.latest_valid_checkpoint(base) == preempt
+    os.remove(_a_state_file(preempt))
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter2"
+
+
+def test_resolve_load_path(tmp_path, tiny):
+    base = str(tmp_path / "m")
+    art1 = _save(base, 1, tiny)
+    art2 = _save(base, 2, tiny)
+    # a concrete artifact dir resolves to itself
+    assert ckpt_mod.resolve_load_path(art1) == art1
+    # a save base resolves to the newest VALID artifact
+    assert ckpt_mod.resolve_load_path(base) == art2
+    os.remove(_a_state_file(art2))
+    assert ckpt_mod.resolve_load_path(base) == art1
+
+
+# ------------------------------------------------------ rotation safety
+
+def _facade_shim(config):
+    """A Code2VecModel with only the attributes rotation needs — building
+    the full model (vocabs, mesh, jitted state) is irrelevant to the
+    on-disk policy under test."""
+    from code2vec_tpu.model_facade import Code2VecModel
+    shim = Code2VecModel.__new__(Code2VecModel)
+    shim.config = config
+    shim.log = lambda *_: None
+    return shim
+
+
+def test_rotation_sweeps_orphaned_staging_dirs(tmp_path, tiny):
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    dead = f"{base}_iter9{ckpt_mod.STAGING_INFIX}999999999"
+    live = f"{base}_iter9{ckpt_mod.STAGING_INFIX}{os.getpid()}"
+    os.makedirs(dead)
+    os.makedirs(live)
+    _facade_shim(Config(model_save_path=base, max_to_keep=5,
+                        train_data_path_prefix="x"))._rotate_epoch_checkpoints()
+    assert not os.path.exists(dead)    # orphan of a killed save: swept
+    assert os.path.exists(live)        # live process's staging: untouched
+    assert os.path.exists(f"{base}_iter1")
+
+
+def test_rotation_never_deletes_the_only_valid_artifact(tmp_path, tiny):
+    """max_to_keep=2 with the two newest artifacts corrupt: the oldest —
+    the only one that verifies — must survive rotation."""
+    base = str(tmp_path / "m")
+    for e in (1, 2, 3):
+        _save(base, e, tiny)
+    for e in (2, 3):
+        os.remove(_a_state_file(f"{base}_iter{e}"))
+    _facade_shim(Config(model_save_path=base, max_to_keep=2,
+                        train_data_path_prefix="x"))._rotate_epoch_checkpoints()
+    assert os.path.exists(f"{base}_iter1")
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+
+
+def test_rotation_keeps_rotating_when_retained_are_valid(tmp_path, tiny):
+    base = str(tmp_path / "m")
+    for e in (1, 2, 3):
+        _save(base, e, tiny)
+    _facade_shim(Config(model_save_path=base, max_to_keep=2,
+                        train_data_path_prefix="x"))._rotate_epoch_checkpoints()
+    assert not os.path.exists(f"{base}_iter1")
+    assert os.path.exists(f"{base}_iter2")
+    assert os.path.exists(f"{base}_iter3")
+
+
+def test_corrupt_clean_save_does_not_supersede_preempt(tmp_path, tiny):
+    """A preemption artifact is only deleted when a NEWER clean artifact
+    actually verifies; a corrupt clean save must not take the only
+    loadable state down with it."""
+    vocabs, config = tiny
+    base = str(tmp_path / "m")
+    preempt = ckpt_mod.save_model(f"{base}_iter2_preempt",
+                                  chaos_child.build_state(2),
+                                  vocabs, config, epoch=2)
+    corrupt = _save(base, 3, tiny)
+    os.remove(_a_state_file(corrupt))
+    _facade_shim(Config(model_save_path=base, max_to_keep=5,
+                        train_data_path_prefix="x"))._rotate_epoch_checkpoints()
+    assert os.path.exists(preempt)
+    assert ckpt_mod.latest_valid_checkpoint(base) == preempt
+    # once a VALID newer clean artifact exists, the preempt is reclaimed
+    _save(base, 4, tiny)
+    _facade_shim(Config(model_save_path=base, max_to_keep=5,
+                        train_data_path_prefix="x"))._rotate_epoch_checkpoints()
+    assert not os.path.exists(preempt)
+
+
+# ------------------------------------------------- NaN/Inf loss sentinel
+
+def _fake_batch(n=2, m=4):
+    return RowBatch(
+        source_token_indices=np.ones((n, m), np.int32),
+        path_indices=np.ones((n, m), np.int32),
+        target_token_indices=np.ones((n, m), np.int32),
+        context_valid_mask=np.ones((n, m), np.float32),
+        target_index=np.ones((n,), np.int32),
+        example_valid=np.ones((n,), bool))
+
+
+class _State:
+    step = np.zeros((), np.int32)
+
+
+def _marker_stream(batches_per_epoch, epochs):
+    for e in range(epochs):
+        for _ in range(batches_per_epoch):
+            yield _fake_batch()
+        yield EpochEnd(e + 1)
+
+
+def test_nonfinite_loss_halt_checkpoints_and_raises(tiny_config):
+    """`halt` policy: the first NaN log-window average triggers a
+    preemption-style checkpoint (suffix `_preempt`, never clobbering the
+    clean artifact) and a nonzero exit via NonFiniteLossError."""
+    tiny_config.num_train_epochs = 2
+    tiny_config.num_batches_to_log_progress = 2
+    tiny_config.verbose_mode = 0
+    tiny_config.on_nonfinite_loss = "halt"
+    saves, steps = [], []
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, (np.float32("nan") if len(steps) >= 3
+                       else np.float32(1.0))
+
+    def save_fn(state, epoch, suffix=""):
+        saves.append((epoch, suffix))
+
+    trainer = Trainer(tiny_config, train_step, save_fn=save_fn)
+    with pytest.raises(NonFiniteLossError, match="nan"):
+        trainer.train(_State(), _marker_stream(8, 2),
+                      rng=np.zeros((2,), np.uint32))
+    assert len(steps) == 4          # stopped at the first NaN log window
+    # `_nanhalt`, not `_preempt`: the poisoned state must never be the
+    # artifact an auto-restarted `--load <base>` resolves to (that would
+    # be an infinite NaN crash loop)
+    assert saves == [(0, "_nanhalt")]
+    assert trainer.preempted
+    assert ckpt_mod.parse_iter_name("m_iter0_nanhalt") is None
+
+
+def test_nonfinite_loss_warn_continues(tiny_config):
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_batches_to_log_progress = 2
+    tiny_config.on_nonfinite_loss = "warn"
+    logs = []
+    tiny_config.log = logs.append
+    steps = []
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, (np.float32("inf") if len(steps) == 3
+                       else np.float32(1.0))
+
+    saves = []
+    trainer = Trainer(tiny_config, train_step,
+                      save_fn=lambda s, e, suffix="": saves.append(e))
+    trainer.train(_State(), _marker_stream(6, 1),
+                  rng=np.zeros((2,), np.uint32))
+    assert len(steps) == 6          # ran the full epoch
+    assert saves == [1]             # normal end-of-epoch save, no preempt
+    assert any("Non-finite average loss" in m for m in logs)
+
+
+def test_nonfinite_policy_validated_by_config():
+    with pytest.raises(ValueError, match="on_nonfinite_loss"):
+        Config(train_data_path_prefix="x",
+               on_nonfinite_loss="explode").verify()
+
+
+# -------------------------------------------------- profiler trace leak
+
+def test_exception_mid_trace_does_not_leak_open_trace(tiny_config, tmp_path):
+    """A crash between start_trace (batch 10) and stop_trace (batch 20)
+    must close the trace in the loop's finally block — a leaked trace
+    poisons every later profiler use in the process."""
+    import jax
+    tiny_config.num_train_epochs = 1
+    tiny_config.verbose_mode = 0
+    steps = []
+
+    def train_step(state, *args):
+        steps.append(1)
+        if len(steps) == 14:
+            raise RuntimeError("boom mid-trace")
+        return state, np.float32(1.0)
+
+    trainer = Trainer(tiny_config, train_step,
+                      profile_dir=str(tmp_path / "trace"))
+    with pytest.raises(RuntimeError, match="boom mid-trace"):
+        trainer.train(_State(), _marker_stream(25, 1),
+                      rng=np.zeros((2,), np.uint32))
+    # if the trace leaked, a fresh start_trace raises "already started"
+    jax.profiler.start_trace(str(tmp_path / "trace2"))
+    jax.profiler.stop_trace()
+
+
+# ------------------------------------------- SIGTERM preemption, for real
+
+def test_sigterm_mid_train_writes_preempt_artifact_and_resumes(tmp_path):
+    """The whole preemption story in a real subprocess: SIGTERM lands
+    mid-train, the watcher checkpoints `_iter<N>_preempt` within the
+    grace window and exits 0; `--load <save_base>` then resolves to that
+    preemption artifact and resumes its epoch numbering."""
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    base = str(run_dir / "model")
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, "train", str(tmp_path), base],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        # wait for the first COMMITTED artifact, then preempt
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail(f"train child died early:\n{proc.stdout.read()}")
+            if ckpt_mod.latest_valid_checkpoint(base):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("no checkpoint appeared within the deadline")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, out
+    assert "CHAOS_TRAIN_DONE" in out
+
+    preempts = glob.glob(base + "_iter*_preempt")
+    assert preempts, f"no preemption artifact written:\n{out}"
+    meta = ckpt_mod.verify_checkpoint(preempts[0])  # committed + intact
+    assert meta["epoch"] >= 1
+
+    # resume: the facade resolves --load <base> past nothing-in-particular
+    # to the preemption artifact and continues its epoch numbering
+    from code2vec_tpu.model_facade import Code2VecModel
+    cfg = Config(model_load_path=base, max_contexts=8,
+                 default_embeddings_size=16, compute_dtype="float32",
+                 use_packed_data=False, verbose_mode=0)
+    model = Code2VecModel(cfg)
+    assert cfg.model_load_path.endswith("_preempt")
+    assert model.initial_epoch == meta["epoch"]
+
+
+# ------------------------------------------- serving extractor timeouts
+
+def _extractor(tmp_path, timeout=None):
+    from code2vec_tpu.serving.extractor_bridge import PathExtractor
+    config = Config(max_contexts=4, train_data_path_prefix="x")
+    return PathExtractor(config, timeout=timeout)
+
+
+def test_extractor_timeout_kills_hung_child(tmp_path):
+    ex = _extractor(tmp_path, timeout=1.0)
+    ex._build_command = lambda path: [
+        sys.executable, "-c",
+        "import sys,time; print('hello'); sys.stdout.flush(); "
+        "sys.stderr.write('still going'); sys.stderr.flush(); "
+        "time.sleep(600)"]
+    from code2vec_tpu.serving.extractor_bridge import ExtractionTimeout
+    start = time.time()
+    with pytest.raises(ExtractionTimeout) as ei:
+        ex.extract_paths("whatever.java")
+    assert time.time() - start < 30  # killed, not waited out
+    assert "still going" in str(ei.value)
+    # ValueError subclass: the interactive REPL's catch-print-continue
+    # handles a timeout like any other failed extraction
+    assert isinstance(ei.value, ValueError)
+
+
+def test_extractor_nonzero_exit_surfaces_stderr_despite_stdout(tmp_path):
+    """The old bridge trusted any non-empty stdout; a nonzero exit with
+    partial output must raise and carry stderr."""
+    ex = _extractor(tmp_path)
+    ex._build_command = lambda path: [
+        sys.executable, "-c",
+        "import sys; print('target ctx,1,ctx'); "
+        "sys.stderr.write('OutOfMemoryError mid-file'); sys.exit(3)"]
+    with pytest.raises(ValueError) as ei:
+        ex.extract_paths("whatever.java")
+    assert "code 3" in str(ei.value)
+    assert "OutOfMemoryError mid-file" in str(ei.value)
+
+
+def test_cli_flags_roundtrip():
+    from code2vec_tpu.cli import config_from_args
+    cfg = config_from_args(["--data", "d", "--on_nonfinite_loss", "warn",
+                            "--extractor_timeout", "9"])
+    assert cfg.on_nonfinite_loss == "warn"
+    assert cfg.extractor_timeout_s == 9.0
+    cfg = config_from_args(["--data", "d"])
+    assert cfg.on_nonfinite_loss == "halt"       # config.py default
+    assert cfg.extractor_timeout_s == 120.0
+
+
+def test_extractor_timeout_config_plumbing():
+    from code2vec_tpu.serving.extractor_bridge import PathExtractor
+    config = Config(max_contexts=4, train_data_path_prefix="x",
+                    extractor_timeout_s=7.5)
+    assert PathExtractor(config).timeout == 7.5
+    assert PathExtractor(config, timeout=0).timeout is None  # 0 disables
+    with pytest.raises(ValueError, match="extractor_timeout_s"):
+        Config(train_data_path_prefix="x", extractor_timeout_s=-1).verify()

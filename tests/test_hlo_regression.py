@@ -100,9 +100,16 @@ def test_sparse_step_exchanges_rows_not_tables():
     dense_text = _train_hlo(sparse=False)
     sparse_text = _train_hlo(sparse=True)
 
+    # The op ITSELF must be a table-shaped all-reduce (`= f32[32,16]{...}
+    # all-reduce(`): some XLA versions print fusion consumers that
+    # mention an all-reduce operand on the same line as a table-shaped
+    # output, which a bare substring test would miscount.
+    table_ar = re.compile(
+        rf"= {re.escape(TOKEN_TABLE_SHARD)}\S* all-reduce\(")
+
     def table_allreduces(text):
         return [ln for ln in _collective_lines(text)
-                if "all-reduce" in ln and TOKEN_TABLE_SHARD in ln]
+                if table_ar.search(ln)]
 
     # the detector must actually detect: dense HAS the table exchange
     assert table_allreduces(dense_text), (
