@@ -114,6 +114,14 @@ def measure(batch_size: int = BATCH, contexts: int = CONTEXTS,
     float(loss)  # host fetch: the only reliable completion barrier over the
     #              axon tunnel, where block_until_ready can return early.
 
+    # Timings also flow through the observability registry
+    # (code2vec_tpu/obs): a CI runner pointing C2V_METRICS_FILE at a
+    # node-exporter textfile dir gets the same numbers Prometheus-side
+    # that the JSON contract line reports.
+    from code2vec_tpu import obs
+    h_window = obs.histogram(
+        "bench_window_seconds",
+        f"one timed window of {TIMED_STEPS} flagship train steps")
     window_rates = []
     for _ in range(n_windows):
         t0 = time.perf_counter()
@@ -123,9 +131,14 @@ def measure(batch_size: int = BATCH, contexts: int = CONTEXTS,
         # update, so fetching it forces the full window's step chain.
         float(loss)
         dt = time.perf_counter() - t0
+        h_window.observe(dt)
+        obs.default_tracer().maybe_record("bench_window", t0, dt)
         window_rates.append(TIMED_STEPS * batch_size / dt)
     window_rates.sort()
     examples_per_sec = window_rates[len(window_rates) // 2]
+    obs.gauge("bench_examples_per_sec",
+              "median-window flagship throughput",
+              sparse=str(sparse).lower()).set(examples_per_sec)
 
     import jax
 
@@ -147,6 +160,14 @@ def measure(batch_size: int = BATCH, contexts: int = CONTEXTS,
 
 
 def main() -> None:
+    # Optional observability side-channels (stdout stays exactly one JSON
+    # line): C2V_METRICS_FILE gets a Prometheus snapshot of the bench
+    # histograms/gauges, C2V_TRACE_EXPORT a Chrome trace of the windows.
+    metrics_file = os.environ.get("C2V_METRICS_FILE")
+    trace_export = os.environ.get("C2V_TRACE_EXPORT")
+    if trace_export:
+        from code2vec_tpu import obs
+        obs.default_tracer().enable()
     result = measure()
     # Secondary: the touched-rows sparse-Adam step (the advertised
     # pod-scale optimizer, config.use_sparse_embedding_update). Recorded
@@ -161,6 +182,12 @@ def main() -> None:
     result["sparse_adam_min"] = sparse_result["value_min"]
     result["sparse_adam_max"] = sparse_result["value_max"]
     result["flagship_default"] = "dense adam (reference-faithful; sparse is the pod-scale opt-in)"
+    if metrics_file:
+        from code2vec_tpu.obs import exporters
+        exporters.write_prometheus(metrics_file)
+    if trace_export:
+        from code2vec_tpu import obs
+        obs.default_tracer().export_chrome_trace(trace_export)
     print(json.dumps(result))
 
 

@@ -100,6 +100,26 @@ def arguments_parser() -> ArgumentParser:
     parser.add_argument("--profile_dir", metavar="DIR",
                         help="write a jax.profiler trace of train batches "
                              "10-20 to DIR (TensorBoard/Perfetto viewable)")
+    parser.add_argument("--metrics_file", metavar="FILE",
+                        help="write a Prometheus text-format metrics "
+                             "snapshot here, atomically rewritten at every "
+                             "log boundary (node-exporter textfile style)")
+    parser.add_argument("--metrics_port", type=int, default=0,
+                        metavar="PORT",
+                        help="serve the Prometheus snapshot at "
+                             "http://127.0.0.1:PORT/metrics during "
+                             "training; 0 disables")
+    parser.add_argument("--heartbeat_file", metavar="FILE",
+                        help="atomically rewrite a JSON heartbeat {step, "
+                             "epoch, last_loss, wall_time, ...} here each "
+                             "log window so external watchdogs can detect "
+                             "hangs by staleness")
+    parser.add_argument("--trace_export", metavar="FILE",
+                        help="write host-side wall-time spans (data wait/"
+                             "dispatch/loss sync/checkpoint/eval) as Chrome "
+                             "trace-event JSON here when training ends "
+                             "(Perfetto-loadable; complements "
+                             "--profile_dir's device trace)")
     return parser
 
 
@@ -130,6 +150,10 @@ def config_from_args(argv=None) -> Config:
         use_manual_tp_kernels=not args.gspmd,
         rss_limit_gb=args.rss_limit_gb,
         profile_dir=args.profile_dir,
+        metrics_file=args.metrics_file,
+        metrics_port=args.metrics_port,
+        heartbeat_file=args.heartbeat_file,
+        trace_export=args.trace_export,
     )
     if args.batch_size:
         config.train_batch_size = args.batch_size

@@ -146,6 +146,23 @@ class Config:
     # When set, a jax.profiler trace of train batches 10-20 is written
     # here (viewable in TensorBoard / Perfetto).
     profile_dir: Optional[str] = None
+
+    # -- observability (code2vec_tpu/obs; no reference equivalent) --
+    # Prometheus text-format snapshot, rewritten atomically at every log
+    # boundary (node-exporter textfile-collector style). None disables.
+    metrics_file: Optional[str] = None
+    # Localhost HTTP port serving the same snapshot at /metrics for a
+    # direct Prometheus scrape. 0 disables.
+    metrics_port: int = 0
+    # JSON heartbeat file {step, epoch, last_loss, wall_time, ...},
+    # rewritten atomically each log window so an external watchdog can
+    # detect a hung trainer by staleness alone. None disables.
+    heartbeat_file: Optional[str] = None
+    # Chrome trace-event JSON of host-side spans (data wait / dispatch /
+    # loss sync / checkpoint / eval), written when training ends —
+    # loadable in Perfetto, complementing the device-side --profile_dir
+    # trace. None disables span buffering entirely.
+    trace_export: Optional[str] = None
     # Random seed for params/dropout.
     seed: int = 42
 
@@ -284,6 +301,9 @@ class Config:
         if self.extractor_timeout_s < 0:
             raise ValueError(
                 "extractor_timeout_s must be >= 0 (0 disables).")
+        if not (0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                "metrics_port must be in [0, 65535] (0 disables).")
 
     # ---------------------------------------------------------------- logging
 

@@ -26,11 +26,26 @@ import dataclasses
 import enum
 import os
 import random
+import time
 from typing import Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from code2vec_tpu import obs
 from code2vec_tpu.vocab import Code2VecVocabs
+
+# Handles cached at module scope: _parse_chunk is the reader's hot path
+# (called from the worker pool threads; the registry's metrics are
+# thread-safe, the lookup lock is what we avoid per chunk).
+_H_PARSE = obs.histogram(
+    "data_parse_seconds",
+    "parse+filter of one reader chunk (parse_chunk_lines raw lines)")
+_C_ROWS_READ = obs.counter("data_rows_read_total",
+                           "raw .c2v lines parsed")
+_C_ROWS_DROPPED = obs.counter(
+    "data_rows_dropped_total",
+    "parsed rows removed by the reference row filter (OOV target / no "
+    "valid context)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -367,10 +382,17 @@ class PathContextReader:
             yield EpochEnd(epoch)
 
     def _parse_chunk(self, chunk: List[str]) -> RowBatch:
+        t0 = time.perf_counter()
         raw = parse_context_lines(chunk, self.vocabs, self.config.max_contexts,
                                   self.estimator_action)
         keep = row_filter_mask(raw, self.vocabs, self.estimator_action)
-        return _select_rows(raw, np.nonzero(keep)[0])
+        out = _select_rows(raw, np.nonzero(keep)[0])
+        dur = time.perf_counter() - t0
+        _H_PARSE.observe(dur)
+        _C_ROWS_READ.inc(len(chunk))
+        _C_ROWS_DROPPED.inc(len(chunk) - out.target_index.shape[0])
+        obs.default_tracer().maybe_record("data_parse_chunk", t0, dur)
+        return out
 
     def _parsed_chunks(self, line_iter: Iterator) -> Iterator:
         """Yield filtered RowBatch chunks (and EpochEnd markers, in order)

@@ -18,6 +18,7 @@ from typing import Callable, Iterable, Optional
 import jax
 import numpy as np
 
+from code2vec_tpu import obs
 from code2vec_tpu.evaluation.metrics import (
     ModelEvaluationResults, SubtokensEvaluationMetric, TargetWordTables,
     TopKAccuracyEvaluationMetric, batch_prediction_info,
@@ -60,6 +61,21 @@ class Evaluator:
         strictly serial order (parse -> transfer -> step -> metrics per
         batch); both paths produce identical results (pinned by
         tests), the pipelined one just overlaps host and device work."""
+        with obs.span("evaluate",
+                      hist=obs.histogram("eval_seconds",
+                                         "one full evaluation pass")):
+            results = self._evaluate_inner(params, batches,
+                                           code_vectors_path, prefetch)
+        obs.counter("eval_runs_total", "completed evaluation passes").inc()
+        # Last-eval quality gauges: the same scalars the TB eval/ tags
+        # carry, visible to a Prometheus scrape between TB flushes.
+        for name, value in results.tb_scalars():
+            obs.gauge(f"eval_{name}", "latest evaluation result").set(value)
+        return results
+
+    def _evaluate_inner(self, params, batches: Iterable,
+                        code_vectors_path: Optional[str],
+                        prefetch: bool) -> ModelEvaluationResults:
         config = self.config
         topk_metric = TopKAccuracyEvaluationMetric(
             config.top_k_words_considered_during_prediction, self.tables)
@@ -162,6 +178,9 @@ class Evaluator:
              subtoken_metric.nr_false_negatives) = packed[:5]
             topk_metric.nr_correct_predictions = packed[5:]
 
+        obs.counter("eval_examples_total",
+                    "examples scored across evaluation passes "
+                    "(host-local rows)").inc(total_predictions)
         return ModelEvaluationResults(
             topk_acc=topk_metric.topk_correct_predictions,
             subtoken_precision=subtoken_metric.precision,

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from code2vec_tpu import common as common_mod
+from code2vec_tpu import obs
 from code2vec_tpu.common import count_lines_in_file
 from code2vec_tpu.config import Config
 from code2vec_tpu.data.packed import PackedDataset, pack_c2v
@@ -289,6 +290,16 @@ class Code2VecModel:
         return save_fn
 
     def _rotate_epoch_checkpoints(self):
+        # Rotation rides the save critical path (the trainer is paused),
+        # so its wall time is worth a first-class metric.
+        with obs.span("checkpoint_rotate",
+                      hist=obs.histogram(
+                          "checkpoint_rotate_seconds",
+                          "orphan sweep + max_to_keep rotation after a "
+                          "clean save")):
+            self._rotate_epoch_checkpoints_inner()
+
+    def _rotate_epoch_checkpoints_inner(self):
         # reference keeps MAX_TO_KEEP epoch checkpoints (config.py:57).
         config = self.config
         pattern = f"{config.model_save_path}_iter*"
@@ -303,7 +314,11 @@ class Code2VecModel:
                    and not ckpt_mod.staging_owner_alive(p)]
         for p in sorted(orphans,
                         key=lambda p: ckpt_mod.BACKUP_INFIX in os.path.basename(p)):
-            if ckpt_mod.reclaim_orphan(p, log=self.log) == "removed":
+            outcome = ckpt_mod.reclaim_orphan(p, log=self.log)
+            obs.counter("checkpoint_orphans_reclaimed_total",
+                        "orphaned commit-protocol dirs swept or promoted "
+                        "by rotation", outcome=outcome).inc()
+            if outcome == "removed":
                 self.log(f"Swept orphaned checkpoint staging dir {p}")
         paths = glob.glob(pattern)  # re-glob: promotion adds artifacts
         parsed = {p: ckpt_mod.parse_iter_name(p) for p in paths}

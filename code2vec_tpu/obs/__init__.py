@@ -1,0 +1,53 @@
+"""Unified observability: metrics registry + span tracer + exporters.
+
+One import surface for every instrumented layer:
+
+    from code2vec_tpu import obs
+
+    _H_SAVE = obs.histogram("checkpoint_save_seconds", "save wall time")
+    with obs.span("checkpoint_save", hist=_H_SAVE):
+        ...
+    obs.counter("checkpoint_saves_total").inc()
+
+- Metrics (`obs.metrics`): process-wide registry of counters/gauges/
+  fixed-bucket histograms; Prometheus text + TB scalar export.
+- Tracing (`obs.tracer`): `span(name)` wall-time spans into a ring
+  buffer; Chrome trace-event JSON export (Perfetto-loadable),
+  complementing the device-side `jax.profiler` trace.
+- Exporters (`obs.exporters`): atomic Prometheus snapshot file
+  (`--metrics_file`), localhost HTTP `/metrics` (`--metrics_port`),
+  atomic JSON heartbeat (`--heartbeat_file`), and a dump of every
+  registered metric into TensorBoard at log boundaries.
+
+Everything is stdlib-only and safe to import from any layer (no jax, no
+circular deps): the data-reader worker threads, the checkpoint commit
+path, and the serving bridge all record into the same registry.
+"""
+
+from __future__ import annotations
+
+from code2vec_tpu.obs import exporters, metrics, tracer
+from code2vec_tpu.obs.metrics import (
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    default_registry,
+)
+from code2vec_tpu.obs.tracer import SpanTracer, default_tracer, span
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "SpanTracer",
+    "DEFAULT_BUCKETS", "counter", "gauge", "histogram", "span",
+    "default_registry", "default_tracer", "exporters", "metrics",
+    "tracer",
+]
+
+
+def counter(name: str, help: str = "", **labels) -> Counter:
+    return default_registry().counter(name, help, **labels)
+
+
+def gauge(name: str, help: str = "", **labels) -> Gauge:
+    return default_registry().gauge(name, help, **labels)
+
+
+def histogram(name: str, help: str = "", buckets=None, **labels) -> Histogram:
+    return default_registry().histogram(name, help, buckets=buckets, **labels)

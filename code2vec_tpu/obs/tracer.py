@@ -1,0 +1,160 @@
+"""Host-side span tracer: wall-time spans in a ring buffer, exportable as
+Chrome trace-event JSON (loads in Perfetto / chrome://tracing / the
+TensorBoard trace viewer).
+
+This complements the device-side `jax.profiler` trace (`--profile_dir`):
+the profiler shows where XLA spends device time, this shows where the
+HOST spends wall time — data wait vs. dispatch vs. loss sync vs.
+checkpoint saves vs. eval — which is exactly the split the device trace
+cannot see.
+
+Cost model: recording is OFF by default; a disabled tracer's
+`maybe_record` is one attribute check. When enabled, each span is one
+tuple append into a bounded deque (the ring buffer caps memory on long
+runs — a multi-day run keeps the most recent `capacity` spans). Span
+TIMING (perf_counter pairs) is done by the caller / the `span` context
+manager regardless, because the same measurement usually feeds a
+histogram that is always on.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from code2vec_tpu.obs import metrics as _metrics
+
+
+class SpanTracer:
+    """Bounded ring buffer of (name, start, duration, thread) spans."""
+
+    def __init__(self, capacity: int = 65536):
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        # perf_counter epoch: Chrome trace wants microsecond timestamps on
+        # one monotonic axis; absolute wall time is recorded separately in
+        # the metadata so runs can still be aligned to the clock.
+        self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
+        self.enabled = False
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def maybe_record(self, name: str, start_s: float, dur_s: float) -> None:
+        """Record a completed span (perf_counter start + duration). No-op
+        when disabled — the one-attr check keeps instrumented call sites
+        free to call this unconditionally."""
+        if not self.enabled:
+            return
+        self.record(name, start_s, dur_s)
+
+    def record(self, name: str, start_s: float, dur_s: float) -> None:
+        item = (name, start_s, dur_s, threading.get_ident(),
+                threading.current_thread().name)
+        with self._lock:
+            self._buf.append(item)
+
+    # ------------------------------------------------------------ export
+
+    def _serialize_chrome_trace(self) -> str:
+        """Chrome trace-event JSON (`traceEvents` of `ph:"X"` complete
+        events + thread/process-name metadata so Perfetto labels the
+        host threads readably). Serialized by hand instead of json.dump:
+        the export runs in the trainer's `finally` — including the
+        preemption path, where a scheduler grace window is ticking — and
+        the stdlib encoder costs seconds on a full 65536-span buffer
+        (hundreds of thousands of tiny dict encodes). Span names are
+        produced by our own call sites; the fields that could need
+        escaping go through json.dumps."""
+        with self._lock:
+            spans = list(self._buf)
+        pid = os.getpid()
+        parts = []
+        seen_tids = {}
+        for name, start_s, dur_s, tid, tname in spans:
+            if tid not in seen_tids:
+                seen_tids[tid] = tname
+            parts.append(
+                '{"name":%s,"ph":"X","cat":"host","ts":%.3f,"dur":%.3f,'
+                '"pid":%d,"tid":%d}'
+                % (json.dumps(name), (start_s - self._epoch) * 1e6,
+                   dur_s * 1e6, pid, tid))
+        for tid, tname in seen_tids.items():
+            parts.append(
+                '{"name":"thread_name","ph":"M","pid":%d,"tid":%d,'
+                '"args":{"name":%s}}' % (pid, tid, json.dumps(tname)))
+        parts.append(
+            '{"name":"process_name","ph":"M","pid":%d,'
+            '"args":{"name":"code2vec_tpu host"}}' % pid)
+        return ('{"traceEvents":[%s],"displayTimeUnit":"ms",'
+                '"otherData":{"trace_epoch_unix_s":%r,'
+                '"producer":"code2vec_tpu.obs.tracer"}}'
+                % (",".join(parts), self._epoch_wall))
+
+    def chrome_trace(self) -> dict:
+        """The trace as a parsed object (in-process inspection, tests);
+        one serializer, so this can never drift from the exported file."""
+        return json.loads(self._serialize_chrome_trace())
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Atomically write the Chrome trace JSON to `path`."""
+        tmp = f"{path}.tmp-{os.getpid()}"
+        dirpart = os.path.dirname(os.path.abspath(path))
+        os.makedirs(dirpart, exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(self._serialize_chrome_trace())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+_DEFAULT = SpanTracer()
+
+
+def default_tracer() -> SpanTracer:
+    return _DEFAULT
+
+
+class span:
+    """Context manager timing one named host-side section.
+
+    Always measures (two perf_counter calls); feeds the measurement to an
+    optional always-on histogram and to the tracer's ring buffer when
+    tracing is enabled. Reentrant-per-instance is NOT supported — create
+    one per `with` (the usual idiom `with obs.span("x"):` does)."""
+
+    __slots__ = ("name", "hist", "tracer", "_t0", "seconds")
+
+    def __init__(self, name: str, hist: Optional[_metrics.Histogram] = None,
+                 tracer: Optional[SpanTracer] = None):
+        self.name = name
+        self.hist = hist
+        self.tracer = tracer if tracer is not None else _DEFAULT
+        self.seconds = 0.0
+
+    def __enter__(self) -> "span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.seconds = time.perf_counter() - self._t0
+        if self.hist is not None:
+            self.hist.observe(self.seconds)
+        self.tracer.maybe_record(self.name, self._t0, self.seconds)
+        return False

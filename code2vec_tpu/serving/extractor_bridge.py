@@ -16,9 +16,24 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
+import time
 from typing import Dict, List, Optional, Tuple
 
+from code2vec_tpu import obs
 from code2vec_tpu.common import java_string_hashcode
+
+_H_EXTRACT = obs.histogram(
+    "extractor_seconds",
+    "serving-side path extraction: subprocess spawn to parsed contexts")
+_C_CALLS = obs.counter("extractor_calls_total",
+                       "serving-side extractions attempted")
+_C_TIMEOUTS = obs.counter(
+    "extractor_timeouts_total",
+    "extractor children killed after config.extractor_timeout_s")
+_C_FAILURES = obs.counter(
+    "extractor_failures_total",
+    "extractions that failed (nonzero exit / empty output), "
+    "timeouts excluded")
 
 DEFAULT_JAR_PATH = "JavaExtractor/JPredict/target/JavaExtractor-0.0.1-SNAPSHOT.jar"
 NATIVE_EXTRACTOR_ENV = "C2V_NATIVE_EXTRACTOR"
@@ -72,6 +87,17 @@ class PathExtractor:
             f"jar `{self.jar_path}` not present (or no java runtime).")
 
     def extract_paths(self, path: str) -> Tuple[List[str], Dict[str, str]]:
+        _C_CALLS.inc()
+        t0 = time.perf_counter()
+        try:
+            return self._extract_paths_inner(path)
+        finally:
+            dur = time.perf_counter() - t0
+            _H_EXTRACT.observe(dur)
+            obs.default_tracer().maybe_record("extract_paths", t0, dur)
+
+    def _extract_paths_inner(self, path: str
+                             ) -> Tuple[List[str], Dict[str, str]]:
         command = self._build_command(path)
         process = subprocess.Popen(command, stdout=subprocess.PIPE,
                                    stderr=subprocess.PIPE)
@@ -80,6 +106,7 @@ class PathExtractor:
         except subprocess.TimeoutExpired:
             process.kill()
             out, err = process.communicate()
+            _C_TIMEOUTS.inc()
             raise ExtractionTimeout(
                 f"path extraction of {path} exceeded {self.timeout:g}s "
                 f"and was killed; partial stderr: "
@@ -89,11 +116,13 @@ class PathExtractor:
             # Surface stderr even when the child produced some stdout —
             # a nonzero exit means the extraction is incomplete and the
             # partial output must not be silently served.
+            _C_FAILURES.inc()
             raise ValueError(
                 f"extractor exited with code {process.returncode} on "
                 f"{path} ({len(output)} stdout lines discarded); stderr: "
                 f"{err.decode(errors='replace').strip()!r}")
         if len(output) == 0:
+            _C_FAILURES.inc()
             raise ValueError(err.decode())
         hash_to_string: Dict[str, str] = {}
         result = []

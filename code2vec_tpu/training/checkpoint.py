@@ -46,6 +46,7 @@ from typing import Callable, Optional
 import numpy as np
 import orbax.checkpoint as ocp
 
+from code2vec_tpu import obs
 from code2vec_tpu.training.state import TrainState
 from code2vec_tpu.utils.faults import fault_point
 
@@ -249,6 +250,19 @@ def verify_checkpoint(model_path: str) -> dict:
     required files present, meta parseable, Orbax state dir non-empty —
     enough to reject the blatant half-writes the old layout could leave.
     """
+    with obs.span("checkpoint_verify",
+                  hist=obs.histogram("checkpoint_verify_seconds",
+                                     "manifest probe of one artifact")):
+        try:
+            return _verify_checkpoint_inner(model_path)
+        except CheckpointIntegrityError:
+            obs.counter("checkpoint_verify_failures_total",
+                        "artifacts that failed their integrity check "
+                        "(resume fallback walked past them)").inc()
+            raise
+
+
+def _verify_checkpoint_inner(model_path: str) -> dict:
     base = _abs(model_path)
     if not os.path.isdir(base):
         raise CheckpointIntegrityError(f"{base}: not a directory")
@@ -368,6 +382,22 @@ def save_model(model_save_path: str, state: TrainState, vocabs, config,
     (see the commit protocol in the module docstring). The `save` fault
     points between the steps are inert in production and let
     tests/test_chaos.py kill the save at every interesting boundary."""
+    with obs.span("checkpoint_save",
+                  hist=obs.histogram("checkpoint_save_seconds",
+                                     "full save: stage + flush + commit")):
+        out = _save_model_inner(model_save_path, state, vocabs, config,
+                                epoch, released)
+    obs.counter("checkpoint_saves_total",
+                "committed checkpoint artifacts").inc()
+    obs.gauge("checkpoint_last_save_unixtime",
+              "wall clock of the last committed save").set_to_current_time()
+    obs.gauge("checkpoint_last_save_epoch",
+              "epoch recorded in the last committed save").set(epoch)
+    return out
+
+
+def _save_model_inner(model_save_path: str, state: TrainState, vocabs,
+                      config, epoch: int, released: bool) -> str:
     base = _abs(model_save_path) + (RELEASED_SUFFIX if released else "")
     staging = f"{base}{STAGING_INFIX}{os.getpid()}"
     if os.path.isdir(staging):
@@ -400,14 +430,18 @@ def save_model(model_save_path: str, state: TrainState, vocabs, config,
             "adam_nu_dtype": str(getattr(config, "adam_nu_dtype", "float32")),
         }, f, indent=2)
     fault_point("save")   # 3: meta written, Orbax state missing
-    ckptr = ocp.StandardCheckpointer()
-    target = {"params": state.params, "step": state.step}
-    if not released:
-        target["opt_state"] = state.opt_state
-    state_dir = os.path.join(staging, _STATE_DIR)
-    ckptr.save(state_dir, target, force=True)
-    ckptr.wait_until_finished()
-    ckptr.close()
+    with obs.span("checkpoint_orbax_flush",
+                  hist=obs.histogram(
+                      "checkpoint_orbax_flush_seconds",
+                      "Orbax save + wait_until_finished (the bulk bytes)")):
+        ckptr = ocp.StandardCheckpointer()
+        target = {"params": state.params, "step": state.step}
+        if not released:
+            target["opt_state"] = state.opt_state
+        state_dir = os.path.join(staging, _STATE_DIR)
+        ckptr.save(state_dir, target, force=True)
+        ckptr.wait_until_finished()
+        ckptr.close()
     fault_point("save")   # 4: Orbax flushed, manifest missing
     _write_manifest(staging, epoch, released)
     fault_point("save")   # 5: fully staged, not yet committed

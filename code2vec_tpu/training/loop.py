@@ -28,6 +28,8 @@ from typing import Callable, Iterable, Optional
 import jax
 import numpy as np
 
+from code2vec_tpu import obs
+from code2vec_tpu.obs import exporters as obs_exporters
 from code2vec_tpu.data.reader import EpochEnd
 from code2vec_tpu.training.state import TrainState
 from code2vec_tpu.utils.prefetch import DevicePrefetcher
@@ -161,6 +163,48 @@ class Trainer:
         eval_every = config.num_train_batches_to_evaluate
         tb = self._make_tb_writer()
 
+        # ---- observability (code2vec_tpu/obs) ----------------------------
+        # Per-batch host timings go into always-on histograms (handles
+        # cached here: the registry lookup takes a lock); spans land in
+        # the trace ring buffer only when --trace_export armed it;
+        # heartbeat/Prometheus/TB exports happen at log boundaries only.
+        reg = obs.default_registry()
+        tracer = obs.default_tracer()
+        trace_path = getattr(config, "trace_export", None)
+        if trace_path:
+            tracer.enable()
+        metrics_file = getattr(config, "metrics_file", None)
+        heartbeat_file = getattr(config, "heartbeat_file", None)
+        metrics_server = None
+        metrics_port = int(getattr(config, "metrics_port", 0) or 0)
+        if metrics_port:
+            metrics_server = obs_exporters.start_metrics_server(metrics_port)
+            log(f"Serving Prometheus metrics at http://127.0.0.1:"
+                f"{metrics_server.server_address[1]}/metrics")
+        h_data_wait = reg.histogram(
+            "train_data_wait_seconds",
+            "host wait for the next prefetched batch")
+        h_dispatch = reg.histogram(
+            "train_step_dispatch_seconds",
+            "host-side dispatch of the jitted train step (async: device "
+            "execution overlaps; sync time is train_loss_sync_seconds)")
+        h_loss_sync = reg.histogram(
+            "train_loss_sync_seconds",
+            "blocking device fetch of a window's losses")
+        c_batches = reg.counter("train_batches_total",
+                                "train batches consumed this process")
+        c_epochs = reg.counter("train_epochs_total", "completed data passes")
+        c_nonfinite = reg.counter(
+            "train_nonfinite_loss_batches_total",
+            "individual batches whose loss came back NaN/Inf")
+        g_loss = reg.gauge("train_last_avg_loss",
+                           "window-average loss at the last drain")
+        g_throughput = reg.gauge(
+            "train_examples_per_sec",
+            "window throughput at the last log boundary")
+        g_epoch = reg.gauge("train_epoch", "current epoch number")
+        g_rss = reg.gauge("process_rss_bytes", "current resident set size")
+
         batch_num = 0              # batches this run
         trace_active = False       # profiler trace in flight
         epoch = self.initial_epoch
@@ -170,6 +214,8 @@ class Trainer:
         throughput_ema = None
         pending_losses = []
         multi_batch_start = time.time()
+        win_data_wait = 0.0        # host-side step-time breakdown,
+        win_dispatch = 0.0         # accumulated over the log window
         last_avg_loss = float("nan")
         prefetcher = DevicePrefetcher(batches, self.mesh,
                                       depth=config.prefetch_batches)
@@ -238,6 +284,9 @@ class Trainer:
         def run_eval(state, label):
             if self.evaluate_fn is None:
                 return
+            # Not span-wrapped here: the Evaluator itself records the
+            # `evaluate` span + eval_seconds histogram around the same
+            # interval — a trainer-side wrapper would just double it.
             results = self.evaluate_fn(state)
             if results is not None:
                 log(f"{label} -- {results}")
@@ -247,9 +296,95 @@ class Trainer:
                         tb.scalar(f"eval/{name}", value, step)
                     tb.flush()
 
+        def write_heartbeat(status: str) -> None:
+            """Atomic JSON heartbeat: step/epoch/loss plus a wall-time
+            stamp an external watchdog compares against now. Uses only
+            host-side counters — never syncs the device."""
+            if heartbeat_file is None:
+                return
+            obs_exporters.write_heartbeat(
+                heartbeat_file,
+                status=status,
+                step=batch_num,
+                epoch=epoch,
+                batch_in_epoch=batch_in_epoch,
+                last_loss=(None if not np.isfinite(last_avg_loss)
+                           else last_avg_loss),
+                examples_per_sec=throughput_ema,
+                rss_bytes=current_rss_bytes())
+
+        def drain_losses(where: str):
+            """Fetch every pending per-batch loss (the one place the host
+            blocks on the device), update the window average, and run the
+            non-finite sentinel over EACH batch loss — not just the
+            average — so a single poisoned batch trips the policy even in
+            windows that are drained early (mid-epoch eval or an epoch
+            boundary) whose losses the log-boundary average never sees.
+            The check costs no extra sync: `jnp.isfinite` over the
+            already-fetched loss vector is host-side arithmetic on
+            scalars the drain just paid for. Returns (losses, sync_s)."""
+            nonlocal pending_losses, last_avg_loss, trace_active
+            if not pending_losses:
+                return np.empty((0,)), 0.0
+            t0 = time.perf_counter()
+            fetched = jax.device_get(pending_losses)
+            sync_s = time.perf_counter() - t0
+            h_loss_sync.observe(sync_s)
+            tracer.maybe_record("loss_sync", t0, sync_s)
+            pending_losses = []
+            losses = np.asarray(fetched, dtype=np.float64)
+            last_avg_loss = float(losses.mean())
+            g_loss.set(last_avg_loss)
+            finite = np.isfinite(losses)
+            if finite.all() and np.isfinite(last_avg_loss):
+                return losses, sync_s
+            n_bad = int((~finite).sum())
+            c_nonfinite.inc(max(n_bad, 1))
+            first_bad = int(np.argmax(~finite)) if n_bad else losses.size - 1
+            bad_batch = batch_num - losses.size + 1 + first_bad
+            bad_value = float(losses[first_bad]) if n_bad else last_avg_loss
+            policy = getattr(config, "on_nonfinite_loss", "halt")
+            log(f"Non-finite average loss ({last_avg_loss}) at batch "
+                f"{batch_num} (epoch {epoch}, {where}): {max(n_bad, 1)} "
+                f"poisoned batch(es), first is batch {bad_batch} with "
+                f"loss {bad_value}; policy: {policy}")
+            if policy != "halt":
+                return losses, sync_s
+            if trace_active:
+                jax.profiler.stop_trace()
+                trace_active = False
+            # Checkpoint through the preemption save path but under a
+            # `_nanhalt` suffix: the poisoned params are preserved for
+            # post-mortem, yet the name is invisible to resume
+            # resolution and rotation (parse_iter_name -> None), so a
+            # scheduler auto-restarting with `--load <base>` resumes
+            # the last FINITE artifact instead of crash-looping on the
+            # NaN state.
+            save_preempt(state, epoch, suffix="_nanhalt")
+            self.preempted = True
+            self.final_epoch = epoch
+            raise NonFiniteLossError(
+                f"training loss became {bad_value} at batch {bad_batch} "
+                f"(epoch {epoch}, window average {last_avg_loss}); "
+                f"poisoned state kept in an _iter{epoch}_nanhalt "
+                f"artifact for post-mortem (excluded from resume). "
+                f"`--load` resumes the last clean artifact; rerun with "
+                f"--on_nonfinite_loss warn to push through.")
+
+        write_heartbeat("starting")
         try:
-            for item in prefetcher:
+            batch_iter = iter(prefetcher)
+            while True:
+                t_wait = time.perf_counter()
+                try:
+                    item = next(batch_iter)
+                except StopIteration:
+                    break
+                wait_s = time.perf_counter() - t_wait
                 if isinstance(item, EpochEnd):
+                    # Per-batch sentinel over the partial window the epoch
+                    # boundary is about to discard (see drain_losses).
+                    drain_losses("epoch boundary")
                     if jax.process_count() > 1:
                         # Lockstep sanity check, on the consumer thread so
                         # it cannot race the step loop's collectives: all
@@ -260,6 +395,8 @@ class Trainer:
                             item.epoch * 1_000_000 + batch_in_epoch,
                             "epoch boundary (epoch, batches-in-epoch)")
                     epoch = self.initial_epoch + item.epoch
+                    c_epochs.inc()
+                    g_epoch.set(epoch)
                     if steps_per_epoch is None:
                         steps_per_epoch = batch_in_epoch
                     batch_in_epoch = 0
@@ -269,12 +406,14 @@ class Trainer:
                     if (epoch % config.save_every_epochs == 0
                             or epoch >= config.num_train_epochs):
                         if self.save_fn is not None:
-                            self.save_fn(state, epoch)
+                            with obs.span("checkpoint_save_epoch"):
+                                self.save_fn(state, epoch)
                         run_eval(state, f"After {epoch} epochs")
                         if self.stop_fn is not None and self.stop_fn():
                             log(f"Early stopping after epoch {epoch}")
                             break
-                    pending_losses = []
+                    write_heartbeat("running")
+                    win_data_wait = win_dispatch = 0.0
                     multi_batch_start = time.time()
                     continue
 
@@ -282,15 +421,33 @@ class Trainer:
                 batch_num += 1
                 batch_in_epoch += 1
                 batches_since_eval += 1
+                h_data_wait.observe(wait_s)
+                win_data_wait += wait_s
+                tracer.maybe_record("data_wait", t_wait, wait_s)
                 if self.profile_dir and batch_num == 10:
                     jax.profiler.start_trace(self.profile_dir)
                     trace_active = True
+                t_disp = time.perf_counter()
                 state, loss = self.train_step(state, *arrays, rng)
+                disp_s = time.perf_counter() - t_disp
+                h_dispatch.observe(disp_s)
+                win_dispatch += disp_s
+                tracer.maybe_record("step_dispatch", t_disp, disp_s)
+                c_batches.inc()
                 pending_losses.append(loss)
                 if preemption_agreed(batch_num):
                     # Preemption notice: checkpoint what we have and leave
                     # cleanly inside the scheduler's grace window. `--load`
                     # resumes from this epoch's numbering.
+                    # Drain FIRST: if the in-flight window is NaN-poisoned
+                    # the halt policy must win — it saves under `_nanhalt`
+                    # (invisible to resume) and raises, where the preempt
+                    # save below would write the poisoned params as a
+                    # resume-ELIGIBLE artifact and hand the auto-restart
+                    # loop a NaN state to crash-cycle on. The device sync
+                    # costs nothing extra: the save fetches the same
+                    # state anyway.
+                    drain_losses("preemption")
                     if trace_active:
                         jax.profiler.stop_trace()
                         trace_active = False
@@ -305,47 +462,14 @@ class Trainer:
                     trace_active = False
                     log(f"Wrote profiler trace to {self.profile_dir}")
                 if batch_num % config.num_batches_to_log_progress == 0:
-                    # Blocks on the device only here.
-                    last_avg_loss = float(np.mean(jax.device_get(pending_losses)))
-                    if not np.isfinite(last_avg_loss):
-                        # NaN/Inf sentinel: the log boundary is the one
-                        # place the host already blocks on losses, so the
-                        # check adds no synchronization. A diverged run
-                        # must never silently burn a pod-day computing
-                        # NaNs (config.on_nonfinite_loss: halt|warn).
-                        policy = getattr(config, "on_nonfinite_loss",
-                                         "halt")
-                        log(f"Non-finite average loss ({last_avg_loss}) "
-                            f"at batch {batch_num} (epoch {epoch}); "
-                            f"policy: {policy}")
-                        if policy == "halt":
-                            if trace_active:
-                                jax.profiler.stop_trace()
-                                trace_active = False
-                            # Checkpoint through the preemption save path
-                            # but under a `_nanhalt` suffix: the poisoned
-                            # params are preserved for post-mortem, yet
-                            # the name is invisible to resume resolution
-                            # and rotation (parse_iter_name -> None), so
-                            # a scheduler auto-restarting with
-                            # `--load <base>` resumes the last FINITE
-                            # artifact instead of crash-looping on the
-                            # NaN state.
-                            save_preempt(state, epoch, suffix="_nanhalt")
-                            self.preempted = True
-                            self.final_epoch = epoch
-                            raise NonFiniteLossError(
-                                f"average training loss became "
-                                f"{last_avg_loss} at batch {batch_num} "
-                                f"(epoch {epoch}); poisoned state kept "
-                                f"in an _iter{epoch}_nanhalt artifact "
-                                f"for post-mortem (excluded from "
-                                f"resume). `--load` resumes the last "
-                                f"clean artifact; rerun with "
-                                f"--on_nonfinite_loss warn to push "
-                                f"through.")
+                    # Blocks on the device only here: the drain fetches
+                    # the window's losses and runs the non-finite
+                    # sentinel over each batch (config.on_nonfinite_loss:
+                    # halt|warn) — a diverged run must never silently
+                    # burn a pod-day computing NaNs.
+                    losses, sync_s = drain_losses("log boundary")
                     elapsed = time.time() - multi_batch_start
-                    n = len(pending_losses) * config.train_batch_size
+                    n = losses.size * config.train_batch_size
                     throughput = n / max(elapsed, 1e-9)
                     throughput_ema = (
                         throughput if throughput_ema is None else
@@ -360,23 +484,54 @@ class Trainer:
                         eta = (f", epoch {epoch + 1}: "
                                f"{batch_in_epoch}/{steps_per_epoch} batches, "
                                f"ETA {int(eta_s) // 60}m{int(eta_s) % 60:02d}s")
+                    # Step-time breakdown: where the window's wall time
+                    # went on the host. `device` is the remainder — time
+                    # the host sat inside neither wait/dispatch/sync; on
+                    # a healthy run it is the device-bound fraction.
+                    other_s = max(
+                        elapsed - win_data_wait - win_dispatch - sync_s, 0.0)
                     log(f"Average loss at batch {batch_num}: {last_avg_loss:.6f}, "
                         f"\tthroughput: {throughput:.0f} samples/sec "
-                        f"({contexts_rate / 1e6:.2f}M path-contexts/sec{eta})")
+                        f"({contexts_rate / 1e6:.2f}M path-contexts/sec{eta})"
+                        f" [host: data-wait {win_data_wait:.2f}s, dispatch "
+                        f"{win_dispatch:.2f}s, loss-sync {sync_s:.2f}s, "
+                        f"device/other {other_s:.2f}s]")
+                    g_throughput.set(throughput)
+                    g_epoch.set(epoch)
+                    g_rss.set(current_rss_bytes())
+                    reg.gauge("train_window_data_wait_seconds",
+                              "data wait total over the last log window"
+                              ).set(win_data_wait)
+                    reg.gauge("train_window_dispatch_seconds",
+                              "dispatch total over the last log window"
+                              ).set(win_dispatch)
+                    reg.gauge("train_window_loss_sync_seconds",
+                              "loss sync at the last log boundary"
+                              ).set(sync_s)
                     if tb is not None:
                         step = int(np.asarray(jax.device_get(state.step)))
                         tb.scalar("train/loss", last_avg_loss, step)
                         tb.scalar("train/examples_per_sec", throughput, step)
+                        # every registered metric (all subsystems) lands
+                        # in TB under obs/ at each log boundary
+                        obs_exporters.tb_export(tb, step, registry=reg)
                         tb.flush()
-                    pending_losses = []
+                    write_heartbeat("running")
+                    if metrics_file:
+                        obs_exporters.write_prometheus(metrics_file,
+                                                       registry=reg)
+                    win_data_wait = win_dispatch = 0.0
                     multi_batch_start = time.time()
                 if eval_every and batches_since_eval >= eval_every:
                     # reference: ModelEvaluationCallback fires every
                     # NUM_TRAIN_BATCHES_TO_EVALUATE=1800 train batches
                     # (keras_model.py:326-369, config.py:55).
                     batches_since_eval = 0
+                    # Drain first: the eval reset used to DISCARD these
+                    # losses unchecked — the window the average masks.
+                    drain_losses("mid-epoch eval boundary")
                     run_eval(state, f"Mid-epoch (batch {batch_num}) evaluation")
-                    pending_losses = []
+                    win_data_wait = win_dispatch = 0.0
                     multi_batch_start = time.time()
 
         finally:
@@ -393,11 +548,36 @@ class Trainer:
                 trace_active = False
             if watcher is not None:
                 watcher.uninstall()
+            # Flush+close the TB event file HERE, not after the loop: a
+            # crash (or the NaN-halt raise) must not lose the tail of the
+            # event stream. Same for the final heartbeat/snapshot — the
+            # last state an external watchdog sees must say why the
+            # process stopped. All teardown is best-effort: it must
+            # never mask the in-flight exception.
+            if tb is not None:
+                try:
+                    tb.close()
+                except Exception:
+                    pass
+            exc_in_flight = sys.exc_info()[0] is not None
+            status = ("error" if exc_in_flight
+                      else "preempted" if self.preempted else "done")
+            try:
+                write_heartbeat(status)
+                if metrics_file:
+                    obs_exporters.write_prometheus(metrics_file,
+                                                   registry=reg)
+                if trace_path:
+                    tracer.export_chrome_trace(trace_path)
+                    log(f"Wrote host-span Chrome trace to {trace_path} "
+                        f"({len(tracer)} spans buffered)")
+            except Exception:
+                if not exc_in_flight:
+                    raise
+            obs_exporters.stop_metrics_server(metrics_server)
 
         log("Done training")
         self.final_epoch = epoch
-        if tb is not None:
-            tb.close()
         elapsed = int(time.time() - start_time)
         log("Training time: %sH:%sM:%sS\n" % (
             elapsed // 3600, (elapsed // 60) % 60, elapsed % 60))

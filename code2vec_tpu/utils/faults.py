@@ -103,6 +103,17 @@ def fault_point(name: str) -> None:
     n, action = armed
     if _hits[name] != n:
         return
+    # Fired faults are counted so `raise`-action drills (and anything
+    # else sharing this process) can prove via the registry which fault
+    # paths fired. An `exit`-action increment necessarily dies with the
+    # process — os._exit runs no exporters by design, that IS the fault
+    # being simulated; exit drills are observed by their distinctive
+    # exit code instead. Imported lazily: the unarmed fast path above
+    # must stay one dict check with no import machinery.
+    from code2vec_tpu import obs
+    obs.counter("fault_injected_total",
+                "armed fault points that fired",
+                point=name, action=action).inc()
     if action == "exit":
         os._exit(FAULT_EXIT_CODE)
     raise FaultInjected(f"injected fault at point {name!r} (hit {n})")

@@ -7,12 +7,30 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, Optional
 
+from code2vec_tpu import obs
 from code2vec_tpu.data.reader import EpochEnd
 from code2vec_tpu.training.step import (
     device_put_batch, fused_path_applies, pack_batch_host,
 )
+
+# Module-scope handles: these fire once per batch on the worker and
+# consumer threads (registry metrics are thread-safe).
+_H_PACK = obs.histogram(
+    "prefetch_pack_seconds",
+    "host packing of one batch's fused transfer buffer (worker thread)")
+_H_DEVICE_PUT = obs.histogram(
+    "prefetch_device_put_seconds",
+    "host-side cost of dispatching one batch's device transfer "
+    "(consumer thread; the transfer itself is async)")
+_C_BATCHES = obs.counter("prefetch_batches_total",
+                         "batches staged by the prefetch worker")
+_G_DEPTH = obs.gauge(
+    "prefetch_queue_depth",
+    "ready batches queued ahead of the consumer at its last take "
+    "(0 every step = the pipeline is feed-bound)")
 
 
 class DevicePrefetcher:
@@ -65,9 +83,17 @@ class DevicePrefetcher:
                 elif pack:
                     # the packed buffer is all the consumer needs unless
                     # it asked for the host batch too — don't pin both
+                    t0 = time.perf_counter()
+                    packed = pack_batch_host(batch)
+                    dur = time.perf_counter() - t0
+                    _H_PACK.observe(dur)
+                    obs.default_tracer().maybe_record("prefetch_pack",
+                                                      t0, dur)
+                    _C_BATCHES.inc()
                     item = (batch if self.keep_host_batch else None,
-                            pack_batch_host(batch))
+                            packed)
                 else:
+                    _C_BATCHES.inc()
                     item = (batch, None)
                 if not self._put(item):
                     return
@@ -88,8 +114,14 @@ class DevicePrefetcher:
                 if isinstance(item, EpochEnd):
                     yield item
                     continue
+                _G_DEPTH.set(self._queue.qsize())
                 batch, packed = item
+                t0 = time.perf_counter()
                 arrays = device_put_batch(batch, self.mesh, packed=packed)
+                dur = time.perf_counter() - t0
+                _H_DEVICE_PUT.observe(dur)
+                obs.default_tracer().maybe_record("prefetch_device_put",
+                                                  t0, dur)
                 yield (arrays, batch if self.keep_host_batch else None)
         finally:
             # consumer stopped (normally, by exception, or abandoned):

@@ -78,7 +78,12 @@ def _event(wall_time: float, step: int, *, file_version: Optional[str] = None,
 
 
 class ScalarWriter:
-    """Appends scalar events to one `events.out.tfevents.*` file."""
+    """Appends scalar events to one `events.out.tfevents.*` file.
+
+    Lifecycle: usable as a context manager; `close()` is idempotent and
+    flushes first, so the trainer can close it in a `finally` (a crash or
+    the NaN-halt raise must not lose the tail of the event stream) while
+    any later defensive close stays harmless."""
 
     def __init__(self, logdir: str):
         os.makedirs(logdir, exist_ok=True)
@@ -99,7 +104,21 @@ class ScalarWriter:
         self._write(_event(time.time(), int(step), scalar=(tag, value)))
 
     def flush(self) -> None:
-        self._f.flush()
+        if not self._f.closed:
+            self._f.flush()
+
+    @property
+    def closed(self) -> bool:
+        return self._f.closed
 
     def close(self) -> None:
-        self._f.close()
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self) -> "ScalarWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False

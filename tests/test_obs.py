@@ -1,0 +1,446 @@
+"""Observability subsystem (code2vec_tpu/obs): registry semantics,
+Prometheus text rendering, span tracer + Chrome trace export, the
+atomic file exporters and the /metrics HTTP endpoint — plus a tier-1
+smoke test that runs a tiny train loop and asserts the heartbeat file,
+Prometheus snapshot, TB event file and Chrome trace all appear with sane
+contents, and regression tests for the per-batch non-finite-loss guard
+(windows that the old average-only sentinel discarded unchecked)."""
+
+import json
+import os
+import struct
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import obs
+from code2vec_tpu.data.reader import EpochEnd, RowBatch
+from code2vec_tpu.obs import exporters
+from code2vec_tpu.obs.metrics import MetricsRegistry
+from code2vec_tpu.obs.tracer import SpanTracer, span
+from code2vec_tpu.training.loop import NonFiniteLossError, Trainer
+
+
+# ------------------------------------------------------------- registry
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g")
+    g.set(4.0)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3.0
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.1, 0.5, 2.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(2.65)
+    # le is INCLUSIVE (Prometheus semantics): the 0.1 observation counts
+    # in the 0.1 bucket
+    assert h.cumulative_counts() == [2, 3]
+
+
+def test_registration_is_idempotent_and_type_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", point="save")
+    b = reg.counter("x_total", point="save")
+    assert a is b                       # same (name, labels) -> same child
+    other = reg.counter("x_total", point="load")
+    assert other is not a               # different labels -> sibling
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+
+
+def test_prometheus_render_format():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests", method="get").inc(3)
+    reg.gauge("temp").set(1.5)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    text = reg.render_prometheus()
+    assert "# HELP req_total requests" in text
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{method="get"} 3' in text
+    assert "temp 1.5" in text
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lat_seconds_sum 5.05" in text
+    assert "lat_seconds_count 2" in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("c_total", path='we"ird\\name\n').inc()
+    text = reg.render_prometheus()
+    assert 'path="we\\"ird\\\\name\\n"' in text
+
+
+def test_tb_scalars_flatten_histograms_and_labels():
+    reg = MetricsRegistry()
+    reg.counter("c_total", kind="a").inc(2)
+    h = reg.histogram("h_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    h.observe(1.5)
+    tags = dict(reg.tb_scalars())
+    assert tags["c_total.kind.a"] == 2.0
+    assert tags["h_seconds/count"] == 2.0
+    assert tags["h_seconds/sum"] == pytest.approx(2.0)
+    assert tags["h_seconds/mean"] == pytest.approx(1.0)
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("n_total")
+    h = reg.histogram("h_seconds", buckets=(0.5,))
+
+    def work():
+        for _ in range(5000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 40000
+    assert h.count == 40000
+    assert h.cumulative_counts() == [40000]
+
+
+def test_default_registry_module_helpers():
+    c = obs.counter("obs_selftest_total", "test counter")
+    before = c.value
+    obs.counter("obs_selftest_total").inc()
+    assert obs.counter("obs_selftest_total").value == before + 1
+    assert "obs_selftest_total" in obs.default_registry().render_prometheus()
+
+
+# --------------------------------------------------------------- tracer
+
+def test_span_times_and_feeds_histogram_even_when_tracer_disabled():
+    reg = MetricsRegistry()
+    tracer = SpanTracer()
+    assert not tracer.enabled
+    h = reg.histogram("s_seconds", buckets=(10.0,))
+    with span("work", hist=h, tracer=tracer) as s:
+        pass
+    assert h.count == 1
+    assert s.seconds >= 0
+    assert len(tracer) == 0            # disabled: nothing buffered
+
+
+def test_tracer_ring_buffer_bounded_and_exports_chrome_trace(tmp_path):
+    tracer = SpanTracer(capacity=8)
+    tracer.enable()
+    for i in range(20):
+        with span(f"s{i}", tracer=tracer):
+            pass
+    assert len(tracer) == 8            # ring buffer: newest 8 kept
+    out = tracer.export_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(out))
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert names == [f"s{i}" for i in range(12, 20)]
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X":             # Perfetto-required complete-event keys
+            assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in doc["traceEvents"])
+    assert doc["otherData"]["trace_epoch_unix_s"] > 0
+
+
+def test_span_records_on_exception():
+    tracer = SpanTracer()
+    tracer.enable()
+    with pytest.raises(RuntimeError):
+        with span("failing", tracer=tracer):
+            raise RuntimeError("boom")
+    assert len(tracer) == 1            # the span still closed + recorded
+
+
+# ------------------------------------------------------------ exporters
+
+def test_write_prometheus_is_atomic_and_complete(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc(7)
+    path = str(tmp_path / "sub" / "metrics.prom")
+    exporters.write_prometheus(path, registry=reg)
+    assert open(path).read() == reg.render_prometheus()
+    # no tmp litter left behind
+    assert os.listdir(tmp_path / "sub") == ["metrics.prom"]
+
+
+def test_heartbeat_schema(tmp_path):
+    path = str(tmp_path / "hb.json")
+    exporters.write_heartbeat(path, status="running", step=12, epoch=3,
+                              last_loss=1.25)
+    hb = json.load(open(path))
+    assert hb["schema_version"] == exporters.HEARTBEAT_SCHEMA_VERSION
+    assert hb["step"] == 12 and hb["epoch"] == 3
+    assert hb["last_loss"] == 1.25
+    assert hb["status"] == "running"
+    assert hb["pid"] == os.getpid()
+    assert hb["wall_time"] > 1.7e9     # a real unix timestamp
+    # rewrite replaces, never appends
+    exporters.write_heartbeat(path, status="done", step=13)
+    hb2 = json.load(open(path))
+    assert hb2["step"] == 13 and hb2["status"] == "done"
+
+
+def test_metrics_http_server_serves_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("served_total").inc(5)
+    server = exporters.start_metrics_server(0, registry=reg)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "served_total 5" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+    finally:
+        exporters.stop_metrics_server(server)
+
+
+# ------------------------------------------------ checkpoint-layer metrics
+
+def test_verify_failure_counts_into_registry(tmp_path):
+    from code2vec_tpu.training import checkpoint as ckpt_mod
+    c = obs.counter("checkpoint_verify_failures_total")
+    before = c.value
+    with pytest.raises(ckpt_mod.CheckpointIntegrityError):
+        ckpt_mod.verify_checkpoint(str(tmp_path / "nonexistent"))
+    assert c.value == before + 1
+    text = obs.default_registry().render_prometheus()
+    assert "checkpoint_verify_seconds_bucket" in text
+
+
+def test_fault_fire_counts_into_registry():
+    from code2vec_tpu.utils import faults
+    faults.reset("obs_probe=raise")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("obs_probe")
+    finally:
+        faults.reset(None)
+    c = obs.counter("fault_injected_total", point="obs_probe",
+                    action="raise")
+    assert c.value == 1
+
+
+# ------------------------------------------------------ train-loop smoke
+
+def _fake_batch(n=2, m=4):
+    return RowBatch(
+        source_token_indices=np.ones((n, m), np.int32),
+        path_indices=np.ones((n, m), np.int32),
+        target_token_indices=np.ones((n, m), np.int32),
+        context_valid_mask=np.ones((n, m), np.float32),
+        target_index=np.ones((n,), np.int32),
+        example_valid=np.ones((n,), bool))
+
+
+def _marker_stream(batches_per_epoch, epochs):
+    for e in range(epochs):
+        for _ in range(batches_per_epoch):
+            yield _fake_batch()
+        yield EpochEnd(e + 1)
+
+
+class _State:
+    step = np.zeros((), np.int32)
+
+
+def test_train_loop_emits_heartbeat_snapshot_tb_and_trace(tiny_config,
+                                                          tmp_path):
+    """Tier-1 smoke for the whole export surface: one tiny train run with
+    every sink configured produces (a) a JSON heartbeat with step/epoch/
+    loss, (b) a Prometheus snapshot with the step-breakdown histograms,
+    (c) a TB event file carrying the obs/ tags, (d) a Perfetto-loadable
+    Chrome trace with the per-batch host spans."""
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_batches_to_log_progress = 2
+    tiny_config.verbose_mode = 0
+    tiny_config.use_tensorboard = True
+    tiny_config.model_save_path = str(tmp_path / "model")
+    tiny_config.metrics_file = str(tmp_path / "metrics.prom")
+    tiny_config.heartbeat_file = str(tmp_path / "heartbeat.json")
+    tiny_config.trace_export = str(tmp_path / "trace.json")
+
+    def train_step(state, *args):
+        return state, np.float32(2.0)
+
+    saves = []
+    trainer = Trainer(tiny_config, train_step,
+                      save_fn=lambda s, e, suffix="": saves.append(e))
+    try:
+        trainer.train(_State(), _marker_stream(6, 1),
+                      rng=np.zeros((2,), np.uint32))
+    finally:
+        obs.default_tracer().disable()
+
+    # (a) heartbeat: final state says the run finished cleanly
+    hb = json.load(open(tiny_config.heartbeat_file))
+    assert hb["status"] == "done"
+    assert hb["step"] == 6
+    assert hb["epoch"] == 1
+    assert hb["last_loss"] == pytest.approx(2.0)
+    assert hb["rss_bytes"] > 0
+
+    # (b) Prometheus snapshot: step-time breakdown + loop counters
+    prom = open(tiny_config.metrics_file).read()
+    assert "train_data_wait_seconds_bucket" in prom
+    assert "train_step_dispatch_seconds_bucket" in prom
+    assert "train_loss_sync_seconds_bucket" in prom
+    assert "train_last_avg_loss 2" in prom
+    assert "train_epochs_total" in prom
+
+    # (c) TB event file exists and carries both the classic train/ tags
+    # and the registry dump under obs/
+    tb_dir = tiny_config.tensorboard_dir
+    events = [f for f in os.listdir(tb_dir) if "tfevents" in f]
+    assert len(events) == 1
+    blob = open(os.path.join(tb_dir, events[0]), "rb").read()
+    assert b"train/loss" in blob
+    assert b"obs/train_batches_total" in blob
+
+    # (d) Chrome trace: per-batch host spans, Perfetto-loadable JSON
+    doc = json.load(open(tiny_config.trace_export))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "step_dispatch" in names
+    assert "data_wait" in names
+    assert "loss_sync" in names
+
+    assert saves == [1]                # the loop itself behaved normally
+
+
+def test_train_loop_with_obs_disabled_writes_nothing(tiny_config, tmp_path):
+    """Default config: no heartbeat/snapshot/trace files appear and the
+    loop runs exactly as before (the instrumentation is passive)."""
+    tiny_config.num_train_epochs = 1
+    tiny_config.verbose_mode = 0
+
+    def train_step(state, *args):
+        return state, np.float32(1.0)
+
+    trainer = Trainer(tiny_config, train_step)
+    trainer.train(_State(), _marker_stream(3, 1),
+                  rng=np.zeros((2,), np.uint32))
+    assert not any(p.name.endswith((".prom", ".json"))
+                   for p in tmp_path.iterdir())
+
+
+# ----------------------------------- per-batch non-finite guard (ROADMAP)
+
+def test_nan_batch_caught_when_eval_reset_would_discard_it(tiny_config):
+    """Regression for the average-only sentinel's blind spot: a poisoned
+    batch in a window that a mid-epoch eval drains used to be DISCARDED
+    unchecked (the eval reset cleared pending_losses). The per-batch
+    guard must trip the halt policy there."""
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_batches_to_log_progress = 100   # no log boundary
+    tiny_config.num_train_batches_to_evaluate = 2   # eval at batch 2
+    tiny_config.verbose_mode = 0
+    tiny_config.on_nonfinite_loss = "halt"
+    steps, saves, evals = [], [], []
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, (np.float32("nan") if len(steps) == 1
+                       else np.float32(1.0))
+
+    trainer = Trainer(tiny_config, train_step,
+                      evaluate_fn=lambda s: evals.append(1),
+                      save_fn=lambda s, e, suffix="": saves.append(suffix))
+    with pytest.raises(NonFiniteLossError, match="nan"):
+        trainer.train(_State(), _marker_stream(8, 1),
+                      rng=np.zeros((2,), np.uint32))
+    assert len(steps) == 2             # tripped at the eval-boundary drain
+    assert evals == []                 # BEFORE the eval ran
+    assert saves == ["_nanhalt"]
+    assert trainer.preempted
+
+
+def test_nan_batch_caught_at_epoch_boundary_before_clean_save(tiny_config):
+    """Same blind spot at the epoch boundary: the poisoned tail window
+    must halt BEFORE the end-of-epoch clean save (which would otherwise
+    become the newest resume candidate with poisoned params)."""
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_batches_to_log_progress = 100
+    tiny_config.verbose_mode = 0
+    tiny_config.on_nonfinite_loss = "halt"
+    saves = []
+
+    def train_step(state, *args):
+        return state, np.float32("inf")
+
+    trainer = Trainer(tiny_config, train_step,
+                      save_fn=lambda s, e, suffix="": saves.append(suffix))
+    with pytest.raises(NonFiniteLossError):
+        trainer.train(_State(), _marker_stream(3, 1),
+                      rng=np.zeros((2,), np.uint32))
+    assert saves == ["_nanhalt"]       # no clean epoch save happened
+
+
+def test_nan_window_halts_instead_of_preempt_checkpointing(tiny_config):
+    """A preemption landing inside a NaN-poisoned window must NOT save
+    the poisoned params as a resume-ELIGIBLE `_preempt` artifact: the
+    drain runs first, the halt policy wins, and the state goes under
+    `_nanhalt` (invisible to resume) — otherwise an auto-restarting
+    scheduler would crash-loop on the NaN checkpoint."""
+    import os as _os
+    import signal as _signal
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_batches_to_log_progress = 100   # no log boundary
+    tiny_config.verbose_mode = 0
+    tiny_config.on_nonfinite_loss = "halt"
+    saves, steps = [], []
+
+    def train_step(state, *args):
+        steps.append(1)
+        if len(steps) == 2:
+            _os.kill(_os.getpid(), _signal.SIGTERM)
+        return state, np.float32("nan")
+
+    trainer = Trainer(tiny_config, train_step,
+                      save_fn=lambda s, e, suffix="": saves.append(suffix))
+    with pytest.raises(NonFiniteLossError):
+        trainer.train(_State(), _marker_stream(8, 1),
+                      rng=np.zeros((2,), np.uint32))
+    assert saves == ["_nanhalt"]       # never a plain "_preempt"
+    assert trainer.preempted
+
+
+def test_nonfinite_batches_counted(tiny_config):
+    tiny_config.num_train_epochs = 1
+    tiny_config.num_batches_to_log_progress = 4
+    tiny_config.verbose_mode = 0
+    tiny_config.on_nonfinite_loss = "warn"
+    c = obs.counter("train_nonfinite_loss_batches_total")
+    before = c.value
+    steps = []
+
+    def train_step(state, *args):
+        steps.append(1)
+        return state, (np.float32("nan") if len(steps) in (2, 3)
+                       else np.float32(1.0))
+
+    trainer = Trainer(tiny_config, train_step)
+    trainer.train(_State(), _marker_stream(4, 1),
+                  rng=np.zeros((2,), np.uint32))
+    assert c.value == before + 2       # each poisoned batch counted

@@ -1,0 +1,183 @@
+"""Wire-format lock for the dependency-free TensorBoard writer
+(utils/tb.py): an independent reader re-parses the TFRecord framing with
+its own bitwise CRC32C (not the writer's table), verifies BOTH masked
+CRCs of every record, and fully decodes the hand-encoded Event protos
+(wall_time / step / file_version / Summary tag+simple_value) — so any
+change to the framing or the proto field encoding shows up as a test
+diff, not as a TensorBoard that silently stops loading our files. Plus
+the writer lifecycle: context manager, idempotent close, flush-after-
+close harmless."""
+
+import math
+import struct
+
+import pytest
+
+from code2vec_tpu.utils.tb import ScalarWriter
+
+
+# ------------------------- independent CRC32C (bitwise, no lookup table)
+
+def _crc32c_bitwise(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc ^= b
+        for _ in range(8):
+            crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc_independent(data: bytes) -> int:
+    crc = _crc32c_bitwise(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------- minimal protobuf wire decoder
+
+def _read_varint(data: bytes, i: int):
+    shift = 0
+    out = 0
+    while True:
+        b = data[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _decode_summary_value(data: bytes) -> dict:
+    """Summary.Value: tag=1 (len-delim), simple_value=2 (32-bit float)."""
+    out = {}
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        fnum, wire = key >> 3, key & 7
+        if fnum == 1 and wire == 2:
+            ln, i = _read_varint(data, i)
+            out["tag"] = data[i:i + ln].decode()
+            i += ln
+        elif fnum == 2 and wire == 5:
+            out["simple_value"] = struct.unpack("<f", data[i:i + 4])[0]
+            i += 4
+        else:
+            pytest.fail(f"unexpected Summary.Value field {fnum} wire {wire}")
+    return out
+
+
+def _decode_event(data: bytes) -> dict:
+    """Event: wall_time=1 (double), step=2 (varint), file_version=3
+    (string), summary=5 (message of repeated Value=1)."""
+    ev = {"values": []}
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        fnum, wire = key >> 3, key & 7
+        if fnum == 1 and wire == 1:
+            ev["wall_time"] = struct.unpack("<d", data[i:i + 8])[0]
+            i += 8
+        elif fnum == 2 and wire == 0:
+            ev["step"], i = _read_varint(data, i)
+        elif fnum == 3 and wire == 2:
+            ln, i = _read_varint(data, i)
+            ev["file_version"] = data[i:i + ln].decode()
+            i += ln
+        elif fnum == 5 and wire == 2:
+            ln, i = _read_varint(data, i)
+            summary = data[i:i + ln]
+            i += ln
+            j = 0
+            while j < len(summary):
+                skey, j = _read_varint(summary, j)
+                assert skey >> 3 == 1 and skey & 7 == 2, \
+                    "Summary must only carry repeated Value (field 1)"
+                vlen, j = _read_varint(summary, j)
+                ev["values"].append(
+                    _decode_summary_value(summary[j:j + vlen]))
+                j += vlen
+        else:
+            pytest.fail(f"unexpected Event field {fnum} wire {wire}")
+    return ev
+
+
+def _read_events(path: str) -> list:
+    """Re-parse the TFRecord stream, verifying length-header and payload
+    masked CRCs with the independent CRC32C implementation."""
+    events = []
+    with open(path, "rb") as f:
+        blob = f.read()
+    i = 0
+    while i < len(blob):
+        header = blob[i:i + 8]
+        assert len(header) == 8, "truncated record header"
+        (length,) = struct.unpack("<Q", header)
+        (hcrc,) = struct.unpack("<I", blob[i + 8:i + 12])
+        assert hcrc == _masked_crc_independent(header), "header CRC mismatch"
+        payload = blob[i + 12:i + 12 + length]
+        assert len(payload) == length, "truncated record payload"
+        (pcrc,) = struct.unpack("<I",
+                                blob[i + 12 + length:i + 16 + length])
+        assert pcrc == _masked_crc_independent(payload), \
+            "payload CRC mismatch"
+        events.append(_decode_event(payload))
+        i += 16 + length
+    return events
+
+
+# ----------------------------------------------------------------- tests
+
+def test_event_stream_roundtrip_decodes_tags_values_steps(tmp_path):
+    w = ScalarWriter(str(tmp_path / "tb"))
+    w.scalar("train/loss", 1.5, step=7)
+    w.scalar("eval/f1", -0.25, step=300)          # multi-byte varint step
+    w.scalar("obs/x", 3.0e-9, step=2**33)         # >32-bit step
+    w.close()
+
+    events = _read_events(w.path)
+    assert len(events) == 4
+
+    head = events[0]
+    assert head["file_version"] == "brain.Event:2"
+    assert head["step"] == 0
+    assert head["values"] == []
+
+    tags = [(e["values"][0]["tag"], e["values"][0]["simple_value"],
+             e["step"]) for e in events[1:]]
+    assert tags[0][0] == "train/loss"
+    assert tags[0][1] == pytest.approx(1.5)
+    assert tags[0][2] == 7
+    assert tags[1][0] == "eval/f1"
+    assert tags[1][1] == pytest.approx(-0.25)
+    assert tags[1][2] == 300
+    assert tags[2][0] == "obs/x"
+    assert tags[2][1] == pytest.approx(3.0e-9, rel=1e-6)  # f32 rounding
+    assert tags[2][2] == 2**33
+
+    # every event carries a plausible wall clock
+    for e in events:
+        assert 1.7e9 < e["wall_time"] < 4e9
+        assert not math.isnan(e["wall_time"])
+
+
+def test_writer_is_a_context_manager_with_idempotent_close(tmp_path):
+    with ScalarWriter(str(tmp_path / "tb")) as w:
+        w.scalar("a", 1.0, step=1)
+        assert not w.closed
+    assert w.closed
+    w.close()          # second close: harmless
+    w.flush()          # flush after close: harmless (trainer finally path)
+    events = _read_events(w.path)
+    assert len(events) == 2            # file_version + the scalar
+
+
+def test_close_flushes_buffered_tail(tmp_path):
+    """The trainer closes the writer in its `finally`; that close must
+    flush OS-buffered records so a crash right after loses nothing."""
+    w = ScalarWriter(str(tmp_path / "tb"))
+    for i in range(50):
+        w.scalar("t", float(i), step=i)
+    w.close()
+    events = _read_events(w.path)
+    assert len(events) == 51
+    assert [e["values"][0]["simple_value"] for e in events[1:]] == \
+        [float(i) for i in range(50)]
