@@ -97,6 +97,19 @@ def arguments_parser() -> ArgumentParser:
                         help="kill a hung serving-side path-extractor "
                              "child after this many seconds (default: "
                              "config.py's 120; 0 disables)")
+    parser.add_argument("--preprocess_workers", type=int, default=0,
+                        metavar="N",
+                        help="host worker processes for the on-demand "
+                             ".c2v -> .c2vb pack at training startup "
+                             "(and the offline fused corpus compiler); "
+                             "output is byte-identical at any worker "
+                             "count; 0 = in-process serial")
+    parser.add_argument("--checkpoint_hash_content", action="store_true",
+                        help="record full-content sha256 of every "
+                             "checkpoint file (incl. the Orbax shards, "
+                             "hashed on a thread pool AFTER the atomic "
+                             "commit) into the manifest; resume "
+                             "verifies the hashes when present")
     parser.add_argument("--profile_dir", metavar="DIR",
                         help="write a jax.profiler trace of train batches "
                              "10-20 to DIR (TensorBoard/Perfetto viewable)")
@@ -147,6 +160,8 @@ def config_from_args(argv=None) -> Config:
            if (value := getattr(args, knob)) is not None},
         seed=args.seed,
         use_packed_data=not args.no_packed_data,
+        preprocess_workers=args.preprocess_workers,
+        checkpoint_hash_content=args.checkpoint_hash_content,
         use_manual_tp_kernels=not args.gspmd,
         rss_limit_gb=args.rss_limit_gb,
         profile_dir=args.profile_dir,

@@ -141,6 +141,13 @@ class Config:
     dropout_prng_impl: str = "rbg"
     # Prefer the packed int32 binary sidecar (.c2vb) when present.
     use_packed_data: bool = True
+    # Host worker processes for the offline data compile: the on-demand
+    # .c2v -> .c2vb pack at training startup (model_facade) and the
+    # fused raw-corpus compiler (data/preprocess.py compile_corpus).
+    # Output is byte-identical at any worker count; 0 = in-process
+    # serial. No reference analog (the reference preprocesses in awk +
+    # single-process Python).
+    preprocess_workers: int = 0
     # Number of batches the host pipeline keeps in flight ahead of device.
     prefetch_batches: int = 4
     # When set, a jax.profiler trace of train batches 10-20 is written
@@ -163,6 +170,15 @@ class Config:
     # loadable in Perfetto, complementing the device-side --profile_dir
     # trace. None disables span buffering entirely.
     trace_export: Optional[str] = None
+    # Full-content sha256 of every checkpoint file (including the
+    # multi-GB Orbax shards, chunked + hashed on a thread pool) recorded
+    # into the manifest AFTER the atomic commit, so it stays off the
+    # save critical path; resume verifies the hashes when present
+    # (training/checkpoint.py). Default off: the manifest's
+    # existence+size probe already rejects truncation, and Orbax
+    # checksums its own payloads — this adds bit-rot/corruption
+    # detection for long-lived artifacts.
+    checkpoint_hash_content: bool = False
     # Random seed for params/dropout.
     seed: int = 42
 
@@ -304,6 +320,9 @@ class Config:
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(
                 "metrics_port must be in [0, 65535] (0 disables).")
+        if self.preprocess_workers < 0:
+            raise ValueError(
+                "preprocess_workers must be >= 0 (0 = in-process serial).")
 
     # ---------------------------------------------------------------- logging
 

@@ -59,8 +59,48 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.c2v_pack_file.restype = i64
         lib.c2v_pack_file.argtypes = [p, ctypes.c_char_p, ctypes.c_char_p,
                                       ctypes.c_char_p, i32, i32]
+        try:
+            lib.c2v_parse_rows.restype = i64
+            lib.c2v_parse_rows.argtypes = [p, ctypes.c_char_p, i64, i32,
+                                           ctypes.POINTER(i32), i64]
+        except AttributeError:
+            pass  # pre-parse_rows build; parse_blob stays available
+        try:
+            lib.c2v_histogram_range.restype = i64
+            lib.c2v_histogram_range.argtypes = [
+                ctypes.c_char_p, i64, i64, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p]
+        except AttributeError:
+            # library built before the histogram entry point existed;
+            # histogram_range() raises and callers fall back to Python
+            pass
         _lib = lib
         return _lib
+
+
+def histogram_range(raw_path: str, start: int, end: int, tokens_out: str,
+                    paths_out: str, targets_out: str) -> int:
+    """Token/path/target occurrence histograms over one line-aligned byte
+    range of a raw extractor file, dumped as "count word" lines — the
+    map step of the multiprocess histogram build (needs no vocab tables).
+    Returns the number of lines consumed."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "c2v_histogram_range"):
+        raise RuntimeError(
+            "libc2vdata.so with c2v_histogram_range not built "
+            "(run `make -C cpp`)")
+    n = lib.c2v_histogram_range(raw_path.encode(), start, end,
+                                tokens_out.encode(), paths_out.encode(),
+                                targets_out.encode())
+    if n < 0:
+        raise IOError(f"native histogram failed for {raw_path} "
+                      f"[{start}:{end})")
+    return n
+
+
+def has_histogram_range() -> bool:
+    lib = load_library()
+    return lib is not None and hasattr(lib, "c2v_histogram_range")
 
 
 def _i32ptr(a: np.ndarray):
@@ -68,21 +108,46 @@ def _i32ptr(a: np.ndarray):
 
 
 class NativeTables:
-    """Native string->id tables for one `Code2VecVocabs` instance."""
+    """Native string->id tables for one `Code2VecVocabs` instance (or, via
+    `from_tables`, for raw bytes->id dicts — the multiprocess pack workers
+    carry plain dicts instead of a pickled vocab object)."""
 
     def __init__(self, vocabs):
         lib = load_library()
         if lib is None:
             raise RuntimeError("libc2vdata.so not built (run `make -C cpp`)")
-        self._lib = lib
         tok, pth, tgt = (vocabs.token_vocab, vocabs.path_vocab,
                          vocabs.target_vocab)
+
+        def encode(vocab):
+            return {w.encode("utf-8", "surrogateescape"): i
+                    for w, i in vocab.word_to_index.items()}
+
+        self._init_from(lib, encode(tok), encode(pth), encode(tgt),
+                        tok.pad_index, tok.oov_index, pth.pad_index,
+                        pth.oov_index, tgt.oov_index)
+
+    @classmethod
+    def from_tables(cls, token_b2i, path_b2i, target_b2i, *, token_pad,
+                    token_oov, path_pad, path_oov,
+                    target_oov) -> "NativeTables":
+        """Build tables from bytes->id dicts directly (no vocab object)."""
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("libc2vdata.so not built (run `make -C cpp`)")
+        self = cls.__new__(cls)
+        self._init_from(lib, token_b2i, path_b2i, target_b2i, token_pad,
+                        token_oov, path_pad, path_oov, target_oov)
+        return self
+
+    def _init_from(self, lib, token_b2i, path_b2i, target_b2i, token_pad,
+                   token_oov, path_pad, path_oov, target_oov) -> None:
+        self._lib = lib
         self._handle = lib.c2v_tables_create(
-            tok.pad_index, tok.oov_index, pth.pad_index, pth.oov_index,
-            tgt.oov_index)
-        for which, vocab in enumerate((tok, pth, tgt)):
-            items = sorted(vocab.word_to_index.items(), key=lambda kv: kv[1])
-            words = "\n".join(w for w, _ in items).encode("utf-8", "surrogateescape")
+            token_pad, token_oov, path_pad, path_oov, target_oov)
+        for which, table in enumerate((token_b2i, path_b2i, target_b2i)):
+            items = sorted(table.items(), key=lambda kv: kv[1])
+            words = b"\n".join(w for w, _ in items)
             ids = np.asarray([i for _, i in items], dtype=np.int32)
             lib.c2v_tables_load(self._handle, which, words, len(words),
                                 _i32ptr(ids), len(items))
@@ -103,9 +168,18 @@ class NativeTables:
         text = "".join(line if line.endswith("\n") else line + "\n"
                        for line in lines)
         data = text.encode("utf-8", "surrogateescape")
-        n, m = len(lines), max_contexts
+        n = len(lines)
         if data.count(b"\n") != n:
             return None
+        return self.parse_blob(data, n, max_contexts)
+
+    def parse_blob(self, data: bytes, n: int, max_contexts: int):
+        """Parse `n` newline-terminated context lines, pre-encoded as one
+        bytes blob, to (src, pth, tgt, label, mask) arrays. The pack
+        workers' entry point: they hold bytes lines already, so there is
+        no per-line join/re-encode. Caller guarantees `data` holds
+        exactly `n` lines, each ending in b"\\n"."""
+        m = max_contexts
         src = np.empty((n, m), dtype=np.int32)
         pth = np.empty((n, m), dtype=np.int32)
         tgt = np.empty((n, m), dtype=np.int32)
@@ -115,9 +189,25 @@ class NativeTables:
             self._handle, data, len(data), m, _i32ptr(src), _i32ptr(pth),
             _i32ptr(tgt), _i32ptr(label),
             mask.ctypes.data_as(ctypes.c_void_p), n)
-        # "\n".join never yields extra rows; a short count means a bug.
+        # newline-terminated input never yields extra rows; a short count
+        # means a bug.
         assert parsed == n, (parsed, n)
         return src, pth, tgt, label, mask
+
+    def parse_rows_blob(self, data: bytes, n: int,
+                        max_contexts: int) -> np.ndarray:
+        """Parse `n` newline-terminated lines (one bytes blob) straight
+        into an `(n, 1 + 3*m)` int32 array in the `.c2vb` interleaved row
+        layout — the pack workers write this buffer to disk with no
+        further copy. Requires a libc2vdata.so with `c2v_parse_rows`
+        (raises AttributeError on older builds; callers fall back to
+        `parse_blob` + explicit interleave)."""
+        m = max_contexts
+        rec = np.empty((n, 1 + 3 * m), dtype=np.int32)
+        parsed = self._lib.c2v_parse_rows(self._handle, data, len(data), m,
+                                          _i32ptr(rec), n)
+        assert parsed == n, (parsed, n)
+        return rec
 
     def pack_file(self, c2v_path: str, out_path: str, max_contexts: int,
                   targets_path: Optional[str] = None,

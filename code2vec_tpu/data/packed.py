@@ -23,11 +23,15 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import random
+import shutil
 import struct
-from typing import Iterator, List, Optional
+import tempfile
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from code2vec_tpu.data import preprocess as preprocess_mod
 from code2vec_tpu.data import reader as reader_mod
 from code2vec_tpu.data.reader import EpochEnd, EstimatorAction, RowBatch
 from code2vec_tpu.vocab import Code2VecVocabs
@@ -49,8 +53,14 @@ def vocabs_fingerprint(vocabs: Code2VecVocabs) -> str:
 
 def pack_c2v(c2v_path: str, vocabs: Code2VecVocabs, max_contexts: int,
              out_path: Optional[str] = None, chunk_lines: int = 8192,
-             write_targets_sidecar: bool = True) -> str:
-    """Compile a `.c2v` text file into a `.c2vb` memmap (returns its path)."""
+             write_targets_sidecar: bool = True, num_workers: int = 0) -> str:
+    """Compile a `.c2v` text file into a `.c2vb` memmap (returns its path).
+
+    `num_workers > 1` shards the text by line-aligned byte ranges across
+    that many worker processes (row order — and therefore the output
+    bytes — are unchanged); the native whole-file path still wins when
+    libc2vdata.so is built.
+    """
     out_path = out_path or (c2v_path + "b")  # data.train.c2v -> data.train.c2vb
     tmp_path = out_path + ".tmp"
     n_rows = 0
@@ -66,6 +76,15 @@ def pack_c2v(c2v_path: str, vocabs: Code2VecVocabs, max_contexts: int,
                                   targets_path=targets_sidecar)
         return _write_pack_meta(out_path, c2v_path, n_rows, max_contexts,
                                 vocabs)
+
+    if num_workers > 1:
+        # Compat mode of the fused compiler: no sampling (contexts past
+        # `max_contexts` are truncated like `parse_context_lines`), one
+        # row per line — exactly the serial loop below, sharded.
+        pack_raw(c2v_path, out_path, vocabs, None, None, max_contexts,
+                 num_workers=num_workers,
+                 write_targets_sidecar=write_targets_sidecar)
+        return out_path
 
     with open(tmp_path, "wb") as out:
         out.write(_HEADER.pack(_MAGIC, _VERSION, 0, max_contexts))
@@ -117,6 +136,515 @@ def _write_chunk(out, tgt_file, chunk, vocabs, max_contexts) -> int:
     return n
 
 
+# ----------------------------------------------- fused raw -> .c2vb compile
+#
+# The offline compiler's hot half: multiprocessing workers read raw
+# extractor output by line-aligned byte ranges, apply the reference's
+# two-tier in-vocab sampling (reference: preprocess.py:41-56), look up
+# vocab ids, and write int32 rows into per-shard segment files that the
+# parent stitches (header + concatenation) into one `.c2vb` + `.targets`
+# sidecar — no padded `.c2v` text intermediate. Output is byte-identical
+# at any worker count: each method's sampling RNG is seeded from
+# (global seed, global line ordinal), and segments concatenate in file
+# order. The same machinery packs existing `.c2v` text in parallel
+# (sampling disabled — `pack_c2v(num_workers=...)`).
+
+_PACK_CTX: Optional[dict] = None
+_PACK_NATIVE = "unset"
+
+
+def _method_rng(seed: int, ordinal: int) -> random.Random:
+    """Per-method sampling RNG from a stable hash of (seed, ordinal) —
+    identical in every worker layout, which is what makes the parallel
+    compile byte-identical to the serial one."""
+    digest = hashlib.blake2b(struct.pack("<qq", seed, ordinal),
+                             digest_size=16).digest()
+    return random.Random(int.from_bytes(digest, "little"))
+
+
+def _init_pack_worker(ctx: dict) -> None:
+    global _PACK_CTX, _PACK_NATIVE
+    _PACK_CTX = ctx
+    _PACK_NATIVE = "unset"
+
+
+def _pack_worker_native_tables():
+    """Per-worker native split+lookup tables when libc2vdata.so is built
+    (the GIL-releasing core from data/native.py), else None. Built once
+    per worker process from the ctx's bytes->id dicts."""
+    global _PACK_NATIVE
+    if _PACK_NATIVE == "unset":
+        from code2vec_tpu.data import native
+        ctx = _PACK_CTX
+        if native.load_library() is None:
+            _PACK_NATIVE = None
+        else:
+            _PACK_NATIVE = native.NativeTables.from_tables(
+                ctx["token_b2i"], ctx["path_b2i"], ctx["target_b2i"],
+                token_pad=ctx["token_pad"], token_oov=ctx["token_oov"],
+                path_pad=ctx["path_pad"], path_oov=ctx["path_oov"],
+                target_oov=ctx["target_oov"])
+    return _PACK_NATIVE
+
+
+def _pack_shard(task) -> dict:
+    """Compile one byte range of the raw file into segment files.
+
+    Per-line work is memoized per DISTINCT context string (corpora
+    repeat contexts heavily): one dict hit replaces split + three vocab
+    lookups for every repeat occurrence. The memo is cleared past
+    `_MEMO_CAP` entries so worker RSS stays bounded on any corpus.
+    """
+    shard_idx, start, end, ordinal = task
+    ctx = _PACK_CTX
+    m: int = ctx["max_contexts"]
+    seed: int = ctx["seed"]
+    token_b2i: Dict[bytes, int] = ctx["token_b2i"]
+    path_b2i: Dict[bytes, int] = ctx["path_b2i"]
+    target_b2i: Dict[bytes, int] = ctx["target_b2i"]
+    token_pad, token_oov = ctx["token_pad"], ctx["token_oov"]
+    path_pad, path_oov = ctx["path_pad"], ctx["path_oov"]
+    target_oov = ctx["target_oov"]
+    word_ok, path_ok = ctx["word_ok"], ctx["path_ok"]
+    sampling = word_ok is not None
+    tables = _pack_worker_native_tables()
+    native_rows = tables is not None and hasattr(tables._lib,
+                                                 "c2v_parse_rows")
+    memo: Dict[bytes, tuple] = {}
+    memo_cap = preprocess_mod._MEMO_CAP
+    # Emission memo: one packed int64 per distinct context
+    # (sid | pid<<21 | tid<<42), so a whole chunk's id resolution is a
+    # C-speed `map` + `np.fromiter` instead of a per-context Python
+    # loop. Packing needs every token/path id under 2^21 (the java14m
+    # reference vocabs are 1.3M/911K); larger vocabs take the tuple
+    # fallback below.
+    memo_pack: Dict[bytes, int] = {}
+    pack_ok = (max(token_b2i.values(), default=0) < (1 << 21)
+               and max(path_b2i.values(), default=0) < (1 << 21))
+
+    def lookup(c: bytes) -> tuple:
+        """(src_id, path_id, tgt_id, tier) for one context string; tier
+        is 2 fully-in-vocab / 1 partially / 0 (reference tier test,
+        preprocess.py:77-84). Missing pieces behave like the reader's
+        sparse fill (reader.py parse_context_lines): empty -> PAD."""
+        pieces = c.split(b",")
+        a = pieces[0]
+        b = pieces[1] if len(pieces) > 1 else b""
+        d = pieces[2] if len(pieces) > 2 else b""
+        sid = token_b2i.get(a, token_pad if a == b"" else token_oov)
+        pid = path_b2i.get(b, path_pad if b == b"" else path_oov)
+        tid = token_b2i.get(d, token_pad if d == b"" else token_oov)
+        if not sampling:
+            tier = 0
+        elif a in word_ok and b in path_ok and d in word_ok:
+            tier = 2
+        elif a in word_ok or b in path_ok or d in word_ok:
+            tier = 1
+        else:
+            tier = 0
+        if len(memo) >= memo_cap:
+            memo.clear()
+        memo[c] = entry = (sid, pid, tid, tier)
+        return entry
+
+    def lookup_pack(c: bytes) -> int:
+        pieces = c.split(b",")
+        a = pieces[0]
+        b = pieces[1] if len(pieces) > 1 else b""
+        d = pieces[2] if len(pieces) > 2 else b""
+        v = (token_b2i.get(a, token_pad if a == b"" else token_oov)
+             | path_b2i.get(b, path_pad if b == b"" else path_oov) << 21
+             | token_b2i.get(d, token_pad if d == b"" else token_oov) << 42)
+        if len(memo_pack) >= memo_cap:
+            memo_pack.clear()
+        memo_pack[c] = v
+        return v
+
+    seg_path = os.path.join(ctx["seg_dir"], f"seg{shard_idx:05d}")
+    seg = open(seg_path + ".bin", "wb", buffering=4 * 1024 * 1024)
+    tgt_seg = (open(seg_path + ".targets", "wb", buffering=1024 * 1024)
+               if ctx["write_targets"] else None)
+    c2v_seg = (open(seg_path + ".c2v", "wb", buffering=4 * 1024 * 1024)
+               if ctx["emit_c2v"] else None)
+
+    rows = contexts_seen = contexts_kept = widest = skipped = 0
+    # chunk accumulators, flushed every `flush_rows` methods: one name,
+    # one context count and a flat context stream per kept row (the flat
+    # list is extended at C level in the line loop — per-context Python
+    # work happens only in `flush`, vectorized)
+    flush_rows = 8192
+    names: List[bytes] = []
+    ks: List[int] = []
+    all_ctxs: List[bytes] = []
+    need_row_slices = tables is not None or c2v_seg is not None
+
+    def row_slices() -> List[List[bytes]]:
+        pos = 0
+        out = []
+        for k in ks:
+            out.append(all_ctxs[pos:pos + k])
+            pos += k
+        return out
+
+    def flush() -> None:
+        nonlocal rows
+        n = len(names)
+        if not n:
+            return
+        per_row = row_slices() if need_row_slices else None
+        if tables is not None:
+            blob = b"\n".join(b" ".join([name] + ctxs)
+                              for name, ctxs in zip(names, per_row)) + b"\n"
+            if native_rows:
+                rec = tables.parse_rows_blob(blob, n, m)
+            else:
+                src, pth, tgt, label, _mask = tables.parse_blob(blob, n, m)
+                rec = np.empty((n, 1 + 3 * m), dtype=np.int32)
+                rec[:, 0] = label
+                rec[:, 1:1 + m] = src
+                rec[:, 1 + m:1 + 2 * m] = pth
+                rec[:, 1 + 2 * m:] = tgt
+        else:
+            labels = np.fromiter(
+                (target_b2i.get(nm, target_oov) for nm in names),
+                dtype=np.int32, count=n)
+            ks_arr = np.asarray(ks, dtype=np.int64)
+            mask = np.arange(m) < ks_arr[:, None]
+            rec = np.empty((n, 1 + 3 * m), dtype=np.int32)
+            rec[:, 0] = labels
+            if pack_ok:
+                # one C-speed map over the occurrence stream; misses
+                # (first sight of a distinct context) patched inline
+                mget = memo_pack.get
+                vals_list = list(map(mget, all_ctxs))
+                if None in vals_list:
+                    for i, v in enumerate(vals_list):
+                        if v is None:
+                            c = all_ctxs[i]
+                            v = mget(c)  # repeats resolve on first sight
+                            vals_list[i] = (v if v is not None
+                                            else lookup_pack(c))
+                vals = np.array(vals_list, dtype=np.int64)
+                m21 = (1 << 21) - 1
+                streams = ((1, vals & m21, token_pad),
+                           (1 + m, (vals >> 21) & m21, path_pad),
+                           (1 + 2 * m, vals >> 42, token_pad))
+            else:
+                # tuple fallback for vocabs too large for 21-bit packing
+                flat_s: List[int] = []
+                flat_p: List[int] = []
+                flat_t: List[int] = []
+                for c in all_ctxs:
+                    entry = memo.get(c)
+                    if entry is None:
+                        entry = lookup(c)
+                    flat_s.append(entry[0])
+                    flat_p.append(entry[1])
+                    flat_t.append(entry[2])
+                streams = ((1, np.asarray(flat_s, np.int32), token_pad),
+                           (1 + m, np.asarray(flat_p, np.int32), path_pad),
+                           (1 + 2 * m, np.asarray(flat_t, np.int32),
+                            token_pad))
+            # boolean assignment fills in C (row-major) order == the
+            # order `all_ctxs` was appended in
+            for off, ids, pad in streams:
+                block = rec[:, off:off + m]
+                block.fill(pad)
+                block[mask] = ids
+        seg.write(rec)
+        if tgt_seg is not None:
+            tgt_seg.write(b"\n".join(names) + b"\n")
+        if c2v_seg is not None:
+            c2v_seg.write(b"".join(
+                b" ".join([name] + ctxs) + b" " * (m - len(ctxs)) + b"\n"
+                for name, ctxs in zip(names, per_row)))
+        rows += n
+        names.clear()
+        ks.clear()
+        all_ctxs.clear()
+
+    def sample_line(parts: List[bytes], ordinal: int) -> List[bytes]:
+        """Reference two-tier sampling for one over-budget method
+        (preprocess.py:41-56): keep fully-in-vocab contexts first, then
+        partially-in-vocab, sampling at random within the tier that
+        crosses the budget."""
+        in_vocab: List[bytes] = []
+        mixed: List[bytes] = []
+        for c in parts[1:]:
+            entry = memo.get(c)
+            if entry is None:
+                entry = lookup(c)
+            if entry[3] == 2:
+                in_vocab.append(c)
+            elif entry[3] == 1:
+                mixed.append(c)
+        if len(in_vocab) > m:
+            return _method_rng(seed, ordinal).sample(in_vocab, m)
+        if len(in_vocab) + len(mixed) > m:
+            return in_vocab + _method_rng(seed, ordinal).sample(
+                mixed, m - len(in_vocab))
+        return in_vocab + mixed
+
+    def run_native_lines() -> None:
+        """Hot loop when the native core is built and no `.c2v` text is
+        being emitted: under-budget lines go to the GIL-releasing C
+        parser UNSPLIT (one `count` + one `find` of Python work per
+        line); only the rare over-budget methods pay a Python split for
+        the sampling tiers."""
+        nonlocal rows, contexts_seen, contexts_kept, widest, skipped, ordinal
+        pend_lines: List[bytes] = []
+
+        def flush_lines() -> None:
+            nonlocal rows
+            n = len(pend_lines)
+            if not n:
+                return
+            blob = b"\n".join(pend_lines) + b"\n"
+            if native_rows:
+                rec = tables.parse_rows_blob(blob, n, m)
+            else:
+                src, pth, tgt, label, _mask = tables.parse_blob(blob, n, m)
+                rec = np.empty((n, 1 + 3 * m), dtype=np.int32)
+                rec[:, 0] = label
+                rec[:, 1:1 + m] = src
+                rec[:, 1 + m:1 + 2 * m] = pth
+                rec[:, 1 + 2 * m:] = tgt
+            seg.write(rec)
+            if tgt_seg is not None:
+                tgt_seg.write(b"\n".join(names) + b"\n")
+                names.clear()
+            rows += n
+            pend_lines.clear()
+
+        for lines in preprocess_mod.iter_range_line_chunks(
+                ctx["raw_path"], start, end):
+            for line in lines:
+                k = line.count(b" ")
+                contexts_seen += k
+                if k > widest:
+                    widest = k
+                if sampling:
+                    if k > m:
+                        parts = line.split(b" ")
+                        contexts = sample_line(parts, ordinal)
+                        k = len(contexts)
+                        if not contexts:
+                            skipped += 1
+                            ordinal += 1
+                            continue
+                        line = b" ".join([parts[0]] + contexts)
+                    elif k == 0:
+                        skipped += 1
+                        ordinal += 1
+                        continue
+                contexts_kept += k if k < m else m
+                if tgt_seg is not None:
+                    sp = line.find(b" ")
+                    names.append(line if sp < 0 else line[:sp])
+                pend_lines.append(line)
+                ordinal += 1
+                if len(pend_lines) >= flush_rows:
+                    flush_lines()
+        flush_lines()
+
+    def run_general_lines() -> None:
+        nonlocal all_ctxs, contexts_seen, contexts_kept, widest, skipped, \
+            ordinal
+        for lines in preprocess_mod.iter_range_line_chunks(
+                ctx["raw_path"], start, end):
+            for line in lines:
+                parts = line.split(b" ")
+                name, contexts = parts[0], parts[1:]
+                k = len(contexts)
+                contexts_seen += k
+                if k > widest:
+                    widest = k
+                if sampling:
+                    if k > m:
+                        contexts = sample_line(parts, ordinal)
+                        k = len(contexts)
+                    if not contexts:
+                        skipped += 1
+                        ordinal += 1
+                        continue
+                elif k > m:
+                    contexts = contexts[:m]
+                    k = m
+                contexts_kept += k
+                names.append(name)
+                ks.append(k)
+                all_ctxs += contexts
+                ordinal += 1
+                if len(names) >= flush_rows:
+                    flush()
+        flush()
+
+    try:
+        if tables is not None and c2v_seg is None:
+            run_native_lines()
+        else:
+            run_general_lines()
+    finally:
+        seg.close()
+        if tgt_seg is not None:
+            tgt_seg.close()
+        if c2v_seg is not None:
+            c2v_seg.close()
+    return {"shard": shard_idx, "rows": rows, "skipped": skipped,
+            "contexts_seen": contexts_seen, "contexts_kept": contexts_kept,
+            "widest": widest}
+
+
+def _encode_keys(d) -> Dict[bytes, int]:
+    return {w.encode("utf-8", "surrogateescape"): i for w, i in d.items()}
+
+
+def _encoded_tables(vocabs: Code2VecVocabs) -> Dict[str, Dict[bytes, int]]:
+    """bytes->id worker tables for `vocabs`, cached on the instance:
+    compile_corpus packs three splits with the same vocabs, and
+    re-encoding the 2.2M java14m words per split costs seconds."""
+    cache = getattr(vocabs, "_b2i_cache", None)
+    if cache is None:
+        cache = {
+            "token": _encode_keys(vocabs.token_vocab.word_to_index),
+            "path": _encode_keys(vocabs.path_vocab.word_to_index),
+            "target": _encode_keys(vocabs.target_vocab.word_to_index),
+        }
+        vocabs._b2i_cache = cache
+    return cache
+
+
+def _append_file(dst, src_path: str) -> None:
+    """Append `src_path` to the open binary file `dst` (kernel-side
+    `sendfile` when available), then delete it to free disk."""
+    dst.flush()
+    with open(src_path, "rb") as src:
+        size = os.fstat(src.fileno()).st_size
+        offset = 0
+        try:
+            while offset < size:
+                sent = os.sendfile(dst.fileno(), src.fileno(), offset,
+                                   size - offset)
+                if sent == 0:
+                    break
+                offset += sent
+        except (AttributeError, OSError):
+            src.seek(offset)
+            shutil.copyfileobj(src, dst, 16 * 1024 * 1024)
+    os.unlink(src_path)
+
+
+def pack_raw(raw_path: str, out_path: str, vocabs: Code2VecVocabs,
+             word_to_count: Optional[Dict[str, int]],
+             path_to_count: Optional[Dict[str, int]], max_contexts: int,
+             seed: int = 0, num_workers: int = 1,
+             c2v_out: Optional[str] = None,
+             write_targets_sidecar: bool = True, log=None) -> int:
+    """Fused compile of RAW extractor output straight to `.c2vb` (+
+    `.targets` sidecar, + optional compat `.c2v` text at `c2v_out`),
+    applying the reference's in-vocab sampling when `word_to_count`/
+    `path_to_count` are given (`None` disables sampling: contexts
+    truncate at `max_contexts` and every line yields a row — the
+    `.c2v`-repack compat mode). Returns the row count.
+
+    Workers process line-aligned byte ranges into per-shard segment
+    files; the parent stitches them in order, so the output is
+    byte-identical at any `num_workers` (the per-method RNG makes the
+    sampling itself worker-layout-invariant)."""
+    workers = max(1, num_workers)
+    sampling = word_to_count is not None
+    ranges = preprocess_mod.line_aligned_ranges(raw_path, workers)
+    out_dir = os.path.dirname(os.path.abspath(out_path)) or "."
+    seg_dir = tempfile.mkdtemp(prefix="c2v_pack_", dir=out_dir)
+    ctx = {
+        "raw_path": raw_path,
+        "seg_dir": seg_dir,
+        "max_contexts": max_contexts,
+        "seed": seed,
+        "token_b2i": _encoded_tables(vocabs)["token"],
+        "path_b2i": _encoded_tables(vocabs)["path"],
+        "target_b2i": _encoded_tables(vocabs)["target"],
+        "token_pad": vocabs.token_vocab.pad_index,
+        "token_oov": vocabs.token_vocab.oov_index,
+        "path_pad": vocabs.path_vocab.pad_index,
+        "path_oov": vocabs.path_vocab.oov_index,
+        "target_oov": vocabs.target_vocab.oov_index,
+        "word_ok": (frozenset(_encode_keys(word_to_count)) if sampling
+                    else None),
+        "path_ok": (frozenset(_encode_keys(path_to_count)) if sampling
+                    else None),
+        "emit_c2v": c2v_out is not None,
+        "write_targets": write_targets_sidecar,
+    }
+    # The final files are stitched INCREMENTALLY, in shard order, as
+    # workers finish (imap preserves task order): most of the
+    # concatenation I/O overlaps the remaining shards' compute instead
+    # of serializing after the pool drains. Row count is patched into
+    # the header at the end (it is unknown up front in sampling mode).
+    seg = lambda i, suffix: os.path.join(seg_dir, f"seg{i:05d}{suffix}")  # noqa: E731
+    outs = [(out_path, ".bin")]
+    if write_targets_sidecar:
+        outs.append((out_path + ".targets", ".targets"))
+    if c2v_out is not None:
+        outs.append((c2v_out, ".c2v"))
+    handles = {}
+    results = []
+
+    def consume(result: dict) -> None:
+        results.append(result)
+        for final, suffix in outs:
+            _append_file(handles[suffix], seg(result["shard"], suffix))
+
+    global _PACK_CTX, _PACK_NATIVE
+    try:
+        for final, suffix in outs:
+            handles[suffix] = open(final + ".tmp", "wb")
+        handles[".bin"].write(_HEADER.pack(_MAGIC, _VERSION, 0, max_contexts))
+        if len(ranges) == 1:
+            _init_pack_worker(ctx)
+            consume(_pack_shard((0, ranges[0][0], ranges[0][1], 0)))
+        else:
+            with preprocess_mod._worker_pool(
+                    len(ranges), initializer=_init_pack_worker,
+                    initargs=(ctx,)) as pool:
+                ordinals = preprocess_mod.range_start_ordinals(
+                    raw_path, ranges, pool=pool)
+                tasks = [(i, s, e, o) for i, ((s, e), o)
+                         in enumerate(zip(ranges, ordinals))]
+                for result in pool.imap(_pack_shard, tasks):
+                    consume(result)
+        n_rows = sum(r["rows"] for r in results)
+        handles[".bin"].seek(0)
+        handles[".bin"].write(_HEADER.pack(_MAGIC, _VERSION, n_rows,
+                                           max_contexts))
+        for handle in handles.values():
+            handle.close()
+        for final, suffix in outs:
+            os.replace(final + ".tmp", final)
+    finally:
+        _PACK_CTX, _PACK_NATIVE = None, "unset"
+        for handle in handles.values():
+            if not handle.closed:
+                handle.close()
+        for final, suffix in outs:
+            if os.path.exists(final + ".tmp"):
+                os.unlink(final + ".tmp")
+        shutil.rmtree(seg_dir, ignore_errors=True)
+
+    _write_pack_meta(out_path, raw_path, n_rows, max_contexts, vocabs)
+    if log is not None and sampling:
+        skipped = sum(r["skipped"] for r in results)
+        seen = sum(r["contexts_seen"] for r in results)
+        kept = sum(r["contexts_kept"] for r in results)
+        widest = max(r["widest"] for r in results)
+        denom = max(n_rows, 1)
+        log(f"{out_path}: {n_rows} examples written, {skipped} skipped "
+            f"(no contexts)")
+        log(f"  contexts/method: {seen / denom:.1f} raw -> "
+            f"{kept / denom:.1f} after sampling (widest method: {widest})")
+    return n_rows
+
+
 class PackedDataset:
     """Zero-copy view over a `.c2vb` file with batched iteration.
 
@@ -124,6 +652,17 @@ class PackedDataset:
     better shuffling than the reference's 10K-element buffer,
     path_context_reader.py:139) and yields fixed-size batches.
     """
+
+    @staticmethod
+    def read_header(path: str):
+        """(rows, max_contexts) from a `.c2vb` header without opening
+        the memmap — lets the facade size a fused-compiled dataset that
+        has no `.c2v` text to count lines in."""
+        with open(path, "rb") as f:
+            magic, _version, n, m = _HEADER.unpack(f.read(_HEADER.size))
+        if magic != _MAGIC:
+            raise ValueError(f"{path} is not a .c2vb file")
+        return n, m
 
     def __init__(self, path: str, vocabs: Code2VecVocabs,
                  shard_index: int = 0, num_shards: int = 1):

@@ -10,6 +10,7 @@ one Python module. Run-once and I/O-bound, so Python is the right tool
 
 from __future__ import annotations
 
+import heapq
 import math
 import os
 import pickle
@@ -18,20 +19,280 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import time
 from collections import Counter
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu import obs
+
+# ------------------------------------------------------------ parallelism
+#
+# The offline pipeline is a one-shot compile over a multi-GB corpus
+# (java14m: 32 GB raw, reference README:69-75), so it map-reduces over
+# host cores: the raw file is split into byte ranges aligned to line
+# boundaries and each range is processed by a `multiprocessing` worker.
+# Workers are pure host-side code (numpy + dicts, no jax), so `fork` is
+# the zero-copy fast path; once the XLA backend (or any other thread) is
+# live in this process (tests, a trainer that packs on demand), forking
+# is unsafe and `spawn` is used instead — worker modules import cleanly
+# under both, and spawn workers skip the package's jax import entirely
+# (the C2V_HOST_WORKER gate in code2vec_tpu/__init__.py).
 
 
-def build_histograms(raw_path: str) -> Tuple[Counter, Counter, Counter]:
+def _jax_backend_live() -> bool:
+    # `import jax` alone starts no runtime threads; an initialized XLA
+    # backend does. The package __init__ always imports jax, so mere
+    # presence in sys.modules would force spawn everywhere.
+    xb = sys.modules.get("jax._src.xla_bridge")
+    return bool(getattr(xb, "_backends", None))
+
+
+def _mp_context():
+    import multiprocessing as mp
+    import threading
+    if ("fork" in mp.get_all_start_methods()
+            and threading.active_count() == 1 and not _jax_backend_live()):
+        return mp.get_context("fork")
+    return mp.get_context("spawn")
+
+
+class _worker_pool:
+    """`Pool` wrapper: picks fork/spawn per `_mp_context`, and marks the
+    children as host-side data workers (C2V_HOST_WORKER) so spawned ones
+    skip the package's jax import."""
+
+    def __init__(self, num_workers: int, initializer=None, initargs=()):
+        ctx = _mp_context()
+        prev = os.environ.get("C2V_HOST_WORKER")
+        os.environ["C2V_HOST_WORKER"] = "1"
+        try:
+            self._pool = ctx.Pool(num_workers, initializer=initializer,
+                                  initargs=initargs)
+        finally:
+            if prev is None:
+                os.environ.pop("C2V_HOST_WORKER", None)
+            else:
+                os.environ["C2V_HOST_WORKER"] = prev
+
+    def __enter__(self):
+        return self._pool.__enter__()
+
+    def __exit__(self, *exc):
+        return self._pool.__exit__(*exc)
+
+
+def line_aligned_ranges(path: str, n_shards: int) -> List[Tuple[int, int]]:
+    """Split `[0, filesize)` into up to `n_shards` contiguous byte ranges
+    whose boundaries fall on line starts, so every worker sees whole
+    lines and the concatenation of ranges is exactly the file."""
+    size = os.path.getsize(path)
+    if size == 0 or n_shards <= 1:
+        return [(0, size)]
+    bounds = [0]
+    with open(path, "rb") as f:
+        for i in range(1, n_shards):
+            target = size * i // n_shards
+            if target <= bounds[-1]:
+                continue
+            f.seek(target)
+            f.readline()  # finish the line straddling the cut
+            pos = f.tell()
+            if bounds[-1] < pos < size:
+                bounds.append(pos)
+    bounds.append(size)
+    return list(zip(bounds[:-1], bounds[1:]))
+
+
+def iter_range_line_chunks(path: str, start: int, end: int,
+                           chunk_bytes: int = 32 * 1024 * 1024):
+    """Yield lists of newline-stripped bytes lines covering `[start, end)`
+    of `path`. `start`/`end` must fall on line boundaries
+    (`line_aligned_ranges` guarantees it). Chunked binary reads + one
+    C-level split keep the per-line Python overhead near zero."""
+    with open(path, "rb") as f:
+        f.seek(start)
+        remaining = end - start
+        carry = b""
+        while remaining > 0:
+            blob = f.read(min(chunk_bytes, remaining))
+            if not blob:
+                break
+            remaining -= len(blob)
+            lines = (carry + blob).split(b"\n")
+            carry = lines.pop()
+            if lines:
+                yield lines
+        if carry:
+            yield [carry]  # unterminated final line
+
+
+def _count_range_newlines(args) -> int:
+    path, start, end = args
+    count = 0
+    with open(path, "rb") as f:
+        f.seek(start)
+        remaining = end - start
+        while remaining > 0:
+            blob = f.read(min(32 * 1024 * 1024, remaining))
+            if not blob:
+                break
+            remaining -= len(blob)
+            count += blob.count(b"\n")
+    return count
+
+
+def range_start_ordinals(path: str, ranges: List[Tuple[int, int]],
+                         pool=None) -> List[int]:
+    """Line ordinal of the first line of each range (ranges start at line
+    boundaries, so lines-before == newlines-before). One cheap parallel
+    byte-counting pass; this is what lets every worker seed each method's
+    sampling RNG from its GLOBAL line ordinal, making the output
+    independent of the worker count."""
+    if len(ranges) == 1:
+        return [0]
+    tasks = [(path, s, e) for s, e in ranges[:-1]]  # last range not needed
+    counts = (pool.map(_count_range_newlines, tasks) if pool is not None
+              else [_count_range_newlines(t) for t in tasks])
+    ordinals = [0]
+    for c in counts:
+        ordinals.append(ordinals[-1] + c)
+    return ordinals
+
+
+# Bound on the per-worker distinct-string memo Counters/caches: real
+# corpora repeat contexts heavily, so memoizing per distinct context
+# collapses most per-occurrence Python work to one C-level dict hit —
+# but an adversarial corpus of all-distinct contexts must not grow RSS
+# without bound, so memos are drained/cleared past this many entries.
+_MEMO_CAP = 2_000_000
+
+
+def _drain_ctx_counts(ctx_counts: Counter, tokens: Counter,
+                      paths: Counter) -> None:
+    """Fold per-distinct-context occurrence counts into the token/path
+    histograms: each context splits ONCE however many times it occurred."""
+    for ctx, count in ctx_counts.items():
+        pieces = ctx.split(b",")
+        if len(pieces) != 3:
+            continue
+        tokens[pieces[0]] += count
+        paths[pieces[1]] += count
+        tokens[pieces[2]] += count
+    ctx_counts.clear()
+
+
+def _read_count_dump(path: str) -> Counter:
+    """Parse a native "count word" histogram dump (bytes keys)."""
+    out: Counter = Counter()
+    with open(path, "rb", buffering=8 * 1024 * 1024) as f:
+        for line in f:
+            count, word = line.rstrip(b"\n").split(b" ", 1)
+            out[word] = int(count)
+    return out
+
+
+def _histogram_shard(args) -> Tuple[Counter, Counter, Counter]:
+    """Map step: histograms over one byte range of the raw file.
+
+    Uses the native GIL-releasing split core (`c2v_histogram_range`)
+    when libc2vdata.so is built: C++ does the per-occurrence counting
+    and Python only reads back one "count word" line per DISTINCT word.
+
+    The pure-Python fallback counts whole context strings first (a
+    C-speed `Counter.update`) and splits only the distinct ones —
+    corpora repeat contexts heavily, so this collapses most
+    per-occurrence Python work; the distinct-context Counter is drained
+    past `_MEMO_CAP` so worker RSS stays bounded on any corpus. Keys
+    are bytes either way; the reduce step decodes once."""
+    path, start, end = args
+    from code2vec_tpu.data import native
+    if native.has_histogram_range():
+        dump_dir = tempfile.mkdtemp(prefix="c2v_hist_",
+                                    dir=os.path.dirname(path) or ".")
+        try:
+            outs = [os.path.join(dump_dir, name)
+                    for name in ("tokens", "paths", "targets")]
+            native.histogram_range(path, start, end, *outs)
+            return tuple(_read_count_dump(p) for p in outs)
+        finally:
+            shutil.rmtree(dump_dir, ignore_errors=True)
+    tokens: Counter = Counter()
+    paths: Counter = Counter()
+    targets: Counter = Counter()
+    ctx_counts: Counter = Counter()
+    for lines in iter_range_line_chunks(path, start, end):
+        names: List[bytes] = []
+        ctxs: List[bytes] = []
+        for line in lines:
+            parts = line.split(b" ")
+            if not parts[0]:
+                continue
+            names.append(parts[0])
+            ctxs += parts[1:]
+        targets.update(names)
+        ctx_counts.update(ctxs)
+        # empty fields (double spaces) split to one piece and are
+        # skipped by the drain, like the serial loop's `if not ctx`
+        if len(ctx_counts) > _MEMO_CAP:
+            _drain_ctx_counts(ctx_counts, tokens, paths)
+    _drain_ctx_counts(ctx_counts, tokens, paths)
+    return tokens, paths, targets
+
+
+def _decode_counter(counter: Counter) -> Counter:
+    return Counter({k.decode("utf-8", "surrogateescape"): v
+                    for k, v in counter.items()})
+
+
+def build_histograms(raw_path: str,
+                     num_workers: int = 0) -> Tuple[Counter, Counter, Counter]:
     """Frequency histograms over a raw extractor-output file.
 
     Equivalent of the reference's three awk passes (preprocess.sh:56-58):
     every occurrence counts, including duplicates within a line.
+
+    `num_workers == 0` runs the original in-process serial loop;
+    `num_workers >= 1` map-reduces over line-aligned byte ranges in that
+    many `multiprocessing` workers (1 runs the sharded algorithm
+    in-process — the fused pipeline's serial reference point). The merged
+    result equals the serial loop's for any worker count
+    (tests/test_preprocess_pipeline.py pins it).
     """
-    targets: Counter = Counter()
-    tokens: Counter = Counter()
-    paths: Counter = Counter()
-    with open(raw_path, "r", buffering=16 * 1024 * 1024) as f:
+    if num_workers >= 1:
+        t0 = time.perf_counter()
+        ranges = line_aligned_ranges(raw_path, num_workers)
+        tasks = [(raw_path, s, e) for s, e in ranges]
+        if len(tasks) == 1:
+            shards = [_histogram_shard(tasks[0])]
+        else:
+            with _worker_pool(len(tasks)) as pool:
+                shards = pool.map(_histogram_shard, tasks)
+        tokens: Counter = Counter()
+        paths: Counter = Counter()
+        targets: Counter = Counter()
+        for tok, pth, tgt in shards:
+            tokens.update(tok)
+            paths.update(pth)
+            targets.update(tgt)
+        dur = time.perf_counter() - t0
+        obs.histogram("preprocess_phase_seconds",
+                      "wall time of one offline-pipeline phase",
+                      phase="histograms").observe(dur)
+        n_lines = sum(targets.values())
+        obs.counter("preprocess_rows_total", "raw lines consumed per phase",
+                    phase="histograms").inc(n_lines)
+        obs.gauge("preprocess_rows_per_sec", "phase throughput",
+                  phase="histograms").set(n_lines / max(dur, 1e-9))
+        return (_decode_counter(tokens), _decode_counter(paths),
+                _decode_counter(targets))
+
+    targets = Counter()
+    tokens = Counter()
+    paths = Counter()
+    # utf-8/surrogateescape pinned (not the locale default) so the serial
+    # and sharded paths tokenize identical bytes identically.
+    with open(raw_path, "r", buffering=16 * 1024 * 1024,
+              encoding="utf-8", errors="surrogateescape") as f:
         for line in f:
             parts = line.rstrip("\n").split(" ")
             if not parts or not parts[0]:
@@ -56,20 +317,37 @@ def truncate_histogram(histogram: Dict[str, int], max_size: Optional[int]) -> Di
     """
     if max_size is None or len(histogram) <= max_size:
         return dict(histogram)
-    min_count = sorted(histogram.values(), reverse=True)[max_size] + 1
+    # The (max_size+1)'th largest count via a bounded heap: O(V log K)
+    # and O(K) extra memory instead of sorting all V values (V is 1.3M
+    # for the java14m token histogram).
+    min_count = heapq.nlargest(max_size + 1, histogram.values())[-1] + 1
     return {w: c for w, c in histogram.items() if c >= min_count}
 
 
+def canonical_freq_dict(histogram: Dict[str, int]) -> Dict[str, int]:
+    """Re-key a frequency dict in (count desc, word asc) order.
+
+    Dict iteration order is what breaks count ties downstream
+    (`Vocab.create_from_freq_dict`'s stable sort), and a merged
+    map-reduce histogram's insertion order depends on the worker count —
+    canonicalizing here is part of what makes the fused pipeline's
+    output byte-identical at any worker count."""
+    return dict(sorted(histogram.items(), key=lambda kv: (-kv[1], kv[0])))
+
+
 def _context_full_found(parts, word_to_count, path_to_count) -> bool:
-    # reference: preprocess.py:77-79
-    return (parts[0] in word_to_count and parts[1] in path_to_count
-            and parts[2] in word_to_count)
+    # reference: preprocess.py:77-79; missing pieces (malformed/empty
+    # context fields) count as not-found instead of crashing the
+    # sampling tiers (the reference would IndexError on such input)
+    return (len(parts) > 2 and parts[0] in word_to_count
+            and parts[1] in path_to_count and parts[2] in word_to_count)
 
 
 def _context_partial_found(parts, word_to_count, path_to_count) -> bool:
     # reference: preprocess.py:82-84
-    return (parts[0] in word_to_count or parts[1] in path_to_count
-            or parts[2] in word_to_count)
+    return (parts[0] in word_to_count
+            or (len(parts) > 1 and parts[1] in path_to_count)
+            or (len(parts) > 2 and parts[2] in word_to_count))
 
 
 def process_file(file_path: str, data_file_role: str, dataset_name: str,
@@ -173,6 +451,94 @@ def preprocess(train_raw: str, val_raw: str, test_raw: str, output_name: str,
             num_training_examples = n
     save_dictionaries(output_name, word_to_count, path_to_count,
                       target_to_count, num_training_examples, log=log)
+    return output_name
+
+
+def compile_corpus(train_raw: str, val_raw: str, test_raw: str,
+                   output_name: str, max_contexts: int = 200,
+                   word_vocab_size: int = 1301136,
+                   path_vocab_size: int = 911417,
+                   target_vocab_size: int = 261245, seed: int = 0,
+                   num_workers: int = 1, emit_c2v: bool = False,
+                   stats_out: Optional[dict] = None, log=print) -> str:
+    """Fused multiprocess offline compile: raw extractor output ->
+    `.c2vb` memmaps (+`.targets` sidecars) + `.dict.c2v`, with no padded
+    `.c2v` text intermediate (that text is LARGER than the raw input and
+    the old pack stage re-parsed every byte of it).
+
+    Map-reduce histograms over the train split, vocab truncation, then a
+    fused sample+lookup+pack pass per split (`data/packed.py pack_raw`)
+    that applies the reference's two-tier in-vocab sampling contract
+    (reference: preprocess.py:41-56) and writes int32 rows directly.
+
+    Output is byte-identical at ANY worker count: each method's sampling
+    RNG is seeded from (global seed, method ordinal), histograms are
+    canonicalized before tie-breaking, and per-shard segments are
+    stitched in file order. `emit_c2v` additionally writes the padded
+    `.c2v` text files (compat path for reference tooling; same format
+    and sampling contract, per-method RNG instead of one serial stream).
+
+    `stats_out`, when given, is filled with per-phase wall times and row
+    counts (the preprocessing bench reads it).
+    """
+    from code2vec_tpu.data import packed
+
+    stats = stats_out if stats_out is not None else {}
+    t0 = time.perf_counter()
+    workers = max(1, num_workers)
+    tokens, paths, targets = build_histograms(train_raw, num_workers=workers)
+    stats["histograms_s"] = round(time.perf_counter() - t0, 2)
+    log(f"histograms: {len(tokens)} tokens, {len(paths)} paths, "
+        f"{len(targets)} targets ({stats['histograms_s']}s, "
+        f"{workers} workers)")
+
+    t1 = time.perf_counter()
+    word_to_count = canonical_freq_dict(
+        truncate_histogram(tokens, word_vocab_size))
+    path_to_count = canonical_freq_dict(
+        truncate_histogram(paths, path_vocab_size))
+    target_to_count = canonical_freq_dict(
+        truncate_histogram(targets, target_vocab_size))
+    del tokens, paths, targets
+
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+    vocabs = Code2VecVocabs.create_from_freq_dicts(
+        WordFreqDicts(word_to_count, path_to_count, target_to_count, 0),
+        max_token_vocab_size=word_vocab_size,
+        max_path_vocab_size=path_vocab_size,
+        max_target_vocab_size=target_vocab_size)
+    stats["vocab_s"] = round(time.perf_counter() - t1, 2)
+
+    t2 = time.perf_counter()
+    num_training_examples = 0
+    total_rows = 0
+    for file_path, role in zip([test_raw, val_raw, train_raw],
+                               ["test", "val", "train"]):
+        out_path = f"{output_name}.{role}.c2vb"
+        c2v_out = f"{output_name}.{role}.c2v" if emit_c2v else None
+        rows = packed.pack_raw(
+            file_path, out_path, vocabs, word_to_count, path_to_count,
+            max_contexts, seed=seed, num_workers=workers, c2v_out=c2v_out,
+            log=log)
+        obs.counter("preprocess_rows_total", "raw lines consumed per phase",
+                    phase=f"pack_{role}").inc(rows)
+        total_rows += rows
+        if role == "train":
+            num_training_examples = rows
+    dur = time.perf_counter() - t2
+    stats["pack_s"] = round(dur, 2)
+    stats["rows"] = total_rows
+    obs.histogram("preprocess_phase_seconds",
+                  "wall time of one offline-pipeline phase",
+                  phase="fused_pack").observe(dur)
+    obs.gauge("preprocess_rows_per_sec", "phase throughput",
+              phase="fused_pack").set(total_rows / max(dur, 1e-9))
+
+    save_dictionaries(output_name, word_to_count, path_to_count,
+                      target_to_count, num_training_examples, log=log)
+    stats["wall_s"] = round(time.perf_counter() - t0, 2)
+    log(f"fused compile: {total_rows} rows packed in {stats['pack_s']}s "
+        f"({workers} workers); end-to-end {stats['wall_s']}s")
     return output_name
 
 
@@ -504,6 +870,17 @@ def main(argv=None) -> None:
     parser.add_argument("--extract_timeout", type=float, default=600.0,
                         help="seconds before a hung extraction is killed "
                              "and retried per subdirectory/file")
+    parser.add_argument("--preprocess_workers", type=int, default=0,
+                        help="host worker processes for the fused "
+                             "histogram+sample+pack compile that emits "
+                             ".c2vb memmaps directly (output is "
+                             "byte-identical at any worker count); 0 "
+                             "runs the original serial .c2v text "
+                             "pipeline")
+    parser.add_argument("--emit_c2v", action="store_true",
+                        help="with --preprocess_workers >= 1, also write "
+                             "the padded .c2v text files (compat path "
+                             "for reference tooling)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -537,11 +914,28 @@ def main(argv=None) -> None:
         raws = {"train": args.train_raw, "val": args.val_raw,
                 "test": args.test_raw}
 
-    preprocess(raws["train"], raws["val"], raws["test"], args.output_name,
-               max_contexts=args.max_contexts,
-               word_vocab_size=args.word_vocab_size,
-               path_vocab_size=args.path_vocab_size,
-               target_vocab_size=args.target_vocab_size, seed=args.seed)
+    if args.preprocess_workers >= 1:
+        compile_corpus(raws["train"], raws["val"], raws["test"],
+                       args.output_name, max_contexts=args.max_contexts,
+                       word_vocab_size=args.word_vocab_size,
+                       path_vocab_size=args.path_vocab_size,
+                       target_vocab_size=args.target_vocab_size,
+                       seed=args.seed, num_workers=args.preprocess_workers,
+                       emit_c2v=args.emit_c2v)
+    else:
+        preprocess(raws["train"], raws["val"], raws["test"],
+                   args.output_name, max_contexts=args.max_contexts,
+                   word_vocab_size=args.word_vocab_size,
+                   path_vocab_size=args.path_vocab_size,
+                   target_vocab_size=args.target_vocab_size, seed=args.seed)
+
+    # Same side-channel contract as bench.py: a CI runner pointing
+    # C2V_METRICS_FILE at a node-exporter textfile dir gets the phase
+    # timings/throughput Prometheus-side.
+    metrics_file = os.environ.get("C2V_METRICS_FILE")
+    if metrics_file:
+        from code2vec_tpu.obs import exporters
+        exporters.write_prometheus(metrics_file)
 
 
 if __name__ == "__main__":

@@ -129,6 +129,13 @@ class Code2VecModel:
         if os.path.isfile(sidecar):
             with open(sidecar) as f:
                 return int(f.readline())
+        if not os.path.exists(dataset_path):
+            # Fused-compiled datasets (data/preprocess.py compile_corpus)
+            # carry no `.c2v` text at all — the row count lives in the
+            # packed header.
+            packed_path = dataset_path + "b"
+            if os.path.exists(packed_path):
+                return PackedDataset.read_header(packed_path)[0]
         n = count_lines_in_file(dataset_path)
         try:
             with open(sidecar, "w") as f:
@@ -149,7 +156,8 @@ class Code2VecModel:
         if not os.path.exists(packed_path):
             self.log(f"Packing {c2v_path} -> {packed_path} (one-time)")
             pack_c2v(c2v_path, self.vocabs, self.config.max_contexts,
-                     out_path=packed_path)
+                     out_path=packed_path,
+                     num_workers=self.config.preprocess_workers)
         shard_index, num_shards = distributed.host_shard()
         ds = PackedDataset(packed_path, self.vocabs,
                            shard_index=shard_index, num_shards=num_shards)

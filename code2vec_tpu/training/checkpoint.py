@@ -61,10 +61,13 @@ RELEASED_SUFFIX = ".release"
 STAGING_INFIX = ".tmp-"
 BACKUP_INFIX = ".old-"
 
-# Small files worth a full content hash in the manifest. The Orbax state
-# files are covered by existence+size only — hashing multi-GB shards on
-# every save/probe would dominate checkpoint time, and Orbax already
-# checksums its own payloads internally.
+# Small files worth a full content hash in the manifest at save time.
+# The Orbax state files are covered by existence+size in the commit-path
+# manifest — hashing multi-GB shards before the commit would dominate
+# checkpoint time, and Orbax already checksums its own payloads
+# internally. Opt-in `config.checkpoint_hash_content` adds full-content
+# hashes for everything AFTER the commit (`hash_artifact_content`),
+# verified on resume.
 _HASHED_FILES = ("dictionaries.bin", _META_NAME)
 
 
@@ -138,6 +141,42 @@ def _sha256_file(path: str) -> str:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+def hash_artifact_content(base: str, max_threads: int = 4) -> dict:
+    """Record a full-content sha256 for EVERY manifest-listed file —
+    including the multi-GB Orbax shards the manifest otherwise only
+    size-checks — and rewrite the manifest atomically (tmp + rename).
+
+    Meant to run AFTER the atomic commit (`config.checkpoint_hash_content`
+    in save_model), so the hashing of large shards never extends the
+    window in which a kill loses the save: a crash mid-hash just leaves a
+    valid artifact without content hashes. Incremental 1 MB chunks on a
+    thread pool (hashlib releases the GIL, so hashing overlaps I/O and
+    scales past one core). Returns the updated manifest."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with obs.span("checkpoint_content_hash",
+                  hist=obs.histogram(
+                      "checkpoint_content_hash_seconds",
+                      "post-commit full-content sha256 of one artifact")):
+        manifest_path = os.path.join(base, MANIFEST_NAME)
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        rels = sorted(manifest["files"])
+        with ThreadPoolExecutor(max_workers=max_threads) as pool:
+            digests = pool.map(
+                lambda rel: _sha256_file(os.path.join(base, rel)), rels)
+        for rel, digest in zip(rels, digests):
+            manifest["files"][rel]["content_sha256"] = digest
+        manifest["content_hashed"] = True
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, manifest_path)
+        return manifest
 
 
 def _fsync_dir(path: str) -> None:
@@ -239,12 +278,17 @@ def reclaim_orphan(path: str,
     return "removed"
 
 
-def verify_checkpoint(model_path: str) -> dict:
+def verify_checkpoint(model_path: str, check_content: bool = False) -> dict:
     """Probe an artifact against its manifest; returns the parsed meta on
     success, raises CheckpointIntegrityError naming the first offending
     file otherwise. Cheap by design (stat per file, hash only the small
     sidecars), so resume can probe a fallback chain and rotation can
     re-check candidates without meaningful cost.
+
+    `check_content=True` additionally re-hashes every file carrying a
+    post-commit `content_sha256` (written when the save ran with
+    `checkpoint_hash_content`) — the resume path's deep probe; the
+    rotation/fallback walks keep the cheap default.
 
     Pre-manifest (legacy) artifacts get a structural probe instead:
     required files present, meta parseable, Orbax state dir non-empty —
@@ -254,7 +298,7 @@ def verify_checkpoint(model_path: str) -> dict:
                   hist=obs.histogram("checkpoint_verify_seconds",
                                      "manifest probe of one artifact")):
         try:
-            return _verify_checkpoint_inner(model_path)
+            return _verify_checkpoint_inner(model_path, check_content)
         except CheckpointIntegrityError:
             obs.counter("checkpoint_verify_failures_total",
                         "artifacts that failed their integrity check "
@@ -262,7 +306,8 @@ def verify_checkpoint(model_path: str) -> dict:
             raise
 
 
-def _verify_checkpoint_inner(model_path: str) -> dict:
+def _verify_checkpoint_inner(model_path: str,
+                             check_content: bool = False) -> dict:
     base = _abs(model_path)
     if not os.path.isdir(base):
         raise CheckpointIntegrityError(f"{base}: not a directory")
@@ -287,15 +332,37 @@ def _verify_checkpoint_inner(model_path: str) -> dict:
         p = os.path.join(base, rel)
         if not os.path.isfile(p):
             raise CheckpointIntegrityError(f"{p}: listed in manifest but missing")
-        size = os.path.getsize(p)
-        if size != entry.get("size"):
+        try:
+            size = os.path.getsize(p)
+            if size != entry.get("size"):
+                raise CheckpointIntegrityError(
+                    f"{p}: size {size} != manifest size {entry.get('size')} "
+                    f"(truncated or partially written)")
+            want_hash = entry.get("sha256")
+            content_hash = (entry.get("content_sha256") if check_content
+                            else None)
+            if want_hash or content_hash:
+                digest = _sha256_file(p)  # one pass serves both checks
+                if want_hash and digest != want_hash:
+                    raise CheckpointIntegrityError(
+                        f"{p}: sha256 mismatch against manifest (corrupt)")
+                if content_hash and digest != content_hash:
+                    raise CheckpointIntegrityError(
+                        f"{p}: content sha256 mismatch against manifest "
+                        f"(bit-rot or size-preserving corruption)")
+        except OSError as e:
+            # A file that vanishes BETWEEN the isfile() probe and the
+            # stat/hash is an artifact being swapped underneath us — on a
+            # multi-host pod every host runs the same commit (staging
+            # rename + backup swap) on the same final path, so a peer's
+            # commit window can briefly empty the directory a rotation
+            # probe is walking (the cross-host save barrier is a known
+            # ROADMAP item). Degrade to the integrity error the callers
+            # are built to tolerate (fallback walks skip the candidate;
+            # resume retries older) instead of crashing the trainer.
             raise CheckpointIntegrityError(
-                f"{p}: size {size} != manifest size {entry.get('size')} "
-                f"(truncated or partially written)")
-        want_hash = entry.get("sha256")
-        if want_hash and _sha256_file(p) != want_hash:
-            raise CheckpointIntegrityError(
-                f"{p}: sha256 mismatch against manifest (corrupt)")
+                f"{p}: vanished or became unreadable mid-probe ({e}) — "
+                f"concurrent commit/rotation by another process")
     return _load_meta_checked(base)
 
 
@@ -446,6 +513,21 @@ def _save_model_inner(model_save_path: str, state: TrainState, vocabs,
     _write_manifest(staging, epoch, released)
     fault_point("save")   # 5: fully staged, not yet committed
     _commit_staging(staging, base)
+    if getattr(config, "checkpoint_hash_content", False):
+        # Post-commit by design: the artifact is already durable, so
+        # hashing the multi-GB shards never widens the crash window —
+        # a kill mid-hash leaves a valid artifact without content
+        # hashes (which resume then simply doesn't check).
+        try:
+            hash_artifact_content(base)
+        except OSError:
+            # a peer host's commit swapped the artifact mid-hash (the
+            # same race verify_checkpoint degrades gracefully); the
+            # surviving copy is covered by its own writer's hash pass
+            obs.counter(
+                "checkpoint_content_hash_races_total",
+                "post-commit hash passes abandoned because a peer "
+                "swapped the artifact underneath them").inc()
     return base
 
 
@@ -467,9 +549,11 @@ def load_model(model_load_path: str, state_like: TrainState,
 
     The artifact is manifest-verified FIRST, so a truncated or
     half-written directory fails fast with the offending file named
-    instead of surfacing as an opaque Orbax pytree error mid-restore."""
+    instead of surfacing as an opaque Orbax pytree error mid-restore.
+    Resume is the deep probe: post-commit content hashes (saves made
+    with `checkpoint_hash_content`) are re-checked here when present."""
     base = _abs(model_load_path)
-    meta = verify_checkpoint(base)
+    meta = verify_checkpoint(base, check_content=True)
     if params_only:
         template = {"params": state_like.params, "step": state_like.step}
         restore_args = ocp.checkpoint_utils.construct_restore_args(template)

@@ -12,6 +12,11 @@
 //    layout: 16-byte header + per-row [target, src*M, path*M, tgt*M]
 //    int32 records), multithreaded within sequential chunks, plus an
 //    optional raw-target-strings sidecar for evaluation.
+//  * c2v_histogram_range: token/path/target occurrence histograms over
+//    one line-aligned byte range of a raw extractor file (the awk pass,
+//    preprocess.sh:56-58) — the map step of the multiprocess offline
+//    compiler's map-reduce histograms (data/preprocess.py), dumped as
+//    "count word" lines for the Python reduce step.
 //
 // String->id lookup uses a single open-addressing table (FNV-1a 64) over
 // one string arena: ~40 bytes/entry for the 2.2M-word java14m vocabs vs
@@ -103,6 +108,73 @@ struct Tables {
   int32_t token_pad = 0, token_oov = 0;
   int32_t path_pad = 0, path_oov = 0;
   int32_t target_oov = 0;
+};
+
+struct CountTable {
+  // growable open-addressing occurrence counter (same hashing/arena
+  // scheme as StringTable, but values are counts and the table grows:
+  // histogram cardinality is corpus-dependent)
+  struct Slot {
+    uint64_t hash = 0;
+    uint64_t offset = 0;
+    uint32_t len = 0;
+    uint64_t count = 0;
+    bool used = false;
+  };
+  std::vector<Slot> slots;
+  std::string arena;
+  size_t n = 0;
+
+  CountTable() { slots.assign(1 << 16, Slot{}); }
+
+  void Rehash() {
+    std::vector<Slot> old;
+    old.swap(slots);
+    slots.assign(old.size() * 2, Slot{});
+    size_t mask = slots.size() - 1;
+    for (const Slot& s : old) {
+      if (!s.used) continue;
+      size_t i = s.hash & mask;
+      while (slots[i].used) i = (i + 1) & mask;
+      slots[i] = s;
+    }
+  }
+
+  void Add(std::string_view word) {
+    uint64_t h = StringTable::Hash(word);
+    size_t mask = slots.size() - 1;
+    size_t i = h & mask;
+    while (slots[i].used) {
+      if (slots[i].hash == h && slots[i].len == word.size() &&
+          std::memcmp(arena.data() + slots[i].offset, word.data(),
+                      slots[i].len) == 0) {
+        ++slots[i].count;
+        return;
+      }
+      i = (i + 1) & mask;
+    }
+    slots[i] = Slot{h, arena.size(), static_cast<uint32_t>(word.size()), 1,
+                    true};
+    arena.append(word.data(), word.size());
+    if (++n * 2 >= slots.size()) Rehash();
+  }
+
+  // One "count word\n" line per entry (word never holds ' '/'\n': it
+  // came from a space-split, newline-split corpus field).
+  bool Dump(const char* path) const {
+    std::FILE* out = std::fopen(path, "wb");
+    if (out == nullptr) return false;
+    bool ok = true;
+    for (const Slot& s : slots) {
+      if (!s.used) continue;
+      ok &= std::fprintf(out, "%llu ", static_cast<unsigned long long>(
+                                           s.count)) > 0;
+      ok &= std::fwrite(arena.data() + s.offset, 1, s.len, out) == s.len;
+      ok &= std::fputc('\n', out) != EOF;
+    }
+    ok &= std::fclose(out) == 0;
+    return ok;
+  }
 };
 
 // Parses one `.c2v` line (no trailing newline) into one row of output.
@@ -248,6 +320,43 @@ int64_t c2v_parse_text(void* tables, const char* text, int64_t text_len,
   return n;
 }
 
+// Like c2v_parse_text, but writes the .c2vb interleaved row layout
+// ([target, src*M, path*M, tgt*M] int32 per row) straight into
+// `out_rows` (max_rows x (1+3*M)), so the caller can write the buffer
+// to disk with no re-copy. No mask output (the packed reader derives
+// it). Returns rows parsed.
+int64_t c2v_parse_rows(void* tables, const char* text, int64_t text_len,
+                       int32_t m, int32_t* out_rows, int64_t max_rows) {
+  const Tables* t = static_cast<const Tables*>(tables);
+  std::vector<std::string_view> lines =
+      SplitLines(std::string_view(text, static_cast<size_t>(text_len)));
+  int64_t n = std::min<int64_t>(static_cast<int64_t>(lines.size()), max_rows);
+  const int64_t row_ints = 1 + 3 * static_cast<int64_t>(m);
+  std::atomic<int64_t> next{0};
+  int n_threads = static_cast<int>(
+      std::min<int64_t>(n / 512 + 1, std::thread::hardware_concurrency()
+                                         ? std::thread::hardware_concurrency()
+                                         : 4));
+  auto work = [&]() {
+    while (true) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      int32_t* row = out_rows + i * row_ints;
+      ParseLine(*t, lines[i], m, row + 1, row + 1 + m, row + 1 + 2 * m, row,
+                nullptr);
+    }
+  };
+  if (n_threads <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> threads;
+    for (int k = 1; k < n_threads; ++k) threads.emplace_back(work);
+    work();
+    for (auto& th : threads) th.join();
+  }
+  return n;
+}
+
 // Compiles `c2v_path` to the .c2vb layout at `out_path` (written via a
 // .tmp + rename). If `targets_path` is non-null, writes one raw target
 // string per row. Returns row count, or -1 on I/O error.
@@ -379,6 +488,81 @@ int64_t c2v_pack_file(void* tables, const char* c2v_path, const char* out_path,
     std::filesystem::rename(targets_tmp, targets_path, ec);
   if (ec) return -1;
   return total_rows;
+}
+
+// Histograms over the byte range [start, end) of `raw_path` (boundaries
+// must fall on line starts). Exact semantics of the Python serial loop
+// (data/preprocess.py build_histograms, itself the reference's three awk
+// passes): a line with an empty first field is skipped entirely; empty
+// context fields and contexts without exactly 3 comma-pieces are
+// skipped; tokens count fields 1 and 3 of each context, paths field 2,
+// targets the line's first field. Each histogram is dumped to its out
+// path as "count word" lines. Returns lines consumed, or -1 on I/O
+// error.
+int64_t c2v_histogram_range(const char* raw_path, int64_t start, int64_t end,
+                            const char* tokens_out, const char* paths_out,
+                            const char* targets_out) {
+  std::ifstream in(raw_path, std::ios::binary);
+  if (!in) return -1;
+  in.seekg(start);
+  if (!in) return -1;
+
+  CountTable tokens, paths, targets;
+  int64_t lines_seen = 0;
+
+  auto consume_line = [&](std::string_view line) {
+    size_t sp = line.find(' ');
+    std::string_view name = line.substr(0, sp);
+    if (name.empty()) return;
+    ++lines_seen;
+    targets.Add(name);
+    size_t pos = sp;
+    while (pos != std::string_view::npos) {
+      size_t field_start = pos + 1;
+      pos = line.find(' ', field_start);
+      std::string_view ctx = line.substr(
+          field_start, pos == std::string_view::npos ? pos : pos - field_start);
+      if (ctx.empty()) continue;
+      size_t c1 = ctx.find(',');
+      if (c1 == std::string_view::npos) continue;
+      size_t c2 = ctx.find(',', c1 + 1);
+      if (c2 == std::string_view::npos) continue;
+      if (ctx.find(',', c2 + 1) != std::string_view::npos) continue;  // != 3
+      tokens.Add(ctx.substr(0, c1));
+      paths.Add(ctx.substr(c1 + 1, c2 - c1 - 1));
+      tokens.Add(ctx.substr(c2 + 1));
+    }
+  };
+
+  std::string carry, chunk_text;
+  std::vector<char> io(32 << 20);
+  int64_t remaining = end - start;
+  while (remaining > 0) {
+    std::streamsize want =
+        std::min<int64_t>(remaining, static_cast<int64_t>(io.size()));
+    in.read(io.data(), want);
+    std::streamsize got = in.gcount();
+    if (in.bad()) return -1;
+    if (got <= 0) break;
+    remaining -= got;
+    chunk_text.assign(carry);
+    carry.clear();
+    chunk_text.append(io.data(), static_cast<size_t>(got));
+    size_t last_nl = chunk_text.rfind('\n');
+    if (last_nl == std::string::npos) {
+      carry = std::move(chunk_text);
+      continue;
+    }
+    carry = chunk_text.substr(last_nl + 1);
+    chunk_text.resize(last_nl);  // drop the trailing '\n' as well
+    for (std::string_view line : SplitLines(chunk_text)) consume_line(line);
+  }
+  if (!carry.empty()) consume_line(carry);
+
+  if (!tokens.Dump(tokens_out) || !paths.Dump(paths_out) ||
+      !targets.Dump(targets_out))
+    return -1;
+  return lines_seen;
 }
 
 }  // extern "C"

@@ -634,3 +634,92 @@ def test_extractor_timeout_config_plumbing():
     assert PathExtractor(config, timeout=0).timeout is None  # 0 disables
     with pytest.raises(ValueError, match="extractor_timeout_s"):
         Config(train_data_path_prefix="x", extractor_timeout_s=-1).verify()
+
+
+# ---------------------------------------------- post-commit content hashing
+
+
+def test_content_hashing_catches_size_preserving_corruption(tmp_path, tiny):
+    """`checkpoint_hash_content` records full-content sha256 for EVERY
+    file (incl. the Orbax shards the commit-path manifest only
+    size-checks) AFTER the atomic commit; resume's deep probe
+    (`verify_checkpoint(check_content=True)`) must catch a
+    size-preserving bitflip that the cheap probe cannot see."""
+    import dataclasses
+    import json as json_mod
+
+    vocabs, config = tiny
+    config = dataclasses.replace(config, checkpoint_hash_content=True)
+    base = str(tmp_path / "model_iter1")
+    out = ckpt_mod.save_model(base, chaos_child.build_state(1), vocabs,
+                              config, epoch=1)
+    with open(os.path.join(out, ckpt_mod.MANIFEST_NAME)) as f:
+        manifest = json_mod.load(f)
+    assert manifest["content_hashed"] is True
+    state_files = [rel for rel in manifest["files"]
+                   if rel.startswith("state" + os.sep)
+                   or rel.startswith("state/")]
+    assert state_files, "no Orbax state files in manifest"
+    assert all("content_sha256" in entry
+               for entry in manifest["files"].values())
+    ckpt_mod.verify_checkpoint(out, check_content=True)
+
+    # size-preserving bitflip in the largest state file
+    big = max(state_files, key=lambda rel: manifest["files"][rel]["size"])
+    victim = os.path.join(out, big)
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(victim, "wb") as f:
+        f.write(bytes(blob))
+    ckpt_mod.verify_checkpoint(out)  # cheap probe: same sizes, passes
+    with pytest.raises(ckpt_mod.CheckpointIntegrityError,
+                       match="content sha256"):
+        ckpt_mod.verify_checkpoint(out, check_content=True)
+
+
+def test_content_hashing_off_by_default(tmp_path, tiny):
+    """Without the flag the manifest carries no content hashes and the
+    save path never pays the full-file hashing cost."""
+    import json as json_mod
+
+    vocabs, config = tiny
+    out = ckpt_mod.save_model(str(tmp_path / "model_iter1"),
+                              chaos_child.build_state(1), vocabs, config,
+                              epoch=1)
+    with open(os.path.join(out, ckpt_mod.MANIFEST_NAME)) as f:
+        manifest = json_mod.load(f)
+    assert "content_hashed" not in manifest
+    state_entries = [entry for rel, entry in manifest["files"].items()
+                     if rel.startswith("state")]
+    assert state_entries
+    assert all("content_sha256" not in entry for entry in state_entries)
+    # and the deep probe is then simply a no-op extra check
+    ckpt_mod.verify_checkpoint(out, check_content=True)
+
+
+def test_verify_degrades_when_file_vanishes_mid_probe(tmp_path, tiny,
+                                                      monkeypatch):
+    """A manifest-listed file that disappears BETWEEN the isfile() check
+    and the stat/hash (a peer host's commit swap on a multi-host pod, or
+    concurrent rotation) must surface as CheckpointIntegrityError — which
+    the fallback walks tolerate by design — never as a raw OSError that
+    crashes the trainer."""
+    vocabs, config = tiny
+    out = ckpt_mod.save_model(str(tmp_path / "model_iter1"),
+                              chaos_child.build_state(1), vocabs, config,
+                              epoch=1)
+
+    real_getsize = os.path.getsize
+
+    def racy_getsize(path):
+        if path.endswith("dictionaries.bin"):
+            raise FileNotFoundError(2, "vanished mid-probe", path)
+        return real_getsize(path)
+
+    monkeypatch.setattr(os.path, "getsize", racy_getsize)
+    with pytest.raises(ckpt_mod.CheckpointIntegrityError,
+                       match="mid-probe"):
+        ckpt_mod.verify_checkpoint(out)
+    # and latest_valid_checkpoint just walks past it instead of crashing
+    assert ckpt_mod.latest_valid_checkpoint(
+        str(tmp_path / "model"), log=lambda *_: None) is None

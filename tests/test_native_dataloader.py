@@ -135,6 +135,85 @@ def test_native_pack_matches_python_pack(tmp_path, vocabs):
     assert native_targets == python_targets
 
 
+def test_from_tables_and_parse_rows_match_vocab_tables(vocabs):
+    """The worker-side table constructor (raw bytes->id dicts, no vocab
+    object) and the interleaved-row parse entry point must agree with
+    the vocab-built tables + separate-array parse."""
+    m = 4
+    ref = native.NativeTables(vocabs)
+    worker = native.NativeTables.from_tables(
+        {w.encode(): i for w, i in vocabs.token_vocab.word_to_index.items()},
+        {w.encode(): i for w, i in vocabs.path_vocab.word_to_index.items()},
+        {w.encode(): i for w, i in vocabs.target_vocab.word_to_index.items()},
+        token_pad=vocabs.token_vocab.pad_index,
+        token_oov=vocabs.token_vocab.oov_index,
+        path_pad=vocabs.path_vocab.pad_index,
+        path_oov=vocabs.path_vocab.oov_index,
+        target_oov=vocabs.target_vocab.oov_index)
+    lines = [ln.rstrip("\n") for ln in LINES]
+    blob = ("\n".join(lines) + "\n").encode()
+    n = len(lines)
+    src, pth, tgt, label, _mask = ref.parse_blob(blob, n, m)
+    rec = worker.parse_rows_blob(blob, n, m)
+    np.testing.assert_array_equal(rec[:, 0], label)
+    np.testing.assert_array_equal(rec[:, 1:1 + m], src)
+    np.testing.assert_array_equal(rec[:, 1 + m:1 + 2 * m], pth)
+    np.testing.assert_array_equal(rec[:, 1 + 2 * m:], tgt)
+
+
+def test_native_histogram_range_matches_python(tmp_path):
+    """`c2v_histogram_range` (the map step of the multiprocess histogram
+    build) must reproduce the Python serial loop exactly, including the
+    skip rules for empty names/fields and non-3-piece contexts."""
+    from code2vec_tpu.data import preprocess as pp
+    raw = tmp_path / "raw.txt"
+    raw.write_text(
+        "get|x foo,111,bar foo,111,bar bar,222,baz\n"
+        "\n"                                  # blank line skipped
+        " t,1,t\n"                            # empty name: line skipped
+        "set|y  foo,111,foo ,, a,b\n"         # empty field, 3-empty, 2-piece
+        "get|x a,b,c,d e,111,f\n"             # 4-piece skipped, 3-piece kept
+        "solo\n"
+        "last f,222,g")                       # unterminated final line
+    serial = pp.build_histograms(str(raw))
+    assert native.has_histogram_range()
+    sharded = pp.build_histograms(str(raw), num_workers=2)
+    assert tuple(sharded) == tuple(serial)
+
+
+def test_fused_pack_native_matches_python(tmp_path, vocabs):
+    """pack_raw with the native worker core vs the pure-Python memo path:
+    identical `.c2vb` bytes and sidecar (sampling engaged)."""
+    raw = tmp_path / "raw.txt"
+    rng = np.random.default_rng(3)
+    tokens = ["foo", "bar", "baz", "n", "zzz"]
+    paths = ["111", "222", "-333", "999"]
+    with open(raw, "w") as f:
+        for i in range(200):
+            k = int(rng.integers(1, 9))  # m=4 -> plenty over budget
+            ctxs = [",".join([str(rng.choice(tokens)), str(rng.choice(paths)),
+                              str(rng.choice(tokens))]) for _ in range(k)]
+            f.write(f"get|x {' '.join(ctxs)}\n")
+    w2c = {"foo": 5, "bar": 4, "baz": 3, "n": 2}
+    p2c = {"111": 5, "222": 4, "-333": 3}
+    native_out = str(tmp_path / "native.c2vb")
+    packed.pack_raw(str(raw), native_out, vocabs, w2c, p2c, 4, seed=11,
+                    num_workers=1)
+    lib = native._lib
+    native._lib = None
+    try:
+        python_out = str(tmp_path / "python.c2vb")
+        packed.pack_raw(str(raw), python_out, vocabs, w2c, p2c, 4, seed=11,
+                        num_workers=1)
+    finally:
+        native._lib = lib
+    with open(native_out, "rb") as a, open(python_out, "rb") as b:
+        assert a.read() == b.read()
+    with open(native_out + ".targets", "rb") as a, \
+            open(python_out + ".targets", "rb") as b:
+        assert a.read() == b.read()
+
+
 def test_packed_dataset_roundtrip_native(tmp_path, vocabs):
     c2v = tmp_path / "data.train.c2v"
     c2v.write_text("\n".join(LINES) + "\n")

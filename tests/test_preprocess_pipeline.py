@@ -310,6 +310,208 @@ def test_parallel_extraction_keeps_retry_protection(tmp_path):
     assert all("Hang" not in ln for ln in lines)
 
 
+# ------------------------------------------------ fused parallel compiler
+
+
+def _write_raw(path, n, seed, n_tokens=20, n_paths=9, n_names=12,
+               widths=(1, 2, 3, 8, 12)):
+    """Synthetic raw extractor output with repeated contexts, empty
+    fields, blank lines and (given a small max_contexts) methods that
+    overflow the sampling budget."""
+    import random as random_mod
+    r = random_mod.Random(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            k = r.choice(widths)
+            ctxs = [f"t{r.randrange(n_tokens)},p{r.randrange(n_paths)},"
+                    f"t{r.randrange(n_tokens)}" for _ in range(k)]
+            if r.random() < 0.1:
+                ctxs.append("")  # empty field (double space)
+            f.write(f"m|{r.randrange(n_names)} " + " ".join(ctxs) + "\n")
+            if r.random() < 0.05:
+                f.write("\n")  # blank line
+
+
+@pytest.fixture()
+def raw_corpus(tmp_path):
+    paths = {}
+    for role, (n, seed) in {"train": (400, 1), "val": (60, 2),
+                            "test": (60, 3)}.items():
+        paths[role] = str(tmp_path / f"{role}.raw.txt")
+        _write_raw(paths[role], n, seed)
+    return paths
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_histogram_merge_matches_serial(raw_corpus, monkeypatch,
+                                        force_python):
+    """Map-reduce histograms over byte-range shards must merge to exactly
+    the serial loop's Counters at any worker count (the tentpole's
+    correctness contract for the map step) — on both the native
+    (`c2v_histogram_range`) and pure-Python map steps."""
+    serial = pp.build_histograms(raw_corpus["train"])
+    if force_python:
+        from code2vec_tpu.data import native
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_checked", True)
+    for workers in (1, 2, 4):
+        tokens, paths, targets = pp.build_histograms(raw_corpus["train"],
+                                                     num_workers=workers)
+        assert tokens == serial[0], workers
+        assert paths == serial[1], workers
+        assert targets == serial[2], workers
+
+
+def test_truncate_histogram_heapq_matches_sort():
+    """The heapq.nlargest threshold must equal the old full-sort one."""
+    import random as random_mod
+    r = random_mod.Random(5)
+    hist = {f"w{i}": r.randrange(1, 40) for i in range(500)}
+    for max_size in (1, 7, 100, 499, 500, 900):
+        got = pp.truncate_histogram(dict(hist), max_size)
+        if len(hist) <= max_size:
+            assert got == hist
+            continue
+        min_count = sorted(hist.values(), reverse=True)[max_size] + 1
+        want = {w: c for w, c in hist.items() if c >= min_count}
+        assert got == want, max_size
+
+
+def _compile(raw_corpus, out_name, workers, emit_c2v=False):
+    return pp.compile_corpus(
+        raw_corpus["train"], raw_corpus["val"], raw_corpus["test"],
+        out_name, max_contexts=6, word_vocab_size=15, path_vocab_size=8,
+        target_vocab_size=10, seed=7, num_workers=workers,
+        emit_c2v=emit_c2v, log=lambda *a: None)
+
+
+def test_fused_compile_byte_identical_across_worker_counts(tmp_path,
+                                                           raw_corpus):
+    """The acceptance-bar determinism contract: `.c2vb` + `.targets`
+    sidecar + `.dict.c2v` (and the compat `.c2v` text) are byte-identical
+    at 1, 2 and 4 workers — per-method RNG seeded from (seed, global
+    line ordinal) + canonicalized histograms + in-order segment
+    stitching."""
+    blobs = {}
+    for workers in (1, 2, 4):
+        name = str(tmp_path / f"w{workers}" / "data")
+        os.makedirs(os.path.dirname(name))
+        _compile(raw_corpus, name, workers, emit_c2v=True)
+        out = {}
+        for role in ("train", "val", "test"):
+            for suffix in (".c2vb", ".c2vb.targets", ".c2v"):
+                with open(f"{name}.{role}{suffix}", "rb") as f:
+                    out[role + suffix] = f.read()
+        with open(f"{name}.dict.c2v", "rb") as f:
+            out["dict"] = f.read()
+        blobs[workers] = out
+    assert blobs[1] == blobs[2]
+    assert blobs[1] == blobs[4]
+    # sampling actually engaged (methods wider than max_contexts=6 exist)
+    # and over-budget methods kept <= max_contexts
+    lines = blobs[1]["train.c2v"].decode().splitlines()
+    assert all(len(ln.split(" ")) == 1 + 6 for ln in lines)
+
+
+def test_fused_compile_matches_legacy_text_path(tmp_path, raw_corpus):
+    """With max_contexts wide enough that sampling never engages, the
+    fused raw->`.c2vb` output must be byte-identical to the legacy
+    process_file -> pack_c2v chain (same rows, same ids, same sidecar) —
+    the fusion removes the text intermediate, not semantics."""
+    from code2vec_tpu.data import packed
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+
+    name = str(tmp_path / "legacy" / "data")
+    os.makedirs(os.path.dirname(name))
+    pp.preprocess(raw_corpus["train"], raw_corpus["val"],
+                  raw_corpus["test"], name, max_contexts=20,
+                  word_vocab_size=15, path_vocab_size=8,
+                  target_vocab_size=10, seed=7, log=lambda *a: None)
+    tokens, paths, targets = pp.build_histograms(raw_corpus["train"])
+    w2c = pp.canonical_freq_dict(pp.truncate_histogram(tokens, 15))
+    p2c = pp.canonical_freq_dict(pp.truncate_histogram(paths, 8))
+    t2c = pp.canonical_freq_dict(pp.truncate_histogram(targets, 10))
+    vocabs = Code2VecVocabs.create_from_freq_dicts(
+        WordFreqDicts(w2c, p2c, t2c, 0), max_token_vocab_size=15,
+        max_path_vocab_size=8, max_target_vocab_size=10)
+    legacy = packed.pack_c2v(name + ".train.c2v", vocabs, 20)
+    fused = str(tmp_path / "legacy" / "fused.train.c2vb")
+    packed.pack_raw(raw_corpus["train"], fused, vocabs, w2c, p2c, 20,
+                    seed=7, num_workers=2)
+    with open(legacy, "rb") as a, open(fused, "rb") as b:
+        assert a.read() == b.read()
+    with open(legacy + ".targets", "rb") as a, \
+            open(fused + ".targets", "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_pack_c2v_parallel_matches_serial(tmp_path, raw_corpus,
+                                          monkeypatch):
+    """`pack_c2v(num_workers>1)` (compat repack of existing text) must be
+    byte-identical to the serial Python loop. Native is monkeypatched
+    away so the sharded Python stitcher itself is what's exercised."""
+    from code2vec_tpu.data import native, packed
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+
+    name = str(tmp_path / "out" / "data")
+    os.makedirs(os.path.dirname(name))
+    pp.preprocess(raw_corpus["train"], raw_corpus["val"],
+                  raw_corpus["test"], name, max_contexts=6,
+                  word_vocab_size=15, path_vocab_size=8,
+                  target_vocab_size=10, seed=7, log=lambda *a: None)
+    tokens, paths, targets = pp.build_histograms(raw_corpus["train"])
+    vocabs = Code2VecVocabs.create_from_freq_dicts(
+        WordFreqDicts(pp.truncate_histogram(tokens, 15),
+                      pp.truncate_histogram(paths, 8),
+                      pp.truncate_histogram(targets, 10), 0),
+        max_token_vocab_size=15, max_path_vocab_size=8,
+        max_target_vocab_size=10)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_checked", True)
+    serial = packed.pack_c2v(name + ".train.c2v", vocabs, 6,
+                             out_path=str(tmp_path / "serial.c2vb"))
+    parallel = packed.pack_c2v(name + ".train.c2v", vocabs, 6,
+                               out_path=str(tmp_path / "parallel.c2vb"),
+                               num_workers=3)
+    with open(serial, "rb") as a, open(parallel, "rb") as b:
+        assert a.read() == b.read()
+    with open(serial + ".targets", "rb") as a, \
+            open(parallel + ".targets", "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_fused_cli_end_to_end(tmp_path, raw_corpus):
+    """`--preprocess_workers` CLI path: raw files -> .c2vb + dict, then
+    the packed dataset loads and round-trips against its vocab."""
+    from code2vec_tpu.data.packed import PackedDataset
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts, \
+        load_word_freq_dicts
+
+    name = str(tmp_path / "out" / "mini")
+    pp.main(["--train_raw", raw_corpus["train"],
+             "--val_raw", raw_corpus["val"],
+             "--test_raw", raw_corpus["test"],
+             "--output_name", name, "--max_contexts", "8",
+             "--word_vocab_size", "15", "--path_vocab_size", "8",
+             "--target_vocab_size", "10",
+             "--preprocess_workers", "2"])
+    for role in ("train", "val", "test"):
+        assert os.path.exists(f"{name}.{role}.c2vb")
+        assert os.path.exists(f"{name}.{role}.c2vb.targets")
+        # the compat text path is opt-in and was not requested
+        assert not os.path.exists(f"{name}.{role}.c2v")
+    freq = load_word_freq_dicts(f"{name}.dict.c2v")
+    assert freq.num_train_examples > 0
+    vocabs = Code2VecVocabs.create_from_freq_dicts(
+        WordFreqDicts(freq.token_to_count, freq.path_to_count,
+                      freq.target_to_count, freq.num_train_examples),
+        max_token_vocab_size=15, max_path_vocab_size=8,
+        max_target_vocab_size=10)
+    ds = PackedDataset(f"{name}.train.c2vb", vocabs)
+    assert ds.num_rows_total == freq.num_train_examples
+    assert len(ds.target_strings) == ds.num_rows_total
+
+
 def test_external_shuffle_recursive_oversized_buckets(tmp_path):
     """When the input is so large relative to the budget that even capped
     buckets exceed it, buckets are shuffled recursively and streamed —
