@@ -97,6 +97,29 @@ def arguments_parser() -> ArgumentParser:
                         help="kill a hung serving-side path-extractor "
                              "child after this many seconds (default: "
                              "config.py's 120; 0 disables)")
+    parser.add_argument("--extractor_retries", dest="extractor_retries",
+                        type=int, default=None, metavar="N",
+                        help="retry a crashed/failed-to-launch "
+                             "serving-side extractor child up to N times "
+                             "with exponential backoff (default: "
+                             "config.py's 2; timeouts are never retried; "
+                             "0 disables)")
+    parser.add_argument("--async_checkpointing", action="store_true",
+                        help="defer the checkpoint commit (Orbax flush "
+                             "wait + cross-host barrier + manifest + "
+                             "atomic rename) to a background commit "
+                             "thread with bounded in-flight depth; the "
+                             "step loop only pays staging + dispatch. "
+                             "Crash-atomicity and the multi-host commit "
+                             "protocol are unchanged")
+    parser.add_argument("--save_barrier_timeout",
+                        dest="save_barrier_timeout_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="per-barrier timeout of the cross-host "
+                             "checkpoint commit protocol (default: "
+                             "config.py's 600); on expiry the save "
+                             "fails loudly instead of hanging the pod "
+                             "on a dead peer")
     parser.add_argument("--preprocess_workers", type=int, default=0,
                         metavar="N",
                         help="host worker processes for the on-demand "
@@ -156,8 +179,11 @@ def config_from_args(argv=None) -> Config:
         compute_dtype=args.compute_dtype,
         **{knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
                                     "on_nonfinite_loss",
-                                    "extractor_timeout_s")
+                                    "extractor_timeout_s",
+                                    "extractor_retries",
+                                    "save_barrier_timeout_s")
            if (value := getattr(args, knob)) is not None},
+        async_checkpointing=args.async_checkpointing,
         seed=args.seed,
         use_packed_data=not args.no_packed_data,
         preprocess_workers=args.preprocess_workers,

@@ -47,6 +47,30 @@ class Config:
     # interactive/serving bridge, where one wedged extractor child would
     # otherwise hang the predict request forever. 0 disables.
     extractor_timeout_s: float = 120.0
+    # Retries (beyond the first attempt) when the serving-side extractor
+    # subprocess fails to launch or crashes (nonzero exit / no output),
+    # with bounded exponential backoff between attempts. Distinct from
+    # the timeout above: a HUNG child is killed and NOT retried (the
+    # next one would likely hang the same way and double the stall);
+    # a crashed child usually hit a transient (OOM, fork pressure).
+    # 0 disables retries.
+    extractor_retries: int = 2
+    # Defer the checkpoint commit (Orbax flush wait + cross-host commit
+    # barrier + manifest + atomic rename) to a background commit thread
+    # (training/checkpoint.py AsyncCommitter) with bounded in-flight
+    # depth, so the step loop's save stall shrinks to staging + array
+    # dispatch. Crash-atomicity is unchanged: the manifest still lands
+    # only after the flush + barrier, and the trainer drains the
+    # pipeline before exiting (incl. on preemption). No reference
+    # analog.
+    async_checkpointing: bool = False
+    # Seconds each cross-host checkpoint commit barrier waits for every
+    # host before declaring the save failed (a peer died or hung
+    # mid-protocol). Generous by default: the barrier only fires after
+    # each host's own Orbax flush, so it usually completes in
+    # milliseconds; stragglers flushing multi-GB shards to cold storage
+    # are the long tail it must tolerate.
+    save_barrier_timeout_s: float = 600.0
     train_batch_size: int = 1024
     test_batch_size: int = 1024
     top_k_words_considered_during_prediction: int = 10
@@ -317,6 +341,13 @@ class Config:
         if self.extractor_timeout_s < 0:
             raise ValueError(
                 "extractor_timeout_s must be >= 0 (0 disables).")
+        if self.extractor_retries < 0:
+            raise ValueError(
+                "extractor_retries must be >= 0 (0 disables retries).")
+        if self.save_barrier_timeout_s <= 0:
+            raise ValueError(
+                "save_barrier_timeout_s must be > 0 (a barrier that "
+                "never times out turns a dead peer into a pod hang).")
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(
                 "metrics_port must be in [0, 65535] (0 disables).")

@@ -12,6 +12,7 @@ from __future__ import annotations
 import glob
 import os
 import shutil
+import sys
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -104,6 +105,9 @@ class Code2VecModel:
                      f"(epoch {self.initial_epoch})")
         self._eval_step = None
         self._predict_step = None
+        # Async checkpoint commit pipeline; created by _make_save_fn when
+        # config.async_checkpointing, closed when training ends.
+        self._committer: Optional[ckpt_mod.AsyncCommitter] = None
         # per-variable shape/param dump (reference: tensorflow_model.py:59-63)
         for name, p in sorted(self.state.params.items()):
             self.log(f"variable name: {name} -- shape: "
@@ -266,12 +270,31 @@ class Code2VecModel:
         evaluate_fn = ((lambda state: self._evaluate_with_params(state.params))
                        if config.is_testing else None)
         batches = self._train_batches()
+        committer = self._committer
         trainer = Trainer(config, train_step, mesh=self.mesh,
                           evaluate_fn=evaluate_fn, save_fn=save_fn,
                           profile_dir=config.profile_dir,
                           initial_epoch=self.initial_epoch,
-                          steps_per_epoch_hint=self._steps_per_epoch)
-        self.state = trainer.train(self.state, batches, dropout_rng(config))
+                          steps_per_epoch_hint=self._steps_per_epoch,
+                          commit_drain_fn=(committer.drain if committer
+                                           else None))
+        try:
+            self.state = trainer.train(self.state, batches,
+                                       dropout_rng(config))
+        finally:
+            if committer is not None:
+                # The trainer already drained (its finally); this stops
+                # the commit thread and surfaces any failure a killed
+                # drain left behind. Never mask an in-flight exception —
+                # checked BEFORE the close() attempt (inside the except
+                # handler sys.exc_info() would report close's own error).
+                exc_in_flight = sys.exc_info()[0] is not None
+                try:
+                    committer.close()
+                except Exception:
+                    if not exc_in_flight:
+                        raise
+                self._committer = None
         self.initial_epoch = trainer.final_epoch
         if trainer.preempted:
             # The preemption checkpoint is already on disk; a second full
@@ -284,16 +307,39 @@ class Code2VecModel:
 
     def _make_save_fn(self):
         config = self.config
+        if getattr(config, "async_checkpointing", False):
+            self._committer = ckpt_mod.AsyncCommitter(
+                max_in_flight=2, log=self.log)
+            self.log("Async checkpointing on: commit barrier + manifest "
+                     "+ rename run on a background commit thread")
+        else:
+            self._committer = None
 
         def save_fn(state, epoch, suffix=""):
             # suffix="_preempt" (preemption checkpoints) keeps the save
             # from clobbering the clean end-of-epoch _iter<N> artifact
             # whose metrics the eval log refers to.
             path = f"{config.model_save_path}_iter{epoch}{suffix}"
-            ckpt_mod.save_model(path, state, self.vocabs, config, epoch=epoch)
-            self.log(f"Saved after {epoch} epochs in: {path}")
-            if not suffix:
-                self._rotate_epoch_checkpoints()
+            if suffix or self._committer is None:
+                # Preemption/NaN-halt saves stay SYNCHRONOUS even in
+                # async mode: the grace window ends at process exit, so
+                # the artifact must be committed before save_fn returns
+                # (the trainer drains in-flight commits first).
+                ckpt_mod.save_model(path, state, self.vocabs, config,
+                                    epoch=epoch)
+                self.log(f"Saved after {epoch} epochs in: {path}")
+                if not suffix:
+                    self._rotate_epoch_checkpoints()
+            else:
+                # Rotation rides the commit thread too — it belongs
+                # after the rename, and its glob/verify/rmtree walk is
+                # exactly the kind of filesystem stall async mode takes
+                # off the step path.
+                ckpt_mod.save_model(path, state, self.vocabs, config,
+                                    epoch=epoch, committer=self._committer,
+                                    on_committed=self._rotate_epoch_checkpoints)
+                self.log(f"Save after {epoch} epochs dispatched to the "
+                         f"async commit pipeline: {path}")
 
         return save_fn
 
@@ -310,6 +356,13 @@ class Code2VecModel:
     def _rotate_epoch_checkpoints_inner(self):
         # reference keeps MAX_TO_KEEP epoch checkpoints (config.py:57).
         config = self.config
+        if distributed.process_count() > 1 and distributed.process_index():
+            # On a pod the artifact store is shared: process 0 — the
+            # commit-protocol's single committing host — also owns
+            # rotation. Peers sweeping concurrently would race the
+            # rmtree/promote walk (and mis-probe the liveness of
+            # process 0's shared staging dir from another machine).
+            return
         pattern = f"{config.model_save_path}_iter*"
         # Sweep orphaned commit-protocol dirs (`.tmp-<pid>` staging /
         # `.old-<pid>` backups) left by killed saves — but never another

@@ -27,6 +27,7 @@ process logs the examples it scored; metrics are global.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -36,21 +37,56 @@ from code2vec_tpu.parallel import mesh as mesh_lib
 
 _initialized = False
 
+# Bounded exponential backoff for jax.distributed.initialize: the
+# coordinator may come up seconds after its workers on a real pod (or a
+# transient RPC failure may hit the connect), and ONE failed connect
+# silently degrading a host to single-process would deadlock its peers'
+# collectives at the first training step. Delays in seconds.
+_INIT_ATTEMPTS = 4
+_INIT_BACKOFF_BASE_S = 0.5
+_INIT_BACKOFF_CAP_S = 8.0
+
+
+def _initialize_with_retries(**kwargs) -> None:
+    """`jax.distributed.initialize` with bounded exponential backoff.
+    Raises the LAST error after `_INIT_ATTEMPTS` failures — the caller
+    decides whether that is fatal (explicit coordinator) or degradable
+    (auto-detection heuristic)."""
+    import logging
+    delay = _INIT_BACKOFF_BASE_S
+    for attempt in range(1, _INIT_ATTEMPTS + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except (ValueError, RuntimeError) as e:
+            if attempt == _INIT_ATTEMPTS:
+                raise
+            logging.getLogger("code2vec_tpu").warning(
+                "jax.distributed.initialize failed (attempt %d/%d: %s); "
+                "retrying in %.1fs", attempt, _INIT_ATTEMPTS, e, delay)
+            time.sleep(delay)
+            delay = min(delay * 2, _INIT_BACKOFF_CAP_S)
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
     """Join the multi-host runtime. Safe to call unconditionally: a
     no-op for single-process runs with no coordinator configured (the
-    common laptop/single-chip case) and idempotent across calls."""
+    common laptop/single-chip case) and idempotent across calls.
+
+    Transient coordinator-connect failures are retried with bounded
+    exponential backoff before anything else happens: falling back to
+    single-process on a pod host that merely raced its coordinator's
+    startup would deadlock every peer's collectives."""
     global _initialized
     if _initialized:
         return
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is not None:
-        # explicitly configured: failures are real errors
-        jax.distributed.initialize(
+        # explicitly configured: failures (after retries) are real errors
+        _initialize_with_retries(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
         _initialized = True
@@ -62,18 +98,100 @@ def initialize(coordinator_address: Optional[str] = None,
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     if len(hostnames.split(",")) > 1:
         try:
-            jax.distributed.initialize()
+            _initialize_with_retries()
             _initialized = True
         except (ValueError, RuntimeError) as e:
             import logging
             logging.getLogger("code2vec_tpu").warning(
-                "multi-host auto-initialization failed (%s); "
-                "continuing single-process", e)
+                "multi-host auto-initialization failed after %d attempts "
+                "(%s); continuing single-process", _INIT_ATTEMPTS, e)
 
 
 def host_shard() -> Tuple[int, int]:
     """(shard_index, num_shards) for this host's data pipeline."""
     return jax.process_index(), jax.process_count()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+class BarrierTimeout(RuntimeError):
+    """A cross-host commit barrier did not complete within its timeout —
+    a peer host died, hung, or never reached the same protocol stage.
+    The save that hit it must be treated as FAILED on this host (no
+    manifest is written after a failed barrier, so resume rejects the
+    artifact and the pod falls back collectively)."""
+
+
+def coordination_client():
+    """The jax.distributed coordination-service client, or None outside
+    a multi-process runtime. Unlike the device collectives above, its
+    barriers and KV store are host-side RPCs — safe to call from a
+    background thread (the async checkpoint commit thread) without
+    racing the step loop's device collectives."""
+    try:
+        from jax._src import distributed as _jax_distributed
+        return _jax_distributed.global_state.client
+    except Exception:
+        return None
+
+
+def commit_barrier(name: str, timeout_s: float) -> None:
+    """Rendezvous every process at `name` or raise BarrierTimeout.
+
+    Built on the coordination service (thread-safe, real timeout), NOT
+    on device collectives: the checkpoint commit pipeline runs this off
+    the main thread while the step loop owns the devices. Single
+    process: no-op. Callers must use a name unique to one rendezvous
+    (the checkpoint protocol includes a lockstep save ordinal)."""
+    if jax.process_count() == 1:
+        return
+    client = coordination_client()
+    if client is None:
+        # Multi-process but no coordination client (initialize() was
+        # bypassed): fall back to a device-collective sync. Main-thread
+        # only — documented limitation of this degraded path.
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+        return
+    try:
+        client.wait_at_barrier(name, timeout_in_ms=int(timeout_s * 1000))
+    except Exception as e:
+        raise BarrierTimeout(
+            f"cross-host barrier {name!r} failed after {timeout_s:g}s: "
+            f"{e}. A peer host likely died or hung mid-protocol; this "
+            f"save must be treated as failed.") from e
+
+
+def broadcast_from_primary(key: str, value: Optional[str],
+                           timeout_s: float) -> str:
+    """Share one small string from process 0 with every process via the
+    coordination KV store (process 0 passes the value, others pass None
+    and block until it is published). Used to agree on the shared
+    checkpoint staging directory name. Single process: identity."""
+    if jax.process_count() == 1:
+        assert value is not None
+        return value
+    client = coordination_client()
+    if client is None:
+        raise RuntimeError(
+            f"broadcast_from_primary({key!r}) requires the jax.distributed "
+            f"coordination service; call distributed.initialize() first.")
+    if jax.process_index() == 0:
+        assert value is not None
+        client.key_value_set(key, value, allow_overwrite=True)
+        return value
+    try:
+        return client.blocking_key_value_get(key, int(timeout_s * 1000))
+    except Exception as e:
+        raise BarrierTimeout(
+            f"waiting for broadcast key {key!r} timed out after "
+            f"{timeout_s:g}s: {e}") from e
 
 
 def local_batch_size(global_batch_size: int) -> int:

@@ -30,10 +30,16 @@ _C_CALLS = obs.counter("extractor_calls_total",
 _C_TIMEOUTS = obs.counter(
     "extractor_timeouts_total",
     "extractor children killed after config.extractor_timeout_s")
-_C_FAILURES = obs.counter(
-    "extractor_failures_total",
-    "extractions that failed (nonzero exit / empty output), "
-    "timeouts excluded")
+
+_FAILURES_HELP = ("extractions that failed (nonzero exit / empty output / "
+                  "launch failure), timeouts excluded; retried=yes means "
+                  "another attempt followed, retried=no means the failure "
+                  "was surfaced to the caller")
+
+
+def _count_failure(retried: bool) -> None:
+    obs.counter("extractor_failures_total", _FAILURES_HELP,
+                retried="yes" if retried else "no").inc()
 
 DEFAULT_JAR_PATH = "JavaExtractor/JPredict/target/JavaExtractor-0.0.1-SNAPSHOT.jar"
 NATIVE_EXTRACTOR_ENV = "C2V_NATIVE_EXTRACTOR"
@@ -46,6 +52,16 @@ class ExtractionTimeout(ValueError):
     like any other failed extraction instead of crashing the session."""
 
 
+class ExtractorCrash(ValueError):
+    """The extractor child DIED rather than rejecting its input: killed
+    by a signal (negative returncode) or a fatal-exit code >= 126
+    (137 = SIGKILL/OOM, 134 = SIGABRT, ...). Distinguished from plain
+    nonzero diagnostic exits because only crashes are plausibly
+    transient (memory pressure, fork storms) and therefore retried;
+    a parser that deterministically rejects a file would fail
+    identically on every retry and only add latency."""
+
+
 def _native_extractor_path() -> str:
     env = os.environ.get(NATIVE_EXTRACTOR_ENV)
     if env:
@@ -56,9 +72,16 @@ def _native_extractor_path() -> str:
 
 
 class PathExtractor:
+    # backoff before retry attempt k (1-based) is _RETRY_BACKOFF_BASE_S *
+    # 2**(k-1), capped — a crashed child usually hit transient pressure
+    # (fork storm, OOM kill), which a short pause outlasts.
+    _RETRY_BACKOFF_BASE_S = 0.2
+    _RETRY_BACKOFF_CAP_S = 2.0
+
     def __init__(self, config, jar_path: str = DEFAULT_JAR_PATH,
                  max_path_length: int = 8, max_path_width: int = 2,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None):
         self.config = config
         self.jar_path = jar_path
         self.max_path_length = max_path_length
@@ -70,6 +93,12 @@ class PathExtractor:
         if timeout is None:
             timeout = float(getattr(config, "extractor_timeout_s", 120.0))
         self.timeout = timeout if timeout > 0 else None
+        # Launch/crash retries (config.extractor_retries). Timeouts are
+        # NOT retried: a child that hung once will likely hang again,
+        # and the caller already waited a full timeout.
+        if retries is None:
+            retries = int(getattr(config, "extractor_retries", 2))
+        self.retries = max(retries, 0)
 
     def _build_command(self, path: str) -> List[str]:
         native = _native_extractor_path()
@@ -90,11 +119,40 @@ class PathExtractor:
         _C_CALLS.inc()
         t0 = time.perf_counter()
         try:
-            return self._extract_paths_inner(path)
+            return self._extract_with_retries(path)
         finally:
             dur = time.perf_counter() - t0
             _H_EXTRACT.observe(dur)
             obs.default_tracer().maybe_record("extract_paths", t0, dur)
+
+    def _extract_with_retries(self, path: str
+                              ) -> Tuple[List[str], Dict[str, str]]:
+        """Bounded retry-with-backoff around one extraction. Retried:
+        subprocess launch failures (OSError from Popen) and child
+        CRASHES (ExtractorCrash: signal-killed / fatal-exit codes).
+        Not retried: deterministic rejections (plain nonzero diagnostic
+        exits, empty output — identical on every retry), timeouts
+        (their own policy, see __init__), and missing-extractor setup
+        errors (FileNotFoundError from _build_command — no number of
+        retries builds the binary)."""
+        for attempt in range(self.retries + 1):
+            try:
+                return self._extract_paths_inner(path)
+            except ExtractionTimeout:
+                raise
+            except FileNotFoundError:
+                raise  # no extractor installed at all — not transient
+            except (ExtractorCrash, OSError) as e:
+                final = attempt == self.retries
+                _count_failure(retried=not final)
+                if final:
+                    raise
+                backoff = min(self._RETRY_BACKOFF_BASE_S * (2 ** attempt),
+                              self._RETRY_BACKOFF_CAP_S)
+                time.sleep(backoff)
+            except ValueError:
+                _count_failure(retried=False)
+                raise
 
     def _extract_paths_inner(self, path: str
                              ) -> Tuple[List[str], Dict[str, str]]:
@@ -115,14 +173,18 @@ class PathExtractor:
         if process.returncode != 0:
             # Surface stderr even when the child produced some stdout —
             # a nonzero exit means the extraction is incomplete and the
-            # partial output must not be silently served.
-            _C_FAILURES.inc()
-            raise ValueError(
-                f"extractor exited with code {process.returncode} on "
+            # partial output must not be silently served. (Failure
+            # counting lives in _extract_with_retries, which also knows
+            # whether another attempt follows.) Signal deaths and
+            # fatal-exit codes raise the retryable crash subclass.
+            crashed = process.returncode < 0 or process.returncode >= 126
+            exc_type = ExtractorCrash if crashed else ValueError
+            raise exc_type(
+                f"extractor {'crashed' if crashed else 'exited'} with "
+                f"code {process.returncode} on "
                 f"{path} ({len(output)} stdout lines discarded); stderr: "
                 f"{err.decode(errors='replace').strip()!r}")
         if len(output) == 0:
-            _C_FAILURES.inc()
             raise ValueError(err.decode())
         hash_to_string: Dict[str, str] = {}
         result = []

@@ -19,7 +19,11 @@ landing mid-save, since a grace window that expires during `save_model`
 would otherwise leave a half-written `_iter<N>` directory that the next
 `--load` resume picks by name and dies on):
 
-1. every file is written into a `<base>.tmp-<pid>` staging directory;
+1. every file is written into a `<base>.tmp-<pid>` staging directory
+   (multi-host: ONE shared `<base>.tmp-mh<pid0>` staging dir, named by
+   process 0 and broadcast over the coordination KV store — Orbax's
+   collective save writes every host's shards into the same tree, which
+   per-host staging dirs would tear apart);
 2. a manifest (file list + sizes, sha256 of `dictionaries.bin` and the
    meta JSON, an Orbax-completion marker) is recorded LAST, after
    `wait_until_finished`, so its presence certifies the whole artifact;
@@ -28,38 +32,89 @@ would otherwise leave a half-written `_iter<N>` directory that the next
 4. orphaned staging dirs from killed saves are swept by checkpoint
    rotation (model_facade._rotate_epoch_checkpoints).
 
+Multi-host pods add a commit-barrier protocol on top (manifest format 2;
+ROADMAP's deferred cross-host save-barrier item). All barriers ride the
+jax.distributed coordination service (parallel/distributed.py
+`commit_barrier`): host-side RPCs with real timeouts, safe on the async
+commit thread.
+
+    stage      proc 0 prepares the shared staging dir, broadcasts its
+               name; barrier `stage` before any host writes into it
+    flush      Orbax collective save + per-host wait_until_finished
+    barrier    `commit` — NO host proceeds toward the manifest/rename
+               until EVERY host's Orbax flush finished (a host killed
+               here fails the barrier on the survivors, the save errors
+               out manifest-less, and resume rejects the artifact)
+    ack        each host writes `commit_ack.<process_index>` into the
+               staged artifact; barrier `acks`
+    commit     proc 0 alone writes the manifest (recording
+               process_count + the ack set) and performs the atomic
+               rename; barrier `committed` releases the peers
+    verify     resume rejects any manifest whose recorded ack set is
+               not exactly {0..process_count-1}
+
+Async commits (`config.async_checkpointing`) defer everything after the
+Orbax dispatch onto an `AsyncCommitter` thread: the step loop's save
+stall shrinks to staging + array dispatch, while the barrier + manifest
++ rename + content-hash pass run behind it with bounded in-flight depth
+and back-pressure. `drain()` (called in the trainer's `finally` and on
+preemption) completes the pipeline deterministically before exit.
+
 Restore is integrity-verified: `verify_checkpoint` re-checks the
 manifest, `latest_valid_checkpoint` walks newest -> oldest past any
 candidate that fails it, and `load_model` verifies before handing the
 directory to Orbax so truncation fails fast with a named file instead of
-an opaque pytree error deep in the restore.
+an opaque pytree error deep in the restore. On a multi-host pod the
+fallback walk is COLLECTIVE: hosts agree (min over local bests, re-voted
+until unanimous) on one artifact, because each host walking backward
+independently can land on different steps and deadlock the pod's
+restore-time collectives.
 """
 
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
 import shutil
+import threading
 from typing import Callable, Optional
 
 import numpy as np
 import orbax.checkpoint as ocp
 
 from code2vec_tpu import obs
+from code2vec_tpu.parallel import distributed
+from code2vec_tpu.parallel.distributed import BarrierTimeout  # re-export
 from code2vec_tpu.training.state import TrainState
 from code2vec_tpu.utils.faults import fault_point
 
 _STATE_DIR = "state"
 _META_NAME = "code2vec_meta.json"
 MANIFEST_NAME = "code2vec_manifest.json"
-MANIFEST_FORMAT = 1
+# Format 2 adds the multi-host commit-protocol fields: `process_count`
+# and `commit_acks` (the participant set that reached the post-flush
+# barrier). Format-1 artifacts (pre-barrier saves) remain loadable —
+# they carry no participant record to check.
+MANIFEST_FORMAT = 2
+ACK_PREFIX = "commit_ack."
 RELEASED_SUFFIX = ".release"
 # Commit-protocol working dirs: `.tmp-<pid>` is the staging dir a save
-# builds in; `.old-<pid>` briefly holds the previous artifact while a
-# same-path overwrite swaps the new one in.
+# builds in (`.tmp-mh<pid0>` when the pod shares one staging dir);
+# `.old-<pid>` briefly holds the previous artifact while a same-path
+# overwrite swaps the new one in.
 STAGING_INFIX = ".tmp-"
 BACKUP_INFIX = ".old-"
+_SHARED_STAGING_TAG = "mh"
+
+# Lockstep save ordinal: save_model is a collective call on a pod, so
+# every process draws the same ordinal for the same save — it keys the
+# barrier/KV names, making each rendezvous unique per save.
+_save_ordinal = itertools.count()
+
+# Default cross-host barrier timeout when the config carries none.
+DEFAULT_BARRIER_TIMEOUT_S = 600.0
 
 # Small files worth a full content hash in the manifest at save time.
 # The Orbax state files are covered by existence+size in the commit-path
@@ -93,7 +148,10 @@ def staging_owner_alive(path: str) -> bool:
     """Does the process that created this staging/backup dir still run?
     Used by the sweeper so a concurrent save's in-flight staging dir is
     left alone while leftovers of killed saves are reclaimed. Unparseable
-    names are treated as orphaned."""
+    names are treated as orphaned. Shared multi-host staging dirs
+    (`.tmp-mh<pid0>`) are owned by process 0 — which is also the only
+    process that runs the sweeper on a pod, so the liveness probe always
+    runs on the machine that owns the pid."""
     name = os.path.basename(path.rstrip(os.sep))
     for infix in (STAGING_INFIX, BACKUP_INFIX):
         if infix in name:
@@ -101,6 +159,8 @@ def staging_owner_alive(path: str) -> bool:
             break
     else:
         return False
+    if tail.startswith(_SHARED_STAGING_TAG):
+        tail = tail[len(_SHARED_STAGING_TAG):]
     try:
         pid = int(tail)
     except ValueError:
@@ -194,28 +254,59 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def _write_manifest(base: str, epoch: int, released: bool) -> None:
+def write_commit_ack(staging: str, index: int) -> str:
+    """Record this host's commit acknowledgment inside the staged
+    artifact: a tiny `commit_ack.<process_index>` file proving the host
+    survived to the post-flush barrier. The manifest (written after the
+    ack barrier) records the full ack set; resume rejects artifacts
+    whose recorded participant set is incomplete."""
+    path = os.path.join(staging, f"{ACK_PREFIX}{index}")
+    with open(path, "w") as f:
+        json.dump({"process_index": index, "pid": os.getpid()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    obs.counter("checkpoint_commit_acks_total",
+                "per-host commit acknowledgments written after the "
+                "post-flush barrier").inc()
+    return path
+
+
+def _write_manifest(base: str, epoch: int, released: bool,
+                    process_count: int = 1) -> None:
     """Record every file in the (staged) artifact with its size, plus
     content hashes for the small sidecars. Written last: its presence is
     the Orbax-completion marker — `save_model` only writes it after
-    `wait_until_finished`, so a manifest-bearing directory is a fully
-    flushed artifact."""
+    `wait_until_finished` (and, on a pod, after the cross-host commit
+    barrier), so a manifest-bearing directory is a fully flushed
+    artifact. Records the participating process count and the commit-ack
+    set found on disk; a manifest whose ack set is short of its
+    process_count is rejected at verify time."""
     files = {}
+    acks = []
     for root, _dirs, names in os.walk(base):
         for name in names:
             p = os.path.join(root, name)
             rel = os.path.relpath(p, base)
             if rel == MANIFEST_NAME:
                 continue
+            if rel.startswith(ACK_PREFIX) and os.sep not in rel:
+                try:
+                    acks.append(int(rel[len(ACK_PREFIX):]))
+                except ValueError:
+                    pass
             entry = {"size": os.path.getsize(p)}
             if rel in _HASHED_FILES:
                 entry["sha256"] = _sha256_file(p)
             files[rel] = entry
+    if process_count == 1 and not acks:
+        acks = [0]  # single-process saves carry no ack files
     manifest = {
         "format": MANIFEST_FORMAT,
         "epoch": epoch,
         "released": released,
         "orbax_complete": True,
+        "process_count": process_count,
+        "commit_acks": sorted(acks),
         "files": files,
     }
     path = os.path.join(base, MANIFEST_NAME)
@@ -328,6 +419,24 @@ def _verify_checkpoint_inner(model_path: str,
         raise CheckpointIntegrityError(
             f"{manifest_path}: Orbax completion marker missing — the save "
             f"was interrupted before wait_until_finished")
+    if "process_count" in manifest:
+        # Manifest format 2: the save recorded its participant set. An
+        # incomplete ack set means a host died between the commit
+        # barrier and the manifest (or the manifest was hand-edited);
+        # its shards may be missing from the artifact, so reject it.
+        want = int(manifest["process_count"])
+        acks = manifest.get("commit_acks")
+        try:
+            got = (sorted({int(a) for a in acks})
+                   if isinstance(acks, list) else None)
+        except (TypeError, ValueError):
+            got = None
+        if got != list(range(want)):
+            raise CheckpointIntegrityError(
+                f"{manifest_path}: commit-ack participant set {got} is "
+                f"not the full {want}-process set — a host did not "
+                f"survive to the commit barrier; its shards cannot be "
+                f"trusted to be in this artifact")
     for rel, entry in manifest["files"].items():
         p = os.path.join(base, rel)
         if not os.path.isfile(p):
@@ -390,8 +499,43 @@ def _load_meta_checked(base: str) -> dict:
             f"{meta_path}: unreadable or corrupt meta ({e})")
 
 
+def _candidate_key(parsed) -> int:
+    """Encode (epoch, is_preempt) as one integer preserving the resume
+    preference order (newer epoch wins; at equal epoch the preemption
+    artifact wins — see latest_valid_checkpoint)."""
+    epoch, preempt = parsed
+    return epoch * 2 + (1 if preempt else 0)
+
+
+def _candidate_path(save_base: str, key: int) -> str:
+    epoch, preempt = key // 2, bool(key % 2)
+    return f"{save_base}_iter{epoch}" + ("_preempt" if preempt else "")
+
+
+def _local_latest_valid(save_base: str, excluded,
+                        log: Optional[Callable[[str], None]] = None):
+    """This host's newest verifying candidate (key, path), skipping any
+    key in `excluded`; (None, None) if nothing verifies."""
+    import glob
+    candidates = []  # ((epoch, is_preempt), path)
+    for p in glob.glob(save_base + "_iter*"):
+        parsed = parse_iter_name(p)
+        if parsed is None or _candidate_key(parsed) in excluded:
+            continue
+        candidates.append((parsed, p))
+    for parsed, path in sorted(candidates, reverse=True):
+        try:
+            verify_checkpoint(path)
+            return _candidate_key(parsed), path
+        except CheckpointIntegrityError as e:
+            if log is not None:
+                log(f"Skipping corrupt/partial checkpoint {path}: {e}")
+    return None, None
+
+
 def latest_valid_checkpoint(save_base: str,
-                            log: Optional[Callable[[str], None]] = None):
+                            log: Optional[Callable[[str], None]] = None,
+                            collective: Optional[bool] = None):
     """Newest `<save_base>_iter<N>[_preempt]` artifact that PASSES its
     integrity check (None if no candidate does). Walks newest -> oldest
     past corrupt/partial artifacts, logging each skip, so a save killed
@@ -400,22 +544,48 @@ def latest_valid_checkpoint(save_base: str,
 
     At equal N the preemption artifact wins: it was written mid-epoch
     N+1, so its params are strictly more trained than the clean
-    end-of-epoch-N save."""
-    import glob
-    candidates = []  # ((epoch, is_preempt), path)
-    for p in glob.glob(save_base + "_iter*"):
-        parsed = parse_iter_name(p)
-        if parsed is None:
-            continue
-        candidates.append((parsed, p))
-    for _parsed, path in sorted(candidates, reverse=True):
+    end-of-epoch-N save.
+
+    On a multi-host pod (`collective=None` auto-detects; pass False to
+    force a host-local walk, e.g. post-mortem tooling) the walk is a
+    COLLECTIVE agreement: each host proposes its local best, the pod
+    takes the minimum (the newest artifact every host accepts can only
+    be <= each local best), every host re-verifies that candidate, and
+    the vote repeats with the candidate excluded until unanimous — all
+    hosts return the SAME path (or all None). Without this, hosts whose
+    independent backward walks diverge restore different steps and
+    deadlock the pod's first collective. Runs host collectives: main
+    thread only."""
+    if collective is None:
+        collective = distributed.process_count() > 1
+    if not collective or distributed.process_count() == 1:
+        return _local_latest_valid(save_base, excluded=(), log=log)[1]
+    excluded = set()
+    while True:
+        local_key, _local_path = _local_latest_valid(save_base, excluded, log)
+        proposal = -1 if local_key is None else local_key
+        agreed = distributed.agree_scalar(proposal, "min")
+        if agreed < 0:
+            # At least one host verifies NOTHING (it also vetoes every
+            # newer candidate its peers hold): resuming a subset would
+            # desync the pod, so all hosts consistently start fresh.
+            return None
+        path = _candidate_path(save_base, agreed)
         try:
             verify_checkpoint(path)
-            return path
+            ok = 1.0
         except CheckpointIntegrityError as e:
+            ok = 0.0
             if log is not None:
-                log(f"Skipping corrupt/partial checkpoint {path}: {e}")
-    return None
+                log(f"Pod-agreed candidate {path} fails verification on "
+                    f"this host: {e}")
+        votes = distributed.allreduce_host_scalars(np.array([ok]))[0]
+        if int(votes) == distributed.process_count():
+            if log is not None and excluded:
+                log(f"Pod agreed on fallback checkpoint {path} after "
+                    f"excluding {len(excluded)} candidate(s)")
+            return path
+        excluded.add(agreed)
 
 
 # Back-compat name: the pre-manifest API returned the newest artifact by
@@ -438,96 +608,298 @@ def resolve_load_path(model_load_path: str,
     return found if found is not None else base
 
 
+class AsyncCommitter:
+    """Bounded background pipeline for the deferred half of a save.
+
+    `save_model(..., committer=...)` stages the artifact and dispatches
+    the Orbax write synchronously, then hands the rest — Orbax
+    wait_until_finished, the cross-host commit barrier, acks, manifest,
+    atomic rename, content-hash pass — to this single commit thread.
+    The step loop's save stall shrinks to staging + dispatch.
+
+    Guarantees kept from the synchronous protocol:
+    - bounded in-flight depth with BACK-PRESSURE: `submit` blocks once
+      `max_in_flight` commits are pending, so a slow filesystem can
+      never queue unbounded half-finished saves;
+    - commit failures are never silent: the first error re-raises on
+      the next `submit` or `drain` (the trainer drains in its
+      `finally`, so a failed commit fails the run);
+    - `drain()` completes every pending commit deterministically —
+      the preemption path drains BEFORE writing its own artifact, so
+      exit always leaves a fully committed, resumable state."""
+
+    def __init__(self, max_in_flight: int = 2,
+                 log: Optional[Callable[[str], None]] = None):
+        from concurrent.futures import ThreadPoolExecutor
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="c2v-ckpt-commit")
+        self._slots = threading.Semaphore(max(1, int(max_in_flight)))
+        self._lock = threading.Lock()
+        self._futures = []
+        self._errors = []
+        self._depth = 0
+        self._log = log
+        self._g_depth = obs.gauge(
+            "checkpoint_async_inflight",
+            "async checkpoint commits currently pending")
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._depth
+
+    def raise_pending(self) -> None:
+        """Re-raise the first recorded commit failure (original
+        exception object, so fault-injection drills see their own
+        types). Clears it: the caller owns the error once raised."""
+        with self._lock:
+            if not self._errors:
+                return
+            label, err = self._errors.pop(0)
+        raise err
+
+    def submit(self, job: Callable[[], object], label: str) -> None:
+        self.raise_pending()
+        with obs.span("checkpoint_async_backpressure",
+                      hist=obs.histogram(
+                          "checkpoint_async_backpressure_seconds",
+                          "save stalled waiting for an in-flight async "
+                          "commit slot")):
+            self._slots.acquire()  # back-pressure at max_in_flight
+
+        def run():
+            try:
+                with obs.span("checkpoint_async_commit",
+                              hist=obs.histogram(
+                                  "checkpoint_async_commit_seconds",
+                                  "deferred commit: orbax wait + barrier "
+                                  "+ manifest + rename")):
+                    job()
+            except BaseException as e:  # noqa: BLE001 — surfaced on drain
+                with self._lock:
+                    self._errors.append((label, e))
+                obs.counter("checkpoint_async_errors_total",
+                            "async checkpoint commits that failed").inc()
+                if self._log is not None:
+                    self._log(f"Async checkpoint commit {label} FAILED: "
+                              f"{type(e).__name__}: {e}")
+            finally:
+                with self._lock:
+                    self._depth -= 1
+                    self._g_depth.set(self._depth)
+                self._slots.release()
+
+        with self._lock:
+            self._futures = [f for f in self._futures if not f.done()]
+            self._futures.append(self._executor.submit(run))
+            self._depth += 1
+            self._g_depth.set(self._depth)
+
+    def drain(self) -> None:
+        """Block until every pending commit finished; re-raise the first
+        failure. Idempotent and safe to call with nothing in flight."""
+        from concurrent.futures import wait
+        with self._lock:
+            pending = list(self._futures)
+        if pending:
+            wait(pending)
+        self.raise_pending()
+
+    def close(self) -> None:
+        """Drain (surfacing errors) and stop the commit thread."""
+        try:
+            self.drain()
+        finally:
+            self._executor.shutdown(wait=True)
+
+
 def save_model(model_save_path: str, state: TrainState, vocabs, config,
-               epoch: int = 0, released: bool = False) -> str:
+               epoch: int = 0, released: bool = False,
+               committer: Optional[AsyncCommitter] = None,
+               on_committed: Optional[Callable[[], None]] = None) -> str:
     """Save a standalone model artifact at `<model_save_path>` (a directory
     is created): Orbax state + `dictionaries.bin` + config meta. Mirrors
     `Code2VecModelBase.save` (model_base.py:102-109).
 
-    Crash-atomic: everything lands in a `.tmp-<pid>` staging dir, the
-    manifest is recorded last, and the staging dir is renamed into place
-    (see the commit protocol in the module docstring). The `save` fault
-    points between the steps are inert in production and let
-    tests/test_chaos.py kill the save at every interesting boundary."""
+    Crash-atomic: everything lands in a staging dir, the manifest is
+    recorded last, and the staging dir is renamed into place (see the
+    commit protocol in the module docstring). Multi-host pods add the
+    commit-barrier protocol; the save is a COLLECTIVE call there. The
+    `save` fault points between the steps are inert in production and
+    let tests/test_chaos.py kill the save at every interesting boundary.
+
+    With `committer` (async mode) the call returns after staging +
+    Orbax dispatch; flush/barrier/manifest/rename run on the commit
+    thread and `on_committed` (e.g. checkpoint rotation) fires there
+    after a successful commit. The returned path is where the artifact
+    WILL commit; callers needing it durable must drain the committer."""
     with obs.span("checkpoint_save",
-                  hist=obs.histogram("checkpoint_save_seconds",
-                                     "full save: stage + flush + commit")):
-        out = _save_model_inner(model_save_path, state, vocabs, config,
-                                epoch, released)
-    obs.counter("checkpoint_saves_total",
-                "committed checkpoint artifacts").inc()
-    obs.gauge("checkpoint_last_save_unixtime",
-              "wall clock of the last committed save").set_to_current_time()
-    obs.gauge("checkpoint_last_save_epoch",
-              "epoch recorded in the last committed save").set(epoch)
-    return out
+                  hist=obs.histogram(
+                      "checkpoint_save_seconds",
+                      "step-loop save stall: stage + flush + commit "
+                      "(sync) or stage + dispatch (async)")):
+        return _save_model_inner(model_save_path, state, vocabs, config,
+                                 epoch, released, committer, on_committed)
+
+
+def _barrier_timeout_s(config) -> float:
+    return float(getattr(config, "save_barrier_timeout_s", 0)
+                 or DEFAULT_BARRIER_TIMEOUT_S)
 
 
 def _save_model_inner(model_save_path: str, state: TrainState, vocabs,
-                      config, epoch: int, released: bool) -> str:
+                      config, epoch: int, released: bool,
+                      committer: Optional[AsyncCommitter] = None,
+                      on_committed: Optional[Callable[[], None]] = None
+                      ) -> str:
     base = _abs(model_save_path) + (RELEASED_SUFFIX if released else "")
-    staging = f"{base}{STAGING_INFIX}{os.getpid()}"
-    if os.path.isdir(staging):
-        shutil.rmtree(staging)  # leftover from a failed save by this pid
-    os.makedirs(staging)
+    nprocs = distributed.process_count()
+    multi = nprocs > 1
+    ordinal = next(_save_ordinal)  # lockstep: save_model is collective
+    timeout_s = _barrier_timeout_s(config)
+    if multi:
+        # ONE shared staging dir for the whole pod (Orbax's collective
+        # save interleaves every host's shards into the same tree), its
+        # name chosen by process 0 and spread over the coordination KV
+        # store. Process 0 prepares it; the `stage` barrier keeps peers
+        # from writing into a directory that does not exist yet.
+        proposal = (f"{base}{STAGING_INFIX}{_SHARED_STAGING_TAG}"
+                    f"{os.getpid()}" if distributed.process_index() == 0
+                    else None)
+        staging = distributed.broadcast_from_primary(
+            f"c2v:staging:{ordinal}:{os.path.basename(base)}", proposal,
+            timeout_s)
+        if distributed.process_index() == 0:
+            if os.path.isdir(staging):
+                shutil.rmtree(staging)  # leftover from a failed save
+            os.makedirs(staging)
+        distributed.commit_barrier(f"c2v:stage:{ordinal}", timeout_s)
+    else:
+        staging = f"{base}{STAGING_INFIX}{os.getpid()}"
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)  # leftover from a failed save by this pid
+        os.makedirs(staging)
+    committing_host = not multi or distributed.process_index() == 0
     fault_point("save")   # 1: staging created, nothing written
-    vocabs.save(os.path.join(staging, "dictionaries.bin"))
+    if committing_host:
+        vocabs.save(os.path.join(staging, "dictionaries.bin"))
     fault_point("save")   # 2: vocab written, meta missing
-    with open(os.path.join(staging, _META_NAME), "w") as f:
-        json.dump({
-            "released": released,
-            "epoch": epoch,
-            "step": int(np.asarray(state.step)),
-            "token_vocab_size": vocabs.token_vocab.size,
-            "path_vocab_size": vocabs.path_vocab.size,
-            "target_vocab_size": vocabs.target_vocab.size,
-            "token_embeddings_size": config.token_embeddings_size,
-            "path_embeddings_size": config.path_embeddings_size,
-            "separate_oov_and_pad": config.separate_oov_and_pad,
-            # opt_state pytree structure depends on the update mode;
-            # recorded so a mode mismatch fails with a clear error at
-            # restore time instead of an opaque Orbax structure mismatch.
-            "use_sparse_embedding_update": bool(
-                getattr(config, "use_sparse_embedding_update", False)),
-            # Adam moment dtypes shape the opt_state arrays; a restore
-            # into a template with different dtypes can error or silently
-            # cast depending on the Orbax version, so they're recorded
-            # and checked like the sparse-mode flag above.
-            "adam_mu_dtype": str(getattr(config, "adam_mu_dtype", "float32")),
-            "adam_nu_dtype": str(getattr(config, "adam_nu_dtype", "float32")),
-        }, f, indent=2)
+    if committing_host:
+        with open(os.path.join(staging, _META_NAME), "w") as f:
+            json.dump({
+                "released": released,
+                "epoch": epoch,
+                "step": int(np.asarray(state.step)),
+                "token_vocab_size": vocabs.token_vocab.size,
+                "path_vocab_size": vocabs.path_vocab.size,
+                "target_vocab_size": vocabs.target_vocab.size,
+                "token_embeddings_size": config.token_embeddings_size,
+                "path_embeddings_size": config.path_embeddings_size,
+                "separate_oov_and_pad": config.separate_oov_and_pad,
+                # opt_state pytree structure depends on the update mode;
+                # recorded so a mode mismatch fails with a clear error at
+                # restore time instead of an opaque Orbax structure
+                # mismatch.
+                "use_sparse_embedding_update": bool(
+                    getattr(config, "use_sparse_embedding_update", False)),
+                # Adam moment dtypes shape the opt_state arrays; a restore
+                # into a template with different dtypes can error or
+                # silently cast depending on the Orbax version, so they're
+                # recorded and checked like the sparse-mode flag above.
+                "adam_mu_dtype": str(
+                    getattr(config, "adam_mu_dtype", "float32")),
+                "adam_nu_dtype": str(
+                    getattr(config, "adam_nu_dtype", "float32")),
+            }, f, indent=2)
     fault_point("save")   # 3: meta written, Orbax state missing
-    with obs.span("checkpoint_orbax_flush",
-                  hist=obs.histogram(
-                      "checkpoint_orbax_flush_seconds",
-                      "Orbax save + wait_until_finished (the bulk bytes)")):
-        ckptr = ocp.StandardCheckpointer()
-        target = {"params": state.params, "step": state.step}
-        if not released:
-            target["opt_state"] = state.opt_state
-        state_dir = os.path.join(staging, _STATE_DIR)
-        ckptr.save(state_dir, target, force=True)
-        ckptr.wait_until_finished()
-        ckptr.close()
-    fault_point("save")   # 4: Orbax flushed, manifest missing
-    _write_manifest(staging, epoch, released)
-    fault_point("save")   # 5: fully staged, not yet committed
-    _commit_staging(staging, base)
-    if getattr(config, "checkpoint_hash_content", False):
-        # Post-commit by design: the artifact is already durable, so
-        # hashing the multi-GB shards never widens the crash window —
-        # a kill mid-hash leaves a valid artifact without content
-        # hashes (which resume then simply doesn't check).
+    # Orbax dispatch is synchronous in BOTH modes (it snapshots the
+    # arrays); the flush wait is what async mode defers.
+    ckptr = ocp.StandardCheckpointer()
+    target = {"params": state.params, "step": state.step}
+    if not released:
+        target["opt_state"] = state.opt_state
+    state_dir = os.path.join(staging, _STATE_DIR)
+    ckptr.save(state_dir, target, force=True)
+
+    def commit_job():
         try:
-            hash_artifact_content(base)
-        except OSError:
-            # a peer host's commit swapped the artifact mid-hash (the
-            # same race verify_checkpoint degrades gracefully); the
-            # surviving copy is covered by its own writer's hash pass
-            obs.counter(
-                "checkpoint_content_hash_races_total",
-                "post-commit hash passes abandoned because a peer "
-                "swapped the artifact underneath them").inc()
+            with obs.span("checkpoint_orbax_flush",
+                          hist=obs.histogram(
+                              "checkpoint_orbax_flush_seconds",
+                              "Orbax wait_until_finished (the bulk "
+                              "bytes reaching disk)")):
+                ckptr.wait_until_finished()
+        finally:
+            ckptr.close()
+        fault_point("save")   # 4: Orbax flushed, manifest missing
+        fault_point("async_commit")  # deferred commit work begins
+        if multi:
+            fault_point("barrier_enter")
+            with obs.span("checkpoint_commit_barrier",
+                          hist=obs.histogram(
+                              "checkpoint_barrier_wait_seconds",
+                              "wait at the cross-host post-flush commit "
+                              "barrier")):
+                distributed.commit_barrier(f"c2v:commit:{ordinal}",
+                                           timeout_s)
+            # every host survived the flush: ack, then wait for all acks
+            write_commit_ack(staging, distributed.process_index())
+            distributed.commit_barrier(f"c2v:acks:{ordinal}", timeout_s)
+        if committing_host:
+            _write_manifest(staging, epoch, released, process_count=nprocs)
+            fault_point("save")   # 5: fully staged, not yet committed
+            _commit_staging(staging, base)
+        fault_point("callback_crash")  # committed, completion pending
+        if multi:
+            # peers return only once the artifact is liftable at `base`
+            distributed.commit_barrier(f"c2v:committed:{ordinal}",
+                                       timeout_s)
+        if committing_host and getattr(config, "checkpoint_hash_content",
+                                       False):
+            # Post-commit by design: the artifact is already durable, so
+            # hashing the multi-GB shards never widens the crash window —
+            # a kill mid-hash just leaves a valid artifact without
+            # content hashes (which resume then simply doesn't check).
+            try:
+                hash_artifact_content(base)
+            except OSError:
+                # a peer's commit swapped the artifact mid-hash (the same
+                # race verify_checkpoint degrades gracefully); the
+                # surviving copy is covered by its own writer's hash pass
+                obs.counter(
+                    "checkpoint_content_hash_races_total",
+                    "post-commit hash passes abandoned because a peer "
+                    "swapped the artifact underneath them").inc()
+        obs.counter("checkpoint_saves_total",
+                    "committed checkpoint artifacts").inc()
+        obs.gauge("checkpoint_last_save_unixtime",
+                  "wall clock of the last committed save"
+                  ).set_to_current_time()
+        obs.gauge("checkpoint_last_save_epoch",
+                  "epoch recorded in the last committed save").set(epoch)
+        if on_committed is not None:
+            on_committed()
+        return base
+
+    if committer is None:
+        commit_job()
+    else:
+        try:
+            committer.submit(commit_job,
+                             label=f"{os.path.basename(base)}@{ordinal}")
+        except BaseException:
+            # submit resurfaced an EARLIER commit's failure before
+            # accepting this job — but this save's Orbax write is
+            # already dispatched and still streaming into the staging
+            # dir. Settle it before re-raising, or a retry's staging
+            # cleanup races the orphaned background write.
+            try:
+                ckptr.wait_until_finished()
+            except Exception:
+                pass
+            finally:
+                ckptr.close()
+            raise
     return base
 
 

@@ -118,12 +118,20 @@ class Trainer:
                  profile_dir: Optional[str] = None,
                  initial_epoch: int = 0,
                  steps_per_epoch_hint: Optional[int] = None,
-                 stop_fn: Optional[Callable[[], bool]] = None):
+                 stop_fn: Optional[Callable[[], bool]] = None,
+                 commit_drain_fn: Optional[Callable[[], None]] = None):
         self.config = config
         self.train_step = train_step
         self.mesh = mesh
         self.evaluate_fn = evaluate_fn
         self.save_fn = save_fn
+        # Async checkpointing: blocks until every in-flight background
+        # commit finished, re-raising the first failure. Called before
+        # any preemption-path save (the grace-window artifact must land
+        # AFTER — never interleaved with — the pending commit) and in
+        # the loop's finally (a failed async commit must fail the run,
+        # not evaporate with the commit thread).
+        self.commit_drain_fn = commit_drain_fn
         # Early stopping: checked after each epoch-boundary eval. The
         # reference has no in-loop auto-stop but its README recommends
         # training past the best epoch and keeping the best checkpoint
@@ -270,6 +278,19 @@ class Trainer:
             flag = np.array([1.0 if local_stop_flag() else 0.0])
             return bool(distributed.allreduce_host_scalars(flag)[0] > 0)
 
+        def drain_commits(where: str) -> None:
+            """Complete (never abandon) any in-flight async checkpoint
+            commit. On the preemption path a failed commit is logged but
+            must not block the grace-window save — the preempt artifact
+            is about to supersede whatever the commit was writing."""
+            if self.commit_drain_fn is None:
+                return
+            try:
+                self.commit_drain_fn()
+            except Exception as e:
+                log(f"In-flight async checkpoint commit failed during "
+                    f"{where} drain: {type(e).__name__}: {e}")
+
         def save_preempt(state, epoch, suffix="_preempt"):
             if self.save_fn is None:
                 return
@@ -296,10 +317,13 @@ class Trainer:
                         tb.scalar(f"eval/{name}", value, step)
                     tb.flush()
 
-        def write_heartbeat(status: str) -> None:
+        def write_heartbeat(status: str, **extra) -> None:
             """Atomic JSON heartbeat: step/epoch/loss plus a wall-time
             stamp an external watchdog compares against now. Uses only
-            host-side counters — never syncs the device."""
+            host-side counters — never syncs the device. `extra` carries
+            terminal-state detail (the crash's exception class on
+            status=error), so a watchdog can tell a crash from a hang
+            from a preemption without parsing logs."""
             if heartbeat_file is None:
                 return
             obs_exporters.write_heartbeat(
@@ -311,7 +335,8 @@ class Trainer:
                 last_loss=(None if not np.isfinite(last_avg_loss)
                            else last_avg_loss),
                 examples_per_sec=throughput_ema,
-                rss_bytes=current_rss_bytes())
+                rss_bytes=current_rss_bytes(),
+                **extra)
 
         def drain_losses(where: str):
             """Fetch every pending per-batch loss (the one place the host
@@ -360,6 +385,7 @@ class Trainer:
             # scheduler auto-restarting with `--load <base>` resumes
             # the last FINITE artifact instead of crash-looping on the
             # NaN state.
+            drain_commits("NaN halt")
             save_preempt(state, epoch, suffix="_nanhalt")
             self.preempted = True
             self.final_epoch = epoch
@@ -451,6 +477,12 @@ class Trainer:
                     if trace_active:
                         jax.profiler.stop_trace()
                         trace_active = False
+                    # Complete the in-flight async commit FIRST: all
+                    # hosts agreed to stop at the same batch, so every
+                    # host drains the same pending save — deterministic
+                    # cross-host completion — and only then writes the
+                    # (synchronous) preemption artifact.
+                    drain_commits("preemption")
                     save_preempt(state, epoch)
                     log(f"Preemption checkpoint saved (epoch {epoch}, "
                         f"batch {batch_num}); stopping")
@@ -559,11 +591,35 @@ class Trainer:
                     tb.close()
                 except Exception:
                     pass
-            exc_in_flight = sys.exc_info()[0] is not None
+            # Complete any in-flight async checkpoint commit before the
+            # process exits — an abandoned commit thread would leave a
+            # manifest-less staging dir (work lost) or a half-run
+            # protocol (peers stuck at the barrier). A commit failure
+            # with no other exception in flight must fail the run; with
+            # one, it is logged and the original exception wins.
+            commit_error = None
+            if self.commit_drain_fn is not None:
+                try:
+                    self.commit_drain_fn()
+                except Exception as e:
+                    commit_error = e
+                    log(f"Async checkpoint commit failed at drain: "
+                        f"{type(e).__name__}: {e}")
+            exc_type, exc_value, _tb = sys.exc_info()
+            if exc_type is None and commit_error is not None:
+                exc_type, exc_value = type(commit_error), commit_error
+            exc_in_flight = exc_type is not None
             status = ("error" if exc_in_flight
                       else "preempted" if self.preempted else "done")
+            hb_extra = {}
+            if exc_in_flight:
+                # the exception CLASS (and a truncated message) makes an
+                # unhandled crash distinguishable from a hang and from
+                # the clean done/preempted exits in the heartbeat alone
+                hb_extra = {"error_type": exc_type.__name__,
+                            "error_message": str(exc_value)[:300]}
             try:
-                write_heartbeat(status)
+                write_heartbeat(status, **hb_extra)
                 if metrics_file:
                     obs_exporters.write_prometheus(metrics_file,
                                                    registry=reg)
@@ -575,6 +631,8 @@ class Trainer:
                 if not exc_in_flight:
                     raise
             obs_exporters.stop_metrics_server(metrics_server)
+            if commit_error is not None and sys.exc_info()[0] is None:
+                raise commit_error
 
         log("Done training")
         self.final_epoch = epoch

@@ -23,6 +23,20 @@ Spec grammar (comma-separated):
 The spec is parsed lazily on the first `fault_point` call and cached;
 subprocess tests set the env var before the interpreter starts, and
 in-process tests use `reset("...")` / `reset(None)` to (re)arm or disarm.
+
+Fault points in the checkpoint commit protocol (training/checkpoint.py):
+
+- `save` (x5)        — between each staged file (1 staging created,
+                       2 vocab, 3 meta, 4 Orbax flushed, 5 fully staged)
+- `async_commit`     — start of the deferred commit work (post-flush,
+                       pre-barrier); on the commit thread in async mode
+- `barrier_enter`    — immediately before entering the cross-host
+                       post-flush commit barrier (a host killed here
+                       times the barrier out on every survivor)
+- `checkpoint_commit`— staged + barriered, rename pending
+- `checkpoint_swap`  — mid overwrite-swap (the empty-slot window)
+- `callback_crash`   — committed, completion barrier / content-hash
+                       pass still pending
 """
 
 from __future__ import annotations

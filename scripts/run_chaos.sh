@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Run the chaos + multi-process protocol suites with hard timeouts and
+# crash diagnostics.
+#
+# The multi-host tests drive real jax.distributed process pairs; a
+# protocol bug tends to surface as a HANG (a host waiting on a dead
+# peer's collective), so every layer here is timeout-bounded:
+# - each child pair has an in-test subprocess timeout (~150s);
+# - each pytest invocation below gets a wall-clock `timeout` as backstop;
+# - on failure, any heartbeat/metrics snapshot files the children left
+#   under the run dir are dumped so "where was each host when it stopped"
+#   is answerable from CI logs alone.
+#
+# Usage: scripts/run_chaos.sh [extra pytest args...]
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+RUN_DIR="$(mktemp -d "${TMPDIR:-/tmp}/c2v-chaos.XXXXXX")"
+LOG="$RUN_DIR/pytest.log"
+# Children inherit this: tests that export heartbeats/metrics land them
+# where the failure dump below can find them.
+export C2V_CHAOS_DIAG_DIR="$RUN_DIR"
+
+# Per-suite wall-clock backstops (seconds). The suites' own subprocess
+# timeouts fire first; these catch a hang in pytest/collection itself.
+SINGLE_HOST_BUDGET=600
+MULTI_HOST_BUDGET=900
+
+rc=0
+
+run_suite() {
+    local budget="$1"; shift
+    echo "=== $* (budget ${budget}s) ==="
+    timeout -k 20 "$budget" \
+        env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly "$@" 2>&1 | tee -a "$LOG"
+    local suite_rc=${PIPESTATUS[0]}
+    if [ "$suite_rc" -eq 124 ] || [ "$suite_rc" -eq 137 ]; then
+        echo "SUITE TIMED OUT (rc=$suite_rc): likely a protocol hang" \
+            | tee -a "$LOG"
+    fi
+    [ "$suite_rc" -ne 0 ] && rc=$suite_rc
+    return 0
+}
+
+run_suite "$SINGLE_HOST_BUDGET" tests/test_chaos.py "$@"
+run_suite "$MULTI_HOST_BUDGET" tests/test_multihost_chaos.py \
+    tests/test_multiprocess.py "$@"
+
+if [ "$rc" -ne 0 ]; then
+    echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="
+    # heartbeat/metrics snapshots the children left behind: each says
+    # status + step + epoch at the moment its writer stopped
+    find "$RUN_DIR" -maxdepth 4 -type f \
+        \( -name '*heartbeat*.json' -o -name 'hb*.json' \
+           -o -name '*.prom' -o -name '*metrics*' \) 2>/dev/null \
+        | while read -r f; do
+        echo "--- $f ---"
+        cat "$f"
+        echo
+    done
+    echo "full log: $LOG"
+else
+    rm -rf "$RUN_DIR"
+fi
+exit "$rc"

@@ -697,6 +697,431 @@ def test_content_hashing_off_by_default(tmp_path, tiny):
     ckpt_mod.verify_checkpoint(out, check_content=True)
 
 
+# --------------------------------------------- async commit pipeline
+
+def _async_save(base, epoch, tiny, committer, config=None):
+    vocabs, cfg = tiny
+    return ckpt_mod.save_model(f"{base}_iter{epoch}",
+                               chaos_child.build_state(epoch), vocabs,
+                               config or cfg, epoch=epoch,
+                               committer=committer)
+
+
+def test_async_save_commits_and_restores_bit_equal(tmp_path, tiny):
+    """The async pipeline must produce byte-for-byte the same artifact
+    guarantees as the sync path: manifest v2, verifiable, bit-equal
+    restore — with the commit running on the background thread."""
+    import json as json_mod
+    base = str(tmp_path / "m")
+    committer = ckpt_mod.AsyncCommitter(max_in_flight=2)
+    _async_save(base, 1, tiny, committer)
+    _async_save(base, 2, tiny, committer)
+    committer.close()
+    for epoch in (1, 2):
+        ckpt_mod.verify_checkpoint(f"{base}_iter{epoch}")
+        _assert_restores_bit_equal(f"{base}_iter{epoch}", epoch)
+    with open(os.path.join(f"{base}_iter2", ckpt_mod.MANIFEST_NAME)) as f:
+        manifest = json_mod.load(f)
+    assert manifest["format"] == 2
+    assert manifest["process_count"] == 1
+    assert manifest["commit_acks"] == [0]
+
+
+@pytest.mark.parametrize("k", list(range(1, SAVE_FAULT_POINTS + 1)))
+def test_async_crash_at_file_k_falls_back(tmp_path, tiny, k):
+    """The kill-at-every-file-boundary matrix with async commits on:
+    points 1-3 fire in the synchronous staging half (submit-time raise),
+    4-5 on the commit thread (surfaced by drain). Either way the final
+    name never exists half-written and resume lands on `_iter1`."""
+    base = str(tmp_path / "m")
+    committer = ckpt_mod.AsyncCommitter(max_in_flight=2)
+    _async_save(base, 1, tiny, committer)
+    committer.drain()
+    faults.reset(f"save@{k}=raise")
+    with pytest.raises(faults.FaultInjected):
+        _async_save(base, 2, tiny, committer)
+        committer.drain()
+    faults.reset(None)
+    assert not os.path.exists(f"{base}_iter2")
+    leftovers = [p for p in glob.glob(base + "_iter2*")]
+    assert all(ckpt_mod.is_staging_path(p) for p in leftovers)
+    found = ckpt_mod.latest_valid_checkpoint(base)
+    assert found == f"{base}_iter1"
+    _assert_restores_bit_equal(found, 1)
+    committer._executor.shutdown(wait=True)
+
+
+def test_async_commit_error_resurfaces_on_next_submit(tmp_path, tiny):
+    """A commit that failed in the background must fail the NEXT save
+    too (not only the final drain) — the trainer dies at the next epoch
+    boundary instead of silently losing every checkpoint after the
+    first failure."""
+    base = str(tmp_path / "m")
+    committer = ckpt_mod.AsyncCommitter(max_in_flight=2)
+    faults.reset("async_commit=raise")
+    _async_save(base, 1, tiny, committer)
+    deadline = time.time() + 30
+    while committer.in_flight and time.time() < deadline:
+        time.sleep(0.01)   # let the background failure land, unconsumed
+    faults.reset(None)
+    with pytest.raises(faults.FaultInjected):
+        _async_save(base, 2, tiny, committer)  # submit-time resurface
+    # error was consumed; the pipeline is usable again
+    _async_save(base, 2, tiny, committer)
+    committer.close()
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter2"
+
+
+def test_async_committer_backpressure_bounds_inflight():
+    """submit() must block once max_in_flight commits are pending — a
+    slow filesystem cannot queue unbounded half-finished saves."""
+    import threading as th
+    gate = th.Event()
+    started = th.Event()
+    committer = ckpt_mod.AsyncCommitter(max_in_flight=1)
+
+    def slow_job():
+        started.set()
+        gate.wait(30)
+
+    committer.submit(slow_job, "slow")
+    started.wait(5)
+    second_done = th.Event()
+
+    def submit_second():
+        committer.submit(lambda: None, "second")
+        second_done.set()
+
+    t = th.Thread(target=submit_second, daemon=True)
+    t.start()
+    # back-pressure: the second submit must NOT complete while the
+    # first commit still occupies the only slot
+    assert not second_done.wait(0.3)
+    assert committer.in_flight == 1
+    gate.set()
+    assert second_done.wait(10)
+    committer.close()
+    assert committer.in_flight == 0
+
+
+def test_trainer_drains_commits_before_preempt_save(tiny_config):
+    """Preemption with async checkpointing: the in-flight commit is
+    COMPLETED before the grace-window artifact is written (never
+    interleaved, never abandoned)."""
+    tiny_config.num_train_epochs = 1
+    tiny_config.verbose_mode = 0
+    events = []
+
+    def train_step(state, *args):
+        if len([e for e in events if e == "step"]) == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+        events.append("step")
+        return state, np.float32(1.0)
+
+    def save_fn(state, epoch, suffix=""):
+        events.append(("save", suffix))
+
+    trainer = Trainer(tiny_config, train_step, save_fn=save_fn,
+                      commit_drain_fn=lambda: events.append("drain"))
+    trainer.train(_State(), _marker_stream(20, 1),
+                  rng=np.zeros((2,), np.uint32))
+    assert trainer.preempted
+    assert ("save", "_preempt") in events
+    # the drain happened BEFORE the preemption save
+    assert events.index("drain") < events.index(("save", "_preempt"))
+
+
+def test_trainer_finally_drain_failure_fails_the_run(tiny_config):
+    """A background commit failure with an otherwise-clean loop exit
+    must fail the run (exit nonzero), not evaporate with the commit
+    thread — and the heartbeat must say why."""
+    tiny_config.num_train_epochs = 1
+    tiny_config.verbose_mode = 0
+
+    def drain():
+        raise RuntimeError("orbax flush exploded in the background")
+
+    trainer = Trainer(tiny_config, lambda s, *a: (s, np.float32(1.0)),
+                      commit_drain_fn=drain)
+    with pytest.raises(RuntimeError, match="exploded in the background"):
+        trainer.train(_State(), _marker_stream(4, 1),
+                      rng=np.zeros((2,), np.uint32))
+
+
+def test_manifest_incomplete_participant_set_rejected(tmp_path, tiny):
+    """An artifact whose recorded commit-ack set is short of its
+    process_count (a host died between the barrier and the manifest)
+    must fail verification and be walked past by resume."""
+    import json as json_mod
+    base = str(tmp_path / "m")
+    _save(base, 1, tiny)
+    newest = _save(base, 2, tiny)
+    manifest_path = os.path.join(newest, ckpt_mod.MANIFEST_NAME)
+    with open(manifest_path) as f:
+        manifest = json_mod.load(f)
+    manifest["process_count"] = 2          # pretends to be a pod save
+    manifest["commit_acks"] = [0]          # ...with one ack missing
+    with open(manifest_path, "w") as f:
+        json_mod.dump(manifest, f)
+    with pytest.raises(ckpt_mod.CheckpointIntegrityError,
+                       match="participant set"):
+        ckpt_mod.verify_checkpoint(newest)
+    assert ckpt_mod.latest_valid_checkpoint(base) == f"{base}_iter1"
+
+
+def test_format1_manifest_without_participant_fields_still_loads(
+        tmp_path, tiny):
+    """Pre-barrier (format 1) manifests carry no participant record;
+    they must remain loadable, not rejected for missing acks."""
+    import json as json_mod
+    base = str(tmp_path / "m")
+    path = _save(base, 1, tiny)
+    manifest_path = os.path.join(path, ckpt_mod.MANIFEST_NAME)
+    with open(manifest_path) as f:
+        manifest = json_mod.load(f)
+    manifest["format"] = 1
+    del manifest["process_count"]
+    del manifest["commit_acks"]
+    with open(manifest_path, "w") as f:
+        json_mod.dump(manifest, f)
+    ckpt_mod.verify_checkpoint(path)
+    _assert_restores_bit_equal(path, 1)
+
+
+# -------------------------------- heartbeat terminal-state diagnostics
+
+def test_heartbeat_records_error_class_on_unhandled_crash(tiny_config,
+                                                          tmp_path):
+    """An unhandled trainer crash must leave status=error WITH the
+    exception class in the heartbeat — distinguishable from a hang
+    (stale file), a preemption, and a clean exit without log parsing."""
+    import json as json_mod
+    hb = str(tmp_path / "hb.json")
+    tiny_config.heartbeat_file = hb
+    tiny_config.num_train_epochs = 1
+    tiny_config.verbose_mode = 0
+
+    def train_step(state, *args):
+        raise KeyError("poisoned batch layout")
+
+    trainer = Trainer(tiny_config, train_step)
+    with pytest.raises(KeyError):
+        trainer.train(_State(), _marker_stream(4, 1),
+                      rng=np.zeros((2,), np.uint32))
+    with open(hb) as f:
+        beat = json_mod.load(f)
+    assert beat["status"] == "error"
+    assert beat["error_type"] == "KeyError"
+    assert "poisoned batch layout" in beat["error_message"]
+
+
+# ------------------------------- distributed.initialize retry/backoff
+
+def _reset_distributed_initialized():
+    from code2vec_tpu.parallel import distributed
+    distributed._initialized = False
+
+
+def test_initialize_retries_transient_connect_failures(monkeypatch):
+    """A transient coordinator-connect failure must be retried with
+    backoff, NOT silently degrade the host to single-process (which
+    would deadlock its peers' collectives)."""
+    import jax
+    from code2vec_tpu.parallel import distributed
+    _reset_distributed_initialized()
+    attempts, sleeps = [], []
+
+    def flaky_init(**kwargs):
+        attempts.append(kwargs)
+        if len(attempts) < 3:
+            raise RuntimeError("connect refused (coordinator booting)")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    monkeypatch.setattr(
+        "code2vec_tpu.parallel.distributed.time.sleep", sleeps.append)
+    try:
+        distributed.initialize(coordinator_address="host:1234",
+                               num_processes=2, process_id=1)
+        assert len(attempts) == 3
+        assert sleeps == [0.5, 1.0]  # bounded exponential backoff
+        assert distributed._initialized
+    finally:
+        _reset_distributed_initialized()
+
+
+def test_initialize_explicit_coordinator_raises_after_retries(monkeypatch):
+    import jax
+    from code2vec_tpu.parallel import distributed
+    _reset_distributed_initialized()
+    attempts, sleeps = [], []
+
+    def dead_init(**kwargs):
+        attempts.append(1)
+        raise RuntimeError("coordinator is gone")
+
+    monkeypatch.setattr(jax.distributed, "initialize", dead_init)
+    monkeypatch.setattr(
+        "code2vec_tpu.parallel.distributed.time.sleep", sleeps.append)
+    try:
+        with pytest.raises(RuntimeError, match="coordinator is gone"):
+            distributed.initialize(coordinator_address="host:1234")
+        assert len(attempts) == distributed._INIT_ATTEMPTS
+        assert not distributed._initialized
+    finally:
+        _reset_distributed_initialized()
+
+
+def test_initialize_auto_detect_degrades_only_after_retries(monkeypatch):
+    """The TPU-pod auto-detection path keeps its single-process
+    fallback, but only AFTER the bounded retries are exhausted."""
+    import jax
+    from code2vec_tpu.parallel import distributed
+    _reset_distributed_initialized()
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1")
+    attempts = []
+
+    def dead_init(**kwargs):
+        attempts.append(1)
+        raise RuntimeError("no coordinator here")
+
+    monkeypatch.setattr(jax.distributed, "initialize", dead_init)
+    monkeypatch.setattr(
+        "code2vec_tpu.parallel.distributed.time.sleep", lambda s: None)
+    try:
+        distributed.initialize()  # must not raise: degrades
+        assert len(attempts) == distributed._INIT_ATTEMPTS
+        assert not distributed._initialized
+    finally:
+        _reset_distributed_initialized()
+
+
+# -------------------------------------- extractor launch/crash retries
+
+def test_extractor_retries_transient_crash_then_succeeds(tmp_path):
+    """A crashed extractor child (transient OOM/fork pressure) is
+    retried with backoff and the call succeeds; the failure counter
+    records the retried attempts under retried=yes."""
+    from code2vec_tpu import obs
+    marker = tmp_path / "attempts"
+    ex = _extractor(tmp_path)
+    ex.retries = 3
+    ex._RETRY_BACKOFF_BASE_S = 0.01
+    ex._build_command = lambda path: [
+        sys.executable, "-c",
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "if n < 2:\n"
+        "    sys.stderr.write('transient OOM'); sys.exit(137)\n"
+        "print('target ctx,1,ctx')"]
+    before = _failure_count("yes")
+    result, _hashes = ex.extract_paths("whatever.java")
+    assert len(result) == 1 and result[0].startswith("target")
+    assert int(marker.read_text()) == 3      # 2 failures + 1 success
+    assert _failure_count("yes") - before == 2
+
+
+def _failure_count(retried: str) -> float:
+    from code2vec_tpu import obs
+    metrics = obs.default_registry().collect()
+    children = metrics.get("extractor_failures_total", {})
+    child = children.get((("retried", retried),))
+    return float(child.value) if child is not None else 0.0
+
+
+def test_extractor_exhausted_retries_surface_final_failure(tmp_path):
+    ex = _extractor(tmp_path)
+    ex.retries = 1
+    ex._RETRY_BACKOFF_BASE_S = 0.01
+    ex._build_command = lambda path: [
+        sys.executable, "-c",
+        "import sys; sys.stderr.write('persistent crash'); sys.exit(139)"]
+    before_no = _failure_count("no")
+    before_yes = _failure_count("yes")
+    with pytest.raises(ValueError, match="persistent crash"):
+        ex.extract_paths("whatever.java")
+    assert _failure_count("no") - before_no == 1    # the surfaced failure
+    assert _failure_count("yes") - before_yes == 1  # the retried attempt
+
+
+def test_extractor_deterministic_rejection_not_retried(tmp_path):
+    """A plain nonzero diagnostic exit (the extractor REJECTING its
+    input, e.g. unparseable Java) would fail identically on every
+    retry: it must surface immediately, without the crash-retry
+    latency, and count as a non-retried failure."""
+    ex = _extractor(tmp_path)
+    ex.retries = 5
+    calls = []
+    real_inner = ex._extract_paths_inner
+
+    def counting_inner(path):
+        calls.append(1)
+        return real_inner(path)
+
+    ex._extract_paths_inner = counting_inner
+    ex._build_command = lambda path: [
+        sys.executable, "-c",
+        "import sys; sys.stderr.write('syntax error at line 3'); "
+        "sys.exit(2)"]
+    before_no = _failure_count("no")
+    with pytest.raises(ValueError, match="syntax error") as ei:
+        ex.extract_paths("bad.java")
+    from code2vec_tpu.serving.extractor_bridge import ExtractorCrash
+    assert not isinstance(ei.value, ExtractorCrash)
+    assert len(calls) == 1                          # no retries
+    assert _failure_count("no") - before_no == 1
+
+
+def test_extractor_timeout_is_never_retried(tmp_path):
+    """A hung child already cost a full timeout; retrying would likely
+    hang again — the timeout path keeps its own policy."""
+    ex = _extractor(tmp_path, timeout=0.5)
+    ex.retries = 5
+    calls = []
+    real_inner = ex._extract_paths_inner
+
+    def counting_inner(path):
+        calls.append(1)
+        return real_inner(path)
+
+    ex._extract_paths_inner = counting_inner
+    ex._build_command = lambda path: [
+        sys.executable, "-c", "import time; time.sleep(600)"]
+    from code2vec_tpu.serving.extractor_bridge import ExtractionTimeout
+    with pytest.raises(ExtractionTimeout):
+        ex.extract_paths("whatever.java")
+    assert len(calls) == 1
+
+
+def test_extractor_retries_config_plumbing():
+    from code2vec_tpu.serving.extractor_bridge import PathExtractor
+    config = Config(max_contexts=4, train_data_path_prefix="x",
+                    extractor_retries=7)
+    assert PathExtractor(config).retries == 7
+    assert PathExtractor(config, retries=0).retries == 0
+    with pytest.raises(ValueError, match="extractor_retries"):
+        Config(train_data_path_prefix="x", extractor_retries=-1).verify()
+
+
+def test_new_cli_flags_roundtrip():
+    from code2vec_tpu.cli import config_from_args
+    cfg = config_from_args(["--data", "d", "--async_checkpointing",
+                            "--save_barrier_timeout", "33",
+                            "--extractor_retries", "5"])
+    assert cfg.async_checkpointing is True
+    assert cfg.save_barrier_timeout_s == 33.0
+    assert cfg.extractor_retries == 5
+    cfg = config_from_args(["--data", "d"])
+    assert cfg.async_checkpointing is False
+    assert cfg.save_barrier_timeout_s == 600.0    # config.py default
+    assert cfg.extractor_retries == 2
+    with pytest.raises(ValueError, match="save_barrier_timeout_s"):
+        Config(train_data_path_prefix="x",
+               save_barrier_timeout_s=0).verify()
+
+
 def test_verify_degrades_when_file_vanishes_mid_probe(tmp_path, tiny,
                                                       monkeypatch):
     """A manifest-listed file that disappears BETWEEN the isfile() check
