@@ -120,6 +120,13 @@ def arguments_parser() -> ArgumentParser:
                              "config.py's 600); on expiry the save "
                              "fails loudly instead of hanging the pod "
                              "on a dead peer")
+    parser.add_argument("--no_cursor_resume", action="store_true",
+                        help="ignore the checkpoint's saved data cursor "
+                             "and re-run an interrupted epoch from its "
+                             "start instead of skipping the rows it "
+                             "already consumed (cursor resume works on "
+                             "any host count; see README 'Elastic "
+                             "resume')")
     parser.add_argument("--preprocess_workers", type=int, default=0,
                         metavar="N",
                         help="host worker processes for the on-demand "
@@ -184,6 +191,7 @@ def config_from_args(argv=None) -> Config:
                                     "save_barrier_timeout_s")
            if (value := getattr(args, knob)) is not None},
         async_checkpointing=args.async_checkpointing,
+        cursor_resume=not args.no_cursor_resume,
         seed=args.seed,
         use_packed_data=not args.no_packed_data,
         preprocess_workers=args.preprocess_workers,

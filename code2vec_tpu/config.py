@@ -71,6 +71,15 @@ class Config:
     # milliseconds; stragglers flushing multi-GB shards to cold storage
     # are the long tail it must tolerate.
     save_barrier_timeout_s: float = 600.0
+    # Resume the input pipeline from the checkpoint's data cursor
+    # (manifest v3 `data_cursor`): a run resumed from a mid-epoch
+    # (preemption) artifact skips the global rows the interrupted epoch
+    # already consumed — remapped exactly onto the current host count —
+    # so the pass neither skips nor double-reads rows. False re-runs the
+    # interrupted epoch from its start (the pre-v3 behavior). Only the
+    # packed (.c2vb) pipeline supports the cursor; the streaming text
+    # reader always restarts the epoch. No reference analog.
+    cursor_resume: bool = True
     train_batch_size: int = 1024
     test_batch_size: int = 1024
     top_k_words_considered_during_prediction: int = 10

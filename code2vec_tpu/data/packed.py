@@ -645,12 +645,35 @@ def pack_raw(raw_path: str, out_path: str, vocabs: Code2VecVocabs,
     return n_rows
 
 
+def _epoch_rng(seed: int, epoch: int) -> np.random.Generator:
+    """Permutation RNG for one absolute epoch index: a pure function of
+    (seed, epoch), identical on every host and across resume boundaries.
+    This keying is what makes the training order ELASTIC — a run resumed
+    at epoch e (on any host count) draws exactly the permutation the
+    uninterrupted run would have used for epoch e, instead of restarting
+    a stateful RNG chain from the seed."""
+    return np.random.default_rng(np.random.SeedSequence(
+        [int(seed) & 0x7FFFFFFFFFFFFFFF, int(epoch) & 0x7FFFFFFFFFFFFFFF]))
+
+
 class PackedDataset:
     """Zero-copy view over a `.c2vb` file with batched iteration.
 
     Training iteration uses a full random permutation per epoch (strictly
     better shuffling than the reference's 10K-element buffer,
     path_context_reader.py:139) and yields fixed-size batches.
+
+    The TRAINING order is host-count invariant: the row filter and the
+    per-epoch permutation are computed over the GLOBAL row set (identical
+    on every host), and host h of M takes the strided slice
+    `perm[h::M]`, truncated so every host yields the same batch count.
+    Global batch b therefore always consumes rows
+    `perm[b*Bg:(b+1)*Bg]` (Bg = batch_size * num_shards) as a SET,
+    whatever M is — which is what lets a checkpoint's data cursor
+    (global row ordinal) be remapped exactly onto a different host
+    count: no row skipped, none double-read. Evaluation keeps the plain
+    per-host strided file order (metrics are global sums; order and
+    grouping don't matter there).
     """
 
     @staticmethod
@@ -688,7 +711,10 @@ class PackedDataset:
                 raise ValueError(
                     f"{path} was packed with different vocabularies "
                     f"(fingerprint {meta.get('vocab_fingerprint')} != {fp}); re-pack it.")
-        # Host shard: disjoint strided row subset.
+        # Host shard: disjoint strided row subset (evaluation order;
+        # training strides the per-epoch GLOBAL permutation instead).
+        self.shard_index = shard_index
+        self.num_shards = num_shards
         self.row_ids = np.arange(shard_index, n, num_shards)
         self._target_strings: Optional[List[str]] = None
         self._filtered_cache: dict = {}
@@ -734,21 +760,15 @@ class PackedDataset:
             target_strings=strings,
         )
 
-    def _filtered_row_ids(self, estimator_action: EstimatorAction) -> np.ndarray:
-        """Apply the reference row filter once, vectorized over the memmap.
-        Cached per action: the result is immutable for a given file, and
-        both `steps_per_epoch` and `iter_batches` need it (mid-epoch eval
-        calls both every firing — one O(rows) scan, not two)."""
-        cached = self._filtered_cache.get(estimator_action)
-        if cached is not None:
-            return cached
+    def _filter_rows(self, rows: np.ndarray,
+                     estimator_action: EstimatorAction) -> np.ndarray:
         m = self.max_contexts
         token_pad = self.vocabs.token_vocab.pad_index
         path_pad = self.vocabs.path_vocab.pad_index
         keep_chunks = []
-        for start in range(0, len(self.row_ids), 1 << 18):
-            rows = self.row_ids[start:start + (1 << 18)]
-            rec = self._rec[rows]
+        for start in range(0, len(rows), 1 << 18):
+            chunk = rows[start:start + (1 << 18)]
+            rec = self._rec[chunk]
             src = rec[:, 1:1 + m]
             pth = rec[:, 1 + m:1 + 2 * m]
             tgt = rec[:, 1 + 2 * m:]
@@ -756,40 +776,108 @@ class PackedDataset:
                          | (pth != path_pad)).any(axis=1)
             if estimator_action.is_train:
                 any_valid &= rec[:, 0] > self.vocabs.target_vocab.oov_index
-            keep_chunks.append(rows[any_valid])
-        out = (np.concatenate(keep_chunks) if keep_chunks
-               else np.empty((0,), np.int64))
-        self._filtered_cache[estimator_action] = out
-        return out
+            keep_chunks.append(chunk[any_valid])
+        return (np.concatenate(keep_chunks) if keep_chunks
+                else np.empty((0,), np.int64))
+
+    def _filtered_row_ids(self, estimator_action: EstimatorAction) -> np.ndarray:
+        """Apply the reference row filter once over this host's strided
+        shard, vectorized over the memmap. Cached per action: the result
+        is immutable for a given file, and both `steps_per_epoch` and
+        `iter_batches` need it (mid-epoch eval calls both every firing —
+        one O(rows) scan, not two)."""
+        cached = self._filtered_cache.get(estimator_action)
+        if cached is None:
+            cached = self._filter_rows(self.row_ids, estimator_action)
+            self._filtered_cache[estimator_action] = cached
+        return cached
+
+    def _global_filtered_row_ids(
+            self, estimator_action: EstimatorAction) -> np.ndarray:
+        """The row filter over ALL rows — identical on every host, the
+        basis of the host-count-invariant training order. One shard is
+        the global set already; multi-host pays a full-file scan once
+        (cached), the price of an order every topology can agree on."""
+        if self.num_shards == 1:
+            return self._filtered_row_ids(estimator_action)
+        key = ("global", estimator_action)
+        cached = self._filtered_cache.get(key)
+        if cached is None:
+            cached = self._filter_rows(
+                np.arange(self.num_rows_total, dtype=np.int64),
+                estimator_action)
+            self._filtered_cache[key] = cached
+        return cached
 
     def steps_per_epoch(self, batch_size: int,
-                        estimator_action: EstimatorAction) -> int:
+                        estimator_action: EstimatorAction,
+                        skip_rows: int = 0) -> int:
         """Exact number of batches one data pass yields (post-filter) —
         unlike the reference's raw-line `train_steps_per_epoch`
         (config.py:165-167), this counts the rows the trainer will
-        actually consume."""
-        n = len(self._filtered_row_ids(estimator_action))
+        actually consume. Training counts are identical on EVERY host by
+        construction (global row set // global batch). `skip_rows`
+        (training only) is a resume cursor: the count of the epoch's
+        remaining batches after the already-consumed global rows."""
         if estimator_action.is_train:
-            return n // batch_size
+            n = len(self._global_filtered_row_ids(estimator_action))
+            steps = n // (batch_size * self.num_shards)
+            if skip_rows:
+                skip_local = min(skip_rows // self.num_shards,
+                                 steps * batch_size)
+                return (steps * batch_size - skip_local) // batch_size
+            return steps
+        n = len(self._filtered_row_ids(estimator_action))
         return -(-n // batch_size)  # eval pads the tail batch
 
     def iter_batches(self, batch_size: int, estimator_action: EstimatorAction,
                      num_epochs: int = 1, seed: int = 0,
                      repeat_endlessly: bool = False,
                      with_target_strings: bool = False,
-                     yield_epoch_markers: bool = False) -> Iterator[RowBatch]:
+                     yield_epoch_markers: bool = False,
+                     start_epoch: int = 0,
+                     skip_rows: int = 0) -> Iterator[RowBatch]:
+        """Batched iteration. Training epochs shuffle with the
+        epoch-keyed permutation (absolute epoch index `start_epoch + k`)
+        over the GLOBAL filtered row set, strided per host — see the
+        class docstring. `start_epoch` makes a resumed run continue the
+        exact permutation sequence of an uninterrupted one; `skip_rows`
+        drops the first epoch's already-consumed global rows (this
+        host's share: skip_rows // num_shards), the data-cursor remap
+        for elastic resume. EpochEnd markers stay 1-based RELATIVE
+        counts (the trainer adds its initial epoch)."""
+        if estimator_action.is_train:
+            rows = self._global_filtered_row_ids(estimator_action)
+            steps = len(rows) // (batch_size * self.num_shards)
+            epoch = 0
+            while repeat_endlessly or epoch < num_epochs:
+                perm = _epoch_rng(seed, start_epoch + epoch).permutation(rows)
+                # Truncate BEFORE striding: every host sees the same
+                # steps*batch_size sequence length, so batch counts are
+                # lockstep by construction and the global batch set is
+                # exactly perm[:steps*Bg].
+                seq = perm[self.shard_index::self.num_shards][
+                    :steps * batch_size]
+                if epoch == 0 and skip_rows:
+                    seq = seq[skip_rows // self.num_shards:]
+                n_full = (len(seq) // batch_size) * batch_size
+                for start in range(0, n_full, batch_size):
+                    yield self.gather(seq[start:start + batch_size],
+                                      with_target_strings)
+                epoch += 1
+                if yield_epoch_markers:
+                    yield EpochEnd(epoch)
+            return
         rows = self._filtered_row_ids(estimator_action)
-        rng = np.random.default_rng(seed)
         epoch = 0
         while repeat_endlessly or epoch < num_epochs:
-            order = rng.permutation(rows) if estimator_action.is_train else rows
-            n_full = (len(order) // batch_size) * batch_size
+            n_full = (len(rows) // batch_size) * batch_size
             for start in range(0, n_full, batch_size):
-                yield self.gather(order[start:start + batch_size],
+                yield self.gather(rows[start:start + batch_size],
                                   with_target_strings)
-            tail = len(order) - n_full
-            if tail and not estimator_action.is_train:
-                batch = self.gather(order[n_full:], with_target_strings)
+            tail = len(rows) - n_full
+            if tail:
+                batch = self.gather(rows[n_full:], with_target_strings)
                 yield reader_mod._pad_rows(batch, batch_size)
             epoch += 1
             if yield_epoch_markers:

@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import hashlib
 import os
 import random
+import struct
 import time
 from typing import Iterable, Iterator, List, Optional, Sequence
 
@@ -287,6 +289,18 @@ def _iter_file_lines(path: str, shard_index: int, num_shards: int,
             yield line
 
 
+def _epoch_shuffle_rng(seed: int, epoch: int) -> random.Random:
+    """Shuffle RNG for one absolute epoch index: a stable blake2b hash
+    of (seed, epoch), NOT a tuple seed (tuple seeding routes through
+    hash(), which PYTHONHASHSEED randomizes across processes). Keyed
+    per epoch so a resumed run shuffles epoch e exactly like an
+    uninterrupted run would — the text-reader counterpart of the packed
+    dataset's elastic epoch-keyed permutation."""
+    digest = hashlib.blake2b(struct.pack("<qq", seed, epoch),
+                             digest_size=16).digest()
+    return random.Random(int.from_bytes(digest, "little"))
+
+
 class PathContextReader:
     """Streaming batched reader with reference-equivalent semantics.
 
@@ -306,7 +320,8 @@ class PathContextReader:
                  parse_chunk_lines: int = 4096,
                  batch_size: Optional[int] = None,
                  num_epochs: Optional[int] = None,
-                 yield_epoch_markers: bool = False):
+                 yield_epoch_markers: bool = False,
+                 start_epoch: int = 0):
         self.vocabs = vocabs
         self.config = config
         self.estimator_action = estimator_action
@@ -326,7 +341,11 @@ class PathContextReader:
         # `.repeat(epochs).shuffle(buffer)` pipeline has
         # (path_context_reader.py:134-139).
         self.yield_epoch_markers = yield_epoch_markers
-        self._rng = random.Random(config.seed)
+        # Absolute index of the first epoch this reader will stream
+        # (resumed runs pass their completed-epoch count): the shuffle
+        # RNG is keyed per absolute epoch, so the resumed pass orders
+        # its lines exactly as an uninterrupted run would have.
+        self.start_epoch = start_epoch
 
     # ------------------------------------------------------------------
 
@@ -364,19 +383,21 @@ class PathContextReader:
         buf_size = self.config.shuffle_buffer_size
         epoch = 0
         while epochs is None or epoch < epochs:
+            rng = _epoch_shuffle_rng(self.config.seed,
+                                     self.start_epoch + epoch)
             for line in _iter_file_lines(self.data_path, self.shard_index,
                                          self.num_shards,
                                          self.config.csv_buffer_size):
                 if len(buf) < buf_size:
                     buf.append(line)
                     continue
-                j = self._rng.randrange(buf_size)
+                j = rng.randrange(buf_size)
                 out, buf[j] = buf[j], line
                 yield out
             epoch += 1
             if epochs is not None and epoch == epochs:
                 # drain the buffer before the final marker
-                self._rng.shuffle(buf)
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
             yield EpochEnd(epoch)

@@ -38,6 +38,7 @@ from code2vec_tpu.training.state import (
     TrainState, create_train_state, dropout_rng, make_optimizer, num_params,
 )
 from code2vec_tpu.training.step import TrainStepBuilder, device_put_batch
+from code2vec_tpu.utils.faults import fault_point
 from code2vec_tpu.vocab import Code2VecVocabs, VocabType
 
 
@@ -56,14 +57,39 @@ class Code2VecModel:
         config.verify()
         self.log = config.log
         self.log("Creating code2vec TPU model")
+        # Resume provenance, surfaced in the heartbeat, the metrics
+        # registry and the log: which artifacts resume considered and
+        # rejected (and why), and whether the restore was exact,
+        # resharded (different host count / mesh shape than at save
+        # time) or the run started fresh. A rejected artifact must
+        # never silently become a fresh start.
+        self.resume_report: Dict = {"resume_mode": "fresh",
+                                    "restored_step": None,
+                                    "restored_epoch": None,
+                                    "rejected": []}
+        self._resume_cursor: Optional[Dict] = None
+        # Set by _train_batches when a cursor skip is applied: the epoch
+        # it applies to and the global rows skipped (save_fn adds them
+        # back into cursors recorded within that same epoch).
+        self._applied_skip_rows = 0
+        self._applied_skip_epoch: Optional[int] = None
         if config.is_loading:
             # `--load` accepts either a concrete artifact directory or a
             # save base: a base resolves to the newest artifact that
             # PASSES its integrity check (walking past any half-written
             # casualty of a mid-save kill). Resolved before vocab
             # loading — dictionaries.bin comes from the same directory.
+            trail: List[Dict] = []
             resolved = ckpt_mod.resolve_load_path(config.model_load_path,
-                                                  log=self.log)
+                                                  log=self.log, trail=trail)
+            rejected = [t for t in trail if t["outcome"] == "rejected"]
+            self.resume_report["rejected"] = rejected
+            for t in rejected:
+                self.log(f"Resume REJECTED candidate {t['path']}: "
+                         f"{t['reason']}")
+            if rejected:
+                self.log(f"Resume fell back past {len(rejected)} "
+                         f"rejected artifact(s) to {resolved}")
             if resolved != os.path.abspath(config.model_load_path):
                 self.log(f"Resolved --load {config.model_load_path} -> "
                          f"{resolved}")
@@ -96,13 +122,45 @@ class Code2VecModel:
             # --release discards the optimizer state, so it loads
             # params-only and must not run the optimizer layout/dtype
             # guards (it is their advertised escape hatch)
+            report: Dict = {}
             self.state = ckpt_mod.load_model(config.model_load_path,
                                              self.state, config=config,
-                                             params_only=config.release)
+                                             params_only=config.release,
+                                             report=report)
             meta = ckpt_mod.load_model_meta(config.model_load_path)
             self.initial_epoch = int(meta.get("epoch", 0))
+            mode = report.get("resume_mode", "exact")
+            self.resume_report.update(
+                resume_mode=mode,
+                restored_step=report.get("restored_step"),
+                restored_epoch=self.initial_epoch)
+            cursor = report.get("data_cursor")
+            # A cursor only applies to the epoch it was recorded in; a
+            # stale/foreign cursor (hand-moved artifact) is ignored.
+            if (isinstance(cursor, dict)
+                    and int(cursor.get("epoch", -1)) == self.initial_epoch):
+                self._resume_cursor = cursor
+            saved_plan = MeshPlan.from_dict(report.get("saved_mesh_plan"))
+            if mode == "resharded":
+                self.log(
+                    f"RESHARDED restore: artifact was saved by "
+                    f"{report.get('saved_process_count', '?')} process(es) "
+                    f"at mesh {saved_plan.describe()}; restoring onto "
+                    f"{distributed.process_count()} process(es) at mesh "
+                    f"dp={config.dp} tp={config.tp} cp={config.cp} via "
+                    f"current-mesh abstract restore targets")
+            obs.counter("resume_total",
+                        "model restores by topology relationship",
+                        mode=mode).inc()
+            if report.get("restored_step") is not None:
+                obs.gauge("resume_restored_step",
+                          "global step of the restored artifact"
+                          ).set(report["restored_step"])
+            obs.gauge("resume_restored_epoch",
+                      "epoch recorded in the restored artifact"
+                      ).set(self.initial_epoch)
             self.log(f"Loaded model weights from {config.model_load_path} "
-                     f"(epoch {self.initial_epoch})")
+                     f"(epoch {self.initial_epoch}, resume mode: {mode})")
         self._eval_step = None
         self._predict_step = None
         # Async checkpoint commit pipeline; created by _make_save_fn when
@@ -189,17 +247,27 @@ class Code2VecModel:
                      f"to train. Raise --epochs to continue.")
         if config.use_packed_data:
             ds = self._packed_dataset(config.train_data_path)
+            skip_rows = self._cursor_skip_rows()
+            # Remembered for save_fn: a SECOND preemption inside the
+            # resumed (still-incomplete) epoch must record the restored
+            # skip PLUS the new batches — the trainer's batch_in_epoch
+            # restarts at 0 on resume and cannot know about the skip.
+            self._applied_skip_rows = skip_rows
+            self._applied_skip_epoch = (self.initial_epoch if skip_rows
+                                        else None)
             local_steps = ds.steps_per_epoch(batch_size, EstimatorAction.Train)
             batches = ds.iter_batches(batch_size,
                                       EstimatorAction.Train,
                                       num_epochs=epochs_to_run,
                                       seed=config.seed,
-                                      yield_epoch_markers=True)
+                                      yield_epoch_markers=True,
+                                      start_epoch=self.initial_epoch,
+                                      skip_rows=skip_rows)
             if jax.process_count() > 1:
-                # Lockstep contract: hosts filter their shards
-                # independently, so post-filter batch counts can differ;
-                # every collective in the loop assumes they don't. Agree
-                # the min up front and truncate each host's epochs to it.
+                # Lockstep contract: the elastic global order makes the
+                # per-host batch counts equal by construction, but the
+                # agreement stays as the desync tripwire (a host reading
+                # a different file/vocab would silently diverge here).
                 agreed = distributed.agree_scalar(local_steps, "min")
                 if agreed == 0:
                     raise RuntimeError(
@@ -210,18 +278,80 @@ class Code2VecModel:
                 if agreed != local_steps:
                     self.log(f"Host feeds {agreed}/{local_steps} local "
                              f"batches per epoch (pod-agreed minimum)")
+                if skip_rows:
+                    first_steps = ds.steps_per_epoch(
+                        batch_size, EstimatorAction.Train,
+                        skip_rows=skip_rows)
+                    agreed_first = distributed.agree_scalar(first_steps,
+                                                            "min")
+                else:
+                    agreed_first = agreed
                 self._steps_per_epoch = agreed
-                return distributed.lockstep_train_stream(batches, agreed)
+                return distributed.lockstep_train_stream(
+                    batches, agreed, first_epoch_steps=agreed_first)
+            # steps_per_epoch_hint stays the FULL-epoch count: only the
+            # resumed partial epoch's ETA line transiently overestimates
+            # (cosmetic); every later epoch needs the full count.
             self._steps_per_epoch = local_steps
             return batches
         self._require_single_process("training from raw .c2v text")
+        if self._resume_cursor and self._resume_cursor.get(
+                "global_row_ordinal"):
+            self.log("Saved data cursor ignored: the streaming text "
+                     "reader cannot seek mid-epoch; re-running the "
+                     "interrupted epoch from its start (pack the dataset "
+                     "for cursor resume)")
         shard_index, num_shards = distributed.host_shard()
         return PathContextReader(self.vocabs, config, EstimatorAction.Train,
                                  shard_index=shard_index,
                                  num_shards=num_shards,
                                  batch_size=batch_size,
                                  num_epochs=epochs_to_run,
-                                 yield_epoch_markers=True)
+                                 yield_epoch_markers=True,
+                                 start_epoch=self.initial_epoch)
+
+    def _cursor_skip_rows(self) -> int:
+        """Remap the restored artifact's data cursor (global rows the
+        interrupted epoch consumed) onto the CURRENT host count: each
+        host will skip its stride's share (skip_rows // num_hosts) of
+        the epoch's global permutation — which is exactly the set of
+        rows the old topology already trained on, since the global
+        order is host-count invariant. Returns 0 when there is no
+        cursor, it is disabled, or the save was at an epoch boundary."""
+        config = self.config
+        cursor = self._resume_cursor
+        if not cursor or not getattr(config, "cursor_resume", True):
+            if cursor and cursor.get("global_row_ordinal"):
+                self.log("cursor_resume disabled: re-running the "
+                         "interrupted epoch from its start")
+            return 0
+        skip = int(cursor.get("global_row_ordinal", 0) or 0)
+        if skip <= 0:
+            return 0
+        fault_point("cursor_remap")
+        nshards = distributed.process_count()
+        # Round DOWN to a multiple of the CURRENT global batch: re-reading
+        # a few rows is safe, skipping unseen ones is not. Host-count
+        # divisibility alone is not enough — a per-host skip that is not
+        # a multiple of the LOCAL batch would leave the epoch's remaining
+        # sequence batch-misaligned, and the ragged-tail truncation would
+        # silently drop never-trained rows at the epoch's end.
+        global_bs = config.train_batch_size
+        if skip % global_bs:
+            adjusted = (skip // global_bs) * global_bs
+            self.log(f"Data cursor {skip} (saved at global batch size "
+                     f"{cursor.get('global_batch_size', '?')}) is not a "
+                     f"multiple of the current global batch {global_bs}; "
+                     f"rounding down to {adjusted} (re-reads "
+                     f"{skip - adjusted} row(s))")
+            skip = adjusted
+        self.log(f"Cursor resume: epoch {self.initial_epoch + 1} "
+                 f"continues after {skip} already-consumed global rows "
+                 f"({skip // nshards} rows of this host's stride)")
+        obs.gauge("resume_cursor_skip_rows",
+                  "global rows the resumed epoch skipped as "
+                  "already-consumed").set(skip)
+        return skip
 
     def _require_single_process(self, what: str) -> None:
         """Multi-host training/eval requires packed data: the streaming
@@ -277,7 +407,13 @@ class Code2VecModel:
                           initial_epoch=self.initial_epoch,
                           steps_per_epoch_hint=self._steps_per_epoch,
                           commit_drain_fn=(committer.drain if committer
-                                           else None))
+                                           else None),
+                          heartbeat_extra={
+                              "resume_mode":
+                                  self.resume_report["resume_mode"],
+                              "restored_step":
+                                  self.resume_report["restored_step"],
+                          })
         try:
             self.state = trainer.train(self.state, batches,
                                        dropout_rng(config))
@@ -315,18 +451,33 @@ class Code2VecModel:
         else:
             self._committer = None
 
-        def save_fn(state, epoch, suffix=""):
+        def save_fn(state, epoch, suffix="", cursor_rows=0):
             # suffix="_preempt" (preemption checkpoints) keeps the save
             # from clobbering the clean end-of-epoch _iter<N> artifact
-            # whose metrics the eval log refers to.
+            # whose metrics the eval log refers to. cursor_rows (global
+            # rows the in-flight epoch consumed; 0 at epoch boundaries)
+            # becomes the manifest's data cursor, so an elastic resume
+            # on ANY host count can continue the pass without skipping
+            # or double-reading rows.
             path = f"{config.model_save_path}_iter{epoch}{suffix}"
+            ordinal = int(cursor_rows)
+            if epoch == getattr(self, "_applied_skip_epoch", None):
+                # Still inside the epoch this run RESUMED mid-pass: the
+                # trainer's batch counter restarted at 0, so the rows
+                # skipped at resume must be added back or a second
+                # preemption would record an undercounted cursor (and
+                # the next resume would double-read the difference).
+                ordinal += self._applied_skip_rows
+            cursor = {"epoch": epoch,
+                      "global_row_ordinal": ordinal,
+                      "global_batch_size": config.train_batch_size}
             if suffix or self._committer is None:
                 # Preemption/NaN-halt saves stay SYNCHRONOUS even in
                 # async mode: the grace window ends at process exit, so
                 # the artifact must be committed before save_fn returns
                 # (the trainer drains in-flight commits first).
                 ckpt_mod.save_model(path, state, self.vocabs, config,
-                                    epoch=epoch)
+                                    epoch=epoch, data_cursor=cursor)
                 self.log(f"Saved after {epoch} epochs in: {path}")
                 if not suffix:
                     self._rotate_epoch_checkpoints()
@@ -337,7 +488,8 @@ class Code2VecModel:
                 # off the step path.
                 ckpt_mod.save_model(path, state, self.vocabs, config,
                                     epoch=epoch, committer=self._committer,
-                                    on_committed=self._rotate_epoch_checkpoints)
+                                    on_committed=self._rotate_epoch_checkpoints,
+                                    data_cursor=cursor)
                 self.log(f"Save after {epoch} epochs dispatched to the "
                          f"async commit pipeline: {path}")
 
@@ -520,7 +672,12 @@ class Code2VecModel:
     def save(self, model_save_path: Optional[str] = None) -> str:
         path = model_save_path or self.config.model_save_path
         return ckpt_mod.save_model(path, self.state, self.vocabs, self.config,
-                                   epoch=self.initial_epoch)
+                                   epoch=self.initial_epoch,
+                                   data_cursor={
+                                       "epoch": self.initial_epoch,
+                                       "global_row_ordinal": 0,
+                                       "global_batch_size":
+                                           self.config.train_batch_size})
 
     # --------------------------------------------------------- exports
 

@@ -266,9 +266,14 @@ def assert_host_agreement(value: int, what: str) -> None:
             f"this would deadlock the pod's collectives.")
 
 
-def lockstep_train_stream(batches, steps_per_epoch: int):
+def lockstep_train_stream(batches, steps_per_epoch: int,
+                          first_epoch_steps: Optional[int] = None):
     """Truncate a marker-bearing train stream to exactly
-    `steps_per_epoch` batches per epoch.
+    `steps_per_epoch` batches per epoch. `first_epoch_steps` overrides
+    the expectation for the FIRST epoch only: a cursor-resumed run
+    finishes the interrupted pass, which legitimately yields fewer
+    batches than a full one (model_facade passes the pod-agreed count
+    for both).
 
     Each host filters its own strided row shard independently, so raw
     post-filter batch counts can differ across hosts (a host whose shard
@@ -283,17 +288,20 @@ def lockstep_train_stream(batches, steps_per_epoch: int):
     host-dependent ordering — the Trainer asserts epoch agreement on the
     consumer side instead (training/loop.py EpochEnd branch)."""
     from code2vec_tpu.data.reader import EpochEnd
+    target = (first_epoch_steps if first_epoch_steps is not None
+              else steps_per_epoch)
     count = 0
     for item in batches:
         if isinstance(item, EpochEnd):
-            if count < steps_per_epoch:
+            if count < target:
                 raise RuntimeError(
                     f"epoch {item.epoch} produced only {count} local "
-                    f"batches but {steps_per_epoch} were collectively "
+                    f"batches but {target} were collectively "
                     f"agreed; the dataset shrank under the trainer.")
             yield item
             count = 0
-        elif count < steps_per_epoch:
+            target = steps_per_epoch
+        elif count < target:
             count += 1
             yield item
         # else: surplus local batch — other hosts are already done with

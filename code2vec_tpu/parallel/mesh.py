@@ -66,6 +66,20 @@ class MeshPlan:
     def from_config(cls, config) -> "MeshPlan":
         return cls(dp=config.dp, tp=config.tp, cp=config.cp)
 
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "MeshPlan":
+        """Rebuild a plan from a checkpoint manifest's `mesh_plan` record
+        (missing axes default to 1, like an unset config knob)."""
+        d = d or {}
+        return cls(dp=int(d.get("dp", 1)), tp=int(d.get("tp", 1)),
+                   cp=int(d.get("cp", 1)))
+
+    def to_dict(self) -> dict:
+        return {"dp": self.dp, "tp": self.tp, "cp": self.cp}
+
+    def describe(self) -> str:
+        return f"dp={self.dp} tp={self.tp} cp={self.cp}"
+
 
 def make_mesh(plan: MeshPlan, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()

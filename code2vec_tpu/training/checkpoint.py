@@ -69,6 +69,23 @@ fallback walk is COLLECTIVE: hosts agree (min over local bests, re-voted
 until unanimous) on one artifact, because each host walking backward
 independently can land on different steps and deadlock the pod's
 restore-time collectives.
+
+Elastic topology-change restore (manifest format 3): any COMMITTED
+artifact is restorable on any host count and mesh shape. The manifest
+additionally records the save-time mesh plan (dp/tp/cp), the GLOBAL
+parameter-tree structure/shapes/dtypes, and the data-pipeline cursor
+(epoch + global row ordinal). `verify_checkpoint` stays strict about
+COMMIT completeness (the ack set is checked against the manifest's own
+recorded `process_count`, never the restore-time one) — an incomplete
+commit is rejected on any topology, while a complete commit made at a
+DIFFERENT topology verifies fine and is routed to the resharded-restore
+path: `classify_restore` labels it `exact` or `resharded`, and
+`load_model` builds its restore targets from the CURRENT mesh's
+abstract-array metadata (shape/dtype/sharding of the live state
+template) rather than the saved layout, so Orbax reshards params and
+optimizer state on read. The collective fallback vote additionally
+asserts every host reached the same reshard decision for the agreed
+artifact.
 """
 
 from __future__ import annotations
@@ -81,23 +98,29 @@ import shutil
 import threading
 from typing import Callable, Optional
 
+import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
 from code2vec_tpu import obs
 from code2vec_tpu.parallel import distributed
 from code2vec_tpu.parallel.distributed import BarrierTimeout  # re-export
+from code2vec_tpu.parallel.mesh import MeshPlan
 from code2vec_tpu.training.state import TrainState
 from code2vec_tpu.utils.faults import fault_point
 
 _STATE_DIR = "state"
 _META_NAME = "code2vec_meta.json"
 MANIFEST_NAME = "code2vec_manifest.json"
-# Format 2 adds the multi-host commit-protocol fields: `process_count`
+# Format 2 added the multi-host commit-protocol fields: `process_count`
 # and `commit_acks` (the participant set that reached the post-flush
-# barrier). Format-1 artifacts (pre-barrier saves) remain loadable —
-# they carry no participant record to check.
-MANIFEST_FORMAT = 2
+# barrier). Format 3 adds the elastic-restore topology record:
+# `mesh_plan` (dp/tp/cp at save time), `param_tree` (global shapes and
+# dtypes of every state leaf) and `data_cursor` (epoch + global row
+# ordinal of the input pipeline). Every addition is strictly additive:
+# format-1 (pre-barrier) and format-2 manifests remain loadable, and a
+# format-3 manifest read by format-2 code just carries unknown keys.
+MANIFEST_FORMAT = 3
 ACK_PREFIX = "commit_ack."
 RELEASED_SUFFIX = ".release"
 # Commit-protocol working dirs: `.tmp-<pid>` is the staging dir a save
@@ -271,8 +294,107 @@ def write_commit_ack(staging: str, index: int) -> str:
     return path
 
 
+def tree_summary(tree) -> dict:
+    """Flatten a state pytree into {leaf path: {shape, dtype}} with
+    GLOBAL shapes (a sharded jax.Array's `.shape` is its global shape).
+    Recorded into the format-3 manifest so a restore onto any topology
+    can check structural compatibility up front — a mismatched
+    embedding size or optimizer layout fails with the offending leaf
+    named instead of an opaque Orbax pytree error mid-restore."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        out[jax.tree_util.keystr(path)] = {
+            "shape": [int(d) for d in getattr(leaf, "shape", ())],
+            "dtype": str(getattr(leaf, "dtype", type(leaf).__name__)),
+        }
+    return out
+
+
+def load_manifest(model_path: str) -> Optional[dict]:
+    """The artifact's manifest dict, or None for pre-manifest (legacy)
+    artifacts / unreadable files. Read-only convenience for the elastic
+    restore path (topology classification + data cursor); integrity
+    checking stays `verify_checkpoint`'s job."""
+    path = os.path.join(_abs(model_path), MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
+
+
+def _config_mesh_plan(config) -> MeshPlan:
+    """The run's mesh plan, tolerating config-like objects without the
+    mesh knobs (missing axes default to 1, like an unset config)."""
+    return MeshPlan(dp=int(getattr(config, "dp", 1)),
+                    tp=int(getattr(config, "tp", 1)),
+                    cp=int(getattr(config, "cp", 1)))
+
+
+def classify_restore(manifest: Optional[dict], config=None) -> str:
+    """Label a restore of a COMMITTED artifact under the current
+    topology: "exact" (same process count and — when `config` is given —
+    same dp/tp/cp mesh plan as at save time) or "resharded" (any
+    difference; Orbax rebuilds the arrays against the current mesh's
+    shardings). Legacy manifests without topology fields classify as
+    "exact": they carry no record to differ from.
+
+    Completeness is NOT judged here — `verify_checkpoint` rejects
+    incomplete commits against the manifest's own recorded process
+    count; this function only routes complete ones."""
+    if not manifest:
+        return "exact"
+    saved_procs = manifest.get("process_count")
+    if (saved_procs is not None
+            and int(saved_procs) != distributed.process_count()):
+        return "resharded"
+    plan = manifest.get("mesh_plan")
+    if (isinstance(plan, dict) and config is not None
+            and MeshPlan.from_dict(plan) != _config_mesh_plan(config)):
+        return "resharded"
+    return "exact"
+
+
+def _check_param_tree(manifest: Optional[dict], template, base: str) -> None:
+    """Compare the manifest's recorded global parameter tree against the
+    restore template; raise ValueError naming the first offending leaf.
+    Only leaves the template wants are checked (a released load ignores
+    the artifact's opt_state record and vice versa); manifests without
+    the record (formats 1/2) skip the check."""
+    saved = manifest.get("param_tree") if manifest else None
+    if not isinstance(saved, dict):
+        return
+    want = tree_summary(template)
+    missing = sorted(set(want) - set(saved))
+    if missing:
+        raise ValueError(
+            f"{base}: restore template expects leaf {missing[0]} but the "
+            f"artifact's recorded parameter tree has no such leaf — the "
+            f"saved model/optimizer structure differs from this run's "
+            f"configuration ({len(missing)} leaves missing in total).")
+    for key, entry in sorted(want.items()):
+        rec = saved[key]
+        if list(rec.get("shape", ())) != entry["shape"]:
+            raise ValueError(
+                f"{base}: leaf {key} was saved with global shape "
+                f"{rec.get('shape')} but this run expects "
+                f"{entry['shape']}; the model configuration (vocab or "
+                f"embedding sizes) differs from the artifact's. Note "
+                f"that table rows are padded to a multiple of tp — a "
+                f"mesh reshape needs a tp under which the padded shapes "
+                f"agree with the artifact's.")
+        if rec.get("dtype") != entry["dtype"]:
+            raise ValueError(
+                f"{base}: leaf {key} was saved as {rec.get('dtype')} but "
+                f"this run expects {entry['dtype']}; match the precision "
+                f"flags the artifact was saved with.")
+
+
 def _write_manifest(base: str, epoch: int, released: bool,
-                    process_count: int = 1) -> None:
+                    process_count: int = 1,
+                    topology: Optional[dict] = None) -> None:
     """Record every file in the (staged) artifact with its size, plus
     content hashes for the small sidecars. Written last: its presence is
     the Orbax-completion marker — `save_model` only writes it after
@@ -309,6 +431,8 @@ def _write_manifest(base: str, epoch: int, released: bool,
         "commit_acks": sorted(acks),
         "files": files,
     }
+    if topology:
+        manifest.update(topology)
     path = os.path.join(base, MANIFEST_NAME)
     with open(path, "w") as f:
         json.dump(manifest, f, indent=2)
@@ -420,10 +544,15 @@ def _verify_checkpoint_inner(model_path: str,
             f"{manifest_path}: Orbax completion marker missing — the save "
             f"was interrupted before wait_until_finished")
     if "process_count" in manifest:
-        # Manifest format 2: the save recorded its participant set. An
+        # Manifest format 2+: the save recorded its participant set. An
         # incomplete ack set means a host died between the commit
         # barrier and the manifest (or the manifest was hand-edited);
         # its shards may be missing from the artifact, so reject it.
+        # The check is against the manifest's OWN process_count — never
+        # the restore-time one — so a COMPLETE commit made at a
+        # different topology verifies fine (classify_restore routes it
+        # to the resharded-restore path); only INCOMPLETE commits are
+        # rejected.
         want = int(manifest["process_count"])
         acks = manifest.get("commit_acks")
         try:
@@ -513,9 +642,13 @@ def _candidate_path(save_base: str, key: int) -> str:
 
 
 def _local_latest_valid(save_base: str, excluded,
-                        log: Optional[Callable[[str], None]] = None):
+                        log: Optional[Callable[[str], None]] = None,
+                        trail: Optional[list] = None):
     """This host's newest verifying candidate (key, path), skipping any
-    key in `excluded`; (None, None) if nothing verifies."""
+    key in `excluded`; (None, None) if nothing verifies. `trail`, when
+    given, collects one record per candidate CONSIDERED — the resume
+    path surfaces it so a run that fell back past rejected artifacts
+    says so loudly instead of silently starting older (or fresh)."""
     import glob
     candidates = []  # ((epoch, is_preempt), path)
     for p in glob.glob(save_base + "_iter*"):
@@ -526,8 +659,17 @@ def _local_latest_valid(save_base: str, excluded,
     for parsed, path in sorted(candidates, reverse=True):
         try:
             verify_checkpoint(path)
+            if trail is not None:
+                trail.append({"path": path, "outcome": "selected",
+                              "reason": "passes verification"})
             return _candidate_key(parsed), path
         except CheckpointIntegrityError as e:
+            obs.counter(
+                "resume_artifacts_rejected_total",
+                "resume candidates the fallback walk rejected").inc()
+            if trail is not None:
+                trail.append({"path": path, "outcome": "rejected",
+                              "reason": str(e)})
             if log is not None:
                 log(f"Skipping corrupt/partial checkpoint {path}: {e}")
     return None, None
@@ -535,7 +677,8 @@ def _local_latest_valid(save_base: str, excluded,
 
 def latest_valid_checkpoint(save_base: str,
                             log: Optional[Callable[[str], None]] = None,
-                            collective: Optional[bool] = None):
+                            collective: Optional[bool] = None,
+                            trail: Optional[list] = None):
     """Newest `<save_base>_iter<N>[_preempt]` artifact that PASSES its
     integrity check (None if no candidate does). Walks newest -> oldest
     past corrupt/partial artifacts, logging each skip, so a save killed
@@ -554,15 +697,21 @@ def latest_valid_checkpoint(save_base: str,
     the vote repeats with the candidate excluded until unanimous — all
     hosts return the SAME path (or all None). Without this, hosts whose
     independent backward walks diverge restore different steps and
-    deadlock the pod's first collective. Runs host collectives: main
-    thread only."""
+    deadlock the pod's first collective. The agreement covers the
+    RESHARD decision too: once a path is unanimous, every host
+    classifies it against the current topology and a divergence (e.g.
+    one host reading a stale manifest copy) raises the loud desync
+    error instead of letting the pod split between an exact and a
+    resharded restore. Runs host collectives: main thread only."""
     if collective is None:
         collective = distributed.process_count() > 1
     if not collective or distributed.process_count() == 1:
-        return _local_latest_valid(save_base, excluded=(), log=log)[1]
+        return _local_latest_valid(save_base, excluded=(), log=log,
+                                   trail=trail)[1]
     excluded = set()
     while True:
-        local_key, _local_path = _local_latest_valid(save_base, excluded, log)
+        local_key, _local_path = _local_latest_valid(save_base, excluded,
+                                                     log, trail=trail)
         proposal = -1 if local_key is None else local_key
         agreed = distributed.agree_scalar(proposal, "min")
         if agreed < 0:
@@ -584,6 +733,13 @@ def latest_valid_checkpoint(save_base: str,
             if log is not None and excluded:
                 log(f"Pod agreed on fallback checkpoint {path} after "
                     f"excluding {len(excluded)} candidate(s)")
+            # The reshard decision is part of the agreement: every host
+            # must read the same manifest the same way, or the pod's
+            # restore would mix exact and resharded templates.
+            decision = (0 if classify_restore(load_manifest(path)) == "exact"
+                        else 1)
+            distributed.assert_host_agreement(
+                decision, f"reshard decision for {os.path.basename(path)}")
             return path
         excluded.add(agreed)
 
@@ -594,17 +750,20 @@ latest_checkpoint = latest_valid_checkpoint
 
 
 def resolve_load_path(model_load_path: str,
-                      log: Optional[Callable[[str], None]] = None) -> str:
+                      log: Optional[Callable[[str], None]] = None,
+                      trail: Optional[list] = None) -> str:
     """Resolve a `--load` argument: a concrete artifact directory is
     returned as-is; anything else is treated as a save base and resolved
     to its newest VALID `_iter<N>` artifact, so resuming after a crash
-    never requires the operator to guess which directory survived."""
+    never requires the operator to guess which directory survived.
+    `trail` collects the candidates considered/rejected along the way so
+    the caller can report a degraded resume loudly."""
     base = _abs(model_load_path)
     if os.path.isdir(base) and (
             os.path.isfile(os.path.join(base, _META_NAME))
             or os.path.isfile(os.path.join(base, MANIFEST_NAME))):
         return base
-    found = latest_valid_checkpoint(base, log=log)
+    found = latest_valid_checkpoint(base, log=log, trail=trail)
     return found if found is not None else base
 
 
@@ -716,7 +875,8 @@ class AsyncCommitter:
 def save_model(model_save_path: str, state: TrainState, vocabs, config,
                epoch: int = 0, released: bool = False,
                committer: Optional[AsyncCommitter] = None,
-               on_committed: Optional[Callable[[], None]] = None) -> str:
+               on_committed: Optional[Callable[[], None]] = None,
+               data_cursor: Optional[dict] = None) -> str:
     """Save a standalone model artifact at `<model_save_path>` (a directory
     is created): Orbax state + `dictionaries.bin` + config meta. Mirrors
     `Code2VecModelBase.save` (model_base.py:102-109).
@@ -732,14 +892,20 @@ def save_model(model_save_path: str, state: TrainState, vocabs, config,
     Orbax dispatch; flush/barrier/manifest/rename run on the commit
     thread and `on_committed` (e.g. checkpoint rotation) fires there
     after a successful commit. The returned path is where the artifact
-    WILL commit; callers needing it durable must drain the committer."""
+    WILL commit; callers needing it durable must drain the committer.
+
+    `data_cursor` ({"epoch", "global_row_ordinal", ...}) is recorded
+    verbatim into the format-3 manifest — the input-pipeline position
+    this state corresponds to, which an elastic resume remaps to the new
+    host count so no row is skipped or double-read."""
     with obs.span("checkpoint_save",
                   hist=obs.histogram(
                       "checkpoint_save_seconds",
                       "step-loop save stall: stage + flush + commit "
                       "(sync) or stage + dispatch (async)")):
         return _save_model_inner(model_save_path, state, vocabs, config,
-                                 epoch, released, committer, on_committed)
+                                 epoch, released, committer, on_committed,
+                                 data_cursor)
 
 
 def _barrier_timeout_s(config) -> float:
@@ -750,8 +916,8 @@ def _barrier_timeout_s(config) -> float:
 def _save_model_inner(model_save_path: str, state: TrainState, vocabs,
                       config, epoch: int, released: bool,
                       committer: Optional[AsyncCommitter] = None,
-                      on_committed: Optional[Callable[[], None]] = None
-                      ) -> str:
+                      on_committed: Optional[Callable[[], None]] = None,
+                      data_cursor: Optional[dict] = None) -> str:
     base = _abs(model_save_path) + (RELEASED_SUFFIX if released else "")
     nprocs = distributed.process_count()
     multi = nprocs > 1
@@ -821,6 +987,18 @@ def _save_model_inner(model_save_path: str, state: TrainState, vocabs,
     state_dir = os.path.join(staging, _STATE_DIR)
     ckptr.save(state_dir, target, force=True)
 
+    # Format-3 topology record, captured host-side before the deferred
+    # commit: the save-time mesh plan, the GLOBAL tree structure (a
+    # sharded jax.Array's .shape is global), and the data cursor — what
+    # an elastic restore needs to reshard onto any topology and resume
+    # the input pipeline without skipping or double-reading rows.
+    topology = {
+        "mesh_plan": _config_mesh_plan(config).to_dict(),
+        "param_tree": tree_summary(target),
+    }
+    if data_cursor is not None:
+        topology["data_cursor"] = dict(data_cursor)
+
     def commit_job():
         try:
             with obs.span("checkpoint_orbax_flush",
@@ -846,7 +1024,8 @@ def _save_model_inner(model_save_path: str, state: TrainState, vocabs,
             write_commit_ack(staging, distributed.process_index())
             distributed.commit_barrier(f"c2v:acks:{ordinal}", timeout_s)
         if committing_host:
-            _write_manifest(staging, epoch, released, process_count=nprocs)
+            _write_manifest(staging, epoch, released, process_count=nprocs,
+                            topology=topology)
             fault_point("save")   # 5: fully staged, not yet committed
             _commit_staging(staging, base)
         fault_point("callback_crash")  # committed, completion pending
@@ -909,8 +1088,24 @@ def load_model_meta(model_load_path: str) -> dict:
         return json.load(f)
 
 
+def _abstract_restore_template(tree):
+    """Restore targets built from the CURRENT state's abstract-array
+    metadata: every live jax.Array leaf becomes a ShapeDtypeStruct
+    carrying its (current-mesh) sharding, so Orbax lays the restored
+    arrays out for the topology the run HAS, not the one the artifact
+    was saved under — the mechanism behind elastic N->M restore. Host
+    (numpy) leaves stay concrete and restore host-side as before."""
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+        return x
+    return jax.tree.map(leaf, tree)
+
+
 def load_model(model_load_path: str, state_like: TrainState,
-               config=None, params_only: bool = False) -> TrainState:
+               config=None, params_only: bool = False,
+               report: Optional[dict] = None) -> TrainState:
     """Restore a standalone artifact saved by `save_model`. `state_like`
     provides structure/shardings; released artifacts keep `state_like`'s
     (fresh) optimizer state. `params_only` restores just params+step and
@@ -923,11 +1118,37 @@ def load_model(model_load_path: str, state_like: TrainState,
     half-written directory fails fast with the offending file named
     instead of surfacing as an opaque Orbax pytree error mid-restore.
     Resume is the deep probe: post-commit content hashes (saves made
-    with `checkpoint_hash_content`) are re-checked here when present."""
+    with `checkpoint_hash_content`) are re-checked here when present.
+
+    Topology is ELASTIC: a complete commit made at a different host
+    count or mesh shape restores fine — targets are abstract arrays
+    built from `state_like`'s current shardings, the manifest's recorded
+    global tree is checked against them first (mismatches name the
+    offending leaf), and `report` (optional out-param) receives
+    `resume_mode` ("exact" | "resharded"), the saved topology and the
+    restored step for the caller's heartbeat/metrics."""
     base = _abs(model_load_path)
     meta = verify_checkpoint(base, check_content=True)
+    manifest = load_manifest(base)
+    mode = classify_restore(manifest, config)
+    if report is not None:
+        report["resume_mode"] = mode
+        report["path"] = base
+        if manifest:
+            report["saved_process_count"] = manifest.get("process_count")
+            report["saved_mesh_plan"] = manifest.get("mesh_plan")
+            report["data_cursor"] = manifest.get("data_cursor")
+    if mode == "resharded":
+        # Read-only by design: a kill anywhere in the reshard restore
+        # must leave the artifact untouched and re-restorable (the
+        # chaos matrix arms this point to prove it).
+        fault_point("reshard_restore")
+        obs.counter("resume_resharded_restores_total",
+                    "restores that rebuilt the arrays for a topology "
+                    "other than the save-time one").inc()
     if params_only:
         template = {"params": state_like.params, "step": state_like.step}
+        _check_param_tree(manifest, template, base)
         restore_args = ocp.checkpoint_utils.construct_restore_args(template)
         try:
             restore = ocp.args.PyTreeRestore(item=template,
@@ -943,6 +1164,8 @@ def load_model(model_load_path: str, state_like: TrainState,
         with ocp.PyTreeCheckpointer() as ckptr:
             restored = ckptr.restore(os.path.join(base, _STATE_DIR),
                                      args=restore)
+        if report is not None:
+            report["restored_step"] = int(np.asarray(restored["step"]))
         return TrainState(step=restored["step"], params=restored["params"],
                           opt_state=state_like.opt_state)
     if config is not None and not meta.get("released", False):
@@ -974,9 +1197,13 @@ def load_model(model_load_path: str, state_like: TrainState,
     template = {"params": state_like.params, "step": state_like.step}
     if not meta.get("released", False):
         template["opt_state"] = state_like.opt_state
+    _check_param_tree(manifest, template, base)
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(os.path.join(base, _STATE_DIR), template)
+    restored = ckptr.restore(os.path.join(base, _STATE_DIR),
+                             _abstract_restore_template(template))
     ckptr.close()
+    if report is not None:
+        report["restored_step"] = int(np.asarray(restored["step"]))
     return TrainState(
         step=restored["step"],
         params=restored["params"],

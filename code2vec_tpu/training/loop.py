@@ -119,7 +119,8 @@ class Trainer:
                  initial_epoch: int = 0,
                  steps_per_epoch_hint: Optional[int] = None,
                  stop_fn: Optional[Callable[[], bool]] = None,
-                 commit_drain_fn: Optional[Callable[[], None]] = None):
+                 commit_drain_fn: Optional[Callable[[], None]] = None,
+                 heartbeat_extra: Optional[dict] = None):
         self.config = config
         self.train_step = train_step
         self.mesh = mesh
@@ -151,6 +152,12 @@ class Trainer:
         # should skip further (slow) post-training saves — the grace
         # window may not cover a second multi-GB write.
         self.preempted = False
+        # Static fields merged into every heartbeat write: the facade
+        # passes its resume report (resume_mode exact|resharded|fresh,
+        # restored_step), so a watchdog can see from the heartbeat alone
+        # whether this run restored what the operator expected or
+        # silently fell back/started fresh.
+        self.heartbeat_extra = dict(heartbeat_extra or {})
 
     def _make_tb_writer(self):
         if not self.config.use_tensorboard:
@@ -295,12 +302,21 @@ class Trainer:
             if self.save_fn is None:
                 return
             import inspect
-            if "suffix" in inspect.signature(self.save_fn).parameters:
+            sig_params = inspect.signature(self.save_fn).parameters
+            kwargs = {}
+            if "suffix" in sig_params:
                 # distinct name: never clobbers the clean end-of-epoch
                 # artifact the eval log refers to
-                self.save_fn(state, epoch, suffix=suffix)
-            else:
-                self.save_fn(state, epoch)
+                kwargs["suffix"] = suffix
+            if "cursor_rows" in sig_params:
+                # Data cursor for the interrupted epoch: global rows the
+                # pod consumed before this save. batch_in_epoch is
+                # lockstep across hosts (the preemption OR-reduce fires
+                # at a fixed cadence), so every host records the same
+                # ordinal; resume remaps it to the new host count.
+                kwargs["cursor_rows"] = (batch_in_epoch
+                                         * config.train_batch_size)
+            self.save_fn(state, epoch, **kwargs)
 
         def run_eval(state, label):
             if self.evaluate_fn is None:
@@ -326,6 +342,8 @@ class Trainer:
             from a preemption without parsing logs."""
             if heartbeat_file is None:
                 return
+            fields = dict(self.heartbeat_extra)
+            fields.update(extra)
             obs_exporters.write_heartbeat(
                 heartbeat_file,
                 status=status,
@@ -336,7 +354,7 @@ class Trainer:
                            else last_avg_loss),
                 examples_per_sec=throughput_ema,
                 rss_bytes=current_rss_bytes(),
-                **extra)
+                **fields)
 
         def drain_losses(where: str):
             """Fetch every pending per-batch loss (the one place the host

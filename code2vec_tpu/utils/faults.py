@@ -37,6 +37,20 @@ Fault points in the checkpoint commit protocol (training/checkpoint.py):
 - `checkpoint_swap`  — mid overwrite-swap (the empty-slot window)
 - `callback_crash`   — committed, completion barrier / content-hash
                        pass still pending
+
+Fault points in the elastic restore path (training/checkpoint.py
+load_model, model_facade._train_batches):
+
+- `reshard_restore`  — a topology-changed (resharded) restore is about
+                       to read the artifact. Restore is read-only by
+                       design, so a kill here must leave the original
+                       artifact untouched and re-restorable — the
+                       elastic chaos matrix arms it to prove exactly
+                       that.
+- `cursor_remap`     — the saved data-pipeline cursor is being remapped
+                       to the current host count before the resumed
+                       epoch's first batch; same untouched-artifact
+                       contract as `reshard_restore`.
 """
 
 from __future__ import annotations

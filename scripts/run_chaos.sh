@@ -26,6 +26,9 @@ export C2V_CHAOS_DIAG_DIR="$RUN_DIR"
 # timeouts fire first; these catch a hang in pytest/collection itself.
 SINGLE_HOST_BUDGET=600
 MULTI_HOST_BUDGET=900
+# Elastic N->M resume: three phase-1 training pods + per-scenario resume
+# children, each a full facade run — the longest suite of the three.
+ELASTIC_BUDGET=1200
 
 rc=0
 
@@ -47,6 +50,7 @@ run_suite() {
 run_suite "$SINGLE_HOST_BUDGET" tests/test_chaos.py "$@"
 run_suite "$MULTI_HOST_BUDGET" tests/test_multihost_chaos.py \
     tests/test_multiprocess.py "$@"
+run_suite "$ELASTIC_BUDGET" tests/test_elastic_resume.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

@@ -2,9 +2,11 @@
 
 Each of two OS processes joins a real `jax.distributed` runtime and runs
 the PRODUCTION training entry point — `Code2VecModel.train()` — over an
-actual packed dataset engineered so the hosts' post-filter shards yield
-DIFFERENT local batch counts (host 0: 12 kept rows -> 3 local batches,
-host 1: 8 -> 2). The facade path under test is the full composition:
+actual packed dataset whose raw strided shards are UNEVEN (12 vs 8 kept
+train rows; the elastic global train order equalizes the per-host batch
+counts, while the eval shards stay raw-strided at 3 vs 2 local batches,
+exercising the lockstep eval padding). The facade path under test is
+the full composition:
 vocab load -> packed dataset shard -> `agree_scalar` lockstep truncation
 -> jitted collective train steps -> mid-epoch collective eval (with
 lockstep eval padding: 3 vs 2 local eval batches) -> per-epoch Orbax
@@ -106,9 +108,9 @@ def main():
     model.builder.make_train_step = make_recording
     model.train()
 
-    # Lockstep truncation: 2 epochs x agreed-min 2 batches, despite host 0
-    # being able to feed 3. rtol 1e-4, not 1e-5: losses after step 1 are
-    # computed on params that already absorbed cross-topology float
+    # 2 epochs x 2 global batches (elastic global order: 20 filtered
+    # rows // global batch 8). rtol 1e-4, not 1e-5: losses after step 1
+    # are computed on params that already absorbed cross-topology float
     # summation-order differences (see the params comment below).
     np.testing.assert_allclose(losses, expect["losses"], rtol=1e-4)
 

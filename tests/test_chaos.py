@@ -709,8 +709,9 @@ def _async_save(base, epoch, tiny, committer, config=None):
 
 def test_async_save_commits_and_restores_bit_equal(tmp_path, tiny):
     """The async pipeline must produce byte-for-byte the same artifact
-    guarantees as the sync path: manifest v2, verifiable, bit-equal
-    restore — with the commit running on the background thread."""
+    guarantees as the sync path: current-format manifest, verifiable,
+    bit-equal restore — with the commit running on the background
+    thread."""
     import json as json_mod
     base = str(tmp_path / "m")
     committer = ckpt_mod.AsyncCommitter(max_in_flight=2)
@@ -722,7 +723,7 @@ def test_async_save_commits_and_restores_bit_equal(tmp_path, tiny):
         _assert_restores_bit_equal(f"{base}_iter{epoch}", epoch)
     with open(os.path.join(f"{base}_iter2", ckpt_mod.MANIFEST_NAME)) as f:
         manifest = json_mod.load(f)
-    assert manifest["format"] == 2
+    assert manifest["format"] == ckpt_mod.MANIFEST_FORMAT
     assert manifest["process_count"] == 1
     assert manifest["commit_acks"] == [0]
 

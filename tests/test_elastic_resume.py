@@ -1,0 +1,721 @@
+"""Elastic topology-change resume: any committed checkpoint restores on
+any host count / mesh shape, and the input pipeline continues without
+skipping or double-reading rows.
+
+Three layers of proof:
+
+1. In-process data-order laws (exact, row-id level): the packed
+   training order is a GLOBAL epoch-keyed permutation strided per host,
+   so global batch b consumes the same row SET at any host count, a
+   resumed run continues the exact permutation sequence of an
+   uninterrupted one, and a saved cursor remaps onto a different host
+   count with no row skipped or double-read.
+
+2. In-process restore laws: manifest v3 records topology + the global
+   parameter tree; frozen v2/v1 manifests stay loadable; a real
+   dp=2-sharded state saved and restored into a tp=2 mesh template is
+   bit-equal with `resume_mode == "resharded"`; mismatched trees fail
+   naming the offending leaf; degraded resumes are reported loudly
+   (facade resume_report + heartbeat).
+
+3. Real-process chaos (tests/chaos_elastic_child.py): a pod trains on N
+   processes, the whole pod is HARD-KILLED mid-run (post-commit fault
+   point), and the run resumes on M != N — 2->1 and 1->2 — plus a
+   single-host dp=2 -> tp=2 mesh reshape; the restored global parameter
+   tree is asserted bit-equal (params digest) to the pre-kill commit
+   and the loss trajectory continues the uninterrupted reference run's.
+   A SIGTERM preemption drill proves the data cursor: the resumed run's
+   losses continue the reference's mid-epoch, exactly.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.packed import (
+    _HEADER, _MAGIC, _VERSION, PackedDataset, pack_c2v,
+)
+from code2vec_tpu.data.reader import EstimatorAction
+from code2vec_tpu.training import checkpoint as ckpt_mod
+from code2vec_tpu.utils import faults
+from code2vec_tpu.vocab import Code2VecVocabs
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+import chaos_child  # noqa: E402  (deterministic state builders)
+
+CHILD = os.path.join(HERE, "chaos_elastic_child.py")
+GROUP_TIMEOUT_S = 300
+
+pytestmark = [pytest.mark.chaos, pytest.mark.elastic]
+
+
+# ============================ layer 1: data-order laws (in-process) =====
+
+def _write_packed(path: str, vocabs, n_rows: int, m: int = 4) -> None:
+    """A synthetic .c2vb whose row identity is readable back from the
+    batches: source_token_indices[:, 0] = 1000 + row_id (non-pad, so
+    every row passes the filter); targets are all in-vocab."""
+    tgt_ok = vocabs.target_vocab.oov_index + 1
+    rec = np.zeros((n_rows, 1 + 3 * m), dtype=np.int32)
+    rec[:, 0] = tgt_ok
+    rec[:, 1] = 1000 + np.arange(n_rows)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(_MAGIC, _VERSION, n_rows, m))
+        f.write(rec.tobytes())
+
+
+def _row_ids(batch) -> np.ndarray:
+    return batch.source_token_indices[:, 0] - 1000
+
+
+def _global_epoch_batches(path, vocabs, num_hosts, global_bs, num_epochs,
+                          seed=5, start_epoch=0, skip_rows=0):
+    """Drive one PackedDataset per simulated host; regroup the per-host
+    streams into (epoch, batch) -> global row-id set."""
+    local_bs = global_bs // num_hosts
+    hosts = [PackedDataset(path, vocabs, shard_index=h, num_shards=num_hosts)
+             for h in range(num_hosts)]
+    streams = [list(h.iter_batches(local_bs, EstimatorAction.Train,
+                                   num_epochs=num_epochs, seed=seed,
+                                   start_epoch=start_epoch,
+                                   skip_rows=skip_rows))
+               for h in hosts]
+    assert len({len(s) for s in streams}) == 1, "hosts out of lockstep"
+    per_host_ids = [[_row_ids(b) for b in s] for s in streams]
+    n_batches = len(per_host_ids[0])
+    return [frozenset(int(i) for h in range(num_hosts)
+                      for i in per_host_ids[h][b])
+            for b in range(n_batches)]
+
+
+def test_global_batches_invariant_across_host_counts(tiny_vocabs, tmp_path):
+    """Global batch b consumes the SAME row set whether the pod has 1, 2
+    or 4 hosts — the invariant that makes a data cursor meaningful
+    across topology changes — and each epoch is one full pass: no row
+    skipped, none double-read."""
+    path = str(tmp_path / "d.c2vb")
+    _write_packed(path, tiny_vocabs, n_rows=48)
+    per_m = {m: _global_epoch_batches(path, tiny_vocabs, m, global_bs=8,
+                                      num_epochs=2) for m in (1, 2, 4)}
+    assert per_m[1] == per_m[2] == per_m[4]
+    steps = 48 // 8
+    assert len(per_m[1]) == steps * 2
+    for e in range(2):
+        epoch_sets = per_m[1][e * steps:(e + 1) * steps]
+        union = set().union(*epoch_sets)
+        assert len(union) == steps * 8  # disjoint batches: no double-read
+    # epochs shuffle differently (epoch-keyed permutation, not a rerun)
+    assert per_m[1][:steps] != per_m[1][steps:]
+
+
+def test_start_epoch_continues_exact_sequence(tiny_vocabs, tmp_path):
+    """A resumed run (start_epoch=k) draws exactly the batches the
+    uninterrupted run would have drawn from epoch k on — byte-equal
+    arrays, not just equal sets (same host count here)."""
+    path = str(tmp_path / "d.c2vb")
+    _write_packed(path, tiny_vocabs, n_rows=40)
+    ds = PackedDataset(path, tiny_vocabs)
+    full = list(ds.iter_batches(8, EstimatorAction.Train, num_epochs=3,
+                                seed=9))
+    resumed = list(ds.iter_batches(8, EstimatorAction.Train, num_epochs=2,
+                                   seed=9, start_epoch=1))
+    steps = 40 // 8
+    assert len(resumed) == 2 * steps
+    for got, want in zip(resumed, full[steps:]):
+        np.testing.assert_array_equal(got.source_token_indices,
+                                      want.source_token_indices)
+
+
+def test_cursor_remaps_across_host_counts(tiny_vocabs, tmp_path):
+    """Interrupt a 2-host epoch after k global batches; resuming on 1
+    host (and on 4) with skip_rows = k * global_batch continues with
+    exactly the not-yet-consumed row sets of that epoch."""
+    path = str(tmp_path / "d.c2vb")
+    _write_packed(path, tiny_vocabs, n_rows=48)
+    full = _global_epoch_batches(path, tiny_vocabs, 2, global_bs=8,
+                                 num_epochs=1)
+    k = 2  # global batches consumed before the kill
+    for new_hosts in (1, 4):
+        cont = _global_epoch_batches(path, tiny_vocabs, new_hosts,
+                                     global_bs=8, num_epochs=1,
+                                     skip_rows=k * 8)
+        assert cont == full[k:], f"cursor remap broken for M={new_hosts}"
+        consumed_before = set().union(*full[:k])
+        consumed_after = set().union(*cont)
+        assert not consumed_before & consumed_after  # no double-read
+        assert len(consumed_before | consumed_after) == len(full) * 8
+
+
+def test_steps_per_epoch_equal_on_every_host_and_cursor_aware(tiny_vocabs,
+                                                              tmp_path):
+    path = str(tmp_path / "d.c2vb")
+    _write_packed(path, tiny_vocabs, n_rows=43)  # ragged: 43 // 8 = 5
+    for m in (1, 2, 4):
+        counts = {PackedDataset(path, tiny_vocabs, shard_index=h,
+                                num_shards=m).steps_per_epoch(
+                      8 // m, EstimatorAction.Train) for h in range(m)}
+        assert counts == {5}
+    ds = PackedDataset(path, tiny_vocabs, shard_index=0, num_shards=2)
+    assert ds.steps_per_epoch(4, EstimatorAction.Train, skip_rows=16) == 3
+
+
+def test_lockstep_stream_accepts_short_first_epoch():
+    from code2vec_tpu.data.reader import EpochEnd
+    from code2vec_tpu.parallel.distributed import lockstep_train_stream
+
+    def stream(counts):
+        for e, c in enumerate(counts, 1):
+            for i in range(c):
+                yield ("batch", e, i)
+            yield EpochEnd(e)
+
+    out = list(lockstep_train_stream(stream([2, 4]), 4, first_epoch_steps=2))
+    batches = [x for x in out if not hasattr(x, "epoch")]
+    assert len(batches) == 6  # short first epoch + full second, no raise
+    # without the override, a short first epoch is (rightly) a desync
+    with pytest.raises(RuntimeError, match="produced only 2"):
+        list(lockstep_train_stream(stream([2, 4]), 4))
+
+
+def test_trainer_records_cursor_into_preemption_save():
+    """The preemption save carries the data cursor: global rows the
+    interrupted epoch consumed (batch_in_epoch * global batch size)."""
+    import signal
+
+    from code2vec_tpu.data.reader import RowBatch
+    from code2vec_tpu.training.loop import Trainer
+
+    def batch(n=2, m=4):
+        return RowBatch(
+            source_token_indices=np.ones((n, m), np.int32),
+            path_indices=np.ones((n, m), np.int32),
+            target_token_indices=np.ones((n, m), np.int32),
+            context_valid_mask=np.ones((n, m), np.float32),
+            target_index=np.ones((n,), np.int32),
+            example_valid=np.ones((n,), bool))
+
+    def stream():
+        for _ in range(10):
+            yield batch()
+
+    calls = []
+
+    def fake_step(s, *a):
+        calls.append(1)
+        if len(calls) == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return s, np.float32(0.5)
+
+    saves = []
+
+    def save_fn(state, epoch, suffix="", cursor_rows=0):
+        saves.append((epoch, suffix, cursor_rows))
+
+    class _S:
+        step = np.zeros((), np.int32)
+
+    cfg = Config(train_data_path_prefix="x", max_contexts=4,
+                 train_batch_size=4, num_train_epochs=1, verbose_mode=0)
+    tr = Trainer(cfg, fake_step, save_fn=save_fn)
+    tr.train(_S(), stream(), rng=np.zeros((2,), np.uint32))
+    assert tr.preempted
+    assert saves == [(0, "_preempt", 3 * 4)]
+
+
+# ============================ layer 2: restore laws (in-process) ========
+
+def test_manifest_v3_records_topology_and_cursor(tmp_path):
+    base = str(tmp_path / "m_iter1")
+    vocabs, config = chaos_child.build_vocabs(), chaos_child.build_config()
+    ckpt_mod.save_model(base, chaos_child.build_state(1), vocabs, config,
+                        epoch=1, data_cursor={"epoch": 1,
+                                              "global_row_ordinal": 16,
+                                              "global_batch_size": 8})
+    man = ckpt_mod.load_manifest(base)
+    assert man["format"] == 3
+    assert man["mesh_plan"] == {"dp": 1, "tp": 1, "cp": 1}
+    assert man["data_cursor"]["global_row_ordinal"] == 16
+    tree = man["param_tree"]
+    leaf = tree["['params']['token_embedding']"]
+    assert leaf == {"shape": [6, 8], "dtype": "float32"}
+    assert any(k.startswith("['opt_state']") for k in tree)
+
+
+def test_frozen_v2_manifest_still_verifies_and_restores(tmp_path):
+    """Forward-compat regression: an artifact written by CURRENT code
+    whose manifest is rewritten to the frozen format-2 schema (exactly
+    the PR-5 field set) must verify, classify, and restore bit-equal."""
+    base = str(tmp_path / "m_iter1")
+    vocabs, config = chaos_child.build_vocabs(), chaos_child.build_config()
+    ckpt_mod.save_model(base, chaos_child.build_state(1), vocabs, config,
+                        epoch=1)
+    man_path = os.path.join(base, ckpt_mod.MANIFEST_NAME)
+    with open(man_path) as f:
+        man = json.load(f)
+    frozen_v2 = {  # the exact PR-5 schema: no topology fields
+        "format": 2,
+        "epoch": man["epoch"],
+        "released": man["released"],
+        "orbax_complete": True,
+        "process_count": man["process_count"],
+        "commit_acks": man["commit_acks"],
+        "files": man["files"],
+    }
+    with open(man_path, "w") as f:
+        json.dump(frozen_v2, f, indent=2)
+    meta = ckpt_mod.verify_checkpoint(base)
+    assert meta["epoch"] == 1
+    report = {}
+    restored = ckpt_mod.load_model(base, chaos_child.build_state(0),
+                                   report=report)
+    assert report["resume_mode"] == "exact"  # no topology record to differ
+    expected = chaos_child.build_state(1)
+    for name, arr in expected.params.items():
+        np.testing.assert_array_equal(np.asarray(restored.params[name]), arr)
+
+
+def test_classify_restore_routes_topology_changes():
+    cfg = Config(train_data_path_prefix="x", dp=2, tp=1, cp=1)
+    man = {"process_count": 1, "mesh_plan": {"dp": 2, "tp": 1, "cp": 1}}
+    assert ckpt_mod.classify_restore(man, cfg) == "exact"
+    assert ckpt_mod.classify_restore({"process_count": 2,
+                                      "mesh_plan": {"dp": 2}}, cfg) \
+        == "resharded"
+    assert ckpt_mod.classify_restore({"process_count": 1,
+                                      "mesh_plan": {"dp": 1, "tp": 2}},
+                                     cfg) == "resharded"
+    assert ckpt_mod.classify_restore(None, cfg) == "exact"   # legacy
+    assert ckpt_mod.classify_restore({}, cfg) == "exact"
+
+
+def test_param_tree_mismatch_names_offending_leaf(tmp_path):
+    base = str(tmp_path / "m_iter1")
+    vocabs, config = chaos_child.build_vocabs(), chaos_child.build_config()
+    ckpt_mod.save_model(base, chaos_child.build_state(1), vocabs, config,
+                        epoch=1)
+    man_path = os.path.join(base, ckpt_mod.MANIFEST_NAME)
+    with open(man_path) as f:
+        man = json.load(f)
+    man["param_tree"]["['params']['path_embedding']"]["shape"] = [99, 8]
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    with pytest.raises(ValueError, match=r"path_embedding.*99"):
+        ckpt_mod.load_model(base, chaos_child.build_state(0))
+
+
+def test_inprocess_mesh_reshape_dp2_to_tp2_restores_bit_equal(tmp_path,
+                                                              tiny_vocabs):
+    """A REAL dp=2-sharded train state (params + Adam state on an 8-CPU
+    device mesh) saved with mesh_plan dp=2, restored into a tp=2 mesh
+    template: resume_mode == resharded, every leaf bit-equal, and the
+    restored leaves carry the CURRENT (tp=2) shardings."""
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+
+    dims = ModelDims(token_vocab_size=24, path_vocab_size=16,
+                     target_vocab_size=16, token_dim=4, path_dim=4)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=1.0)
+    cfg_save = Config(train_data_path_prefix="x", dp=2,
+                      compute_dtype="float32")
+    opt = make_optimizer(cfg_save)
+    state = create_train_state(module, opt, jax.random.PRNGKey(3),
+                               mesh=make_mesh(MeshPlan(dp=2)),
+                               config=cfg_save)
+    path = ckpt_mod.save_model(str(tmp_path / "m_iter1"), state,
+                               tiny_vocabs, cfg_save, epoch=1)
+    assert ckpt_mod.load_manifest(path)["mesh_plan"]["dp"] == 2
+
+    cfg_load = Config(train_data_path_prefix="x", tp=2,
+                      compute_dtype="float32")
+    state_like = create_train_state(module, opt, jax.random.PRNGKey(11),
+                                    mesh=make_mesh(MeshPlan(tp=2)),
+                                    config=cfg_load)
+    report = {}
+    restored = ckpt_mod.load_model(path, state_like, config=cfg_load,
+                                   report=report)
+    assert report["resume_mode"] == "resharded"
+    for name in state.params:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored.params[name])),
+            np.asarray(jax.device_get(state.params[name])))
+        assert (restored.params[name].sharding
+                == state_like.params[name].sharding), name
+    got_leaves = jax.tree.leaves(restored.opt_state)
+    want_leaves = jax.tree.leaves(state.opt_state)
+    assert len(got_leaves) == len(want_leaves)
+    for got, want in zip(got_leaves, want_leaves):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(got)),
+                                      np.asarray(jax.device_get(want)))
+
+
+def _write_facade_corpus(dirpath: str, n_rows: int = 40) -> str:
+    """Tiny packed-trainable corpus: 7 in-vocab targets, ~10% OOV rows
+    (train-filtered), max_contexts=8. Word counts are chosen so every
+    vocab size (13+1, 7+1, 7+1) is EVEN: table rows are padded to a
+    multiple of tp, so this keeps the global param shapes identical
+    under tp=1 and tp=2 — the precondition of the mesh-reshape resume
+    scenario (a tp whose padding changes the global shapes is correctly
+    rejected with the offending leaf named)."""
+    import pickle
+    import random
+    rng = random.Random(5)
+    tokens = [f"tok{i}" for i in range(13)]
+    paths = [f"path{i}" for i in range(7)]
+
+    def row(target):
+        n_ctx = rng.randint(3, 8)
+        ctx = [f"{rng.choice(tokens)},{rng.choice(paths)},"
+               f"{rng.choice(tokens)}" for _ in range(n_ctx)]
+        return f"{target} " + " ".join(ctx) + " " * (8 - n_ctx)
+
+    rows = [row("zzz" if i % 10 == 9 else f"w{i % 7}")
+            for i in range(n_rows)]
+    prefix = os.path.join(dirpath, "data")
+    with open(prefix + ".train.c2v", "w") as f:
+        f.write("\n".join(rows) + "\n")
+    with open(prefix + ".train.c2v.num_examples", "w") as f:
+        f.write(str(n_rows))
+    with open(prefix + ".dict.c2v", "wb") as f:
+        pickle.dump({t: 10 for t in tokens}, f)
+        pickle.dump({p: 10 for p in paths}, f)
+        pickle.dump({f"w{i}": 10 for i in range(7)}, f)
+        pickle.dump(n_rows, f)
+    config = Config(train_data_path_prefix=prefix, max_contexts=8,
+                    verbose_mode=0)
+    vocabs = Code2VecVocabs.load_or_create(config)
+    pack_c2v(prefix + ".train.c2v", vocabs, 8)
+    return prefix
+
+
+def test_facade_degraded_resume_is_loud_and_in_heartbeat(tmp_path):
+    """Corrupt the newest artifact: resume must fall back, REPORT the
+    rejected candidate (resume_report + log + metrics), and stamp
+    resume_mode/restored_step into the heartbeat — never a silent
+    fresh start."""
+    from code2vec_tpu.model_facade import Code2VecModel
+
+    prefix = _write_facade_corpus(str(tmp_path))
+    base = str(tmp_path / "run" / "m")
+    cfg = Config(train_data_path_prefix=prefix, model_save_path=base,
+                 max_contexts=8, train_batch_size=8, test_batch_size=8,
+                 num_train_epochs=2, save_every_epochs=1,
+                 num_batches_to_log_progress=10 ** 6,
+                 compute_dtype="float32", use_packed_data=True,
+                 verbose_mode=0)
+    model = Code2VecModel(cfg)
+    assert model.resume_report["resume_mode"] == "fresh"
+    model.train()
+    assert os.path.isdir(f"{base}_iter2")
+    # kill the final full-path artifact so --load <base> takes the walk,
+    # and corrupt _iter2 so the walk must fall back to _iter1
+    shutil.rmtree(base)
+    victim = os.path.join(f"{base}_iter2", "dictionaries.bin")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) - 1)
+
+    hb = str(tmp_path / "hb.json")
+    cfg2 = Config(train_data_path_prefix=prefix, model_save_path=base,
+                  model_load_path=base, max_contexts=8, train_batch_size=8,
+                  test_batch_size=8, num_train_epochs=3,
+                  save_every_epochs=1, num_batches_to_log_progress=10 ** 6,
+                  compute_dtype="float32", use_packed_data=True,
+                  heartbeat_file=hb, verbose_mode=0)
+    model2 = Code2VecModel(cfg2)
+    rep = model2.resume_report
+    assert rep["resume_mode"] == "exact"
+    assert rep["restored_epoch"] == 1
+    assert len(rep["rejected"]) == 1
+    assert rep["rejected"][0]["path"].endswith("_iter2")
+    assert "dictionaries.bin" in rep["rejected"][0]["reason"]
+    model2.train()
+    with open(hb) as f:
+        beat = json.load(f)
+    assert beat["resume_mode"] == "exact"
+    assert beat["restored_step"] == rep["restored_step"]
+    assert beat["status"] == "done"
+    # Cursor remap rounds DOWN to a multiple of the CURRENT global
+    # batch (8): a batch-size change across the resume must re-read a
+    # few rows, never leave the epoch's tail batch-misaligned (which
+    # would silently drop unseen rows at the ragged-tail truncation).
+    model2._resume_cursor = {"epoch": 1, "global_row_ordinal": 19,
+                             "global_batch_size": 6}
+    assert model2._cursor_skip_rows() == 16
+
+
+# ============================ layer 3: real-process chaos ===============
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_group(nprocs, args_for_pid, timeout=GROUP_TIMEOUT_S,
+               env_extra=None):
+    """Spawn `nprocs` chaos_elastic_child processes as one pod; returns
+    ([rc...], [stdout...]). Hung pods are killed and fail the test."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", faults.FAULTS_ENV)}
+    if env_extra:
+        env.update(env_extra)
+    port = str(_free_port())
+    procs = [subprocess.Popen(
+        [sys.executable, CHILD, *args_for_pid(pid, port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(nprocs)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout)[0])
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        partial = [p.communicate()[0] for p in procs]
+        pytest.fail(f"elastic chaos pod hung past {timeout}s:\n"
+                    f"{outs + partial}")
+    return [p.returncode for p in procs], outs
+
+
+def _saved_digests(out: str, pid: int = 0) -> dict:
+    """{epoch: digest} from ELASTIC_SAVED markers; later saves of the
+    same epoch (preemption artifacts) win, matching resume preference."""
+    digests = {}
+    for line in out.splitlines():
+        if line.startswith(f"ELASTIC_SAVED {pid} "):
+            _, _, epoch, dig = line.split()
+            digests[int(epoch)] = dig.split("=", 1)[1]
+    return digests
+
+
+def _losses(out: str, pid: int = 0):
+    for line in out.splitlines():
+        if line.startswith(f"ELASTIC_LOSSES {pid} "):
+            return json.loads(line.split(" ", 2)[2])
+    return None
+
+
+def _parse_resumed(out: str, pid: int = 0):
+    for line in out.splitlines():
+        if line.startswith(f"ELASTIC_RESUMED {pid} "):
+            fields = dict(kv.split("=", 1) for kv in line.split()[2:])
+            return (fields["mode"], int(fields["step"]),
+                    int(fields["epoch"]), fields["digest"])
+    return None
+
+
+EPOCHS = 4          # total budget; pods are killed after the epoch-2 commit
+STEPS = 4           # 36 filtered rows // global batch 8
+KILL = "callback_crash@2=exit"  # hard-kill inside save #2's post-commit
+
+
+@pytest.fixture(scope="session")
+def elastic_world(tmp_path_factory):
+    """Phase-1 fixture shared by the resume scenarios: one dataset, a
+    2-process pod and a 1-process run both hard-killed right after the
+    `_iter2` commit, and an uninterrupted single-process reference run
+    providing the loss trajectory ground truth."""
+    root = tmp_path_factory.mktemp("elastic_world")
+    data_prefix = _write_facade_corpus(str(root))
+    world = {"data": data_prefix}
+
+    for name, nprocs, dp in (("pod2", 2, 4), ("pod1", 1, 2)):
+        save_dir = os.path.join(str(root), name)
+        os.makedirs(save_dir)
+        base = os.path.join(save_dir, "m")
+        rcs, outs = _run_group(nprocs, lambda pid, port: [
+            "train", str(pid), str(nprocs), port, data_prefix, base,
+            str(dp), "1", str(EPOCHS), KILL])
+        assert rcs == [faults.FAULT_EXIT_CODE] * nprocs, (
+            f"{name} was not killed at the fault point:\n{outs}")
+        digests = _saved_digests(outs[0])
+        assert set(digests) == {1, 2}, outs[0]
+        man = ckpt_mod.load_manifest(f"{base}_iter2")
+        assert man["format"] == 3
+        assert man["process_count"] == nprocs
+        assert man["mesh_plan"]["dp"] == dp
+        world[name] = {"dir": save_dir, "digests": digests}
+
+    ref_base = os.path.join(str(root), "ref", "m")
+    os.makedirs(os.path.dirname(ref_base))
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "train", str(pid), "1", port, data_prefix, ref_base, "2", "1",
+        str(EPOCHS)])
+    assert rcs == [0], outs
+    world["ref_losses"] = _losses(outs[0])
+    assert len(world["ref_losses"]) == EPOCHS * STEPS
+    return world
+
+
+def _clone_pod(world_entry, tmp_path) -> str:
+    """Fresh copy of a phase-1 save dir (resume runs write new
+    artifacts; scenarios must not contaminate each other)."""
+    dst = str(tmp_path / "save")
+    shutil.copytree(world_entry["dir"], dst)
+    return os.path.join(dst, "m")
+
+
+@pytest.mark.multihost
+def test_kill_pod_resume_2_to_1_bit_equal_and_reshard_fault(elastic_world,
+                                                            tmp_path):
+    """2-process pod killed post-commit; resume SINGLE-process. First
+    with the `reshard_restore` fault armed: the kill mid-reshard must
+    leave the artifact untouched and re-restorable. Then for real: the
+    restored params are bit-equal to the pre-kill commit, resume_mode is
+    resharded, and the loss trajectory continues the reference run's."""
+    w = elastic_world
+    base = _clone_pod(w["pod2"], tmp_path)
+    man_before = ckpt_mod.load_manifest(f"{base}_iter2")
+
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "resume", "0", "1", port, w["data"], base, "2", "1", str(EPOCHS)],
+        env_extra={faults.FAULTS_ENV: "reshard_restore=exit"})
+    assert rcs == [faults.FAULT_EXIT_CODE], outs[0]
+    assert "ELASTIC_RESUMED" not in outs[0]
+    ckpt_mod.verify_checkpoint(f"{base}_iter2")  # untouched
+    assert ckpt_mod.load_manifest(f"{base}_iter2") == man_before
+
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "resume", "0", "1", port, w["data"], base, "2", "1", str(EPOCHS)])
+    assert rcs == [0], outs[0]
+    mode, step, epoch, digest = _parse_resumed(outs[0])
+    assert mode == "resharded"
+    assert epoch == 2 and step == 2 * STEPS
+    assert digest == w["pod2"]["digests"][2], (
+        "restored params differ from the pre-kill commit")
+    losses = _losses(outs[0])
+    assert len(losses) == 2 * STEPS
+    np.testing.assert_allclose(losses, w["ref_losses"][2 * STEPS:],
+                               rtol=5e-3, atol=1e-5)
+
+
+@pytest.mark.multihost
+def test_kill_pod_resume_1_to_2_bit_equal(elastic_world, tmp_path):
+    """1-process run killed post-commit; resume on a 2-process pod: the
+    collective resolve agrees on the artifact AND the reshard decision,
+    both hosts restore the same bit-equal tree, and the trajectory
+    continues the reference's."""
+    w = elastic_world
+    base = _clone_pod(w["pod1"], tmp_path)
+    rcs, outs = _run_group(2, lambda pid, port: [
+        "resume", str(pid), "2", port, w["data"], base, "4", "1",
+        str(EPOCHS)])
+    for pid in (0, 1):
+        assert rcs[pid] == 0, f"resume child {pid} failed:\n{outs[pid]}"
+        mode, step, epoch, digest = _parse_resumed(outs[pid], pid)
+        assert mode == "resharded"
+        assert epoch == 2 and step == 2 * STEPS
+        assert digest == w["pod1"]["digests"][2], (
+            f"host {pid} restored params differ from the pre-kill commit")
+    l0, l1 = _losses(outs[0], 0), _losses(outs[1], 1)
+    assert l0 == l1  # both hosts saw the same global loss
+    np.testing.assert_allclose(l0, w["ref_losses"][2 * STEPS:],
+                               rtol=5e-3, atol=1e-5)
+
+
+def test_kill_pod_resume_mesh_reshape_dp2_to_tp2(elastic_world, tmp_path):
+    """Same host count, different mesh: the dp=2 artifact restores into
+    a dp=1/tp=2 (row-sharded tables) template bit-equal, classified as
+    resharded."""
+    w = elastic_world
+    base = _clone_pod(w["pod1"], tmp_path)
+    # epochs budget == epochs trained: restore-only (the reshaped mesh
+    # is proven by the restore; trajectory is the other tests' job)
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "resume", "0", "1", port, w["data"], base, "1", "2", "2"])
+    assert rcs == [0], outs[0]
+    mode, step, epoch, digest = _parse_resumed(outs[0])
+    assert mode == "resharded"
+    assert epoch == 2 and step == 2 * STEPS
+    assert digest == w["pod1"]["digests"][2]
+
+
+@pytest.fixture(scope="session")
+def preempt_world(tmp_path_factory):
+    """A single-process run preempted (SIGTERM) at global batch 5 — one
+    batch into epoch 2: the `_iter1_preempt` artifact must carry
+    data_cursor epoch=1, ordinal=1*8."""
+    root = tmp_path_factory.mktemp("elastic_preempt")
+    data_prefix = _write_facade_corpus(str(root))
+    save_dir = os.path.join(str(root), "save")
+    os.makedirs(save_dir)
+    base = os.path.join(save_dir, "m")
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "preempt", "0", "1", port, data_prefix, base, "2", "1",
+        str(EPOCHS), "5"])
+    assert rcs == [0], outs[0]
+    assert "ELASTIC_PREEMPTED 0 after=5" in outs[0], outs[0]
+    man = ckpt_mod.load_manifest(f"{base}_iter1_preempt")
+    assert man["data_cursor"] == {"epoch": 1, "global_row_ordinal": 8,
+                                  "global_batch_size": 8}
+    return {"data": data_prefix, "dir": save_dir,
+            "digests": _saved_digests(outs[0]),
+            "losses": _losses(outs[0])}
+
+
+def test_preempt_cursor_resume_continues_mid_epoch(preempt_world,
+                                                   elastic_world, tmp_path):
+    """Resume the preempted run (same topology): first a kill at the
+    `cursor_remap` fault point (artifact must stay restorable), then for
+    real — the restored tree is bit-equal to the preemption commit and
+    the losses continue the uninterrupted reference EXACTLY from batch
+    8 on: the interrupted epoch's remaining batch plus two full epochs,
+    no row skipped or double-read."""
+    w = preempt_world
+    base = _clone_pod(w, tmp_path)
+
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "resume", "0", "1", port, w["data"], base, "2", "1", str(EPOCHS)],
+        env_extra={faults.FAULTS_ENV: "cursor_remap=exit"})
+    assert rcs == [faults.FAULT_EXIT_CODE], outs[0]
+    ckpt_mod.verify_checkpoint(f"{base}_iter1_preempt")  # untouched
+
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "resume", "0", "1", port, w["data"], base, "2", "1", str(EPOCHS)])
+    assert rcs == [0], outs[0]
+    mode, step, epoch, digest = _parse_resumed(outs[0])
+    assert mode == "exact"
+    assert epoch == 1 and step == 5
+    assert digest == w["digests"][1], (
+        "restored params differ from the preemption commit")
+    losses = _losses(outs[0])
+    # 3 remaining batches of the interrupted epoch + 2 full epochs
+    assert len(losses) == 3 + 2 * STEPS
+    ref = elastic_world["ref_losses"]
+    np.testing.assert_allclose(w["losses"], ref[:5], rtol=1e-6)
+    np.testing.assert_allclose(losses, ref[5:], rtol=1e-6)
+
+
+def test_second_preemption_accumulates_cursor(preempt_world, tmp_path):
+    """Preempt AGAIN while still inside the cursor-resumed epoch: the
+    recorded cursor must be the restored skip PLUS the newly consumed
+    rows — the trainer's batch counter restarted at zero on resume, so
+    an unadjusted cursor would double-read the difference on the next
+    resume."""
+    w = preempt_world
+    base = _clone_pod(w, tmp_path)
+    # resume (skips 8 rows = 1 batch of the interrupted epoch), then
+    # SIGTERM after 2 more batches — still inside that epoch (3 remain)
+    rcs, outs = _run_group(1, lambda pid, port: [
+        "preempt", "0", "1", port, w["data"], base, "2", "1", str(EPOCHS),
+        "2", "load"])
+    assert rcs == [0], outs[0]
+    assert "ELASTIC_PREEMPTED 0 after=2" in outs[0], outs[0]
+    man = ckpt_mod.load_manifest(f"{base}_iter1_preempt")
+    assert man["data_cursor"] == {"epoch": 1,
+                                  "global_row_ordinal": 8 + 2 * 8,
+                                  "global_batch_size": 8}
