@@ -36,6 +36,50 @@ def arguments_parser() -> ArgumentParser:
                              "state for a smaller artifact)")
     parser.add_argument("--predict", action="store_true",
                         help="run the interactive prediction shell")
+    parser.add_argument("--serve", action="store_true",
+                        help="run the batched prediction HTTP server "
+                             "(POST /predict, POST /embed, GET /healthz, "
+                             "GET /metrics) on the loaded model; also "
+                             "reachable as the `serve` subcommand "
+                             "(`code2vec_tpu serve --load ...`). "
+                             "SIGTERM drains gracefully")
+    parser.add_argument("--serve_port", type=int, default=None,
+                        metavar="PORT",
+                        help="HTTP port for --serve (default: config.py's "
+                             "8800; 0 picks a free port)")
+    parser.add_argument("--serve_host", default=None, metavar="HOST",
+                        help="HTTP bind address for --serve (default "
+                             "127.0.0.1; put a proxy in front for "
+                             "external exposure)")
+    parser.add_argument("--serve_batch_size", type=int, default=None,
+                        metavar="ROWS",
+                        help="rows per coalesced serving device batch "
+                             "(also the padded row count of every "
+                             "compiled predict shape; default 64)")
+    parser.add_argument("--serve_max_delay_ms", type=float, default=None,
+                        metavar="MS",
+                        help="max milliseconds a request waits for "
+                             "batch-mates before dispatching anyway "
+                             "(default 10; 0 = no coalescing)")
+    parser.add_argument("--serve_buckets", default=None, metavar="LIST",
+                        help="comma-separated padded-context-count "
+                             "buckets for the predict path (default "
+                             "'32,64,128'; max_contexts is always "
+                             "appended) — bounds the number of pjit "
+                             "compilations serving can trigger")
+    parser.add_argument("--serve_cache_entries", type=int, default=None,
+                        metavar="N",
+                        help="LRU prediction-cache capacity keyed by "
+                             "normalized method-body hash (default "
+                             "4096; 0 disables)")
+    parser.add_argument("--extractor_pool_size", type=int, default=None,
+                        metavar="N",
+                        help="warm extractor worker processes kept "
+                             "resident by the serving pool (default 2)")
+    parser.add_argument("--serve_drain_timeout_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="SIGTERM grace: seconds the drain waits "
+                             "for in-flight requests (default 30)")
     parser.add_argument("-fw", "--framework", dest="dl_framework",
                         choices=["jax", "tensorflow", "keras"], default="jax",
                         help="accepted for reference CLI compatibility; this "
@@ -167,9 +211,17 @@ def arguments_parser() -> ArgumentParser:
 
 
 def config_from_args(argv=None) -> Config:
+    if argv is None:
+        argv = sys.argv[1:]
+    # `serve` subcommand sugar: `code2vec_tpu serve --load M` ==
+    # `code2vec_tpu --serve --load M`.
+    serve_subcommand = bool(argv) and argv[0] == "serve"
+    if serve_subcommand:
+        argv = argv[1:]
     args = arguments_parser().parse_args(argv)
     config = Config(
         predict=args.predict,
+        serve=args.serve or serve_subcommand,
         model_save_path=args.save_path,
         model_load_path=args.load_path,
         train_data_path_prefix=args.data_path,
@@ -188,7 +240,14 @@ def config_from_args(argv=None) -> Config:
                                     "on_nonfinite_loss",
                                     "extractor_timeout_s",
                                     "extractor_retries",
-                                    "save_barrier_timeout_s")
+                                    "save_barrier_timeout_s",
+                                    "serve_port", "serve_host",
+                                    "serve_batch_size",
+                                    "serve_max_delay_ms",
+                                    "serve_buckets",
+                                    "serve_cache_entries",
+                                    "extractor_pool_size",
+                                    "serve_drain_timeout_s")
            if (value := getattr(args, knob)) is not None},
         async_checkpointing=args.async_checkpointing,
         cursor_resume=not args.no_cursor_resume,
@@ -250,6 +309,9 @@ def main(argv=None) -> None:
         from code2vec_tpu.serving.interactive import InteractivePredictor
         predictor = InteractivePredictor(config, model)
         predictor.predict()
+    if config.serve:
+        from code2vec_tpu.serving.server import serve_main
+        sys.exit(serve_main(config, model))
 
 
 if __name__ == "__main__":

@@ -106,6 +106,9 @@ class Config:
 
     # -- CLI-filled run mode (reference: config.py:72-87) --
     predict: bool = False
+    # Run the batched prediction HTTP server (serving/server.py) on the
+    # loaded/trained model. No reference analog.
+    serve: bool = False
     model_save_path: Optional[str] = None
     model_load_path: Optional[str] = None
     train_data_path_prefix: Optional[str] = None
@@ -203,6 +206,42 @@ class Config:
     # loadable in Perfetto, complementing the device-side --profile_dir
     # trace. None disables span buffering entirely.
     trace_export: Optional[str] = None
+    # -- serving (code2vec_tpu/serving; no reference equivalent — the
+    # reference "serves" through a one-file interactive REPL) --
+    # HTTP bind for the prediction server (`serve` subcommand /
+    # --serve). Port 0 picks a free port (logged + returned by
+    # PredictionServer.start); localhost by default — fronting proxies
+    # own external exposure/TLS.
+    serve_port: int = 8800
+    serve_host: str = "127.0.0.1"
+    # Rows per coalesced device batch: the dynamic batcher dispatches
+    # when this many method rows are pending (or the delay below
+    # expires). Also the padded row count of every compiled predict
+    # shape — smaller than test_batch_size because serving favors
+    # latency over peak throughput.
+    serve_batch_size: int = 64
+    # Max milliseconds a request waits for batch-mates before the
+    # batcher dispatches anyway: the latency price of coalescing on an
+    # idle server (a busy server fills batches and never waits).
+    serve_max_delay_ms: float = 10.0
+    # Padded-context-count buckets for the predict path (comma list;
+    # max_contexts is always appended, entries >= max_contexts or not
+    # divisible by cp are dropped): every predict batch pads its context
+    # axis up to the smallest bucket that holds its deepest valid
+    # context, so the number of pjit compilations the serving path can
+    # trigger is bounded by len(buckets) instead of one per request
+    # shape.
+    serve_buckets: str = "32,64,128"
+    # LRU prediction-cache capacity (entries), keyed by normalized
+    # method-body hash (serving/cache.py). 0 disables.
+    serve_cache_entries: int = 4096
+    # Warm extractor worker processes kept resident by the serving pool
+    # (serving/extractor_pool.py).
+    extractor_pool_size: int = 2
+    # Seconds the SIGTERM drain waits for in-flight requests before
+    # giving up (mirrors the trainer's preemption grace pattern).
+    serve_drain_timeout_s: float = 30.0
+
     # Full-content sha256 of every checkpoint file (including the
     # multi-GB Orbax shards, chunked + hashed on a thread pool) recorded
     # into the manifest AFTER the atomic commit, so it stays off the
@@ -363,6 +402,32 @@ class Config:
         if self.preprocess_workers < 0:
             raise ValueError(
                 "preprocess_workers must be >= 0 (0 = in-process serial).")
+        if not (0 <= self.serve_port <= 65535):
+            raise ValueError(
+                "serve_port must be in [0, 65535] (0 picks a free port).")
+        if self.serve_batch_size < 1:
+            raise ValueError("serve_batch_size must be >= 1.")
+        if self.serve_max_delay_ms < 0:
+            raise ValueError(
+                "serve_max_delay_ms must be >= 0 (0 = dispatch "
+                "immediately, no coalescing).")
+        if self.serve_cache_entries < 0:
+            raise ValueError(
+                "serve_cache_entries must be >= 0 (0 disables the "
+                "prediction cache).")
+        if self.extractor_pool_size < 1:
+            raise ValueError("extractor_pool_size must be >= 1.")
+        try:
+            from code2vec_tpu.serving.batcher import parse_buckets
+            parse_buckets(self.serve_buckets, self.max_contexts, cp=self.cp)
+        except ValueError:
+            raise ValueError(
+                f"serve_buckets must be a comma-separated list of ints "
+                f"(got {self.serve_buckets!r}).")
+        if self.serve_drain_timeout_s <= 0:
+            raise ValueError(
+                "serve_drain_timeout_s must be > 0 (a drain that never "
+                "times out can outlive the SIGTERM grace window).")
 
     # ---------------------------------------------------------------- logging
 

@@ -247,6 +247,31 @@ def invalid_batch(batch_size: int, max_contexts: int) -> RowBatch:
     )
 
 
+def slice_contexts(batch: RowBatch, m: int) -> RowBatch:
+    """Truncate the context axis to the first `m` columns (bucketed
+    predict: serving/batcher.py picks the smallest configured bucket
+    that still holds every VALID context of the batch, so the slice
+    never drops a real context — only padding columns)."""
+    if batch.source_token_indices.shape[1] <= m:
+        return batch
+
+    def cut(x):
+        return None if x is None else x[:, :m]
+
+    return RowBatch(
+        source_token_indices=cut(batch.source_token_indices),
+        path_indices=cut(batch.path_indices),
+        target_token_indices=cut(batch.target_token_indices),
+        context_valid_mask=cut(batch.context_valid_mask),
+        target_index=batch.target_index,
+        example_valid=batch.example_valid,
+        target_strings=batch.target_strings,
+        source_strings=cut(batch.source_strings),
+        path_strings=cut(batch.path_strings),
+        target_token_strings=cut(batch.target_token_strings),
+    )
+
+
 def _pad_rows(batch: RowBatch, batch_size: int) -> RowBatch:
     """Pad with invalid rows up to `batch_size` (static shapes under jit)."""
     n = batch.target_index.shape[0]

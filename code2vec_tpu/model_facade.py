@@ -162,7 +162,14 @@ class Code2VecModel:
             self.log(f"Loaded model weights from {config.model_load_path} "
                      f"(epoch {self.initial_epoch}, resume mode: {mode})")
         self._eval_step = None
-        self._predict_step = None
+        # Bucketed predict-step cache, shared by offline predict, the
+        # interactive REPL and the serving batcher: one freshly-jitted
+        # eval step per (batch_rows, context_bucket) shape, so the
+        # number of pjit compilations the predict path can trigger is
+        # bounded by the configured bucket list instead of growing with
+        # request shapes. len == compilations (each entry only ever sees
+        # its one shape).
+        self._predict_steps: Dict[Tuple[int, int], object] = {}
         # Async checkpoint commit pipeline; created by _make_save_fn when
         # config.async_checkpointing, closed when training ends.
         self._committer: Optional[ckpt_mod.AsyncCommitter] = None
@@ -608,63 +615,119 @@ class Code2VecModel:
 
     # ---------------------------------------------------------- predict
 
-    def _get_predict_step(self):
-        if self._predict_step is None:
-            self._predict_step = self.builder.make_eval_step(self.state)
-        return self._predict_step
+    @property
+    def context_buckets(self) -> Tuple[int, ...]:
+        """Padded-context-count buckets for the predict path (sorted,
+        always ending in max_contexts, filtered to cp multiples) —
+        parsed once from config.serve_buckets. One compiled step per
+        bucket is the whole compilation budget of the serving path."""
+        cached = getattr(self, "_context_buckets", None)
+        if cached is None:
+            from code2vec_tpu.serving.batcher import parse_buckets
+            cached = self._context_buckets = parse_buckets(
+                getattr(self.config, "serve_buckets", ""),
+                self.config.max_contexts, cp=self.config.cp)
+        return cached
 
-    def predict(self, predict_data_lines: Iterable[str]) -> List[ModelPredictionResults]:
+    def _get_bucketed_predict_step(self, batch_rows: int, m: int):
+        key = (batch_rows, m)
+        step = self._predict_steps.get(key)
+        if step is None:
+            # a FRESH jitted callable per shape: each entry compiles
+            # exactly once, so len(_predict_steps) == pjit compilations
+            step = self._predict_steps[key] = \
+                self.builder.make_eval_step(self.state)
+            self.log(f"Compiling predict step for shape "
+                     f"(rows={batch_rows}, contexts={m}) "
+                     f"[{len(self._predict_steps)} of "
+                     f"<= {len(self.context_buckets)} buckets]")
+        return step
+
+    def predict_compile_count(self) -> int:
+        """Distinct compiled predict-step shapes so far (bounded by the
+        bucket list for a fixed serve batch size; asserted in
+        tests/test_serving.py and recorded by the serving bench)."""
+        return len(self._predict_steps)
+
+    def predict(self, predict_data_lines: Iterable[str],
+                batch_size: Optional[int] = None,
+                with_code_vectors: Optional[bool] = None
+                ) -> List[ModelPredictionResults]:
         """reference: tensorflow_model.py:310-367 — per-line predictions
         with top-k words, softmax-normalized scores, attention per context
-        and the code vector."""
-        config = self.config
-        step = self._get_predict_step()
+        and the code vector.
+
+        Accepts any iterable (never materialized whole): lines stream in
+        `batch_size`-row chunks, each routed through the bucketed
+        compiled-step cache the serving batcher shares, so a million-line
+        offline predict and the HTTP server exercise the SAME bounded set
+        of compiled shapes. `with_code_vectors` defaults to
+        config.export_code_vectors; the serving /embed endpoint forces it
+        on (the step computes the vectors either way — the flag only
+        gates their host-side materialization)."""
+        import itertools
         results: List[ModelPredictionResults] = []
-        lines = list(predict_data_lines)
-        if not lines:
-            return results
-        batch = parse_context_lines(lines, self.vocabs, config.max_contexts,
-                                    EstimatorAction.Predict, keep_strings=True)
-        # Pad the row count to the jitted batch size to avoid recompiles.
-        from code2vec_tpu.data.reader import _pad_rows
-        bs = config.test_batch_size
-        chunks = [batch] if len(lines) <= bs else None
-        if chunks is None:
-            idxs = [np.arange(i, min(i + bs, len(lines)))
-                    for i in range(0, len(lines), bs)]
-            from code2vec_tpu.data.reader import _select_rows
-            chunks = [_select_rows(batch, ix) for ix in idxs]
-        for chunk in chunks:
-            n = chunk.target_index.shape[0]
-            padded = _pad_rows(chunk, bs)
-            arrays = device_put_batch(padded, self.mesh)
-            out = step(self.state.params, *arrays)
-            topk_idx = np.asarray(out.topk_indices)[:n]
-            topk_val = np.asarray(out.topk_values)[:n]
-            code_vectors = np.asarray(out.code_vectors)[:n]
-            attention = np.asarray(out.attention)[:n]
-            # normalize_scores=True in the reference predict graph
-            # (tensorflow_model.py:321): softmax over the k values.
-            e = np.exp(topk_val - topk_val.max(axis=1, keepdims=True))
-            scores = e / e.sum(axis=1, keepdims=True)
-            for i in range(n):
-                words = [self.vocabs.target_vocab.lookup_word(int(j))
-                         for j in topk_idx[i]]
-                attention_per_context: Dict[Tuple[str, str, str], float] = {}
-                for m in range(config.max_contexts):
-                    s = chunk.source_strings[i, m]
-                    p = chunk.path_strings[i, m]
-                    t = chunk.target_token_strings[i, m]
-                    if s or p or t:
-                        attention_per_context[(s, p, t)] = float(attention[i, m])
-                results.append(ModelPredictionResults(
-                    original_name=(chunk.target_strings[i]
-                                   if chunk.target_strings else ""),
-                    topk_predicted_words=words,
-                    topk_predicted_words_scores=scores[i],
-                    attention_per_context=attention_per_context,
-                    code_vector=(code_vectors[i]
-                                 if config.export_code_vectors else None)))
+        bs = int(batch_size or self.config.test_batch_size)
+        if with_code_vectors is None:
+            with_code_vectors = self.config.export_code_vectors
+        it = iter(predict_data_lines)
+        while True:
+            lines = list(itertools.islice(it, bs))
+            if not lines:
+                return results
+            results.extend(self._predict_chunk(lines, bs,
+                                               with_code_vectors))
+
+    def _predict_chunk(self, lines: List[str], bs: int,
+                       with_code_vectors: bool
+                       ) -> List[ModelPredictionResults]:
+        config = self.config
+        from code2vec_tpu.data.reader import _pad_rows, slice_contexts
+        from code2vec_tpu.serving.batcher import bucket_for
+        chunk = parse_context_lines(lines, self.vocabs, config.max_contexts,
+                                    EstimatorAction.Predict,
+                                    keep_strings=True)
+        n = len(lines)
+        # Deepest VALID context column decides the bucket: the slice
+        # below only ever removes all-padding columns.
+        any_valid_col = chunk.context_valid_mask.any(axis=0)
+        deepest = (int(np.nonzero(any_valid_col)[0][-1]) + 1
+                   if any_valid_col.any() else 1)
+        m = bucket_for(deepest, self.context_buckets)
+        chunk = slice_contexts(chunk, m)
+        # Pad the row count to the fixed serve batch size: row count and
+        # context bucket together fully determine the compiled shape.
+        padded = _pad_rows(chunk, bs)
+        step = self._get_bucketed_predict_step(bs, m)
+        arrays = device_put_batch(padded, self.mesh)
+        out = step(self.state.params, *arrays)
+        results: List[ModelPredictionResults] = []
+        topk_idx = np.asarray(out.topk_indices)[:n]
+        topk_val = np.asarray(out.topk_values)[:n]
+        code_vectors = np.asarray(out.code_vectors)[:n]
+        attention = np.asarray(out.attention)[:n]
+        # normalize_scores=True in the reference predict graph
+        # (tensorflow_model.py:321): softmax over the k values.
+        e = np.exp(topk_val - topk_val.max(axis=1, keepdims=True))
+        scores = e / e.sum(axis=1, keepdims=True)
+        for i in range(n):
+            words = [self.vocabs.target_vocab.lookup_word(int(j))
+                     for j in topk_idx[i]]
+            attention_per_context: Dict[Tuple[str, str, str], float] = {}
+            for j in range(m):
+                s = chunk.source_strings[i, j]
+                p = chunk.path_strings[i, j]
+                t = chunk.target_token_strings[i, j]
+                if s or p or t:
+                    attention_per_context[(s, p, t)] = float(attention[i, j])
+            results.append(ModelPredictionResults(
+                original_name=(chunk.target_strings[i]
+                               if chunk.target_strings else ""),
+                topk_predicted_words=words,
+                topk_predicted_words_scores=scores[i],
+                attention_per_context=attention_per_context,
+                code_vector=(code_vectors[i]
+                             if with_code_vectors else None)))
         return results
 
     # ------------------------------------------------------------ save

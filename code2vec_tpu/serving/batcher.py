@@ -1,0 +1,218 @@
+"""Dynamic request batcher: coalesce concurrent predicts into device
+batches under a latency budget, with bucketed context counts.
+
+Why this shape: the device side runs ~41.3K examples/s (BENCH_EVAL.json)
+but only if it is fed BATCHES — a per-request jitted call wastes the
+chip on dispatch overhead, and letting every request shape hit pjit
+would recompile per distinct (rows, contexts) pair. So:
+
+- Requests (groups of extracted method lines) enqueue; a single
+  dispatcher thread collects until either `max_batch_rows` rows are
+  pending or the OLDEST request has waited `max_delay_s`, then runs one
+  model call over the coalesced rows. A lone request on an idle server
+  therefore pays at most `max_delay_s` extra latency; a busy server
+  fills batches and pays none.
+- The model call itself buckets the context axis (model_facade.predict
+  `context_buckets`): rows are padded to the smallest configured bucket
+  that fits their deepest valid context, so the number of compiled
+  shapes is bounded by len(buckets) — shared with offline predict,
+  which routes through the same compiled-step cache.
+
+`submit()` returns a concurrent.futures.Future resolving to the list of
+per-line results; an optional `phases` dict receives the `batch_wait`
+(submit -> dispatch) and `device` SLO phases. `device` is the FULL
+duration of the coalesced model call the request rode in — that is the
+latency the request actually experienced (phases sum to ~total); the
+per-batch cost lives in `serving_device_seconds`, and amortized
+per-row cost is that divided by `serving_batch_rows`. `drain()` stops intake, flushes everything pending, and joins
+the dispatcher — the SIGTERM-grace path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from code2vec_tpu import obs
+
+_H_BATCH_ROWS = obs.histogram(
+    "serving_batch_rows",
+    "rows per dispatched device batch (coalescing effectiveness)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+_H_BATCH_WAIT = obs.histogram(
+    "serving_batch_wait_seconds",
+    "request submit to device-batch dispatch (coalescing delay)")
+_H_DEVICE = obs.histogram(
+    "serving_device_seconds",
+    "one coalesced model call: parse + pad + device step + unpack")
+_C_BATCHES = obs.counter("serving_batches_total",
+                         "device batches dispatched by the batcher")
+_C_ROWS = obs.counter("serving_batch_rows_total",
+                      "method rows pushed through the batcher")
+
+
+def parse_buckets(spec, max_contexts: int, cp: int = 1) -> Tuple[int, ...]:
+    """Normalize a bucket spec ("32,64,128" string or int sequence) into
+    a sorted tuple capped at `max_contexts` (always included, so every
+    legal row fits some bucket) and filtered to multiples of the
+    context-parallel degree (a cp-sharded step needs the context axis
+    divisible by cp)."""
+    if isinstance(spec, str):
+        vals = [int(v) for v in spec.replace(" ", "").split(",") if v]
+    else:
+        vals = [int(v) for v in (spec or ())]
+    vals = sorted({v for v in vals if 0 < v < max_contexts
+                   and v % max(cp, 1) == 0})
+    return tuple(vals) + (max_contexts,)
+
+
+def bucket_for(n_contexts: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket holding a row whose deepest valid context sits at
+    index n_contexts-1. Callers guarantee buckets[-1] == max_contexts."""
+    for b in buckets:
+        if b >= n_contexts:
+            return b
+    return buckets[-1]
+
+
+class _Pending:
+    __slots__ = ("lines", "future", "t_submit", "phases")
+
+    def __init__(self, lines: List[str], phases: Optional[dict]):
+        self.lines = lines
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.phases = phases
+
+
+class DynamicBatcher:
+    """Single dispatcher thread over a condition-guarded pending queue.
+
+    `predict_fn(lines) -> List[result]` is the facade's batched predict:
+    it must return exactly one result per input line, in order. All
+    pending groups are dispatched together in FIFO order up to
+    `max_batch_rows` rows; one oversized group (a file with more methods
+    than the cap) dispatches alone — predict_fn chunks internally, so
+    correctness never depends on the cap.
+    """
+
+    def __init__(self, predict_fn: Callable[[List[str]], List],
+                 max_batch_rows: int = 64, max_delay_s: float = 0.01):
+        self.predict_fn = predict_fn
+        self.max_batch_rows = max(1, int(max_batch_rows))
+        self.max_delay_s = max(0.0, float(max_delay_s))
+        self._cond = threading.Condition()
+        self._pending: List[_Pending] = []
+        self._pending_rows = 0
+        self._draining = False
+        self._closed = False
+        self.batches_dispatched = 0
+        self._thread = threading.Thread(target=self._run,
+                                        name="serving-batcher", daemon=True)
+        self._thread.start()
+
+    # -------------------------------------------------------------- API
+
+    def submit(self, lines: Sequence[str],
+               phases: Optional[dict] = None) -> Future:
+        item = _Pending(list(lines), phases)
+        if not item.lines:
+            item.future.set_result([])
+            return item.future
+        with self._cond:
+            if self._draining:
+                item.future.set_exception(
+                    RuntimeError("batcher is draining; not accepting "
+                                 "new requests"))
+                return item.future
+            self._pending.append(item)
+            self._pending_rows += len(item.lines)
+            self._cond.notify_all()
+        return item.future
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop intake, flush every pending request, join the thread.
+        Idempotent; safe from signal-handler-adjacent threads."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    # -------------------------------------------------------- dispatcher
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            self._dispatch(batch)
+
+    def _collect(self) -> Optional[List[_Pending]]:
+        """Block until a batch is due: rows >= cap, oldest item older
+        than max_delay_s, or draining (flush everything)."""
+        with self._cond:
+            while True:
+                if self._pending:
+                    if (self._draining
+                            or self._pending_rows >= self.max_batch_rows):
+                        return self._take_locked()
+                    age = time.perf_counter() - self._pending[0].t_submit
+                    remaining = self.max_delay_s - age
+                    if remaining <= 0:
+                        return self._take_locked()
+                    self._cond.wait(timeout=remaining)
+                elif self._draining:
+                    self._closed = True
+                    return None
+                else:
+                    self._cond.wait()
+
+    def _take_locked(self) -> List[_Pending]:
+        take: List[_Pending] = []
+        rows = 0
+        while self._pending:
+            nxt = self._pending[0]
+            if take and rows + len(nxt.lines) > self.max_batch_rows:
+                break
+            take.append(self._pending.pop(0))
+            rows += len(nxt.lines)
+        self._pending_rows -= rows
+        return take
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        t_dispatch = time.perf_counter()
+        all_lines: List[str] = []
+        for item in batch:
+            wait = t_dispatch - item.t_submit
+            _H_BATCH_WAIT.observe(wait)
+            if item.phases is not None:
+                item.phases["batch_wait"] = wait
+            all_lines.extend(item.lines)
+        _C_BATCHES.inc()
+        self.batches_dispatched += 1
+        _C_ROWS.inc(len(all_lines))
+        _H_BATCH_ROWS.observe(len(all_lines))
+        try:
+            results = self.predict_fn(all_lines)
+            if len(results) != len(all_lines):
+                raise RuntimeError(
+                    f"predict_fn returned {len(results)} results for "
+                    f"{len(all_lines)} lines")
+        except BaseException as e:  # noqa: BLE001 — futures must settle
+            for item in batch:
+                if not item.future.set_running_or_notify_cancel():
+                    continue
+                item.future.set_exception(e)
+            return
+        dur = time.perf_counter() - t_dispatch
+        _H_DEVICE.observe(dur)
+        off = 0
+        for item in batch:
+            n = len(item.lines)
+            if item.phases is not None:
+                item.phases["device"] = dur
+            if item.future.set_running_or_notify_cancel():
+                item.future.set_result(results[off:off + n])
+            off += n

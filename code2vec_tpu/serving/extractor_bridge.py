@@ -71,6 +71,31 @@ def _native_extractor_path() -> str:
     return os.path.join(here, "cpp", "build", "c2v-extract")
 
 
+def postprocess_extractor_output(output: List[str], max_contexts: int
+                                 ) -> Tuple[List[str], Dict[str, str]]:
+    """Turn raw `--no_hash` extractor lines into model-ready predict
+    lines: truncate to `max_contexts`, re-hash each readable path with
+    Java's String#hashCode (the training data stores hashed paths), pad
+    to a fixed context count, and keep hash->string for the attention
+    display. Shared by the one-shot bridge below and the warm worker
+    pool (serving/extractor_pool.py) so both produce byte-identical
+    predict input (reference driver semantics: extractor.py:11-38)."""
+    hash_to_string: Dict[str, str] = {}
+    result = []
+    for line in output:
+        parts = line.rstrip().split(" ")
+        line_parts = [parts[0]]
+        contexts = parts[1:]
+        for context in contexts[:max_contexts]:
+            w1, p, w2 = context.split(",")
+            hashed = str(java_string_hashcode(p))
+            hash_to_string[hashed] = p
+            line_parts.append(f"{w1},{hashed},{w2}")
+        padding = " " * (max_contexts - len(contexts))
+        result.append(" ".join(line_parts) + padding)
+    return result, hash_to_string
+
+
 class PathExtractor:
     # backoff before retry attempt k (1-based) is _RETRY_BACKOFF_BASE_S *
     # 2**(k-1), capped — a crashed child usually hit transient pressure
@@ -186,18 +211,4 @@ class PathExtractor:
                 f"{err.decode(errors='replace').strip()!r}")
         if len(output) == 0:
             raise ValueError(err.decode())
-        hash_to_string: Dict[str, str] = {}
-        result = []
-        max_contexts = self.config.max_contexts
-        for line in output:
-            parts = line.rstrip().split(" ")
-            line_parts = [parts[0]]
-            contexts = parts[1:]
-            for context in contexts[:max_contexts]:
-                w1, p, w2 = context.split(",")
-                hashed = str(java_string_hashcode(p))
-                hash_to_string[hashed] = p
-                line_parts.append(f"{w1},{hashed},{w2}")
-            padding = " " * (max_contexts - len(contexts))
-            result.append(" ".join(line_parts) + padding)
-        return result, hash_to_string
+        return postprocess_extractor_output(output, self.config.max_contexts)

@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 from code2vec_tpu.common import get_subtokens
-from code2vec_tpu.serving.extractor_bridge import PathExtractor
+from code2vec_tpu.serving.extractor_pool import ExtractorPool
 
 SHOW_TOP_CONTEXTS = 10
 MAX_PATH_LENGTH = 8
@@ -62,38 +62,52 @@ class InteractivePredictor:
     def __init__(self, config, model):
         self.model = model
         self.config = config
-        self.path_extractor = PathExtractor(
-            config, max_path_length=MAX_PATH_LENGTH,
+        # ONE warm extractor held for the whole session (the serving
+        # pool, size 1) instead of a fresh subprocess per snippet:
+        # re-predicting after an edit costs a parse, not a process
+        # spawn. Prediction rides the same bucketed compiled-step cache
+        # the HTTP server uses (model_facade.predict).
+        self.extractor_pool = ExtractorPool(
+            config, size=1, max_path_length=MAX_PATH_LENGTH,
             max_path_width=MAX_PATH_WIDTH)
+
+    def close(self):
+        self.extractor_pool.close()
 
     def predict(self, input_filename: str = "Input.java"):
         print("Starting interactive prediction...")
         oov = self.model.vocabs.target_vocab.special_words.oov
-        while True:
-            print(f'Modify the file: "{input_filename}" and press any key '
-                  'when ready, or "q" / "quit" / "exit" to exit')
-            user_input = input()
-            if user_input.lower() in self.exit_keywords:
-                print("Exiting...")
-                return
-            try:
-                predict_lines, hash_to_string = \
-                    self.path_extractor.extract_paths(input_filename)
-            except (ValueError, FileNotFoundError) as e:
-                print(e)
-                continue
-            raw_results = self.model.predict(predict_lines)
-            method_results = parse_prediction_results(
-                raw_results, hash_to_string, oov, topk=SHOW_TOP_CONTEXTS)
-            for raw, method in zip(raw_results, method_results):
-                print("Original name:\t" + method.original_name)
-                for pair in method.predictions:
-                    print("\t(%f) predicted: %s" % (pair["probability"],
-                                                    pair["name"]))
-                print("Attention:")
-                for att in method.attention_paths:
-                    print("%f\tcontext: %s,%s,%s" % (
-                        att["score"], att["token1"], att["path"], att["token2"]))
-                if self.config.export_code_vectors and raw.code_vector is not None:
-                    print("Code vector:")
-                    print(" ".join(map(str, raw.code_vector)))
+        try:
+            while True:
+                print(f'Modify the file: "{input_filename}" and press any '
+                      'key when ready, or "q" / "quit" / "exit" to exit')
+                user_input = input()
+                if user_input.lower() in self.exit_keywords:
+                    print("Exiting...")
+                    return
+                try:
+                    predict_lines, hash_to_string = \
+                        self.extractor_pool.extract_file(input_filename)
+                except (ValueError, FileNotFoundError) as e:
+                    print(e)
+                    continue
+                raw_results = self.model.predict(predict_lines)
+                method_results = parse_prediction_results(
+                    raw_results, hash_to_string, oov,
+                    topk=SHOW_TOP_CONTEXTS)
+                for raw, method in zip(raw_results, method_results):
+                    print("Original name:\t" + method.original_name)
+                    for pair in method.predictions:
+                        print("\t(%f) predicted: %s" % (pair["probability"],
+                                                        pair["name"]))
+                    print("Attention:")
+                    for att in method.attention_paths:
+                        print("%f\tcontext: %s,%s,%s" % (
+                            att["score"], att["token1"], att["path"],
+                            att["token2"]))
+                    if (self.config.export_code_vectors
+                            and raw.code_vector is not None):
+                        print("Code vector:")
+                        print(" ".join(map(str, raw.code_vector)))
+        finally:
+            self.close()

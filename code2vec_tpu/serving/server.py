@@ -1,0 +1,400 @@
+"""Batched prediction HTTP server: the paper's model behind real traffic.
+
+Request path:
+
+    POST /predict  --cache miss-->  extractor pool (warm --server
+    workers) --> dynamic batcher (coalesce + context-bucketed padded
+    shapes) --> jitted predict step --> JSON response --> LRU cache
+
+Endpoints (JSON unless noted; schema in README "Serving"):
+
+- `POST /predict`  body = raw Java source (or `{"code": "..."}`);
+  per-method top-k name predictions + attention paths (+ code vectors
+  when the model was created with --export_code_vectors).
+- `POST /embed`    same input; code vectors only (forces them on
+  regardless of --export_code_vectors — the embedding IS the product).
+- `GET  /healthz`  liveness + pool/batcher/cache gauges; `"status":
+  "serving"` flips to `"draining"` during SIGTERM grace.
+- `GET  /metrics`  Prometheus text format — the same registry/plumbing
+  as the trainer's --metrics_port (obs/exporters.py).
+
+Every request is timed into per-phase SLO histograms
+(`serving_request_seconds{phase=queue_wait|extract|batch_wait|device|
+total}`) through the PR-2 MetricsRegistry, so p50/p99 per phase come
+free from any Prometheus scrape.
+
+Shutdown mirrors the trainer's preemption-grace pattern
+(training/loop.py PreemptionWatcher): SIGTERM stops intake, in-flight
+requests finish (bounded by config.serve_drain_timeout_s), the batcher
+flushes, the extractor pool is torn down, and the process exits 0.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import signal
+import socketserver
+import threading
+import time
+from typing import Dict, Optional
+
+from code2vec_tpu import obs
+from code2vec_tpu.serving.batcher import DynamicBatcher
+from code2vec_tpu.serving.cache import PredictionCache, cache_key
+from code2vec_tpu.serving.extractor_bridge import ExtractorCrash
+from code2vec_tpu.serving.extractor_pool import ExtractorPool
+from code2vec_tpu.serving.interactive import parse_prediction_results
+
+_PHASES = ("queue_wait", "extract", "batch_wait", "device", "total")
+
+
+def _phase_hist(phase: str):
+    return obs.histogram(
+        "serving_request_seconds",
+        "per-request serving latency by phase: queue_wait (extractor "
+        "slot), extract (path extraction), batch_wait (coalescing), "
+        "device (model call), total (end to end)", phase=phase)
+
+
+_H_PHASE = {p: _phase_hist(p) for p in _PHASES}
+
+
+def _requests_counter(endpoint: str, status: str):
+    return obs.counter("serving_requests_total",
+                       "HTTP requests by endpoint and outcome",
+                       endpoint=endpoint, status=status)
+
+
+class _HTTPError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class PredictionServer:
+    """Owns the pool + batcher + cache around one Code2VecModel.
+
+    Separable from HTTP: `handle(endpoint, code)` returns the response
+    bytes, so tests and the bench can drive the full path in-process,
+    and the HTTP layer stays a thin framing shim.
+    """
+
+    def __init__(self, model, config=None, log=None):
+        self.model = model
+        self.config = config or model.config
+        self.log = log or self.config.log
+        self.pool = ExtractorPool(
+            self.config, size=self.config.extractor_pool_size, log=self.log)
+        # with_code_vectors=True: /predict and /embed rows coalesce into
+        # the SAME batches (a per-endpoint batcher would halve fill);
+        # the step computes vectors anyway, the flag only materializes
+        # them host-side, and _render decides per endpoint what ships.
+        self.batcher = DynamicBatcher(
+            lambda lines: model.predict(
+                lines, batch_size=self.config.serve_batch_size,
+                with_code_vectors=True),
+            max_batch_rows=self.config.serve_batch_size,
+            max_delay_s=self.config.serve_max_delay_ms / 1000.0)
+        self.cache = PredictionCache(self.config.serve_cache_entries)
+        self.topk = self.config.top_k_words_considered_during_prediction
+        self._httpd: Optional[socketserver.BaseServer] = None
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._draining = False
+        self._drained = threading.Event()
+        self.started_at = time.time()
+        self.port: Optional[int] = None
+
+    # ---------------------------------------------------------- predict
+
+    def handle(self, endpoint: str, code: str) -> bytes:
+        """Full serve path for one request; returns the response BYTES
+        (cached verbatim, so a hit is byte-equal to the miss that
+        populated it)."""
+        if not code.strip():
+            raise _HTTPError(400, "empty request body")
+        t0 = time.perf_counter()
+        phases: Dict[str, float] = {}
+        key = cache_key(code, endpoint=endpoint, topk=self.topk)
+        cached = self.cache.get(key)
+        if cached is not None:
+            _H_PHASE["total"].observe(time.perf_counter() - t0)
+            return cached  # type: ignore[return-value]
+        try:
+            lines, hash_to_string = self.pool.extract_source(
+                code, phases=phases)
+        except FileNotFoundError as e:
+            raise _HTTPError(503, f"no extractor available: {e}")
+        except (ExtractorCrash, OSError) as e:
+            # infra failure (workers dying through every retry), NOT the
+            # client's source: 503 tells a well-behaved client to retry.
+            # Must precede the ValueError arm — ExtractorCrash subclasses
+            # it so the REPL's catch-all keeps working.
+            raise _HTTPError(503, f"extractor unavailable: {e}")
+        except ValueError as e:  # parse rejection / timeout: input-driven
+            raise _HTTPError(422, f"extraction failed: {e}")
+        try:
+            raw = self.batcher.submit(lines, phases=phases).result()
+        except RuntimeError as e:  # draining
+            raise _HTTPError(503, str(e))
+        body = json.dumps(
+            self._render(endpoint, raw, hash_to_string),
+            sort_keys=True).encode() + b"\n"
+        self.cache.put(key, body)
+        phases["total"] = time.perf_counter() - t0
+        for phase, dur in phases.items():
+            _H_PHASE[phase].observe(dur)
+        return body
+
+    def _render(self, endpoint: str, raw, hash_to_string) -> dict:
+        if endpoint == "embed":
+            return {"model": "code2vec_tpu",
+                    "vectors": [
+                        ([] if r.code_vector is None
+                         else [float(v) for v in r.code_vector])
+                        for r in raw],
+                    "method_names": [r.original_name for r in raw]}
+        oov = self.model.vocabs.target_vocab.special_words.oov
+        methods = []
+        for r, parsed in zip(raw, parse_prediction_results(
+                raw, hash_to_string, oov, topk=10)):
+            entry = {
+                "original_name": r.original_name,
+                "predictions": [
+                    {"name": p["name"], "probability": p["probability"]}
+                    for p in parsed.predictions],
+                "attention_paths": parsed.attention_paths,
+            }
+            # /predict ships vectors only when the model was created
+            # with --export_code_vectors (/embed always does).
+            if (self.config.export_code_vectors
+                    and r.code_vector is not None):
+                entry["code_vector"] = [float(v) for v in r.code_vector]
+            methods.append(entry)
+        return {"model": "code2vec_tpu", "methods": methods}
+
+    def handle_embed(self, code: str) -> bytes:
+        return self.handle("embed", code)
+
+    # ------------------------------------------------------------- http
+
+    def healthz(self) -> dict:
+        return {
+            "status": "draining" if self._draining else "serving",
+            "uptime_s": time.time() - self.started_at,
+            "pid": os.getpid(),
+            "extractor_pool": {"size": self.pool.size,
+                               "warm": self.pool.warm},
+            "batcher": {"max_batch_rows": self.batcher.max_batch_rows,
+                        "max_delay_ms":
+                            self.batcher.max_delay_s * 1000.0,
+                        "batches_dispatched":
+                            self.batcher.batches_dispatched},
+            "cache": {"capacity": self.cache.capacity,
+                      "entries": len(self.cache)},
+            "buckets": list(self.model.context_buckets),
+            # compiled shapes AT THE SERVE BATCH SIZE — the serving
+            # compilation budget, bounded by len(buckets). (An offline
+            # predict through the same facade at another batch size
+            # adds its own bounded set; predict_compile_count() has the
+            # overall number.) list() snapshots the dict atomically —
+            # the batcher thread inserts newly compiled shapes
+            # concurrently, and a generator over the live dict could
+            # raise mid-iteration.
+            "compiled_predict_steps": sum(
+                1 for rows, _ in list(self.model._predict_steps)
+                if rows == self.config.serve_batch_size),
+            "compiled_predict_steps_all": (
+                self.model.predict_compile_count()),
+            "inflight": self._inflight,
+        }
+
+    def start(self, port: Optional[int] = None,
+              host: Optional[str] = None) -> int:
+        """Bind + serve on a daemon thread; returns the bound port
+        (port 0 picks a free one)."""
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # per-request stderr silenced
+                pass
+
+            def _respond(self, code: int, body: bytes,
+                         ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, message: str) -> None:
+                self._respond(code, json.dumps(
+                    {"error": message}).encode() + b"\n")
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._respond(200, json.dumps(
+                            server.healthz(),
+                            sort_keys=True).encode() + b"\n")
+                    elif path in ("/metrics", "/"):
+                        self._respond(
+                            200, obs.default_registry()
+                            .render_prometheus().encode(),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+                    else:
+                        self._error(404, f"no such endpoint: {path}")
+                except Exception as e:  # noqa: BLE001 — a probe must get
+                    # an HTTP response, never a torn connection (a failed
+                    # liveness probe can restart-loop the replica)
+                    self._error(500, f"{type(e).__name__}: {e}")
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                endpoint = path.lstrip("/")
+                if endpoint not in ("predict", "embed"):
+                    self._error(404, f"no such endpoint: {path}")
+                    return
+                if not server._enter_request():
+                    _requests_counter(endpoint, "draining").inc()
+                    self._error(503, "server is draining")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length)
+                    code = server._decode_body(raw, self.headers)
+                    body = server.handle(endpoint, code)
+                except _HTTPError as e:
+                    _requests_counter(endpoint, str(e.code)).inc()
+                    self._error(e.code, str(e))
+                except Exception as e:  # noqa: BLE001 — 500, not a hang
+                    _requests_counter(endpoint, "500").inc()
+                    self._error(500, f"{type(e).__name__}: {e}")
+                else:
+                    _requests_counter(endpoint, "200").inc()
+                    self._respond(200, body)
+                finally:
+                    server._exit_request()
+
+        httpd = http.server.ThreadingHTTPServer(
+            (host if host is not None else self.config.serve_host,
+             port if port is not None else self.config.serve_port),
+            Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever,
+                         name="serving-http", daemon=True).start()
+        self.log(f"Prediction server listening on "
+                 f"http://{httpd.server_address[0]}:{self.port} "
+                 f"(POST /predict, POST /embed, GET /healthz, "
+                 f"GET /metrics)")
+        return self.port
+
+    @staticmethod
+    def _decode_body(raw: bytes, headers) -> str:
+        text = raw.decode("utf-8", errors="replace")
+        ctype = (headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == "application/json":
+            try:
+                payload = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise _HTTPError(400, f"bad JSON body: {e}")
+            if not isinstance(payload, dict) or "code" not in payload:
+                raise _HTTPError(400, 'JSON body must be {"code": "..."}')
+            return str(payload["code"])
+        return text
+
+    def _enter_request(self) -> bool:
+        with self._inflight_cond:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def _exit_request(self) -> None:
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    # ------------------------------------------------------------ drain
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful stop: refuse new requests, wait for in-flight ones
+        (bounded), flush the batcher, tear down pool + listener.
+        Idempotent; returns True when everything in flight finished
+        inside the budget."""
+        with self._inflight_cond:
+            if self._draining:
+                self._drained.wait(timeout)
+                return self._inflight == 0
+            self._draining = True
+        budget = (timeout if timeout is not None
+                  else self.config.serve_drain_timeout_s)
+        self.log(f"Drain: refusing new requests, waiting up to "
+                 f"{budget:g}s for {self._inflight} in-flight")
+        deadline = time.monotonic() + budget
+        clean = True
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    clean = False
+                    self.log(f"Drain timeout: {self._inflight} "
+                             f"request(s) still in flight")
+                    break
+                self._inflight_cond.wait(timeout=remaining)
+        self.batcher.drain(timeout=max(deadline - time.monotonic(), 1.0))
+        self.pool.close()
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:
+                pass  # teardown must never mask the drain result
+        self._drained.set()
+        self.log(f"Drain complete ({'clean' if clean else 'timed out'})")
+        return clean
+
+
+def serve_main(config, model=None) -> int:
+    """The `serve` CLI subcommand body: build the model, start the
+    server, park the main thread until SIGTERM/SIGINT, drain, exit.
+    Returns the process exit code."""
+    if model is None:
+        from code2vec_tpu.model_facade import Code2VecModel
+        model = Code2VecModel(config)
+    server = PredictionServer(model, config)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        config.log(f"Signal {signal.Signals(signum).name} received: "
+                   f"draining")
+        stop.set()
+
+    prev_term = signal.signal(signal.SIGTERM, _on_signal)
+    prev_int = signal.signal(signal.SIGINT, _on_signal)
+    server.start()
+    if config.heartbeat_file:
+        obs.exporters.write_heartbeat(
+            config.heartbeat_file, status="serving", port=server.port)
+    try:
+        stop.wait()
+    finally:
+        clean = server.drain()
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+        if config.metrics_file:
+            obs.exporters.write_prometheus(config.metrics_file)
+        if config.heartbeat_file:
+            obs.exporters.write_heartbeat(
+                config.heartbeat_file,
+                status="done" if clean else "error",
+                port=server.port)
+    return 0 if clean else 1

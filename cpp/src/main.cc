@@ -3,7 +3,7 @@
 // CLI-compatible with the reference jar (App.java:18-37,
 // CommandLineValues.java:12-40):
 //   c2v-extract --max_path_length 8 --max_path_width 2
-//       (--file F | --dir D) [--no_hash] [--num_threads N]
+//       (--file F | --dir D | --server) [--no_hash] [--num_threads N]
 //       [--min_code_len N] [--max_code_len N] [--max_child_id N]
 //       [--pretty_print]
 //
@@ -11,6 +11,16 @@
 // printed atomically (ExtractFeaturesTask.java:36-52). Parse failures
 // are reported on stderr and the file skipped, like the reference's
 // printStackTrace-and-continue.
+//
+// --server keeps the process resident as a warm extraction worker for
+// the serving pool (code2vec_tpu/serving/extractor_pool.py): it prints
+// "READY\n" once, then serves line-framed requests on stdin --
+//   FILE <path>\n          extract the file at <path>
+//   SRC <nbytes>\n<bytes>\n  extract <nbytes> of raw Java source
+// -- answering each with "OK <nlines>\n" + the method lines, or
+// "ERR <one-line message>\n". One request in flight at a time; the
+// pool runs one process per worker slot, so the in-process --dir
+// thread pool is not used here.
 
 #include <algorithm>
 #include <atomic>
@@ -33,6 +43,7 @@ namespace {
 struct Args {
   std::string file;
   std::string dir;
+  bool server = false;
   c2v::ExtractOptions options;
   int num_threads = 32;  // CommandLineValues.java:27-28
 };
@@ -62,6 +73,7 @@ bool ParseArgs(int argc, char** argv, Args* args) {
     else if (a == "--max_code_len") args->options.max_code_length = std::atoi(need_value(a.c_str()));
     else if (a == "--max_child_id") args->options.max_child_id = std::atoi(need_value(a.c_str()));
     else if (a == "--pretty_print") { /* accepted for CLI parity */ }
+    else if (a == "--server") args->server = true;
     else {
       std::cerr << "unknown flag: " << a << "\n";
       return false;
@@ -72,7 +84,13 @@ bool ParseArgs(int argc, char** argv, Args* args) {
     std::cerr << "--max_path_length and --max_path_width are required\n";
     return false;
   }
-  if (args->file.empty() == args->dir.empty()) {
+  if (args->server) {
+    if (!args->file.empty() || !args->dir.empty()) {
+      std::cerr << "--server takes requests on stdin; --file/--dir "
+                   "conflict with it\n";
+      return false;
+    }
+  } else if (args->file.empty() == args->dir.empty()) {
     std::cerr << "exactly one of --file/--dir is required\n";
     return false;
   }
@@ -149,11 +167,74 @@ int RunDir(const Args& args) {
   return 0;
 }
 
+// Warm-worker loop: line-framed requests on stdin, framed responses on
+// stdout. Every failure answers ERR (never exits), so a wedged parse
+// costs one request, not the worker -- the pool treats process death as
+// a crash and respawns.
+int RunServer(const Args& args) {
+  std::cout << "READY\n" << std::flush;
+  std::string line;
+  while (std::getline(std::cin, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    std::string source;
+    std::string err;
+    if (line.rfind("FILE ", 0) == 0) {
+      try {
+        source = ReadFile(line.substr(5));
+      } catch (const std::exception& e) {
+        err = e.what();
+      }
+    } else if (line.rfind("SRC ", 0) == 0) {
+      long nbytes = std::atol(line.c_str() + 4);
+      if (nbytes < 0) {
+        err = "bad SRC byte count";
+      } else {
+        source.resize(static_cast<size_t>(nbytes));
+        std::cin.read(source.data(), nbytes);
+        if (std::cin.gcount() != nbytes) {
+          err = "short SRC payload";
+        } else {
+          // eat the frame-terminating newline after the payload
+          std::string rest;
+          std::getline(std::cin, rest);
+        }
+      }
+    } else if (line.empty()) {
+      continue;
+    } else {
+      err = "bad request: " + line.substr(0, 64);
+    }
+    std::vector<std::string> lines;
+    if (err.empty()) {
+      try {
+        lines = c2v::ExtractFromSource(source, args.options);
+      } catch (const std::exception& e) {
+        err = e.what();
+      }
+    }
+    if (!err.empty()) {
+      for (char& c : err) {
+        if (c == '\n' || c == '\r') c = ' ';
+      }
+      std::cout << "ERR " << err << "\n" << std::flush;
+      continue;
+    }
+    std::string block = "OK " + std::to_string(lines.size()) + "\n";
+    for (const auto& l : lines) {
+      block += l;
+      block += "\n";
+    }
+    std::cout << block << std::flush;
+  }
+  return 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   Args args;
   if (!ParseArgs(argc, argv, &args)) return 2;
+  if (args.server) return RunServer(args);
   if (!args.file.empty()) {
     ProcessFile(args.file, args.options);
     return 0;

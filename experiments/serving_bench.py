@@ -1,0 +1,225 @@
+"""Serving load generator: p50/p99 latency + throughput at N concurrent
+clients, cache-on vs cache-off, against the real HTTP serving stack.
+
+Drives the FULL production path — HTTP POST /predict -> LRU cache ->
+warm native-extractor pool -> dynamic batcher (context-bucketed padded
+shapes) -> jitted predict step -> JSON — with realistic generated Java
+classes (experiments/javagen.py, the same generator the accuracy bench
+trains on). Two scenarios per concurrency level:
+
+- cache_off: serve_cache_entries=0; every request pays extract+predict.
+- cache_on:  warm LRU; clients replay the same corpus, so steady-state
+  traffic is ~all hits (the IDE/CI re-submit pattern the cache exists
+  for).
+
+Also records the number of distinct pjit compilations the serving
+traffic triggered, which must stay <= the configured bucket count —
+the acceptance criterion of the batcher's bucketing design.
+
+Writes experiments/results/serving.json; summarized in BENCH_SERVING.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+WORKDIR = "/tmp/serving_bench"
+OUT_PATH = os.path.join(REPO, "experiments", "results", "serving.json")
+
+N_CLASSES = 24          # distinct request bodies in the corpus
+REQUESTS_PER_CLIENT = 24
+CLIENT_COUNTS = (4, 8)
+SERVE_BATCH = 16
+SERVE_DELAY_MS = 5.0
+BUCKETS = "32,64,128"
+VOCAB = 20_000
+
+
+def build_model():
+    """Untrained model at a realistic-but-CPU-benchable shape: latency
+    and throughput do not depend on the weights' values."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_facade import Code2VecModel
+
+    os.makedirs(WORKDIR, exist_ok=True)
+    prefix = os.path.join(WORKDIR, "corpus")
+    with open(prefix + ".train.c2v", "w") as f:
+        f.write("stub tok0,p0,tok0" + " " * 199 + "\n")
+    with open(prefix + ".dict.c2v", "wb") as f:
+        pickle.dump({f"tok{i}": 2 for i in range(VOCAB)}, f)
+        pickle.dump({f"p{i}": 2 for i in range(VOCAB)}, f)
+        pickle.dump({f"get|n{i}": 2 for i in range(VOCAB // 2)}, f)
+        pickle.dump(1, f)
+    config = Config(
+        train_data_path_prefix=prefix,
+        compute_dtype="float32",
+        verbose_mode=0,
+        serve_batch_size=SERVE_BATCH,
+        serve_max_delay_ms=SERVE_DELAY_MS,
+        serve_buckets=BUCKETS,
+        extractor_pool_size=2,
+    )
+    return Code2VecModel(config)
+
+
+def make_corpus():
+    from experiments.javagen import NOUNS, generate_class
+    rng = random.Random(7)
+    sources = []
+    for i in range(N_CLASSES):
+        sources.append(generate_class(
+            rng, NOUNS, f"Bench{i}", "com.bench", rng.randint(4, 9)))
+    return sources
+
+
+def _post(port: int, body: str) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=body.encode(),
+        method="POST", headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _counter(name: str, **labels) -> float:
+    from code2vec_tpu import obs
+    key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    child = obs.default_registry().collect().get(name, {}).get(key)
+    return child.value if child is not None else 0.0
+
+
+def run_scenario(model, sources, n_clients: int, cache_entries: int,
+                 log) -> dict:
+    import dataclasses
+
+    from code2vec_tpu.serving.server import PredictionServer
+
+    config = dataclasses.replace(model.config,
+                                 serve_cache_entries=cache_entries)
+    server = PredictionServer(model, config, log=lambda m: None)
+    port = server.start(port=0)
+    try:
+        # Warmup outside the measurement: compiles the bucketed steps
+        # and fills the cache for the cache-on scenario's steady state.
+        warm_methods = 0
+        for src in sources:
+            warm_methods += len(_post(port, src)["methods"])
+        hits0 = _counter("serving_cache_hits_total")
+        latencies: list = []
+        methods_served = [0] * n_clients
+        errors = [0] * n_clients
+
+        def client(ci: int):
+            rng = random.Random(100 + ci)
+            order = list(range(len(sources)))
+            rng.shuffle(order)
+            for k in range(REQUESTS_PER_CLIENT):
+                src = sources[order[k % len(order)]]
+                t0 = time.perf_counter()
+                try:
+                    payload = _post(port, src)
+                except Exception:
+                    errors[ci] += 1
+                    continue
+                latencies.append(time.perf_counter() - t0)
+                methods_served[ci] += len(payload["methods"])
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        hits = _counter("serving_cache_hits_total") - hits0
+        lat_sorted = sorted(latencies)
+
+        def pct(p: float) -> float:
+            return lat_sorted[min(int(len(lat_sorted) * p),
+                                  len(lat_sorted) - 1)]
+
+        n_req = len(latencies)
+        result = {
+            "clients": n_clients,
+            "cache_entries": cache_entries,
+            "requests": n_req,
+            "errors": sum(errors),
+            "wall_s": round(wall, 3),
+            "requests_per_s": round(n_req / wall, 1),
+            "methods_per_s": round(sum(methods_served) / wall, 1),
+            "p50_ms": round(pct(0.50) * 1e3, 2),
+            "p90_ms": round(pct(0.90) * 1e3, 2),
+            "p99_ms": round(pct(0.99) * 1e3, 2),
+            "mean_ms": round(statistics.mean(latencies) * 1e3, 2),
+            "cache_hits": int(hits),
+            "cache_hit_rate": round(hits / n_req, 3) if n_req else 0.0,
+            "batches_dispatched": server.batcher.batches_dispatched,
+        }
+        log(f"  clients={n_clients} cache={'on' if cache_entries else 'off'}"
+            f": p50={result['p50_ms']}ms p99={result['p99_ms']}ms "
+            f"{result['methods_per_s']} methods/s "
+            f"hit_rate={result['cache_hit_rate']}")
+        return result
+    finally:
+        server.drain(timeout=30)
+
+
+def main() -> None:
+    def log(msg: str) -> None:
+        print(msg, flush=True)
+
+    log("Building model + corpus ...")
+    model = build_model()
+    sources = make_corpus()
+    total_methods = sum(s.count("    public ") for s in sources)
+    log(f"Corpus: {len(sources)} classes, ~{total_methods} methods; "
+        f"buckets={model.context_buckets} serve_batch={SERVE_BATCH}")
+    scenarios = []
+    for n_clients in CLIENT_COUNTS:
+        for cache_entries in (0, 4096):
+            scenarios.append(run_scenario(model, sources, n_clients,
+                                          cache_entries, log))
+    compiled = sum(1 for rows, _ in model._predict_steps
+                   if rows == SERVE_BATCH)
+    result = {
+        "bench": "serving",
+        "host_devices": 1,
+        "corpus_classes": len(sources),
+        "requests_per_client": REQUESTS_PER_CLIENT,
+        "serve_batch_size": SERVE_BATCH,
+        "serve_max_delay_ms": SERVE_DELAY_MS,
+        "buckets": list(model.context_buckets),
+        "pjit_compilations_serving": compiled,
+        "pjit_compilations_bound": len(model.context_buckets),
+        "extractor_warm": True,
+        "scenarios": scenarios,
+    }
+    assert compiled <= len(model.context_buckets), (
+        f"serving triggered {compiled} compilations for "
+        f"{len(model.context_buckets)} buckets")
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    log(f"Wrote {OUT_PATH}")
+    diag = os.environ.get("C2V_CHAOS_DIAG_DIR")
+    if diag:
+        from code2vec_tpu import obs
+        obs.exporters.write_prometheus(
+            os.path.join(diag, "serving_bench_metrics.prom"))
+
+
+if __name__ == "__main__":
+    main()

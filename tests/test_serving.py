@@ -1,0 +1,612 @@
+"""Serving subsystem tests: warm extractor pool, dynamic batcher,
+prediction cache, HTTP server, REPL rewire.
+
+A FAKE extractor binary (a small Python script speaking both the
+one-shot `--file` CLI and the warm `--server` protocol, installed via
+the C2V_NATIVE_EXTRACTOR env hook) stands in for the real parser, so
+these tests pin the SERVING machinery — pooling, requeue-on-crash,
+coalescing, bucketed compilation, cache byte-equality, SIGTERM drain —
+independent of the cpp build. Behaviors are driven by markers in the
+"Java" source: NCTX<n> (emit n contexts), SLOW_MARKER (sleep),
+CRASH_ONCE (die with SIGKILL-ish 137 exactly once per stamp file),
+BOOM_ALWAYS (deterministic parse rejection).
+"""
+
+import json
+import os
+import pickle
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import obs
+from code2vec_tpu.config import Config
+
+pytestmark = pytest.mark.serving
+
+FAKE_EXTRACTOR = r'''#!/usr/bin/env python3
+"""Fake c2v extractor: deterministic output derived from the source."""
+import os, re, sys, time
+
+
+def extract(src):
+    if "SLOW_MARKER" in src:
+        time.sleep(float(os.environ.get("C2V_FAKE_SLEEP", "1.0")))
+    if "CRASH_ALWAYS" in src:
+        os._exit(137)
+    if "CRASH_ONCE" in src:
+        stamp = os.environ.get("C2V_FAKE_STAMP", "")
+        if stamp and not os.path.exists(stamp):
+            open(stamp, "w").close()
+            os._exit(137)  # looks like an OOM SIGKILL exit
+    if "BOOM_ALWAYS" in src:
+        raise ValueError("fake deterministic parse error")
+    m = re.search(r"NCTX(\d+)", src)
+    nctx = int(m.group(1)) if m else 3
+    names = re.findall(r"(\w+)\s*\(", src) or ["m"]
+    lines = []
+    for name in names:
+        ctxs = " ".join("tok%d,(P%d)^(Q)_(R%d),tok%d" % (i, i, i, i)
+                        for i in range(nctx))
+        lines.append("%s %s" % (name, ctxs))
+    return lines
+
+
+def main():
+    argv = sys.argv[1:]
+    if os.environ.get("C2V_FAKE_NO_SERVER") and "--server" in argv:
+        sys.stderr.write("unknown flag: --server\n")
+        sys.exit(2)
+    if "--server" not in argv:
+        path = argv[argv.index("--file") + 1]
+        try:
+            with open(path) as f:
+                lines = extract(f.read())
+        except ValueError as e:
+            sys.stderr.write(str(e) + "\n")
+            sys.exit(1)
+        sys.stdout.write("".join(l + "\n" for l in lines))
+        return
+    out = sys.stdout
+    out.write("READY\n")
+    out.flush()
+    stdin = sys.stdin.buffer
+    while True:
+        header = stdin.readline()
+        if not header:
+            return
+        header = header.decode().strip()
+        try:
+            if header.startswith("FILE "):
+                with open(header[5:]) as f:
+                    src = f.read()
+            elif header.startswith("SRC "):
+                n = int(header[4:])
+                src = stdin.read(n).decode()
+                stdin.readline()  # frame terminator
+            elif not header:
+                continue
+            else:
+                raise ValueError("bad request: " + header)
+            lines = extract(src)
+        except ValueError as e:
+            out.write("ERR %s\n" % e)
+            out.flush()
+            continue
+        out.write("OK %d\n" % len(lines))
+        for l in lines:
+            out.write(l + "\n")
+        out.flush()
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+
+@pytest.fixture()
+def fake_extractor(tmp_path, monkeypatch):
+    path = tmp_path / "fake-c2v-extract"
+    path.write_text(FAKE_EXTRACTOR)
+    path.chmod(0o755)
+    monkeypatch.setenv("C2V_NATIVE_EXTRACTOR", str(path))
+    monkeypatch.delenv("C2V_FAKE_NO_SERVER", raising=False)
+    return str(path)
+
+
+def _serving_config(tmp_path, **overrides) -> Config:
+    kwargs = dict(
+        train_data_path_prefix=str(tmp_path / "synthetic"),
+        max_contexts=16,
+        train_batch_size=8, test_batch_size=8,
+        num_train_epochs=1,
+        compute_dtype="float32",
+        verbose_mode=0,
+        serve_batch_size=4,
+        serve_buckets="4,8",
+        serve_max_delay_ms=5.0,
+        serve_cache_entries=16,
+        extractor_pool_size=1,
+        num_batches_to_log_progress=1000,
+        shuffle_buffer_size=64,
+        save_every_epochs=1000,
+    )
+    kwargs.update(overrides)
+    return Config(**kwargs)
+
+
+def _write_synthetic_dataset(tmp_path, n_rows=32, max_contexts=16):
+    import random
+    rng = random.Random(0)
+    tokens = [f"tok{i}" for i in range(6)]
+    paths = [f"p{i}" for i in range(4)]
+    targets = ["name|alpha", "name|beta"]
+    rows = []
+    for _ in range(n_rows):
+        t = rng.randrange(len(targets))
+        ctxs = [f"{tokens[t]},{rng.choice(paths)},{tokens[t]}"
+                for _ in range(rng.randint(2, 6))]
+        rows.append(f"{targets[t]} " + " ".join(ctxs)
+                    + " " * (max_contexts - len(ctxs)))
+    prefix = str(tmp_path / "synthetic")
+    with open(prefix + ".train.c2v", "w") as f:
+        f.write("\n".join(rows) + "\n")
+    with open(prefix + ".dict.c2v", "wb") as f:
+        pickle.dump({w: 10 for w in tokens}, f)
+        pickle.dump({p: 10 for p in paths}, f)
+        pickle.dump({t: 10 for t in targets}, f)
+        pickle.dump(n_rows, f)
+    return prefix
+
+
+@pytest.fixture(scope="module")
+def served_model(tmp_path_factory):
+    """One untrained tiny model shared by the module: serving tests pin
+    machinery (batching, caching, drain), not model quality."""
+    from code2vec_tpu.model_facade import Code2VecModel
+    tmp_path = tmp_path_factory.mktemp("serving-model")
+    _write_synthetic_dataset(tmp_path)
+    return Code2VecModel(_serving_config(tmp_path))
+
+
+def _counter_value(name, **labels):
+    fams = obs.default_registry().collect()
+    key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    child = fams.get(name, {}).get(key)
+    return child.value if child is not None else 0.0
+
+
+# ------------------------------------------------------------- pool
+
+
+def test_pool_warm_extract_and_postprocess(fake_extractor, tmp_path):
+    from code2vec_tpu.serving.extractor_pool import ExtractorPool
+    config = _serving_config(tmp_path)
+    with ExtractorPool(config, size=2) as pool:
+        assert pool.warm, "fake extractor advertises --server"
+        phases = {}
+        lines, h2s = pool.extract_source(
+            "class A { int f(int n) { return n; } } NCTX2", phases=phases)
+        assert len(lines) == 1
+        parts = lines[0].rstrip().split(" ")
+        assert parts[0] == "f"
+        # bridge semantics preserved: paths re-hashed, mapping inverts,
+        # line padded to max_contexts
+        w1, hashed, w2 = parts[1].split(",")
+        assert h2s[hashed] == "(P0)^(Q)_(R0)"
+        assert len(lines[0]) - len(lines[0].rstrip()) == 16 - 2
+        assert phases["queue_wait"] >= 0 and phases["extract"] > 0
+        # same worker serves a second request (no respawn)
+        java_file = tmp_path / "Second.java"
+        java_file.write_text("class B { int g() { return 2; } }")
+        pid_before = {w.proc.pid for w in pool._idle}
+        lines2, _ = pool.extract_file(str(java_file))
+        assert lines2[0].split(" ")[0] == "g"
+        assert {w.proc.pid for w in pool._idle} == pid_before
+
+
+def test_pool_cold_fallback_when_no_server_mode(fake_extractor, tmp_path,
+                                                monkeypatch):
+    from code2vec_tpu.serving.extractor_pool import ExtractorPool
+    monkeypatch.setenv("C2V_FAKE_NO_SERVER", "1")
+    config = _serving_config(tmp_path)
+    with ExtractorPool(config, size=1) as pool:
+        assert not pool.warm
+        lines, _ = pool.extract_source("class A { int g() { return 1; } }")
+        assert lines[0].split(" ")[0] == "g"
+
+
+def test_pool_requeues_crashed_worker_without_double_count(
+        fake_extractor, tmp_path, monkeypatch):
+    """A worker killed mid-request (exit 137 = OOM-style) requeues the
+    request onto a fresh worker; extractor_failures_total counts the
+    failed attempt EXACTLY once, labeled retried=yes."""
+    from code2vec_tpu.serving.extractor_pool import ExtractorPool
+    stamp = tmp_path / "crash-stamp"
+    monkeypatch.setenv("C2V_FAKE_STAMP", str(stamp))
+    config = _serving_config(tmp_path)
+    before_yes = _counter_value("extractor_failures_total", retried="yes")
+    before_no = _counter_value("extractor_failures_total", retried="no")
+    before_rq = _counter_value("extractor_pool_requeues_total")
+    with ExtractorPool(config, size=1) as pool:
+        lines, _ = pool.extract_source(
+            "class A { int h() { return 1; } } CRASH_ONCE")
+        assert lines[0].split(" ")[0] == "h"
+        assert stamp.exists()
+        # the pool still has one LIVE worker after the replacement
+        assert len(pool._idle) == 1 and pool._idle[0].alive
+    assert _counter_value("extractor_failures_total",
+                          retried="yes") == before_yes + 1
+    assert _counter_value("extractor_failures_total",
+                          retried="no") == before_no
+    assert _counter_value("extractor_pool_requeues_total") == before_rq + 1
+
+
+def test_pool_crash_exhausts_retries(fake_extractor, tmp_path,
+                                     monkeypatch):
+    from code2vec_tpu.serving.extractor_bridge import ExtractorCrash
+    from code2vec_tpu.serving.extractor_pool import ExtractorPool
+    config = _serving_config(tmp_path, extractor_retries=1)
+    before_no = _counter_value("extractor_failures_total", retried="no")
+    with ExtractorPool(config, size=1) as pool:
+        with pytest.raises(ExtractorCrash):
+            pool.extract_source("class A { int h() { return 1; } } "
+                                "CRASH_ALWAYS")
+    # final attempt counted retried=no (surfaced to the caller)
+    assert _counter_value("extractor_failures_total",
+                          retried="no") == before_no + 1
+
+
+def test_pool_deterministic_rejection_not_retried(fake_extractor,
+                                                  tmp_path):
+    from code2vec_tpu.serving.extractor_pool import ExtractorPool
+    config = _serving_config(tmp_path)
+    before_rq = _counter_value("extractor_pool_requeues_total")
+    with ExtractorPool(config, size=1) as pool:
+        with pytest.raises(ValueError, match="deterministic parse error"):
+            pool.extract_source("BOOM_ALWAYS")
+        # rejection must not kill the warm worker
+        assert pool._idle[0].alive
+    assert _counter_value("extractor_pool_requeues_total") == before_rq
+
+
+# ---------------------------------------------------------- batcher
+
+
+def test_batcher_coalesces_concurrent_requests():
+    from code2vec_tpu.serving.batcher import DynamicBatcher
+    calls = []
+
+    def predict_fn(lines):
+        calls.append(list(lines))
+        return [f"r:{l}" for l in lines]
+
+    batcher = DynamicBatcher(predict_fn, max_batch_rows=4,
+                             max_delay_s=2.0)
+    futures = []
+
+    def submit(i):
+        futures.append(batcher.submit([f"line{i}"]))
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=10) for f in futures]
+    assert sorted(r[0] for r in results) == [f"r:line{i}"
+                                             for i in range(4)]
+    # 4 rows hit max_batch_rows -> ONE device batch, not four
+    assert batcher.batches_dispatched == 1
+    assert sorted(len(c) for c in calls) == [4]
+    batcher.drain()
+
+
+def test_batcher_flushes_on_delay_and_preserves_order():
+    from code2vec_tpu.serving.batcher import DynamicBatcher
+    batcher = DynamicBatcher(lambda lines: [l.upper() for l in lines],
+                             max_batch_rows=100, max_delay_s=0.02)
+    f = batcher.submit(["a", "b", "c"])
+    assert f.result(timeout=10) == ["A", "B", "C"]
+    batcher.drain()
+
+
+def test_batcher_error_propagates_and_drain_refuses():
+    from code2vec_tpu.serving.batcher import DynamicBatcher
+
+    def boom(lines):
+        raise RuntimeError("device on fire")
+
+    batcher = DynamicBatcher(boom, max_batch_rows=2, max_delay_s=0.01)
+    f = batcher.submit(["x"])
+    with pytest.raises(RuntimeError, match="device on fire"):
+        f.result(timeout=10)
+    batcher.drain()
+    f2 = batcher.submit(["y"])
+    with pytest.raises(RuntimeError, match="draining"):
+        f2.result(timeout=10)
+
+
+def test_parse_buckets_and_bucket_for():
+    from code2vec_tpu.serving.batcher import bucket_for, parse_buckets
+    assert parse_buckets("32,64,128", 200) == (32, 64, 128, 200)
+    # >= max_contexts dropped, max always appended, duplicates collapse
+    assert parse_buckets("8,8,300", 200) == (8, 200)
+    assert parse_buckets("", 200) == (200,)
+    # cp filtering: buckets must stay divisible by the ctx-parallel degree
+    assert parse_buckets("30,32,64", 200, cp=4) == (32, 64, 200)
+    buckets = (32, 64, 200)
+    assert bucket_for(1, buckets) == 32
+    assert bucket_for(32, buckets) == 32
+    assert bucket_for(33, buckets) == 64
+    assert bucket_for(200, buckets) == 200
+
+
+# ------------------------------------------- facade bucketed predict
+
+
+def test_predict_bucket_bound_compilation_count(served_model):
+    """Distinct request shapes map onto the configured bucket list: the
+    compiled-step cache stays <= number of buckets no matter how many
+    context counts traffic brings."""
+    model = served_model
+    buckets = model.context_buckets
+    assert buckets == (4, 8, 16)
+    start = model.predict_compile_count()
+
+    def line(nctx):
+        ctxs = " ".join(f"tok0,p0,tok0" for _ in range(nctx))
+        return "somename " + ctxs + " " * (16 - nctx)
+
+    for nctx in (1, 2, 3, 4, 5, 7, 9, 12, 16, 2, 6, 11):
+        model.predict([line(nctx)], batch_size=4)
+    assert model.predict_compile_count() - start <= len(buckets)
+    # and the shapes actually bucketed (not one giant shape): a 2-context
+    # request must NOT have compiled the 16-context shape alone
+    assert (4, 4) in model._predict_steps
+
+
+def test_predict_accepts_lazy_iterable(served_model):
+    model = served_model
+    lines = ["somename tok0,p0,tok0 tok1,p1,tok1" + " " * 14
+             for _ in range(10)]
+    consumed = []
+
+    def gen():
+        for l in lines:
+            consumed.append(l)
+            yield l
+
+    out = model.predict(gen(), batch_size=4)
+    assert len(out) == 10
+    assert len(consumed) == 10
+    # chunked (3 batches of <=4) results identical to one-shot list
+    out2 = model.predict(lines, batch_size=16)
+    for a, b in zip(out, out2):
+        assert a.topk_predicted_words == b.topk_predicted_words
+        np.testing.assert_allclose(a.topk_predicted_words_scores,
+                                   b.topk_predicted_words_scores,
+                                   rtol=1e-5)
+        assert a.attention_per_context.keys() == \
+            b.attention_per_context.keys()
+
+
+# ------------------------------------------------------------- http
+
+
+@pytest.fixture()
+def server(served_model, fake_extractor):
+    from code2vec_tpu.serving.server import PredictionServer
+    srv = PredictionServer(served_model, served_model.config,
+                           log=lambda m: None)
+    srv.start(port=0)
+    yield srv
+    srv.drain(timeout=10)
+
+
+def _post(port, endpoint, body, ctype="text/plain"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{endpoint}", data=body.encode(),
+        method="POST", headers={"Content-Type": ctype})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_http_end_to_end(server):
+    code = "class A { int addOne(int n) { return n + 1; } }"
+    status, body = _post(server.port, "predict", code)
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["model"] == "code2vec_tpu"
+    [method] = payload["methods"]
+    assert method["original_name"] == "addOne"
+    assert method["predictions"], "top-k predictions missing"
+    for p in method["predictions"]:
+        assert 0.0 <= p["probability"] <= 1.0
+    assert method["attention_paths"]
+    for att in method["attention_paths"]:
+        assert att["path"].startswith("(")  # hash inverted for display
+
+    # JSON body form + /embed (vectors forced on)
+    status, body = _post(server.port, "embed",
+                         json.dumps({"code": code}), "application/json")
+    assert status == 200
+    vectors = json.loads(body)["vectors"]
+    assert len(vectors) == 1
+    assert len(vectors[0]) == server.config.code_vector_size
+
+    # healthz + metrics ride the same listener
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=30) as r:
+        hz = json.loads(r.read())
+    assert hz["status"] == "serving"
+    assert hz["compiled_predict_steps"] <= len(hz["buckets"])
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+        metrics = r.read().decode()
+    assert "serving_request_seconds_bucket" in metrics
+    assert 'phase="total"' in metrics
+
+    # error surface: empty body, parse rejection, unknown endpoint,
+    # and crash-through-every-retry = infra 503 (NOT a client 422:
+    # ExtractorCrash subclasses ValueError, the mapping must not lump
+    # dead workers in with rejected sources)
+    assert _post(server.port, "predict", "")[0] == 400
+    assert _post(server.port, "predict", "BOOM_ALWAYS")[0] == 422
+    assert _post(server.port, "nope", "x")[0] == 404
+    assert _post(server.port, "predict", "CRASH_ALWAYS f(")[0] == 503
+
+
+def test_http_coalesces_concurrent_requests(server):
+    before = server.batcher.batches_dispatched
+    codes = [f"class A{i} {{ int f{i}(int n) {{ return n; }} }}"
+             for i in range(4)]
+    results = [None] * 4
+
+    def post(i):
+        results[i] = _post(server.port, "predict", codes[i])
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r[0] == 200 for r in results)
+    for i, (_, body) in enumerate(results):
+        assert json.loads(body)["methods"][0]["original_name"] == f"f{i}"
+    # 4 single-method requests, serve_batch_size=4, 5ms delay window:
+    # strictly fewer device batches than requests proves coalescing
+    assert server.batcher.batches_dispatched - before < 4
+
+
+def test_cache_hit_is_byte_equal_and_normalized(server):
+    code = "class B { int mul(int a, int b) { return a * b; } }"
+    hits0 = _counter_value("serving_cache_hits_total")
+    status, body1 = _post(server.port, "predict", code)
+    assert status == 200
+    # same method, different formatting -> same cache entry, byte-equal
+    reformatted = code.replace("{ ", "{\n    ").replace("; ", ";\n")
+    status, body2 = _post(server.port, "predict", reformatted)
+    assert status == 200
+    assert body2 == body1
+    assert _counter_value("serving_cache_hits_total") == hits0 + 1
+    # a real edit (here: one that changes the extracted contexts) misses
+    # the cache and re-predicts
+    misses0 = _counter_value("serving_cache_misses_total")
+    status, body3 = _post(server.port, "predict",
+                          code.replace("a * b", "a + b") + " NCTX5")
+    assert body3 != body1
+    assert _counter_value("serving_cache_misses_total") == misses0 + 1
+
+
+def test_cache_lru_eviction():
+    from code2vec_tpu.serving.cache import PredictionCache, cache_key
+    ev0 = _counter_value("serving_cache_evictions_total")
+    cache = PredictionCache(capacity=2)
+    k = [cache_key(f"code{i}") for i in range(3)]
+    cache.put(k[0], b"0")
+    cache.put(k[1], b"1")
+    assert cache.get(k[0]) == b"0"  # touch: k[1] is now LRU
+    cache.put(k[2], b"2")
+    assert cache.get(k[1]) is None
+    assert cache.get(k[0]) == b"0" and cache.get(k[2]) == b"2"
+    assert _counter_value("serving_cache_evictions_total") == ev0 + 1
+    # capacity 0 disables cleanly
+    off = PredictionCache(capacity=0)
+    off.put(k[0], b"x")
+    assert off.get(k[0]) is None
+
+
+def test_sigterm_drain_finishes_inflight(served_model, fake_extractor,
+                                         monkeypatch):
+    """The preemption-grace pattern: a drain racing an in-flight request
+    lets it finish (200), refuses everything after, and tears the
+    listener down."""
+    from code2vec_tpu.serving.server import PredictionServer
+    monkeypatch.setenv("C2V_FAKE_SLEEP", "1.0")
+    srv = PredictionServer(served_model, served_model.config,
+                           log=lambda m: None)
+    srv.start(port=0)
+    slow_result = {}
+
+    def slow_post():
+        slow_result["r"] = _post(
+            srv.port, "predict",
+            "class S { int slow() { return 1; } } SLOW_MARKER")
+
+    t = threading.Thread(target=slow_post)
+    t.start()
+    # let the request enter the extractor before draining
+    deadline = time.time() + 5
+    while srv._inflight == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert srv._inflight == 1
+    assert srv.drain(timeout=30) is True
+    t.join(timeout=30)
+    status, body = slow_result["r"]
+    assert status == 200
+    assert json.loads(body)["methods"][0]["original_name"] == "slow"
+    # the listener is gone: a new request cannot even connect
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz",
+                               timeout=5)
+
+
+# -------------------------------------------------------------- REPL
+
+
+def test_repl_golden_output_format(served_model, fake_extractor,
+                                   tmp_path, monkeypatch, capsys):
+    """The rewired REPL (warm pool underneath) keeps the reference's
+    exact display format (interactive_predict.py:39-72): Original name /
+    tab-indented (prob) predicted rows / Attention: score<TAB>context
+    triples."""
+    from code2vec_tpu.serving.interactive import InteractivePredictor
+    input_file = tmp_path / "Input.java"
+    input_file.write_text(
+        "class A { int addOne(int n) { return n + 1; } }")
+    answers = iter(["", "q"])
+    monkeypatch.setattr("builtins.input", lambda *a: next(answers))
+    predictor = InteractivePredictor(served_model.config, served_model)
+    assert predictor.extractor_pool.size == 1
+    predictor.predict(str(input_file))
+    out = capsys.readouterr().out
+    assert "Starting interactive prediction..." in out
+    assert "Exiting..." in out
+    lines = out.splitlines()
+    assert "Original name:\taddOne" in lines
+    pred_re = re.compile(r"^\t\(\d\.\d{6}\) predicted: (\[.*\]|.+)$")
+    att_re = re.compile(r"^\d\.\d{6}\tcontext: .+,\(.+\),.+$")
+    assert any(pred_re.match(l) for l in lines), lines
+    assert "Attention:" in lines
+    assert any(att_re.match(l) for l in lines), lines
+    # the pool is torn down when the REPL exits
+    assert predictor.extractor_pool._closed
+
+
+def test_serve_cli_flags_parse():
+    from code2vec_tpu.cli import config_from_args
+    config = config_from_args([
+        "serve", "--load", "/tmp/nonexistent-model", "--serve_port", "0",
+        "--serve_batch_size", "32", "--serve_buckets", "16,32",
+        "--serve_max_delay_ms", "2.5", "--serve_cache_entries", "128",
+        "--extractor_pool_size", "3"])
+    assert config.serve is True
+    assert config.serve_port == 0
+    assert config.serve_batch_size == 32
+    assert config.serve_buckets == "16,32"
+    assert config.serve_max_delay_ms == 2.5
+    assert config.serve_cache_entries == 128
+    assert config.extractor_pool_size == 3
+    # --serve flag form equals the subcommand form
+    config2 = config_from_args(["--serve", "--load", "/tmp/x"])
+    assert config2.serve is True
