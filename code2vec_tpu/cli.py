@@ -80,6 +80,33 @@ def arguments_parser() -> ArgumentParser:
                         default=None, metavar="SECONDS",
                         help="SIGTERM grace: seconds the drain waits "
                              "for in-flight requests (default 30)")
+    parser.add_argument("--artifact", dest="serve_artifact", metavar="DIR",
+                        help="serve/evaluate from a release artifact "
+                             "(produced by the `export` subcommand) "
+                             "instead of --load: int8 tables with fused "
+                             "dequant, blockwise top-k, AOT cold-start")
+    parser.add_argument("--artifact_out", dest="export_artifact_path",
+                        metavar="DIR",
+                        help="write a release artifact of the --load'ed "
+                             "model here (the `export` subcommand body): "
+                             "quantized tables + vocabularies + AOT "
+                             "serve lowerings, see README 'Release "
+                             "artifacts'")
+    parser.add_argument("--no_quantize", action="store_true",
+                        help="export fp32 tables instead of per-row "
+                             "symmetric int8 (the artifact stays "
+                             "self-contained, just 4x the bytes; the "
+                             "control arm of BENCH_QUANT.md)")
+    parser.add_argument("--no_aot", action="store_true",
+                        help="skip the jax.export AOT lowerings in the "
+                             "exported artifact (consumers then always "
+                             "trace+compile at cold start)")
+    parser.add_argument("--topk_block", dest="topk_block_size", type=int,
+                        default=None, metavar="ROWS",
+                        help="target-table rows per block of the "
+                             "blockwise top-k prediction head (default "
+                             "4096; 0 forces the classic full-logits "
+                             "materialization)")
     parser.add_argument("-fw", "--framework", dest="dl_framework",
                         choices=["jax", "tensorflow", "keras"], default="jax",
                         help="accepted for reference CLI compatibility; this "
@@ -213,12 +240,33 @@ def arguments_parser() -> ArgumentParser:
 def config_from_args(argv=None) -> Config:
     if argv is None:
         argv = sys.argv[1:]
-    # `serve` subcommand sugar: `code2vec_tpu serve --load M` ==
-    # `code2vec_tpu --serve --load M`.
+    # Subcommand sugar: `code2vec_tpu serve --load M` == `--serve
+    # --load M`; `code2vec_tpu export --load M --artifact_out D` builds
+    # a release artifact (README "Release artifacts").
     serve_subcommand = bool(argv) and argv[0] == "serve"
-    if serve_subcommand:
+    export_subcommand = bool(argv) and argv[0] == "export"
+    if serve_subcommand or export_subcommand:
         argv = argv[1:]
     args = arguments_parser().parse_args(argv)
+    if export_subcommand and not args.export_artifact_path:
+        raise SystemExit(
+            "the `export` subcommand requires --artifact_out DIR")
+    knobs = {knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
+                                      "on_nonfinite_loss",
+                                      "extractor_timeout_s",
+                                      "extractor_retries",
+                                      "save_barrier_timeout_s",
+                                      "serve_port", "serve_host",
+                                      "serve_batch_size",
+                                      "serve_max_delay_ms",
+                                      "serve_buckets",
+                                      "serve_cache_entries",
+                                      "extractor_pool_size",
+                                      "serve_drain_timeout_s",
+                                      "serve_artifact",
+                                      "export_artifact_path",
+                                      "topk_block_size")
+             if (value := getattr(args, knob)) is not None}
     config = Config(
         predict=args.predict,
         serve=args.serve or serve_subcommand,
@@ -236,19 +284,14 @@ def config_from_args(argv=None) -> Config:
         use_sparse_embedding_update=args.sparse_embedding_update,
         dp=args.dp, tp=args.tp, cp=args.cp,
         compute_dtype=args.compute_dtype,
-        **{knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
-                                    "on_nonfinite_loss",
-                                    "extractor_timeout_s",
-                                    "extractor_retries",
-                                    "save_barrier_timeout_s",
-                                    "serve_port", "serve_host",
-                                    "serve_batch_size",
-                                    "serve_max_delay_ms",
-                                    "serve_buckets",
-                                    "serve_cache_entries",
-                                    "extractor_pool_size",
-                                    "serve_drain_timeout_s")
-           if (value := getattr(args, knob)) is not None},
+        **knobs,
+        # A knob present here was typed on the command line — consumers
+        # that would otherwise override a config DEFAULT (ReleaseModel
+        # adopting the artifact's serve_batch_size) must not override an
+        # explicitly-requested value, even one equal to the default.
+        explicit_knobs=tuple(sorted(knobs)),
+        release_quantize=not args.no_quantize,
+        release_aot=not args.no_aot,
         async_checkpointing=args.async_checkpointing,
         cursor_resume=not args.no_cursor_resume,
         seed=args.seed,
@@ -285,8 +328,35 @@ def main(argv=None) -> None:
     from code2vec_tpu.parallel import distributed
     distributed.initialize()
 
+    if config.serve_artifact:
+        # Release-artifact runtime: no checkpoint, no training state —
+        # the artifact carries tables + vocabs + AOT lowerings.
+        from code2vec_tpu.release.runtime import ReleaseModel
+        model = ReleaseModel(config)
+        if not (config.predict or config.serve or config.is_testing):
+            config.log("--artifact given without `serve`, --predict or "
+                       "--test; nothing to do")
+        if config.is_testing:
+            eval_results = model.evaluate()
+            config.log(
+                str(eval_results).replace(
+                    "topk",
+                    f"top{config.top_k_words_considered_during_prediction}"))
+        if config.predict:
+            from code2vec_tpu.serving.interactive import InteractivePredictor
+            InteractivePredictor(config, model).predict()
+        if config.serve:
+            from code2vec_tpu.serving.server import serve_main
+            sys.exit(serve_main(config, model))
+        return
+
     from code2vec_tpu.model_facade import Code2VecModel
     model = Code2VecModel(config)
+
+    if config.export_artifact_path:
+        from code2vec_tpu.release.artifact import export_artifact
+        export_artifact(model, config.export_artifact_path)
+        return
 
     if config.is_training:
         model.train()

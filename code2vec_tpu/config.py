@@ -15,7 +15,7 @@ import logging
 import math
 import os
 import sys
-from typing import Optional
+from typing import Optional, Tuple
 
 
 _LOGGER_NAME = "code2vec_tpu"
@@ -241,6 +241,43 @@ class Config:
     # Seconds the SIGTERM drain waits for in-flight requests before
     # giving up (mirrors the trainer's preemption grace pattern).
     serve_drain_timeout_s: float = 30.0
+    # Rows per streamed target-table block in the blockwise top-k
+    # prediction head (ops/topk.py): the eval/predict steps fold the
+    # ~246K-name classifier through a running top-k merge + logsumexp
+    # instead of materializing the (B, target_vocab) logit row (~1 GB
+    # of HBM traffic per flagship eval batch, written once and read
+    # twice). Indices/values are exactly the full path's (pinned in
+    # tests/test_quant.py). Engages only when the target vocab exceeds
+    # one block and the table is unsharded over `model` (tp == 1);
+    # 0 forces the classic full-logits path.
+    topk_block_size: int = 4096
+
+    # -- release artifacts (code2vec_tpu/release; no reference
+    # equivalent — the reference's --release only strips optimizer
+    # state from a checkpoint) --
+    # `export` subcommand output: write a self-contained quantized
+    # inference artifact (int8 tables + per-row scales, vocabs, AOT
+    # serve lowerings) here. Requires --load.
+    export_artifact_path: Optional[str] = None
+    # `serve`/eval input: run from a release artifact instead of a
+    # checkpoint (serving/server.py gets a release/runtime.py model).
+    serve_artifact: Optional[str] = None
+    # Quantize the three embedding tables to per-row symmetric int8 in
+    # the exported artifact (ops/quant.py). False exports fp32 tables
+    # (same layout, 4x the bytes) — the control arm of BENCH_QUANT.md.
+    release_quantize: bool = True
+    # Also AOT-export (jax.export) the bucketed serve functions into
+    # the artifact, one per (serve_batch_size, context bucket) shape,
+    # so a serving replica cold-starts from deserialized lowerings
+    # instead of retracing each bucket. Artifacts embed the lowering
+    # platform; a replica on a different backend falls back to jit.
+    release_aot: bool = True
+    # Knob names the user set EXPLICITLY on the command line (filled by
+    # cli.config_from_args). Lets a consumer distinguish "holds the
+    # dataclass default because nobody asked" from "the operator typed
+    # exactly the default value": ReleaseModel only adopts an artifact's
+    # AOT-exported serve_batch_size when the flag was never given.
+    explicit_knobs: Tuple[str, ...] = ()
 
     # Full-content sha256 of every checkpoint file (including the
     # multi-GB Orbax shards, chunked + hashed on a thread pool) recorded
@@ -361,8 +398,11 @@ class Config:
 
     def verify(self) -> None:
         # reference: config.py:232-239, plus mesh-shape checks.
-        if not self.is_training and not self.is_loading:
-            raise ValueError("Must train or load a model.")
+        if (not self.is_training and not self.is_loading
+                and not self.serve_artifact):
+            raise ValueError(
+                "Must train or load a model (or serve a release "
+                "artifact via --artifact).")
         if self.is_loading and not os.path.isdir(self.model_load_dir):
             raise ValueError(
                 f"Model load dir `{self.model_load_dir}` does not exist.")
@@ -428,6 +468,43 @@ class Config:
             raise ValueError(
                 "serve_drain_timeout_s must be > 0 (a drain that never "
                 "times out can outlive the SIGTERM grace window).")
+        if self.topk_block_size < 0:
+            raise ValueError(
+                "topk_block_size must be >= 0 (0 forces the full-logits "
+                "top-k path).")
+        if self.export_artifact_path and not self.is_loading:
+            raise ValueError(
+                "export (--artifact_out) requires --load: the artifact "
+                "is built from a trained checkpoint.")
+        if self.export_artifact_path and self.is_training:
+            raise ValueError(
+                "export (--artifact_out) cannot be combined with training "
+                "(--data): main() exports the --load'ed checkpoint and "
+                "exits, so the training run would be silently skipped. "
+                "Train first, then `export --load CKPT --artifact_out "
+                "DIR`.")
+        if self.export_artifact_path and (self.serve or self.predict
+                                          or self.is_testing):
+            raise ValueError(
+                "export (--artifact_out) is a one-shot job and cannot be "
+                "combined with serve/--predict/--test in the same run; "
+                "run those against the exported artifact (--artifact) or "
+                "the checkpoint (--load) separately.")
+        if self.serve_artifact and self.is_loading:
+            raise ValueError(
+                "--artifact and --load are mutually exclusive: a release "
+                "artifact carries its own tables and vocabularies.")
+        if self.serve_artifact and (self.save_w2v or self.save_t2v):
+            raise ValueError(
+                "--artifact cannot be combined with --save_w2v/--save_t2v: "
+                "the vector writers read the fp32 checkpoint tables and "
+                "the artifact branch in main() would silently skip them; "
+                "run them against --load.")
+        if self.serve_artifact and self.is_training:
+            raise ValueError(
+                "--artifact is inference-only (serve/--predict/--test) "
+                "and cannot be combined with training (--data): a "
+                "release artifact has no optimizer state to train.")
 
     # ---------------------------------------------------------------- logging
 

@@ -51,7 +51,239 @@ class ModelPredictionResults(NamedTuple):
     code_vector: Optional[np.ndarray] = None
 
 
-class Code2VecModel:
+class BucketedPredictMixin:
+    """The bucketed predict path shared by the training facade and the
+    release-artifact runtime (release/runtime.py): line parsing, context
+    bucketing, row padding, the (rows, bucket)-keyed compiled-step cache
+    and the host-side result assembly are identical in both; only how a
+    step is BUILT (`_make_predict_step`) and CALLED
+    (`_call_predict_step`) differs — the facade passes live fp32 params
+    into a freshly-jitted eval step, the release runtime calls an
+    AOT-deserialized (or jitted) quantized step over artifact tables.
+    The eval-data plumbing (`_eval_batches` + packed-dataset cache)
+    lives here too, so the standard Evaluator can score either model.
+
+    Requires on the host class: config, log, vocabs, mesh,
+    _predict_steps (dict)."""
+
+    def _make_predict_step(self, batch_rows: int, m: int):
+        raise NotImplementedError
+
+    def _call_predict_step(self, step, arrays):
+        raise NotImplementedError
+
+    @staticmethod
+    def _count_examples(dataset_path: str) -> int:
+        # reference: model_base.py:77-96 (.num_examples sidecar cache)
+        sidecar = dataset_path + ".num_examples"
+        if os.path.isfile(sidecar):
+            with open(sidecar) as f:
+                return int(f.readline())
+        if not os.path.exists(dataset_path):
+            # Fused-compiled datasets (data/preprocess.py compile_corpus)
+            # carry no `.c2v` text at all — the row count lives in the
+            # packed header.
+            packed_path = dataset_path + "b"
+            if os.path.exists(packed_path):
+                return PackedDataset.read_header(packed_path)[0]
+        n = count_lines_in_file(dataset_path)
+        try:
+            with open(sidecar, "w") as f:
+                f.write(str(n))
+        except OSError:
+            pass
+        return n
+
+    def _packed_dataset(self, c2v_path: str) -> PackedDataset:
+        # Memoized: mid-epoch eval opens the test set every firing, and a
+        # fresh PackedDataset would redo the O(rows) filter scan each time.
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = self._packed_cache = {}
+        if c2v_path in cached:
+            return cached[c2v_path]
+        packed_path = c2v_path + "b"
+        if not os.path.exists(packed_path):
+            self.log(f"Packing {c2v_path} -> {packed_path} (one-time)")
+            pack_c2v(c2v_path, self.vocabs, self.config.max_contexts,
+                     out_path=packed_path,
+                     num_workers=self.config.preprocess_workers)
+        shard_index, num_shards = distributed.host_shard()
+        ds = PackedDataset(packed_path, self.vocabs,
+                           shard_index=shard_index, num_shards=num_shards)
+        cached[c2v_path] = ds
+        return ds
+
+    def _require_single_process(self, what: str) -> None:
+        """Multi-host training/eval requires packed data: the streaming
+        text reader cannot know its post-filter batch count before the
+        first pass, so the pod-wide lockstep agreement (see
+        `_train_batches`) has nothing to agree on. Packed data is the
+        designed pod path anyway — raw-text parsing in Python would be
+        feed-bound at pod scale."""
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                f"{what} is not supported with multiple processes; "
+                f"pack the dataset first (use_packed_data=True).")
+
+    def _eval_batches(self) -> Iterable:
+        config = self.config
+        batch_size = distributed.local_batch_size(config.test_batch_size)
+        if config.use_packed_data:
+            ds = self._packed_dataset(config.test_data_path)
+            batches = ds.iter_batches(batch_size,
+                                      EstimatorAction.Evaluate,
+                                      with_target_strings=True)
+            if jax.process_count() > 1:
+                # Lockstep contract (max + pad): every host must drive the
+                # same number of collective eval steps; no real row may be
+                # dropped, so short hosts pad with invalid batches.
+                local = ds.steps_per_epoch(batch_size, EstimatorAction.Evaluate)
+                agreed = distributed.agree_scalar(local, "max")
+                from code2vec_tpu.data.reader import invalid_batch
+                return distributed.lockstep_eval_stream(
+                    batches, agreed,
+                    lambda: invalid_batch(batch_size, config.max_contexts))
+            return batches
+        self._require_single_process("evaluating from raw .c2v text")
+        shard_index, num_shards = distributed.host_shard()
+        return PathContextReader(self.vocabs, config, EstimatorAction.Evaluate,
+                                 shard_index=shard_index,
+                                 num_shards=num_shards,
+                                 batch_size=batch_size)
+
+    @property
+    def context_buckets(self) -> Tuple[int, ...]:
+        """Padded-context-count buckets for the predict path (sorted,
+        always ending in max_contexts, filtered to cp multiples) —
+        parsed once from config.serve_buckets. One compiled step per
+        bucket is the whole compilation budget of the serving path."""
+        cached = getattr(self, "_context_buckets", None)
+        if cached is None:
+            from code2vec_tpu.serving.batcher import parse_buckets
+            cached = self._context_buckets = parse_buckets(
+                getattr(self.config, "serve_buckets", ""),
+                self.config.max_contexts, cp=self.config.cp)
+        return cached
+
+    def _get_bucketed_predict_step(self, batch_rows: int, m: int):
+        key = (batch_rows, m)
+        step = self._predict_steps.get(key)
+        if step is None:
+            # a FRESH callable per shape: each entry compiles exactly
+            # once, so len(_predict_steps) == pjit compilations
+            step = self._predict_steps[key] = \
+                self._make_predict_step(batch_rows, m)
+            self.log(f"Compiling predict step for shape "
+                     f"(rows={batch_rows}, contexts={m}) "
+                     f"[{len(self._predict_steps)} of "
+                     f"<= {len(self.context_buckets)} buckets]")
+        return step
+
+    def predict_compile_count(self) -> int:
+        """Distinct compiled predict-step shapes so far (bounded by the
+        bucket list for a fixed serve batch size; asserted in
+        tests/test_serving.py and recorded by the serving bench)."""
+        return len(self._predict_steps)
+
+    def _default_predict_batch_size(self) -> int:
+        """Rows per predict chunk when the caller didn't pick one. The
+        facade pads to the eval batch; ReleaseModel overrides this with
+        the artifact's serve_batch_size so `--predict --artifact` and
+        offline predict land on the shipped AOT lowerings instead of
+        tracing a fresh (test_batch_size, bucket) shape per bucket."""
+        return int(self.config.test_batch_size)
+
+    def model_fingerprint(self) -> str:
+        """Identity token of the weights this model answers with, mixed
+        into every prediction-cache key (serving/cache.py) and surfaced
+        in /healthz: a re-exported artifact or a differently-trained
+        checkpoint must never satisfy a stale cache entry."""
+        raise NotImplementedError
+
+    def predict(self, predict_data_lines: Iterable[str],
+                batch_size: Optional[int] = None,
+                with_code_vectors: Optional[bool] = None
+                ) -> List[ModelPredictionResults]:
+        """reference: tensorflow_model.py:310-367 — per-line predictions
+        with top-k words, softmax-normalized scores, attention per context
+        and the code vector.
+
+        Accepts any iterable (never materialized whole): lines stream in
+        `batch_size`-row chunks, each routed through the bucketed
+        compiled-step cache the serving batcher shares, so a million-line
+        offline predict and the HTTP server exercise the SAME bounded set
+        of compiled shapes. `with_code_vectors` defaults to
+        config.export_code_vectors; the serving /embed endpoint forces it
+        on (the step computes the vectors either way — the flag only
+        gates their host-side materialization)."""
+        import itertools
+        results: List[ModelPredictionResults] = []
+        bs = int(batch_size or self._default_predict_batch_size())
+        if with_code_vectors is None:
+            with_code_vectors = self.config.export_code_vectors
+        it = iter(predict_data_lines)
+        while True:
+            lines = list(itertools.islice(it, bs))
+            if not lines:
+                return results
+            results.extend(self._predict_chunk(lines, bs,
+                                               with_code_vectors))
+
+    def _predict_chunk(self, lines: List[str], bs: int,
+                       with_code_vectors: bool
+                       ) -> List[ModelPredictionResults]:
+        config = self.config
+        from code2vec_tpu.data.reader import _pad_rows, slice_contexts
+        from code2vec_tpu.serving.batcher import bucket_for
+        chunk = parse_context_lines(lines, self.vocabs, config.max_contexts,
+                                    EstimatorAction.Predict,
+                                    keep_strings=True)
+        n = len(lines)
+        # Deepest VALID context column decides the bucket: the slice
+        # below only ever removes all-padding columns.
+        any_valid_col = chunk.context_valid_mask.any(axis=0)
+        deepest = (int(np.nonzero(any_valid_col)[0][-1]) + 1
+                   if any_valid_col.any() else 1)
+        m = bucket_for(deepest, self.context_buckets)
+        chunk = slice_contexts(chunk, m)
+        # Pad the row count to the fixed serve batch size: row count and
+        # context bucket together fully determine the compiled shape.
+        padded = _pad_rows(chunk, bs)
+        step = self._get_bucketed_predict_step(bs, m)
+        arrays = device_put_batch(padded, self.mesh)
+        out = self._call_predict_step(step, arrays)
+        results: List[ModelPredictionResults] = []
+        topk_idx = np.asarray(out.topk_indices)[:n]
+        topk_val = np.asarray(out.topk_values)[:n]
+        code_vectors = np.asarray(out.code_vectors)[:n]
+        attention = np.asarray(out.attention)[:n]
+        # normalize_scores=True in the reference predict graph
+        # (tensorflow_model.py:321): softmax over the k values.
+        e = np.exp(topk_val - topk_val.max(axis=1, keepdims=True))
+        scores = e / e.sum(axis=1, keepdims=True)
+        for i in range(n):
+            words = [self.vocabs.target_vocab.lookup_word(int(j))
+                     for j in topk_idx[i]]
+            attention_per_context: Dict[Tuple[str, str, str], float] = {}
+            for j in range(m):
+                s = chunk.source_strings[i, j]
+                p = chunk.path_strings[i, j]
+                t = chunk.target_token_strings[i, j]
+                if s or p or t:
+                    attention_per_context[(s, p, t)] = float(attention[i, j])
+            results.append(ModelPredictionResults(
+                original_name=(chunk.target_strings[i]
+                               if chunk.target_strings else ""),
+                topk_predicted_words=words,
+                topk_predicted_words_scores=scores[i],
+                attention_per_context=attention_per_context,
+                code_vector=(code_vectors[i]
+                             if with_code_vectors else None)))
+        return results
+
+
+class Code2VecModel(BucketedPredictMixin):
     def __init__(self, config: Config):
         self.config = config
         config.verify()
@@ -74,6 +306,17 @@ class Code2VecModel:
         self._applied_skip_rows = 0
         self._applied_skip_epoch: Optional[int] = None
         if config.is_loading:
+            from code2vec_tpu.release.artifact import is_release_artifact
+            if is_release_artifact(config.model_load_path):
+                # Reject up front with the quantization field named: the
+                # fp32 checkpoint loader reading int8 payloads would
+                # produce garbage predictions, not an error.
+                raise ValueError(
+                    f"--load points at a release artifact "
+                    f"({config.model_load_path}): its "
+                    f"`quantization.scheme` tables are not an fp32 "
+                    f"checkpoint. Serve it with `serve --artifact "
+                    f"{config.model_load_path}` instead.")
             # `--load` accepts either a concrete artifact directory or a
             # save base: a base resolves to the newest artifact that
             # PASSES its integrity check (walking past any half-written
@@ -121,11 +364,13 @@ class Code2VecModel:
         if config.is_loading:
             # --release discards the optimizer state, so it loads
             # params-only and must not run the optimizer layout/dtype
-            # guards (it is their advertised escape hatch)
+            # guards (it is their advertised escape hatch); artifact
+            # export likewise only reads the params.
+            params_only = config.release or bool(config.export_artifact_path)
             report: Dict = {}
             self.state = ckpt_mod.load_model(config.model_load_path,
                                              self.state, config=config,
-                                             params_only=config.release,
+                                             params_only=params_only,
                                              report=report)
             meta = ckpt_mod.load_model_meta(config.model_load_path)
             self.initial_epoch = int(meta.get("epoch", 0))
@@ -191,47 +436,6 @@ class Code2VecModel:
         if config.is_testing:
             config.num_test_examples = self._count_examples(config.test_data_path)
             self.log(f"    Number of test examples: {config.num_test_examples}")
-
-    @staticmethod
-    def _count_examples(dataset_path: str) -> int:
-        sidecar = dataset_path + ".num_examples"
-        if os.path.isfile(sidecar):
-            with open(sidecar) as f:
-                return int(f.readline())
-        if not os.path.exists(dataset_path):
-            # Fused-compiled datasets (data/preprocess.py compile_corpus)
-            # carry no `.c2v` text at all — the row count lives in the
-            # packed header.
-            packed_path = dataset_path + "b"
-            if os.path.exists(packed_path):
-                return PackedDataset.read_header(packed_path)[0]
-        n = count_lines_in_file(dataset_path)
-        try:
-            with open(sidecar, "w") as f:
-                f.write(str(n))
-        except OSError:
-            pass
-        return n
-
-    def _packed_dataset(self, c2v_path: str) -> PackedDataset:
-        # Memoized: mid-epoch eval opens the test set every firing, and a
-        # fresh PackedDataset would redo the O(rows) filter scan each time.
-        cached = getattr(self, "_packed_cache", None)
-        if cached is None:
-            cached = self._packed_cache = {}
-        if c2v_path in cached:
-            return cached[c2v_path]
-        packed_path = c2v_path + "b"
-        if not os.path.exists(packed_path):
-            self.log(f"Packing {c2v_path} -> {packed_path} (one-time)")
-            pack_c2v(c2v_path, self.vocabs, self.config.max_contexts,
-                     out_path=packed_path,
-                     num_workers=self.config.preprocess_workers)
-        shard_index, num_shards = distributed.host_shard()
-        ds = PackedDataset(packed_path, self.vocabs,
-                           shard_index=shard_index, num_shards=num_shards)
-        cached[c2v_path] = ds
-        return ds
 
     def _train_batches(self) -> Iterable:
         """Training batch stream with EpochEnd markers at data-pass
@@ -359,44 +563,6 @@ class Code2VecModel:
                   "global rows the resumed epoch skipped as "
                   "already-consumed").set(skip)
         return skip
-
-    def _require_single_process(self, what: str) -> None:
-        """Multi-host training/eval requires packed data: the streaming
-        text reader cannot know its post-filter batch count before the
-        first pass, so the pod-wide lockstep agreement (see
-        `_train_batches`) has nothing to agree on. Packed data is the
-        designed pod path anyway — raw-text parsing in Python would be
-        feed-bound at pod scale."""
-        if jax.process_count() > 1:
-            raise RuntimeError(
-                f"{what} is not supported with multiple processes; "
-                f"pack the dataset first (use_packed_data=True).")
-
-    def _eval_batches(self) -> Iterable:
-        config = self.config
-        batch_size = distributed.local_batch_size(config.test_batch_size)
-        if config.use_packed_data:
-            ds = self._packed_dataset(config.test_data_path)
-            batches = ds.iter_batches(batch_size,
-                                      EstimatorAction.Evaluate,
-                                      with_target_strings=True)
-            if jax.process_count() > 1:
-                # Lockstep contract (max + pad): every host must drive the
-                # same number of collective eval steps; no real row may be
-                # dropped, so short hosts pad with invalid batches.
-                local = ds.steps_per_epoch(batch_size, EstimatorAction.Evaluate)
-                agreed = distributed.agree_scalar(local, "max")
-                from code2vec_tpu.data.reader import invalid_batch
-                return distributed.lockstep_eval_stream(
-                    batches, agreed,
-                    lambda: invalid_batch(batch_size, config.max_contexts))
-            return batches
-        self._require_single_process("evaluating from raw .c2v text")
-        shard_index, num_shards = distributed.host_shard()
-        return PathContextReader(self.vocabs, config, EstimatorAction.Evaluate,
-                                 shard_index=shard_index,
-                                 num_shards=num_shards,
-                                 batch_size=batch_size)
 
     # ------------------------------------------------------------ train
 
@@ -615,120 +781,20 @@ class Code2VecModel:
 
     # ---------------------------------------------------------- predict
 
-    @property
-    def context_buckets(self) -> Tuple[int, ...]:
-        """Padded-context-count buckets for the predict path (sorted,
-        always ending in max_contexts, filtered to cp multiples) —
-        parsed once from config.serve_buckets. One compiled step per
-        bucket is the whole compilation budget of the serving path."""
-        cached = getattr(self, "_context_buckets", None)
-        if cached is None:
-            from code2vec_tpu.serving.batcher import parse_buckets
-            cached = self._context_buckets = parse_buckets(
-                getattr(self.config, "serve_buckets", ""),
-                self.config.max_contexts, cp=self.config.cp)
-        return cached
+    def _make_predict_step(self, batch_rows: int, m: int):
+        # a FRESH jitted eval step per shape (BucketedPredictMixin): each
+        # entry compiles exactly once for its one padded shape
+        return self.builder.make_eval_step(self.state)
 
-    def _get_bucketed_predict_step(self, batch_rows: int, m: int):
-        key = (batch_rows, m)
-        step = self._predict_steps.get(key)
-        if step is None:
-            # a FRESH jitted callable per shape: each entry compiles
-            # exactly once, so len(_predict_steps) == pjit compilations
-            step = self._predict_steps[key] = \
-                self.builder.make_eval_step(self.state)
-            self.log(f"Compiling predict step for shape "
-                     f"(rows={batch_rows}, contexts={m}) "
-                     f"[{len(self._predict_steps)} of "
-                     f"<= {len(self.context_buckets)} buckets]")
-        return step
+    def _call_predict_step(self, step, arrays):
+        return step(self.state.params, *arrays)
 
-    def predict_compile_count(self) -> int:
-        """Distinct compiled predict-step shapes so far (bounded by the
-        bucket list for a fixed serve batch size; asserted in
-        tests/test_serving.py and recorded by the serving bench)."""
-        return len(self._predict_steps)
-
-    def predict(self, predict_data_lines: Iterable[str],
-                batch_size: Optional[int] = None,
-                with_code_vectors: Optional[bool] = None
-                ) -> List[ModelPredictionResults]:
-        """reference: tensorflow_model.py:310-367 — per-line predictions
-        with top-k words, softmax-normalized scores, attention per context
-        and the code vector.
-
-        Accepts any iterable (never materialized whole): lines stream in
-        `batch_size`-row chunks, each routed through the bucketed
-        compiled-step cache the serving batcher shares, so a million-line
-        offline predict and the HTTP server exercise the SAME bounded set
-        of compiled shapes. `with_code_vectors` defaults to
-        config.export_code_vectors; the serving /embed endpoint forces it
-        on (the step computes the vectors either way — the flag only
-        gates their host-side materialization)."""
-        import itertools
-        results: List[ModelPredictionResults] = []
-        bs = int(batch_size or self.config.test_batch_size)
-        if with_code_vectors is None:
-            with_code_vectors = self.config.export_code_vectors
-        it = iter(predict_data_lines)
-        while True:
-            lines = list(itertools.islice(it, bs))
-            if not lines:
-                return results
-            results.extend(self._predict_chunk(lines, bs,
-                                               with_code_vectors))
-
-    def _predict_chunk(self, lines: List[str], bs: int,
-                       with_code_vectors: bool
-                       ) -> List[ModelPredictionResults]:
-        config = self.config
-        from code2vec_tpu.data.reader import _pad_rows, slice_contexts
-        from code2vec_tpu.serving.batcher import bucket_for
-        chunk = parse_context_lines(lines, self.vocabs, config.max_contexts,
-                                    EstimatorAction.Predict,
-                                    keep_strings=True)
-        n = len(lines)
-        # Deepest VALID context column decides the bucket: the slice
-        # below only ever removes all-padding columns.
-        any_valid_col = chunk.context_valid_mask.any(axis=0)
-        deepest = (int(np.nonzero(any_valid_col)[0][-1]) + 1
-                   if any_valid_col.any() else 1)
-        m = bucket_for(deepest, self.context_buckets)
-        chunk = slice_contexts(chunk, m)
-        # Pad the row count to the fixed serve batch size: row count and
-        # context bucket together fully determine the compiled shape.
-        padded = _pad_rows(chunk, bs)
-        step = self._get_bucketed_predict_step(bs, m)
-        arrays = device_put_batch(padded, self.mesh)
-        out = step(self.state.params, *arrays)
-        results: List[ModelPredictionResults] = []
-        topk_idx = np.asarray(out.topk_indices)[:n]
-        topk_val = np.asarray(out.topk_values)[:n]
-        code_vectors = np.asarray(out.code_vectors)[:n]
-        attention = np.asarray(out.attention)[:n]
-        # normalize_scores=True in the reference predict graph
-        # (tensorflow_model.py:321): softmax over the k values.
-        e = np.exp(topk_val - topk_val.max(axis=1, keepdims=True))
-        scores = e / e.sum(axis=1, keepdims=True)
-        for i in range(n):
-            words = [self.vocabs.target_vocab.lookup_word(int(j))
-                     for j in topk_idx[i]]
-            attention_per_context: Dict[Tuple[str, str, str], float] = {}
-            for j in range(m):
-                s = chunk.source_strings[i, j]
-                p = chunk.path_strings[i, j]
-                t = chunk.target_token_strings[i, j]
-                if s or p or t:
-                    attention_per_context[(s, p, t)] = float(attention[i, j])
-            results.append(ModelPredictionResults(
-                original_name=(chunk.target_strings[i]
-                               if chunk.target_strings else ""),
-                topk_predicted_words=words,
-                topk_predicted_words_scores=scores[i],
-                attention_per_context=attention_per_context,
-                code_vector=(code_vectors[i]
-                             if with_code_vectors else None)))
-        return results
+    def model_fingerprint(self) -> str:
+        ident = os.path.abspath(self.config.model_load_path
+                                or self.config.model_save_path
+                                or f"seed{self.config.seed}")
+        step = int(jax.device_get(self.state.step))
+        return f"ckpt:{ident}@step{step}#p{num_params(self.state)}"
 
     # ------------------------------------------------------------ save
 

@@ -1,0 +1,75 @@
+"""Per-row symmetric int8 quantization for the embedding tables.
+
+The flagship shape is 227-383M params dominated by three embedding
+tables and the ~246K-name target classifier, and every hot op that
+touches them is memory-bandwidth-bound (BENCH_ROOFLINE.md): int8 storage
+moves one byte per weight instead of four through HBM, with the dequant
+fused into the consuming op — gathers multiply the gathered rows by
+their scales (ops below), the classifier matmul dequants its block
+logits after f32 accumulation (ops/topk.py blockwise_matmul_top_k).
+
+Scheme: per-row symmetric absmax. For row r with scale
+s_r = max|w_r| / 127, q = round(w / s_r) in [-127, 127]; dequant is
+q * s_r. No zero-point (embedding rows are ~zero-centered by init and
+training), so the dequant stays a single fused multiply. Worst-case
+round-trip error is s_r / 2 per element, pinned in tests/test_quant.py;
+the end-to-end quality delta is measured on the accuracy bench by
+experiments/quant_bench.py (BENCH_QUANT.md).
+
+All-zero rows (never-touched vocab tail, padding rows) get scale 0 and
+quantize to exact zeros; the dequant multiply reproduces them exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+QMAX = 127
+
+
+def quantize_rows(table: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side quantizer: f32 (V, D) -> (int8 (V, D), f32 scales (V, 1)).
+
+    Runs in numpy (export is an offline host job; the tables may be
+    bigger than comfortable to round-trip through the device twice).
+    """
+    table = np.asarray(table, np.float32)
+    if table.ndim != 2:
+        raise ValueError(f"quantize_rows expects a 2-D table, "
+                         f"got shape {table.shape}")
+    absmax = np.abs(table).max(axis=1, keepdims=True)
+    scales = (absmax / QMAX).astype(np.float32)
+    # 0-scale rows are exact zeros; guard the divide, not the result.
+    safe = np.where(scales > 0, scales, 1.0)
+    q = np.clip(np.rint(table / safe), -QMAX, QMAX).astype(np.int8)
+    return q, scales
+
+
+def dequantize_rows(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Host-side inverse of quantize_rows (bench/analysis utility)."""
+    return q.astype(np.float32) * np.asarray(scales, np.float32)
+
+
+def dequant_gather(q_table: jax.Array, scales: jax.Array,
+                   ids: jax.Array) -> jax.Array:
+    """Gather rows of an int8 table by id with fused dequant:
+    (..., D) f32. The gather moves int8 bytes; the per-row scale
+    multiply happens on the gathered (batch-sized) rows, never on the
+    full table."""
+    rows = jnp.take(q_table, ids, axis=0).astype(jnp.float32)
+    s = jnp.take(scales[:, 0], ids, axis=0)
+    return rows * s[..., None]
+
+
+def table_gather(table: jax.Array, scales: Optional[jax.Array],
+                 ids: jax.Array) -> jax.Array:
+    """Scheme-agnostic gather: int8 tables carry scales, f32 tables
+    pass scales=None (plain take). One call site serves both release
+    artifact flavors (release/runtime.py)."""
+    if scales is None:
+        return jnp.take(table, ids, axis=0)
+    return dequant_gather(table, scales, ids)

@@ -1,0 +1,12 @@
+"""Release artifacts: self-contained quantized inference bundles.
+
+`artifact.py` writes/loads the on-disk bundle (int8 tables + per-row
+scales, vocabularies, AOT serve lowerings, meta); `runtime.py` is the
+serving/eval fast path that consumes one without ever building the fp32
+training state.
+"""
+
+from code2vec_tpu.release.artifact import (  # noqa: F401
+    ArtifactError, ReleaseArtifact, export_artifact, is_release_artifact,
+    load_artifact,
+)

@@ -5,8 +5,11 @@ Serving traffic is heavily repetitive (IDE plugins re-send the method on
 every keystroke pause; CI re-submits unchanged files), so a small LRU in
 front of extract+predict converts the common case from
 subprocess+device work into a dict hit. Keys are a blake2b digest of the
-WHITESPACE-NORMALIZED source plus every knob that changes the answer
-(endpoint, topk, model identity token) — reformatting a method must hit,
+WHITESPACE-NORMALIZED source plus every knob that changes the answer —
+endpoint, topk, and the serving model's identity fingerprint
+(model_fingerprint(): checkpoint path + step for the facade, artifact
+content hash for a release bundle), so a hot-swapped or re-exported
+model can never satisfy a stale entry. Reformatting a method must hit,
 editing it must miss. Values are opaque to the cache; the HTTP layer
 stores the final serialized response bytes, which makes the hit path
 byte-equal to the miss path by construction (pinned in

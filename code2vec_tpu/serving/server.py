@@ -99,6 +99,12 @@ class PredictionServer:
             max_delay_s=self.config.serve_max_delay_ms / 1000.0)
         self.cache = PredictionCache(self.config.serve_cache_entries)
         self.topk = self.config.top_k_words_considered_during_prediction
+        # Model-identity token mixed into every cache key: a hot-swapped
+        # checkpoint or re-exported artifact must never serve a stale
+        # cached prediction (the key hashes source + knobs only
+        # otherwise). Surfaced in /healthz so a deploy can assert which
+        # weights a replica answers with.
+        self.model_fingerprint = model.model_fingerprint()
         self._httpd: Optional[socketserver.BaseServer] = None
         self._inflight = 0
         self._inflight_cond = threading.Condition()
@@ -117,7 +123,8 @@ class PredictionServer:
             raise _HTTPError(400, "empty request body")
         t0 = time.perf_counter()
         phases: Dict[str, float] = {}
-        key = cache_key(code, endpoint=endpoint, topk=self.topk)
+        key = cache_key(code, endpoint=endpoint, topk=self.topk,
+                        model=self.model_fingerprint)
         cached = self.cache.get(key)
         if cached is not None:
             _H_PHASE["total"].observe(time.perf_counter() - t0)
@@ -185,6 +192,7 @@ class PredictionServer:
             "status": "draining" if self._draining else "serving",
             "uptime_s": time.time() - self.started_at,
             "pid": os.getpid(),
+            "model_fingerprint": self.model_fingerprint,
             "extractor_pool": {"size": self.pool.size,
                                "warm": self.pool.warm},
             "batcher": {"max_batch_rows": self.batcher.max_batch_rows,

@@ -479,21 +479,61 @@ class TrainStepBuilder:
             return self._make_manual_eval_step(example_state, k)
         return self._make_gspmd_eval_step(example_state, k)
 
+    def _eval_topk_block(self) -> int:
+        """Rows per streamed target-table block for the blockwise top-k
+        eval/predict head (ops/topk.py), or 0 for the classic
+        materialize-(B,V)-then-top_k path. Blockwise engages only when
+        it actually removes a materialization (vocab larger than one
+        block) and the table is unsharded over `model` (tp>1 GSPMD row
+        shards would turn each dynamic_slice into a cross-shard
+        gather; the manual-tp builder has its own tp_top_k)."""
+        block = int(getattr(self.config, "topk_block_size", 0) or 0)
+        if block <= 0 or self.config.tp > 1:
+            return 0
+        if block >= self.module.dims.target_vocab_size:
+            return 0
+        return block
+
     def _make_gspmd_eval_step(self, example_state: TrainState, k: int) -> Callable:
         module = self.module
 
         oov_floor = module.dims.target_oov_floor
+        topk_block = self._eval_topk_block()
+        dims = module.dims
 
         def eval_step(params, *batch_arrays) -> EvalOutputs:
             (src, pth, tgt, mask, labels, valid) = batch_arrays
-            logits, code_vectors, attention = module.apply(
-                {"params": params}, src, pth, tgt, mask, deterministic=True)
-            values, indices = jax.lax.top_k(logits, k)
-            safe_logits = jnp.where(jnp.isfinite(logits), logits, -1e30)
             # OOV/PAD-target rows carry no real label; excluding them keeps
             # eval loss comparable to train loss (the reader drops such
             # rows from training, data/reader.py row_filter_mask).
             loss_rows = valid & (labels > oov_floor)
+            if topk_block:
+                # Blockwise prediction head: the (B, target_vocab) logit
+                # row is never materialized — the target table streams
+                # through a running top-k merge + logsumexp
+                # (ops/topk.py; index/value parity with the full path is
+                # exact, pinned in tests/test_quant.py).
+                from code2vec_tpu.ops.topk import (
+                    blockwise_matmul_top_k, gathered_label_logits,
+                )
+                code_vectors, attention = module.apply(
+                    {"params": params}, src, pth, tgt, mask,
+                    deterministic=True, method=Code2VecModule.encode)
+                table = params["target_embedding"]
+                out = blockwise_matmul_top_k(
+                    code_vectors, table, k, topk_block,
+                    valid_rows=dims.real_target_vocab_size,
+                    compute_dtype=module.compute_dtype)
+                label_logit = gathered_label_logits(
+                    code_vectors, table, labels,
+                    compute_dtype=module.compute_dtype)
+                ce = (out.lse - label_logit) * loss_rows.astype(jnp.float32)
+                return EvalOutputs(out.values, out.indices.astype(jnp.int32),
+                                   code_vectors, attention, jnp.sum(ce))
+            logits, code_vectors, attention = module.apply(
+                {"params": params}, src, pth, tgt, mask, deterministic=True)
+            values, indices = jax.lax.top_k(logits, k)
+            safe_logits = jnp.where(jnp.isfinite(logits), logits, -1e30)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 safe_logits, labels) * loss_rows.astype(jnp.float32)
             return EvalOutputs(values, indices.astype(jnp.int32),

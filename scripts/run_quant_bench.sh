@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Run the quantized-release-artifact bench with a hard timeout and crash
+# diagnostics, matching scripts/run_chaos.sh conventions.
+#
+# The bench trains (or reuses) the accuracy-corpus model, evaluates the
+# fp32/blockwise/int8 arms, measures the AOT cold start, and drives the
+# PR-7 serving harness before/after — a hang usually means a wedged
+# serving dispatch or a stuck eval batch, so the run is wall-clock
+# bounded and, on failure, any metrics snapshots the bench left under
+# the run dir are dumped.
+#
+# Usage: scripts/run_quant_bench.sh [extra args passed to the bench]
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+RUN_DIR="$(mktemp -d "${TMPDIR:-/tmp}/c2v-quant.XXXXXX")"
+LOG="$RUN_DIR/bench.log"
+# The bench exports a Prometheus snapshot here at exit; on failure the
+# dump below surfaces it (eval counters, serving SLO histograms).
+export C2V_CHAOS_DIAG_DIR="$RUN_DIR"
+
+# Wall-clock backstop: a cold run (corpus build + ~10-epoch training +
+# four eval arms + serving load) finishes well inside 3600s on a dev
+# CPU; the timeout catches a serving/eval hang, not a slow run. Cached
+# reruns (--root kept) finish in minutes.
+BUDGET=3600
+
+echo "=== quant bench (budget ${BUDGET}s) ==="
+timeout -k 20 "$BUDGET" \
+    env JAX_PLATFORMS=cpu python experiments/quant_bench.py "$@" \
+    2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "BENCH TIMED OUT (rc=$rc): likely an eval/serving hang" | tee -a "$LOG"
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "=== quant bench FAILED (rc=$rc): dumping diagnostics ==="
+    find "$RUN_DIR" -maxdepth 4 -type f \
+        \( -name '*heartbeat*.json' -o -name 'hb*.json' \
+           -o -name '*.prom' -o -name '*metrics*' \) 2>/dev/null \
+        | while read -r f; do
+        echo "--- $f ---"
+        cat "$f"
+        echo
+    done
+    echo "full log: $LOG"
+else
+    rm -rf "$RUN_DIR"
+fi
+exit "$rc"

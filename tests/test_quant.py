@@ -1,0 +1,753 @@
+"""Quantized release-artifact suite: blockwise top-k parity, int8
+round-trip error bounds, artifact save/load (+ named-field rejection),
+AOT serve lowerings, eval-step blockwise parity, cache fingerprinting.
+
+The blockwise merge's exactness claim (ops/topk.py docstring: identical
+indices AND values to full `lax.top_k`, ties included) is pinned here
+across block sizes, including ties from a coarse value grid, k larger
+than a block, and block larger than the vocab.
+"""
+
+import dataclasses
+import json
+import os
+import pickle
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+
+pytestmark = pytest.mark.quant
+
+
+# ----------------------------------------------------- blockwise top-k
+
+
+@pytest.mark.parametrize("block", [1, 3, 7, 16, 64, 100, 1000])
+def test_blockwise_from_logits_matches_lax_top_k(block):
+    from code2vec_tpu.ops.topk import blockwise_top_k_from_logits
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((9, 97)), jnp.float32)
+    k = 10
+    fv, fi = jax.lax.top_k(logits, k)
+    bv, bi = blockwise_top_k_from_logits(logits, k, block)
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(bi))
+    np.testing.assert_array_equal(np.asarray(fv), np.asarray(bv))
+
+
+@pytest.mark.parametrize("block", [2, 5, 16, 41])
+def test_blockwise_tie_breaking_matches(block):
+    """Ties everywhere: logits drawn from 4 distinct values, so every
+    top-k selection is decided by lax.top_k's lower-index-first rule —
+    the merge must reproduce it exactly."""
+    from code2vec_tpu.ops.topk import blockwise_top_k_from_logits
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(
+        rng.choice([-1.0, 0.0, 0.5, 2.0], size=(6, 83)), jnp.float32)
+    for k in (1, 5, 64):
+        fv, fi = jax.lax.top_k(logits, k)
+        bv, bi = blockwise_top_k_from_logits(logits, k, block)
+        np.testing.assert_array_equal(np.asarray(fi), np.asarray(bi),
+                                      err_msg=f"k={k} block={block}")
+        np.testing.assert_array_equal(np.asarray(fv), np.asarray(bv))
+
+
+@pytest.mark.parametrize("v,block,k", [
+    (1000, 96, 10),     # clamped last block (1000 % 96 != 0)
+    (1000, 1024, 10),   # block > vocab: degenerates to one full block
+    (50, 8, 20),        # k larger than a block
+    (7, 3, 7),          # k == vocab
+])
+def test_blockwise_matmul_matches_full(v, block, k):
+    from code2vec_tpu.ops.topk import blockwise_matmul_top_k
+    rng = np.random.default_rng(2)
+    cv = jnp.asarray(rng.standard_normal((5, 24)), jnp.float32)
+    tbl = jnp.asarray(rng.standard_normal((v, 24)), jnp.float32)
+    full = jnp.einsum("bd,vd->bv", cv, tbl,
+                      preferred_element_type=jnp.float32)
+    fv, fi = jax.lax.top_k(full, k)
+    out = jax.jit(lambda c, t: blockwise_matmul_top_k(c, t, k, block))(
+        cv, tbl)
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(out.indices))
+    np.testing.assert_array_equal(np.asarray(fv), np.asarray(out.values))
+    # the streamed logsumexp must agree with the full-row one
+    ref_lse = jax.scipy.special.logsumexp(full, axis=-1)
+    np.testing.assert_allclose(np.asarray(out.lse), np.asarray(ref_lse),
+                               rtol=1e-5)
+
+
+def test_blockwise_matmul_bf16_and_valid_rows():
+    """bf16 compute parity with the full bf16 einsum, and padded
+    classifier rows (valid_rows) never selected."""
+    from code2vec_tpu.ops.topk import blockwise_matmul_top_k
+    rng = np.random.default_rng(3)
+    v, real = 128, 119
+    cv = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+    tbl = jnp.asarray(rng.standard_normal((v, 16)), jnp.float32)
+    full = jnp.einsum("bd,vd->bv", cv.astype(jnp.bfloat16),
+                      tbl.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    full = jnp.where(jnp.arange(v)[None, :] < real, full, -jnp.inf)
+    fv, fi = jax.lax.top_k(full, 8)
+    out = jax.jit(lambda c, t: blockwise_matmul_top_k(
+        c, t, 8, 48, valid_rows=real, compute_dtype=jnp.bfloat16))(cv, tbl)
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(out.indices))
+    np.testing.assert_array_equal(np.asarray(fv), np.asarray(out.values))
+    assert int(np.asarray(out.indices).max()) < real
+
+
+def test_blockwise_int8_scales_match_dequantized_full():
+    """The fused-dequant block matmul selects the same top-k as a full
+    matmul against the explicitly dequantized table."""
+    from code2vec_tpu.ops.quant import quantize_rows
+    from code2vec_tpu.ops.topk import blockwise_matmul_top_k
+    rng = np.random.default_rng(4)
+    tbl = rng.standard_normal((300, 24)).astype(np.float32)
+    q, s = quantize_rows(tbl)
+    deq = q.astype(np.float32) * s
+    cv = jnp.asarray(rng.standard_normal((6, 24)), jnp.float32)
+    full = jnp.einsum("bd,vd->bv", cv, jnp.asarray(deq),
+                      preferred_element_type=jnp.float32)
+    fv, fi = jax.lax.top_k(full, 7)
+    out = jax.jit(lambda c, t, sc: blockwise_matmul_top_k(
+        c, t, 7, 64, scales=sc))(cv, jnp.asarray(q), jnp.asarray(s))
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(out.indices))
+    np.testing.assert_allclose(np.asarray(fv), np.asarray(out.values),
+                               rtol=1e-6)
+
+
+def test_gathered_label_logits_match_full_column():
+    from code2vec_tpu.ops.topk import gathered_label_logits
+    rng = np.random.default_rng(5)
+    cv = jnp.asarray(rng.standard_normal((8, 12)), jnp.float32)
+    tbl = jnp.asarray(rng.standard_normal((40, 12)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 40, 8), jnp.int32)
+    full = jnp.einsum("bd,vd->bv", cv, tbl,
+                      preferred_element_type=jnp.float32)
+    want = np.take_along_axis(np.asarray(full),
+                              np.asarray(labels)[:, None], axis=1)[:, 0]
+    got = np.asarray(gathered_label_logits(cv, tbl, labels))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_blockwise_nonfinite_logits_keep_loss_finite():
+    """CE-guard parity with the full eval path: a weight blow-up that
+    produces Inf/NaN logits must leave the blockwise lse and the label
+    logit finite — the full path substitutes -1e30 (safe_logits in
+    training/step.py) before CE, and a poisoned eval-loss gauge would
+    break best-checkpoint-by-loss comparisons and TB scalars."""
+    from code2vec_tpu.ops.topk import (
+        blockwise_matmul_top_k, gathered_label_logits,
+    )
+    rng = np.random.default_rng(8)
+    tbl = rng.standard_normal((60, 12)).astype(np.float32)
+    tbl[7, :] = np.inf      # blown-up row: its logits are Inf or NaN
+    cv = jnp.asarray(rng.standard_normal((4, 12)), jnp.float32)
+    tblj = jnp.asarray(tbl)
+    out = jax.jit(lambda c, t: blockwise_matmul_top_k(c, t, 5, 16))(
+        cv, tblj)
+    assert np.isfinite(np.asarray(out.lse)).all()
+    # the streamed lse equals the full path's safe-substituted one
+    full = jnp.einsum("bd,vd->bv", cv, tblj,
+                      preferred_element_type=jnp.float32)
+    safe = jnp.where(jnp.isfinite(full), full, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(safe, axis=-1)
+    np.testing.assert_allclose(np.asarray(out.lse), np.asarray(ref_lse),
+                               rtol=1e-5)
+    # a nonfinite label logit clamps exactly as safe_logits[label] would
+    labels = jnp.asarray([7, 0, 7, 3], jnp.int32)
+    ll = np.asarray(gathered_label_logits(cv, tblj, labels))
+    assert np.isfinite(ll).all()
+    np.testing.assert_array_equal(ll[[0, 2]], np.float32(-1e30))
+    want = np.take_along_axis(np.asarray(safe),
+                              np.asarray(labels)[:, None], axis=1)[:, 0]
+    np.testing.assert_allclose(ll, want, rtol=1e-6)
+
+
+# ----------------------------------------------------------- int8 ops
+
+
+def test_int8_round_trip_error_bound():
+    """Per-row symmetric absmax: |x - dequant(quant(x))| <= scale/2 =
+    max|row| / 254 elementwise, and the row absmax survives exactly
+    (it quantizes to +-127 by construction)."""
+    from code2vec_tpu.ops.quant import dequantize_rows, quantize_rows
+    rng = np.random.default_rng(6)
+    tbl = (rng.standard_normal((64, 48))
+           * rng.lognormal(0, 2, (64, 1))).astype(np.float32)
+    tbl[13, :] = 0.0  # all-zero row (untouched vocab tail)
+    q, s = quantize_rows(tbl)
+    assert q.dtype == np.int8 and s.shape == (64, 1)
+    deq = dequantize_rows(q, s)
+    err = np.abs(deq - tbl)
+    bound = np.abs(tbl).max(axis=1, keepdims=True) / 254 + 1e-9
+    assert (err <= bound).all(), float((err / bound).max())
+    np.testing.assert_array_equal(deq[13], np.zeros(48, np.float32))
+    # absmax element is exactly representable
+    flat_amax = np.abs(tbl).argmax(axis=1)
+    rows = np.arange(64)
+    np.testing.assert_allclose(np.abs(deq[rows, flat_amax]),
+                               np.abs(tbl[rows, flat_amax]), rtol=1e-6)
+
+
+def test_dequant_gather_matches_host_dequant():
+    from code2vec_tpu.ops.quant import dequant_gather, quantize_rows
+    rng = np.random.default_rng(7)
+    tbl = rng.standard_normal((30, 8)).astype(np.float32)
+    q, s = quantize_rows(tbl)
+    ids = jnp.asarray(rng.integers(0, 30, (4, 5)), jnp.int32)
+    got = np.asarray(dequant_gather(jnp.asarray(q), jnp.asarray(s), ids))
+    want = (q.astype(np.float32) * s)[np.asarray(ids)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# ------------------------------------------------- eval-step blockwise
+
+
+def _tiny_model(tmp_path, **config_overrides):
+    from code2vec_tpu.model_facade import Code2VecModel
+    rng = random.Random(0)
+    tokens = [f"tok{i}" for i in range(6)]
+    paths = [f"p{i}" for i in range(4)]
+    targets = [f"name|x{i}" for i in range(40)]
+    rows = []
+    for _ in range(48):
+        t = rng.randrange(len(targets))
+        ctxs = [f"{tokens[t % 6]},{rng.choice(paths)},{tokens[t % 6]}"
+                for _ in range(rng.randint(2, 6))]
+        rows.append(f"{targets[t]} " + " ".join(ctxs)
+                    + " " * (16 - len(ctxs)))
+    prefix = str(tmp_path / "synthetic")
+    with open(prefix + ".train.c2v", "w") as f:
+        f.write("\n".join(rows) + "\n")
+    with open(prefix + ".dict.c2v", "wb") as f:
+        pickle.dump({w: 10 for w in tokens}, f)
+        pickle.dump({p: 10 for p in paths}, f)
+        pickle.dump({t: 10 for t in targets}, f)
+        pickle.dump(len(rows), f)
+    kwargs = dict(train_data_path_prefix=prefix, max_contexts=16,
+                  train_batch_size=8, test_batch_size=8,
+                  compute_dtype="float32", verbose_mode=0,
+                  serve_batch_size=4, serve_buckets="4,8",
+                  num_train_epochs=1, save_every_epochs=1000)
+    kwargs.update(config_overrides)
+    return Code2VecModel(Config(**kwargs))
+
+
+def _rand_batch_arrays(model, b=8):
+    rng = np.random.default_rng(11)
+    d = model.dims
+    m = model.config.max_contexts
+    return (jnp.asarray(rng.integers(0, d.token_vocab_size, (b, m)), jnp.int32),
+            jnp.asarray(rng.integers(0, d.path_vocab_size, (b, m)), jnp.int32),
+            jnp.asarray(rng.integers(0, d.token_vocab_size, (b, m)), jnp.int32),
+            jnp.asarray((rng.random((b, m)) > 0.3), jnp.float32),
+            jnp.asarray(rng.integers(2, d.real_target_vocab_size, (b,)),
+                        jnp.int32),
+            jnp.asarray(np.ones(b, bool)))
+
+
+def test_eval_step_blockwise_matches_full(tmp_path):
+    """The production eval step with topk_block_size engaged returns
+    identical top-k indices/values and a matching CE sum vs the
+    full-logits path (target vocab 40+specials, block 8 -> 6 blocks)."""
+    model = _tiny_model(tmp_path)
+    arrays = _rand_batch_arrays(model)
+    full_cfg = dataclasses.replace(model.config, topk_block_size=0)
+    from code2vec_tpu.training.step import TrainStepBuilder
+    full_step = TrainStepBuilder(model.module, model.optimizer, full_cfg,
+                                 mesh=None).make_eval_step(model.state)
+    block_cfg = dataclasses.replace(model.config, topk_block_size=8)
+    builder = TrainStepBuilder(model.module, model.optimizer, block_cfg,
+                               mesh=None)
+    assert builder._eval_topk_block() == 8
+    block_step = builder.make_eval_step(model.state)
+    fo = full_step(model.state.params, *arrays)
+    bo = block_step(model.state.params, *arrays)
+    np.testing.assert_array_equal(np.asarray(fo.topk_indices),
+                                  np.asarray(bo.topk_indices))
+    np.testing.assert_array_equal(np.asarray(fo.topk_values),
+                                  np.asarray(bo.topk_values))
+    np.testing.assert_allclose(np.asarray(fo.code_vectors),
+                               np.asarray(bo.code_vectors), rtol=1e-6)
+    np.testing.assert_allclose(float(fo.loss_sum), float(bo.loss_sum),
+                               rtol=1e-5)
+
+
+def test_eval_topk_block_gates(tmp_path):
+    """Blockwise disengages when it cannot help: block 0, block >= vocab,
+    tp-sharded tables."""
+    from code2vec_tpu.training.step import TrainStepBuilder
+    model = _tiny_model(tmp_path)
+    mk = lambda **kw: TrainStepBuilder(  # noqa: E731
+        model.module, model.optimizer,
+        dataclasses.replace(model.config, **kw),
+        mesh=None)._eval_topk_block()
+    assert mk(topk_block_size=0) == 0
+    assert mk(topk_block_size=100_000) == 0     # >= vocab: full path
+    assert mk(topk_block_size=8) == 8
+    assert mk(topk_block_size=8, tp=2) == 0
+
+
+# ------------------------------------------------- artifact round trip
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("quant-artifact")
+    model = _tiny_model(tmp)
+    from code2vec_tpu.release.artifact import export_artifact
+    art_dir = str(tmp / "artifact")
+    meta = export_artifact(model, art_dir, log=lambda m: None)
+    return model, art_dir, meta
+
+
+def test_artifact_save_load_round_trip(exported):
+    from code2vec_tpu.release.artifact import load_artifact
+    model, art_dir, meta = exported
+    art = load_artifact(art_dir)
+    assert art.meta["fingerprint"] == meta["fingerprint"]
+    assert art.scheme == "int8_rowwise_symmetric"
+    # quantized tables carry scales shaped (V, 1); dense params are f32
+    for name in ("token_embedding", "path_embedding", "target_embedding"):
+        assert art.tables[name].dtype == np.int8
+        assert art.tables[f"{name}.scale"].shape == \
+            (art.tables[name].shape[0], 1)
+    assert art.tables["transform"].dtype == np.float32
+    # >= 3x smaller tables than fp32 (int8 + one f32 scale per row)
+    tb = meta["table_bytes"]
+    assert tb["fp32"] / tb["artifact"] >= 3.0
+    # vocabularies round-trip through the artifact's dictionaries.bin
+    from code2vec_tpu.vocab import Code2VecVocabs
+    v = Code2VecVocabs.load(art.dictionaries_path)
+    assert v.target_vocab.size == model.vocabs.target_vocab.size
+
+
+def test_artifact_fp32_consumer_rejected_with_named_field(exported):
+    from code2vec_tpu.release.artifact import ArtifactError, load_artifact
+    _, art_dir, _ = exported
+    with pytest.raises(ArtifactError, match="quantization.scheme") as ei:
+        load_artifact(art_dir, expect_scheme="float32")
+    assert ei.value.field == "quantization.scheme"
+
+
+def test_artifact_dtype_mismatch_rejected(exported, tmp_path):
+    """A tampered bundle (meta says int8, file holds f32) must fail
+    naming the table, not dequantize garbage."""
+    import shutil
+
+    from code2vec_tpu.release.artifact import ArtifactError, load_artifact
+    _, art_dir, _ = exported
+    broken = str(tmp_path / "broken")
+    shutil.copytree(art_dir, broken)
+    q = np.load(os.path.join(broken, "token_embedding.npy"))
+    np.save(os.path.join(broken, "token_embedding.npy"),
+            q.astype(np.float32))
+    with pytest.raises(ArtifactError, match="token_embedding.dtype"):
+        load_artifact(broken)
+
+
+@pytest.mark.parametrize("field", ["topk", "buckets", "compute_dtype",
+                                   "serve_batch_size", "max_contexts"])
+def test_artifact_missing_meta_field_rejected(exported, tmp_path, field):
+    """A torn or hand-edited meta that lost a runtime-consumed field
+    must fail at LOAD with the field named (ArtifactError), not as a
+    bare KeyError later in ReleaseModel/make_release_step."""
+    import shutil
+
+    from code2vec_tpu.release.artifact import ArtifactError, load_artifact
+    _, art_dir, _ = exported
+    broken = str(tmp_path / f"missing_{field}")
+    shutil.copytree(art_dir, broken)
+    mp = os.path.join(broken, "release_meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    del meta[field]
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ArtifactError, match=field) as ei:
+        load_artifact(broken)
+    assert ei.value.field == field
+
+
+def test_artifact_missing_runtime_dims_rejected(exported, tmp_path):
+    """dims fields only the runtime reads (real_target_vocab_size,
+    target_oov_floor) are part of the load-time contract too."""
+    import shutil
+
+    from code2vec_tpu.release.artifact import ArtifactError, load_artifact
+    _, art_dir, _ = exported
+    broken = str(tmp_path / "missing_dims")
+    shutil.copytree(art_dir, broken)
+    mp = os.path.join(broken, "release_meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    del meta["dims"]["real_target_vocab_size"]
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ArtifactError, match="real_target_vocab_size"):
+        load_artifact(broken)
+
+
+def test_artifact_non_artifact_dir_rejected(tmp_path):
+    from code2vec_tpu.release.artifact import ArtifactError, load_artifact
+    with pytest.raises(ArtifactError, match="not a release artifact"):
+        load_artifact(str(tmp_path))
+
+
+def test_facade_load_rejects_artifact(exported, tmp_path):
+    """--load pointed at a release artifact fails up front with the
+    quantization field named (never reaches the Orbax restore)."""
+    from code2vec_tpu.model_facade import Code2VecModel
+    _, art_dir, _ = exported
+    config = Config(model_load_path=art_dir, verbose_mode=0)
+    with pytest.raises(ValueError, match="quantization.scheme"):
+        Code2VecModel(config)
+
+
+def test_export_requires_load():
+    with pytest.raises(ValueError, match="artifact_out.*requires --load"):
+        Config(train_data_path_prefix="x",
+               export_artifact_path="/tmp/nope").verify()
+
+
+# --------------------------------------------------- release runtime
+
+
+def test_release_model_predictions_and_aot(exported, tmp_path):
+    """ReleaseModel serves the artifact: predictions match the facade's
+    (int8 quantization of this tiny model preserves the ranking), AOT
+    lowerings are used for exported shapes, jit fallback covers others,
+    and quality flows through the standard Evaluator."""
+    import dataclasses as dc
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, meta = exported
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir)
+    rm = ReleaseModel(config, log=lambda m: None)
+    lines = ["alpha tok0,p0,tok0 tok0,p1,tok0", "beta tok1,p2,tok1"]
+    base = model.predict(lines, batch_size=4)
+    rel = rm.predict(lines, batch_size=4)
+    assert [r.topk_predicted_words for r in rel] == \
+        [r.topk_predicted_words for r in base]
+    assert rm.aot_loads["aot"] == 1 and rm.aot_loads["jit_error"] == 0
+    # un-exported shape -> jit fallback, same answers
+    rel2 = rm.predict(lines, batch_size=2)
+    assert [r.topk_predicted_words for r in rel2] == \
+        [r.topk_predicted_words for r in base]
+    assert rm.aot_loads["jit_fallback"] == 1
+    # distinct fingerprints: facade vs artifact (cache-key separation)
+    assert rm.model_fingerprint() != model.model_fingerprint()
+    assert rm.model_fingerprint().startswith("artifact:")
+
+
+def test_release_predict_defaults_to_serve_batch_size(exported):
+    """predict() without an explicit batch_size must chunk at the
+    artifact's serve_batch_size — not the facade's test_batch_size
+    (1024 default) — so `--predict --artifact` and offline predict hit
+    the shipped AOT lowerings instead of tracing unseen shapes."""
+    import dataclasses as dc
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, meta = exported
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir)
+    rm = ReleaseModel(config, log=lambda m: None)
+    assert rm._default_predict_batch_size() == int(meta["serve_batch_size"])
+    rm.predict(["alpha tok0,p0,tok0 tok0,p1,tok0"])
+    assert rm.aot_loads["aot"] == 1 and rm.aot_loads["jit_fallback"] == 0
+    rows = {shape[0] for shape in rm._predict_steps}
+    assert rows == {int(meta["serve_batch_size"])}
+
+
+def test_release_eval_step_close_to_fp32(exported):
+    """EvalOutputs from the release runtime (int8 + blockwise) track the
+    fp32 eval step on random batches: identical top-1 for this model,
+    loss within the quantization tolerance."""
+    model, art_dir, _ = exported
+    import dataclasses as dc
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir)
+    rm = ReleaseModel(config, log=lambda m: None)
+    arrays = _rand_batch_arrays(model)
+    fo = model._get_eval_step()(model.state.params, *arrays)
+    ro = rm.eval_step(None, *arrays)
+    assert np.asarray(ro.topk_indices).shape == \
+        np.asarray(fo.topk_indices).shape
+    np.testing.assert_allclose(np.asarray(ro.code_vectors),
+                               np.asarray(fo.code_vectors),
+                               rtol=0.1, atol=0.05)
+    np.testing.assert_allclose(float(ro.loss_sum), float(fo.loss_sum),
+                               rtol=0.1)
+
+
+def test_aot_export_round_trip_exact(exported):
+    """Deserialized AOT lowering == jit of the same step, bitwise, on
+    the same platform."""
+    from jax import export as jax_export
+
+    from code2vec_tpu.release.artifact import load_artifact
+    from code2vec_tpu.release.runtime import make_release_step
+    model, art_dir, meta = exported
+    art = load_artifact(art_dir)
+    rows = int(meta["serve_batch_size"])
+    m = int(meta["buckets"][0])
+    path = art.aot_path(rows, m)
+    assert path is not None
+    with open(path, "rb") as f:
+        exported_fn = jax_export.deserialize(bytearray(f.read()))
+    params = {k.replace(".scale", "_scale"): jnp.asarray(v)
+              for k, v in art.tables.items()}
+    rng = np.random.default_rng(13)
+    d = meta["dims"]
+    batch = (jnp.asarray(rng.integers(0, d["token_vocab_size"], (rows, m)),
+                         jnp.int32),
+             jnp.asarray(rng.integers(0, d["path_vocab_size"], (rows, m)),
+                         jnp.int32),
+             jnp.asarray(rng.integers(0, d["token_vocab_size"], (rows, m)),
+                         jnp.int32),
+             jnp.ones((rows, m), jnp.float32),
+             jnp.asarray(rng.integers(0, d["real_target_vocab_size"],
+                                      (rows,)), jnp.int32),
+             jnp.asarray(np.ones(rows, bool)))
+    aot_out = exported_fn.call(params, *batch)
+    jit_out = jax.jit(make_release_step(meta))(params, *batch)
+    for a, b in zip(aot_out, jit_out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_release_fp32_forward_matches_facade(exported, tmp_path):
+    """Drift guard for the hand-mirrored forward in make_release_step:
+    on an fp32 artifact the release eval outputs must match the facade
+    eval step tightly — identical top-k indices, values/code vectors/
+    loss to float tolerance. Any change to the canonical forward in
+    models/code2vec.py that is not mirrored in release/runtime.py
+    fails here."""
+    import dataclasses as dc
+
+    from code2vec_tpu.release.artifact import export_artifact
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, _, _ = exported
+    art_dir = str(tmp_path / "fp32_parity")
+    export_artifact(model, art_dir, quantize=False, aot=False,
+                    log=lambda m: None)
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir)
+    rm = ReleaseModel(config, log=lambda m: None)
+    arrays = _rand_batch_arrays(model)
+    fo = model._get_eval_step()(model.state.params, *arrays)
+    ro = rm.eval_step(None, *arrays)
+    np.testing.assert_array_equal(np.asarray(fo.topk_indices),
+                                  np.asarray(ro.topk_indices))
+    np.testing.assert_allclose(np.asarray(ro.topk_values),
+                               np.asarray(fo.topk_values), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ro.code_vectors),
+                               np.asarray(fo.code_vectors), rtol=1e-6,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ro.attention),
+                               np.asarray(fo.attention), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(float(ro.loss_sum), float(fo.loss_sum),
+                               rtol=1e-5)
+
+
+def test_release_model_topk_artifact_authoritative(exported):
+    """A serve-time --topk override cannot change the baked step: the
+    artifact's exported k wins (silent truncation bugfix)."""
+    import dataclasses as dc
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, meta = exported
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir,
+                        top_k_words_considered_during_prediction=3)
+    rm = ReleaseModel(config, log=lambda m: None)
+    assert rm.config.top_k_words_considered_during_prediction == \
+        int(meta["topk"])
+
+
+def test_release_model_explicit_serve_batch_size_respected(exported):
+    """An EXPLICIT --serve_batch_size is honored even when it equals the
+    Config default: only an unset knob adopts the artifact's
+    AOT-exported size (the operator may be bounding per-request
+    latency/memory on a small replica)."""
+    import dataclasses as dc
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, meta = exported
+    default_rows = Config.__dataclass_fields__["serve_batch_size"].default
+    assert default_rows != int(meta["serve_batch_size"])
+    base = dc.replace(model.config, train_data_path_prefix=None,
+                      serve_artifact=art_dir,
+                      serve_batch_size=default_rows)
+    # unset: the artifact's exported size is adopted (AOT lowerings win)
+    implicit = dc.replace(base, explicit_knobs=())
+    rm = ReleaseModel(implicit, log=lambda m: None)
+    assert rm.config.serve_batch_size == int(meta["serve_batch_size"])
+    # explicitly typed, even at the default value: the flag wins
+    explicit = dc.replace(base, explicit_knobs=("serve_batch_size",))
+    rm = ReleaseModel(explicit, log=lambda m: None)
+    assert rm.config.serve_batch_size == default_rows
+
+
+def test_config_rejects_artifact_plus_training():
+    with pytest.raises(ValueError, match="inference-only"):
+        Config(train_data_path_prefix="x",
+               serve_artifact="/tmp/somewhere").verify()
+
+
+def test_config_rejects_export_combined_with_serve_or_test(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    with pytest.raises(ValueError, match="one-shot job"):
+        Config(model_load_path=str(ckpt),
+               export_artifact_path="/tmp/out",
+               test_data_path="x.c2v").verify()
+
+
+def test_config_rejects_export_combined_with_training(tmp_path):
+    """--data + --artifact_out would train nothing (main() exports the
+    loaded checkpoint and exits) — must fail loudly, not skip the run."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    with pytest.raises(ValueError, match="combined with training"):
+        Config(model_load_path=str(ckpt),
+               export_artifact_path="/tmp/out",
+               train_data_path_prefix="corpus").verify()
+
+
+def test_aot_exec_failure_degrades_to_jit(exported, monkeypatch):
+    """A lowering that deserializes but fails at first EXECUTION (version
+    skew surfacing at run time, not deserialize time) must degrade to the
+    jit fallback — counted as jit_error — instead of erroring every
+    request on that bucket."""
+    import dataclasses as dc
+
+    from jax import export as jax_export
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, meta = exported
+
+    class _Poisoned:
+        def call(self, *a, **kw):
+            raise RuntimeError("custom call target not registered")
+
+    monkeypatch.setattr(jax_export, "deserialize",
+                        lambda data: _Poisoned())
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir)
+    rm = ReleaseModel(config, log=lambda m: None)
+    lines = ["alpha tok0,p0,tok0 tok0,p1,tok0"]
+    rel = rm.predict(lines, batch_size=int(meta["serve_batch_size"]))
+    assert [r.topk_predicted_words for r in rel] == \
+        [r.topk_predicted_words for r in model.predict(lines, batch_size=4)]
+    assert rm.aot_loads["jit_error"] == 1 and rm.aot_loads["aot"] == 0
+
+
+def test_release_step_honors_block_zero(exported, monkeypatch):
+    """meta topk_block_size=0 (exporter pinned the full-logits path) must
+    reach the blockwise kernel as one block spanning the table — not be
+    coerced back to the 4096 default by a falsy-0 check. Absent key
+    (older meta) still defaults to 4096."""
+    import code2vec_tpu.release.runtime as runtime_mod
+    from code2vec_tpu.release.runtime import (
+        batch_specs, make_release_step, param_specs,
+    )
+    model, art_dir, meta = exported
+    seen = []
+    real = runtime_mod.blockwise_matmul_top_k
+
+    def spy(q, table, k, block_rows, **kw):
+        seen.append(block_rows)
+        return real(q, table, k, block_rows, **kw)
+
+    monkeypatch.setattr(runtime_mod, "blockwise_matmul_top_k", spy)
+    rows, m = 2, int(meta["buckets"][0])
+    for pinned, want in ((0, int(meta["dims"]["target_vocab_size"])),
+                        (None, 4096)):
+        meta2 = dict(meta, topk_block_size=pinned)
+        if pinned is None:
+            del meta2["topk_block_size"]
+        seen.clear()
+        jax.eval_shape(make_release_step(meta2), param_specs(meta2),
+                       *batch_specs(rows, m))
+        assert seen == [want], (pinned, seen)
+
+
+def test_release_model_evaluate_via_test_surface(exported, tmp_path):
+    """`--artifact DIR --test data.c2v`: ReleaseModel.evaluate() scores
+    the artifact with the standard Evaluator — same metric surface as
+    the facade's --test (the CLI wiring's backing method)."""
+    import dataclasses as dc
+
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, _ = exported
+    test_path = model.config.train_data_path_prefix + ".train.c2v"
+    config = dc.replace(model.config, train_data_path_prefix=None,
+                        serve_artifact=art_dir, test_data_path=test_path,
+                        test_batch_size=16)
+    rm = ReleaseModel(config, log=lambda m: None)
+    results = rm.evaluate()
+    assert 0.0 <= float(results.subtoken_f1) <= 1.0
+    assert results.topk_acc.shape == \
+        (model.config.top_k_words_considered_during_prediction,)
+
+
+def test_reexport_into_same_dir_drops_stale_files(exported, tmp_path):
+    """fp32 re-export over a prior int8 export must fingerprint the
+    same as a clean fp32 export (stale scale files and AOT lowerings
+    must not survive into — or be hashed into — the new bundle)."""
+    from code2vec_tpu.release.artifact import export_artifact, load_artifact
+    model, _, _ = exported
+    clean = str(tmp_path / "clean_fp32")
+    reused = str(tmp_path / "reused")
+    meta_clean = export_artifact(model, clean, quantize=False, aot=False,
+                                 log=lambda m: None)
+    export_artifact(model, reused, quantize=True, aot=True,
+                    log=lambda m: None)
+    meta_reused = export_artifact(model, reused, quantize=False, aot=False,
+                                  log=lambda m: None)
+    assert meta_reused["fingerprint"] == meta_clean["fingerprint"]
+    assert not os.path.exists(
+        os.path.join(reused, "token_embedding.scale.npy"))
+    assert not os.path.isdir(os.path.join(reused, "aot"))
+    art = load_artifact(reused)
+    assert art.scheme == "float32"
+
+
+@pytest.mark.parametrize("backend,platforms,want", [
+    ("cpu", ["cpu"], True),
+    ("tpu", ["tpu"], True),
+    ("gpu", ["cuda"], True),        # jax.export says cuda, backend says gpu
+    ("gpu", ["rocm"], True),
+    ("cpu", ["cuda"], False),
+    ("tpu", ["cpu"], False),
+    ("cpu", [None], False),         # torn meta: no platform recorded
+])
+def test_backend_matches_aot_platform_vocabulary(backend, platforms, want):
+    from code2vec_tpu.release.runtime import _backend_matches
+    assert _backend_matches(backend, platforms) is want
+
+
+def test_serving_cache_key_includes_model_fingerprint(exported):
+    """Two servers over different weights never share cache entries:
+    the key embeds model_fingerprint() (the PR-8 cache bugfix)."""
+    from code2vec_tpu.serving.cache import cache_key
+    model, art_dir, _ = exported
+    code = "class A { int get() { return 1; } }"
+    k_ckpt = cache_key(code, endpoint="predict", topk=10,
+                       model=model.model_fingerprint())
+    k_art = cache_key(code, endpoint="predict", topk=10,
+                      model=f"artifact:deadbeefdeadbeef")
+    assert k_ckpt != k_art
+    # same fingerprint + reformatted source still hits
+    assert cache_key("class A {\n  int get() {\n    return 1; } }",
+                     endpoint="predict", topk=10,
+                     model=model.model_fingerprint()) == k_ckpt
