@@ -4,6 +4,7 @@ dispatch), plus TPU mesh/precision knobs."""
 
 from __future__ import annotations
 
+import os
 import sys
 from argparse import ArgumentParser
 
@@ -80,6 +81,62 @@ def arguments_parser() -> ArgumentParser:
                         default=None, metavar="SECONDS",
                         help="SIGTERM grace: seconds the drain waits "
                              "for in-flight requests (default 30)")
+    parser.add_argument("--serve_deadline_ms", type=float, default=None,
+                        metavar="MS",
+                        help="default end-to-end deadline per serving "
+                             "request (default 2000; clients override "
+                             "via the X-Deadline-Ms header; 0 = no "
+                             "default deadline). Expiry mid-pipeline "
+                             "is an honest 504")
+    parser.add_argument("--serve_deadline_max_ms", type=float,
+                        default=None, metavar="MS",
+                        help="hard ceiling on any request deadline, "
+                             "header-supplied included (default 30000; "
+                             "0 = no ceiling)")
+    parser.add_argument("--serve_queue_depth", type=int, default=None,
+                        metavar="N",
+                        help="admission bound: max requests in the "
+                             "cache-miss pipeline before excess load "
+                             "is shed with 503 + Retry-After "
+                             "(default 64)")
+    parser.add_argument("--serve_breaker_window",
+                        dest="serve_breaker_window_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="circuit-breaker rolling failure window "
+                             "(default 10)")
+    parser.add_argument("--serve_breaker_failure_ratio", type=float,
+                        default=None, metavar="RATIO",
+                        help="failure ratio over the window that opens "
+                             "a breaker (default 0.5)")
+    parser.add_argument("--serve_breaker_min_requests", type=int,
+                        default=None, metavar="N",
+                        help="minimum samples in the window before a "
+                             "breaker can open (default 4)")
+    parser.add_argument("--serve_breaker_cooldown",
+                        dest="serve_breaker_cooldown_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="seconds an open breaker waits before the "
+                             "half-open recovery probe (default 5)")
+    parser.add_argument("--replicas", dest="serve_replicas", type=int,
+                        default=None, metavar="N",
+                        help="supervised multi-replica serving: fork N "
+                             "single-model replicas sharing the listen "
+                             "port (SO_REUSEPORT, else a supervisor "
+                             "round-robin proxy), restart crashed/hung "
+                             "ones with backoff, drain all on SIGTERM "
+                             "(default 1 = no supervisor)")
+    parser.add_argument("--serve_max_restarts", type=int, default=None,
+                        metavar="N",
+                        help="restarts the supervisor grants each "
+                             "replica before escalating to supervisor "
+                             "exit (default 5)")
+    parser.add_argument("--serve_heartbeat_interval",
+                        dest="serve_heartbeat_interval_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="seconds between serving heartbeat "
+                             "rewrites; the supervisor restarts a "
+                             "replica whose heartbeat goes ~3 "
+                             "intervals stale (default 5)")
     parser.add_argument("--artifact", dest="serve_artifact", metavar="DIR",
                         help="serve/evaluate from a release artifact "
                              "(produced by the `export` subcommand) "
@@ -263,6 +320,16 @@ def config_from_args(argv=None) -> Config:
                                       "serve_cache_entries",
                                       "extractor_pool_size",
                                       "serve_drain_timeout_s",
+                                      "serve_deadline_ms",
+                                      "serve_deadline_max_ms",
+                                      "serve_queue_depth",
+                                      "serve_breaker_window_s",
+                                      "serve_breaker_failure_ratio",
+                                      "serve_breaker_min_requests",
+                                      "serve_breaker_cooldown_s",
+                                      "serve_replicas",
+                                      "serve_max_restarts",
+                                      "serve_heartbeat_interval_s",
                                       "serve_artifact",
                                       "export_artifact_path",
                                       "topk_block_size")
@@ -320,8 +387,20 @@ def config_from_args(argv=None) -> Config:
 
 def main(argv=None) -> None:
     # dispatch mirrors reference code2vec.py:16-37
+    if argv is None:
+        argv = sys.argv[1:]
     config = config_from_args(argv)
     config.verify()
+
+    # Supervised multi-replica serving: the PARENT never builds a model
+    # (each replica is its own process with its own model + extractor
+    # pool); it forks N re-execed copies of this command with
+    # --replicas stripped, monitors their heartbeats, restarts crashed
+    # or hung ones, and fans SIGTERM out as a coordinated drain.
+    if (config.serve and config.serve_replicas > 1
+            and "C2V_SERVE_REPLICA" not in os.environ):
+        from code2vec_tpu.serving.supervisor import supervisor_main
+        sys.exit(supervisor_main(config, argv=list(argv)))
 
     # joins the multi-host runtime when a coordinator is configured;
     # no-op on single-process runs (parallel/distributed.py)

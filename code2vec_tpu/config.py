@@ -241,6 +241,49 @@ class Config:
     # Seconds the SIGTERM drain waits for in-flight requests before
     # giving up (mirrors the trainer's preemption grace pattern).
     serve_drain_timeout_s: float = 30.0
+    # -- serving resilience (serving/admission.py, serving/breaker.py,
+    # serving/supervisor.py, serving/swap.py; README "Operating the
+    # server") --
+    # Default end-to-end deadline per request, in milliseconds. Clients
+    # override per request via the `X-Deadline-Ms` header; both are
+    # clamped by serve_deadline_max_ms. The deadline propagates through
+    # the whole pipeline (extractor timeout, batcher coalescing, device
+    # wait); expiry is an honest 504 that never occupies a device slot.
+    # 0 = no default deadline (the max still applies when set).
+    serve_deadline_ms: float = 2000.0
+    # Hard ceiling on any request's deadline — a client cannot pin a
+    # pipeline slot forever by asking for an hour.
+    serve_deadline_max_ms: float = 30000.0
+    # Admission bound: maximum requests admitted into the cache-miss
+    # pipeline at once. Beyond it (or when the estimated queue wait
+    # exceeds a request's remaining budget) requests are SHED with
+    # 503 + Retry-After instead of queueing unboundedly
+    # (serving_requests_shed_total{reason=...}).
+    serve_queue_depth: int = 64
+    # Circuit breakers (extractor pool + device step): rolling failure
+    # window length, the failure ratio that opens the breaker once
+    # min_requests samples exist, and the open->half-open probe
+    # cooldown. An open breaker fails requests fast (503); cache hits
+    # still serve.
+    serve_breaker_window_s: float = 10.0
+    serve_breaker_failure_ratio: float = 0.5
+    serve_breaker_min_requests: int = 4
+    serve_breaker_cooldown_s: float = 5.0
+    # Supervised multi-replica serving (`serve --replicas N`,
+    # serving/supervisor.py): a parent supervisor forks N single-model
+    # replicas sharing the listen port (SO_REUSEPORT; falls back to
+    # per-replica ports behind the supervisor's round-robin proxy),
+    # restarts crashed/hung replicas with exponential backoff, and
+    # fans SIGTERM out as a coordinated drain.
+    serve_replicas: int = 1
+    # Restarts the supervisor grants EACH replica before escalating to
+    # supervisor exit (a replica that cannot stay up is a deploy
+    # problem, not a restart-loop problem).
+    serve_max_restarts: int = 5
+    # Seconds between serving heartbeat rewrites (--heartbeat_file).
+    # The supervisor treats a heartbeat older than ~3 intervals as a
+    # HUNG replica and restarts it.
+    serve_heartbeat_interval_s: float = 5.0
     # Rows per streamed target-table block in the blockwise top-k
     # prediction head (ops/topk.py): the eval/predict steps fold the
     # ~246K-name classifier through a running top-k merge + logsumexp
@@ -468,6 +511,45 @@ class Config:
             raise ValueError(
                 "serve_drain_timeout_s must be > 0 (a drain that never "
                 "times out can outlive the SIGTERM grace window).")
+        if self.serve_deadline_ms < 0:
+            raise ValueError(
+                "serve_deadline_ms must be >= 0 (0 = no default "
+                "deadline).")
+        if self.serve_deadline_max_ms < 0:
+            raise ValueError(
+                "serve_deadline_max_ms must be >= 0 (0 = no ceiling).")
+        if (self.serve_deadline_ms > 0 and self.serve_deadline_max_ms > 0
+                and self.serve_deadline_ms > self.serve_deadline_max_ms):
+            raise ValueError(
+                "serve_deadline_ms must not exceed serve_deadline_max_ms "
+                "(the default deadline would be clamped below itself).")
+        if self.serve_queue_depth < 1:
+            raise ValueError(
+                "serve_queue_depth must be >= 1 (the admission gate "
+                "needs room for at least one request).")
+        if self.serve_breaker_window_s <= 0:
+            raise ValueError("serve_breaker_window_s must be > 0.")
+        if not (0 < self.serve_breaker_failure_ratio <= 1):
+            raise ValueError(
+                "serve_breaker_failure_ratio must be in (0, 1].")
+        if self.serve_breaker_min_requests < 1:
+            raise ValueError("serve_breaker_min_requests must be >= 1.")
+        if self.serve_breaker_cooldown_s <= 0:
+            raise ValueError(
+                "serve_breaker_cooldown_s must be > 0 (an open breaker "
+                "must eventually probe for recovery).")
+        if self.serve_replicas < 1:
+            raise ValueError("serve_replicas (--replicas) must be >= 1.")
+        if self.serve_replicas > 1 and not self.serve:
+            raise ValueError(
+                "--replicas applies to the serve subcommand only "
+                "(supervised multi-replica serving).")
+        if self.serve_max_restarts < 0:
+            raise ValueError(
+                "serve_max_restarts must be >= 0 (0 = never restart, "
+                "escalate on first replica death).")
+        if self.serve_heartbeat_interval_s <= 0:
+            raise ValueError("serve_heartbeat_interval_s must be > 0.")
         if self.topk_block_size < 0:
             raise ValueError(
                 "topk_block_size must be >= 0 (0 forces the full-logits "

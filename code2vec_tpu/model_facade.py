@@ -201,6 +201,25 @@ class BucketedPredictMixin:
         checkpoint must never satisfy a stale cache entry."""
         raise NotImplementedError
 
+    def smoke_schema(self) -> dict:
+        """Run one golden prediction line end to end and report the
+        OUTPUT SCHEMA — the hot-swap health gate (serving/swap.py)
+        compares a candidate model's schema against the running one's
+        before the server's model reference is swapped. The line uses
+        deliberately out-of-vocab words: OOV mapping is part of every
+        model's contract, so any loadable model can run it, and a model
+        whose tables are corrupt surfaces as non-finite scores here
+        instead of NaN predictions in production traffic."""
+        line = "swapsmoke hotswap,probe,hotswap check,gate,check"
+        [r] = self.predict([line], batch_size=1, with_code_vectors=True)
+        scores = np.asarray(r.topk_predicted_words_scores, dtype=np.float64)
+        return {
+            "topk": len(r.topk_predicted_words),
+            "code_vector_size": (0 if r.code_vector is None
+                                 else int(np.asarray(r.code_vector).size)),
+            "scores_finite": bool(np.isfinite(scores).all()),
+        }
+
     def predict(self, predict_data_lines: Iterable[str],
                 batch_size: Optional[int] = None,
                 with_code_vectors: Optional[bool] = None

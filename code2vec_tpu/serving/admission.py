@@ -1,0 +1,218 @@
+"""Admission control + end-to-end request deadlines: overload honesty.
+
+The PR-7 server accepted unbounded work: every request queued behind the
+extractor pool and the batcher no matter how deep the backlog, so under
+overload *every* client saw unbounded latency and none saw an honest
+"try later". This module makes overload a first-class, measurable
+outcome instead of an emergent hang:
+
+- **Deadline**: every request carries one, from `--serve_deadline_ms`
+  (client-overridable via the `X-Deadline-Ms` header, clamped by
+  `--serve_deadline_max_ms`). The deadline object travels the whole
+  pipeline: the extractor pool reuses the remaining budget as its
+  per-request timeout, the batcher refuses to coalesce a request whose
+  remaining budget can't cover the bucket's observed p95 device time,
+  and a request that expires mid-pipeline settles as 504 without ever
+  occupying a device slot.
+- **AdmissionController**: a bounded admission gate in front of the
+  cache-miss pipeline. A request is SHED (503 + `Retry-After`) when the
+  pipeline already holds `--serve_queue_depth` requests, or when the
+  estimated queue wait (depth x EWMA request duration / pipeline
+  concurrency) exceeds the request's remaining deadline budget — there
+  is no point admitting work that will certainly 504.
+
+Shed vocabulary (one counter family, pinned in tests and alerted on —
+README "Operating the server"):
+
+    serving_requests_shed_total{reason=queue_full|deadline|breaker|draining}
+
+`Shed` (503, the request was never worked on — retry elsewhere/later)
+is deliberately distinct from `DeadlineExceeded` (504, the request was
+admitted but its budget ran out mid-pipeline, counted in
+`serving_requests_expired_total{stage=...}`).
+
+Fault point `admission_enqueue` (utils/faults.py) fires on the admit
+path so the serving chaos suite can prove an admission-layer fault
+surfaces as an honest error, never a hang or a corrupt response.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Optional
+
+from code2vec_tpu import obs
+from code2vec_tpu.utils.faults import fault_point
+
+_G_DEPTH = obs.gauge(
+    "serving_admission_depth",
+    "requests admitted into the cache-miss pipeline and not yet "
+    "finished (the admission queue bound applies to this)")
+
+
+def _shed_counter(reason: str):
+    return obs.counter(
+        "serving_requests_shed_total",
+        "requests refused with an honest 503 before any pipeline work: "
+        "queue_full (admission depth at the bound), deadline (estimated "
+        "wait or device time exceeds the request's remaining budget), "
+        "breaker (a circuit breaker is open), draining (SIGTERM grace)",
+        reason=reason)
+
+
+def expired_counter(stage: str):
+    return obs.counter(
+        "serving_requests_expired_total",
+        "admitted requests whose deadline ran out mid-pipeline (504); "
+        "stage says how far they got before expiring",
+        stage=stage)
+
+
+class Shed(Exception):
+    """Request refused before any pipeline work — an honest 503. The
+    server maps `reason` onto serving_requests_shed_total and
+    `retry_after_s` onto the Retry-After header."""
+
+    def __init__(self, reason: str, message: str,
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = max(1.0, float(retry_after_s))
+
+    def count(self) -> None:
+        _shed_counter(self.reason).inc()
+
+
+class DeadlineExceeded(Exception):
+    """An ADMITTED request's budget ran out mid-pipeline — a 504. Kept
+    distinct from Shed: a 503 was never worked on, a 504 was."""
+
+
+class DeadlineInfeasible(Shed):
+    """The batcher's fail-fast refusal: the request has budget left but
+    its bucket's observed p95 device time alone exceeds it, so admitting
+    it to a device batch would only burn a slot on a guaranteed 504.
+    A Shed subclass — the request was not worked on."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__("deadline", message, retry_after_s)
+
+
+class Deadline:
+    """Monotonic per-request budget. `budget_s` <= 0 means unbounded
+    (no default configured and no header) — `remaining()` is +inf and
+    `expired()` never fires, so one code path serves both."""
+
+    __slots__ = ("t0", "budget_s")
+
+    def __init__(self, budget_s: float):
+        self.t0 = time.monotonic()
+        self.budget_s = float(budget_s)
+
+    @property
+    def bounded(self) -> bool:
+        return self.budget_s > 0
+
+    def remaining(self) -> float:
+        if not self.bounded:
+            return math.inf
+        return self.budget_s - (time.monotonic() - self.t0)
+
+    def expired(self) -> bool:
+        return self.bounded and self.remaining() <= 0
+
+
+def deadline_from_request(config, header_ms: Optional[str]) -> Deadline:
+    """Resolve one request's deadline: `X-Deadline-Ms` header when
+    present (client knows its own SLO), else `--serve_deadline_ms`,
+    both clamped by `--serve_deadline_max_ms` so a client cannot pin a
+    pipeline slot forever. An unparsable header is treated as absent
+    (the server-side default still applies) rather than rejected — a
+    malformed hint must not turn a servable request into a 400."""
+    budget_ms = float(getattr(config, "serve_deadline_ms", 0.0))
+    if header_ms is not None:
+        try:
+            requested = float(header_ms)
+        except (TypeError, ValueError):
+            requested = None
+        if requested is not None and requested > 0:
+            budget_ms = requested
+    max_ms = float(getattr(config, "serve_deadline_max_ms", 0.0))
+    if max_ms > 0 and budget_ms > 0:
+        budget_ms = min(budget_ms, max_ms)
+    elif max_ms > 0 and budget_ms <= 0:
+        # No default and no header, but a max is configured: the max IS
+        # the budget — "unbounded" requests still cannot outlive it.
+        budget_ms = max_ms
+    return Deadline(budget_ms / 1000.0)
+
+
+class AdmissionController:
+    """Bounded admission gate for the cache-miss pipeline.
+
+    `admit(deadline)` either returns (the caller MUST pair it with
+    `finish(duration_s)` in a finally) or raises `Shed`. The queue-wait
+    estimate is depth x EWMA(total request duration) / `concurrency`
+    (the extractor pool size — the serving bottleneck on the miss
+    path); until the first completion seeds the EWMA only the hard
+    depth bound sheds, so a cold server never refuses its first
+    requests on a bogus estimate.
+    """
+
+    def __init__(self, max_depth: int, concurrency: int = 1,
+                 ewma_alpha: float = 0.2):
+        self.max_depth = max(1, int(max_depth))
+        self.concurrency = max(1, int(concurrency))
+        self._alpha = float(ewma_alpha)
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._ewma_s: Optional[float] = None
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def estimated_wait_s(self) -> Optional[float]:
+        """Expected queue wait for a request admitted NOW; None until
+        the EWMA has a sample."""
+        with self._lock:
+            if self._ewma_s is None:
+                return None
+            return self._depth * self._ewma_s / self.concurrency
+
+    def admit(self, deadline: Optional[Deadline] = None) -> None:
+        fault_point("admission_enqueue")
+        with self._lock:
+            if self._depth >= self.max_depth:
+                wait = (self._ewma_s or 1.0) * self.max_depth \
+                    / self.concurrency
+                raise Shed(
+                    "queue_full",
+                    f"admission queue full ({self._depth}/"
+                    f"{self.max_depth} in flight)",
+                    retry_after_s=wait)
+            if (deadline is not None and deadline.bounded
+                    and self._ewma_s is not None):
+                est = self._depth * self._ewma_s / self.concurrency
+                if est > deadline.remaining():
+                    raise Shed(
+                        "deadline",
+                        f"estimated queue wait {est * 1e3:.0f}ms exceeds "
+                        f"the request's remaining deadline budget "
+                        f"{max(deadline.remaining(), 0) * 1e3:.0f}ms",
+                        retry_after_s=est)
+            self._depth += 1
+            _G_DEPTH.set(self._depth)
+
+    def finish(self, duration_s: float) -> None:
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
+            _G_DEPTH.set(self._depth)
+            if duration_s >= 0:
+                if self._ewma_s is None:
+                    self._ewma_s = float(duration_s)
+                else:
+                    self._ewma_s += self._alpha * (duration_s
+                                                   - self._ewma_s)

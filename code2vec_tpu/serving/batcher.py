@@ -26,16 +26,33 @@ latency the request actually experienced (phases sum to ~total); the
 per-batch cost lives in `serving_device_seconds`, and amortized
 per-row cost is that divided by `serving_batch_rows`. `drain()` stops intake, flushes everything pending, and joins
 the dispatcher — the SIGTERM-grace path.
+
+Deadline propagation (serving/admission.py): `submit()` takes the
+request's Deadline. A request whose remaining budget cannot cover its
+context bucket's observed p95 device time is REFUSED up front
+(`DeadlineInfeasible`, an honest 503 shed — coalescing it would only
+burn a device slot on a guaranteed 504); a request that expires while
+waiting for batch-mates settles as `DeadlineExceeded` (504) and never
+reaches the device; and a request running out of coalescing slack
+(remaining budget approaching its bucket's p95) forces an early
+dispatch instead of waiting out the full delay budget. Per-bucket
+device times come from a small rolling window of dispatched-batch
+durations — no estimate, no refusal (a cold batcher never sheds on a
+bogus p95).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from code2vec_tpu import obs
+from code2vec_tpu.serving.admission import (
+    Deadline, DeadlineExceeded, DeadlineInfeasible, expired_counter,
+)
 
 _H_BATCH_ROWS = obs.histogram(
     "serving_batch_rows",
@@ -78,13 +95,47 @@ def bucket_for(n_contexts: int, buckets: Sequence[int]) -> int:
 
 
 class _Pending:
-    __slots__ = ("lines", "future", "t_submit", "phases")
+    __slots__ = ("lines", "future", "t_submit", "phases", "deadline",
+                 "bucket")
 
-    def __init__(self, lines: List[str], phases: Optional[dict]):
+    def __init__(self, lines: List[str], phases: Optional[dict],
+                 deadline: Optional[Deadline] = None,
+                 bucket: Optional[int] = None):
         self.lines = lines
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.phases = phases
+        self.deadline = deadline
+        self.bucket = bucket
+
+
+class _DeviceTimeTracker:
+    """Rolling per-bucket device-call durations -> p95 estimate. Small
+    fixed windows (32 samples) so the estimate tracks the CURRENT
+    device behavior — a transient slowdown ages out in 32 batches."""
+
+    MIN_SAMPLES = 4
+
+    def __init__(self, window: int = 32):
+        self._window = window
+        self._lock = threading.Lock()
+        self._samples: Dict[Optional[int], deque] = {}
+
+    def record(self, bucket: Optional[int], duration_s: float) -> None:
+        with self._lock:
+            d = self._samples.get(bucket)
+            if d is None:
+                d = self._samples[bucket] = deque(maxlen=self._window)
+            d.append(float(duration_s))
+
+    def p95(self, bucket: Optional[int]) -> Optional[float]:
+        with self._lock:
+            d = self._samples.get(bucket)
+            if d is None or len(d) < self.MIN_SAMPLES:
+                return None
+            ordered = sorted(d)
+            return ordered[min(int(round(0.95 * (len(ordered) - 1))),
+                               len(ordered) - 1)]
 
 
 class DynamicBatcher:
@@ -99,10 +150,16 @@ class DynamicBatcher:
     """
 
     def __init__(self, predict_fn: Callable[[List[str]], List],
-                 max_batch_rows: int = 64, max_delay_s: float = 0.01):
+                 max_batch_rows: int = 64, max_delay_s: float = 0.01,
+                 buckets: Optional[Sequence[int]] = None):
         self.predict_fn = predict_fn
         self.max_batch_rows = max(1, int(max_batch_rows))
         self.max_delay_s = max(0.0, float(max_delay_s))
+        # Context-bucket list (model.context_buckets) for per-bucket
+        # device-time estimates; None = one global estimate (the
+        # standalone/unit-test construction).
+        self.buckets = tuple(buckets) if buckets else None
+        self.device_times = _DeviceTimeTracker()
         self._cond = threading.Condition()
         self._pending: List[_Pending] = []
         self._pending_rows = 0
@@ -115,12 +172,45 @@ class DynamicBatcher:
 
     # -------------------------------------------------------------- API
 
+    def _bucket_of(self, lines: Sequence[str]) -> Optional[int]:
+        """Context bucket this request's rows would pad to (the deepest
+        line decides, exactly as model_facade._predict_chunk buckets a
+        chunk). Extractor lines are space-separated `name ctx ctx ...`
+        padded with trailing blanks, so a whitespace split counts the
+        real contexts."""
+        if self.buckets is None:
+            return None
+        deepest = max((len(line.split()) - 1 for line in lines),
+                      default=1)
+        return bucket_for(max(deepest, 1), self.buckets)
+
     def submit(self, lines: Sequence[str],
-               phases: Optional[dict] = None) -> Future:
-        item = _Pending(list(lines), phases)
+               phases: Optional[dict] = None,
+               deadline: Optional[Deadline] = None) -> Future:
+        item = _Pending(list(lines), phases, deadline)
         if not item.lines:
             item.future.set_result([])
             return item.future
+        if deadline is not None and deadline.bounded:
+            if deadline.expired():
+                expired_counter("batch_wait").inc()
+                item.future.set_exception(DeadlineExceeded(
+                    "request deadline expired before batching"))
+                return item.future
+            item.bucket = self._bucket_of(item.lines)
+            p95 = self.device_times.p95(item.bucket)
+            if p95 is not None and deadline.remaining() < p95:
+                # Fail-fast refusal: even an immediate solo dispatch
+                # cannot finish inside the budget, so coalescing this
+                # request would spend a device slot on a sure 504.
+                item.future.set_exception(DeadlineInfeasible(
+                    f"remaining deadline budget "
+                    f"{deadline.remaining() * 1e3:.0f}ms is below the "
+                    f"bucket's observed p95 device time "
+                    f"{p95 * 1e3:.0f}ms", retry_after_s=p95))
+                return item.future
+        elif self.buckets is not None:
+            item.bucket = self._bucket_of(item.lines)
         with self._cond:
             if self._draining:
                 item.future.set_exception(
@@ -131,6 +221,14 @@ class DynamicBatcher:
             self._pending_rows += len(item.lines)
             self._cond.notify_all()
         return item.future
+
+    def rebucket(self, buckets: Optional[Sequence[int]]) -> None:
+        """Hot-swap support: adopt a new model's context-bucket grid
+        and drop the device-time samples keyed to the old one (a cold
+        tracker refuses nothing until it has real samples; stale p95s
+        on a changed grid would misprice every feasibility check)."""
+        self.buckets = tuple(buckets) if buckets else None
+        self.device_times = _DeviceTimeTracker()
 
     def drain(self, timeout: Optional[float] = None) -> None:
         """Stop intake, flush every pending request, join the thread.
@@ -151,23 +249,53 @@ class DynamicBatcher:
 
     def _collect(self) -> Optional[List[_Pending]]:
         """Block until a batch is due: rows >= cap, oldest item older
-        than max_delay_s, or draining (flush everything)."""
+        than max_delay_s, any pending item out of coalescing slack
+        (its remaining deadline budget is down to its bucket's p95
+        device time), or draining (flush everything). Expired items are
+        settled as 504 here, before they can occupy a device slot."""
         with self._cond:
             while True:
                 if self._pending:
+                    self._expire_locked()
+                    if not self._pending:
+                        continue
                     if (self._draining
                             or self._pending_rows >= self.max_batch_rows):
                         return self._take_locked()
                     age = time.perf_counter() - self._pending[0].t_submit
-                    remaining = self.max_delay_s - age
-                    if remaining <= 0:
+                    wait = self.max_delay_s - age
+                    for item in self._pending:
+                        if item.deadline is None \
+                                or not item.deadline.bounded:
+                            continue
+                        remaining = item.deadline.remaining()
+                        p95 = self.device_times.p95(item.bucket) or 0.0
+                        # slack = budget left after the device call;
+                        # once it's gone, waiting for batch-mates turns
+                        # a servable request into a 504.
+                        wait = min(wait, remaining - p95, remaining)
+                    if wait <= 0:
                         return self._take_locked()
-                    self._cond.wait(timeout=remaining)
+                    self._cond.wait(timeout=wait)
                 elif self._draining:
                     self._closed = True
                     return None
                 else:
                     self._cond.wait()
+
+    def _expire_locked(self) -> None:
+        alive: List[_Pending] = []
+        for item in self._pending:
+            if item.deadline is not None and item.deadline.expired():
+                self._pending_rows -= len(item.lines)
+                expired_counter("batch_wait").inc()
+                if item.future.set_running_or_notify_cancel():
+                    item.future.set_exception(DeadlineExceeded(
+                        "request deadline expired while waiting for "
+                        "batch-mates"))
+            else:
+                alive.append(item)
+        self._pending = alive
 
     def _take_locked(self) -> List[_Pending]:
         take: List[_Pending] = []
@@ -183,6 +311,21 @@ class DynamicBatcher:
 
     def _dispatch(self, batch: List[_Pending]) -> None:
         t_dispatch = time.perf_counter()
+        # Last expiry check before device work: an item that ran out of
+        # budget between collection and dispatch settles as 504 here
+        # rather than burning rows in the device batch.
+        live: List[_Pending] = []
+        for item in batch:
+            if item.deadline is not None and item.deadline.expired():
+                expired_counter("batch_wait").inc()
+                if item.future.set_running_or_notify_cancel():
+                    item.future.set_exception(DeadlineExceeded(
+                        "request deadline expired at dispatch"))
+            else:
+                live.append(item)
+        batch = live
+        if not batch:
+            return
         all_lines: List[str] = []
         for item in batch:
             wait = t_dispatch - item.t_submit
@@ -208,6 +351,11 @@ class DynamicBatcher:
             return
         dur = time.perf_counter() - t_dispatch
         _H_DEVICE.observe(dur)
+        # The deepest bucket in the batch is the shape the device call
+        # compiled/ran at — that is the bucket this duration informs.
+        batch_bucket = max((i.bucket for i in batch
+                            if i.bucket is not None), default=None)
+        self.device_times.record(batch_bucket, dur)
         off = 0
         for item in batch:
             n = len(item.lines)

@@ -1,0 +1,171 @@
+"""Circuit breakers: fail fast when a serving dependency is down.
+
+Without a breaker, a dead extractor pool (or a wedged device) makes
+every request pay the full timeout before failing — the server stays
+"up" while every client waits seconds for a guaranteed error, and the
+retry storm keeps the corpse warm. The breaker converts a failing
+dependency into *immediate* honest 503s, then probes for recovery:
+
+    CLOSED --(failure rate over the rolling window >= threshold,
+              with at least min_requests samples)--> OPEN
+    OPEN   --(cooldown elapsed)--> HALF_OPEN (exactly ONE probe
+              request is let through; everyone else still sheds)
+    HALF_OPEN --probe succeeds--> CLOSED (window reset)
+    HALF_OPEN --probe fails-----> OPEN (cooldown restarts)
+
+Two breakers guard the serving pipeline (serving/server.py): one around
+the extractor pool (an open breaker fails extraction-dependent requests
+fast — cache hits still serve, pinned in the chaos suite) and one
+around the device step. Knobs: `--serve_breaker_window`,
+`--serve_breaker_failure_ratio`, `--serve_breaker_min_requests`,
+`--serve_breaker_cooldown`.
+
+State is exported as `serving_breaker_state{breaker=...}`
+(0=closed, 1=open, 2=half_open) plus
+`serving_breaker_transitions_total{breaker,to}` so a dashboard shows
+both where each breaker is and how often it flaps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Tuple
+
+from code2vec_tpu import obs
+from code2vec_tpu.serving.admission import Shed
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class BreakerOpen(Shed):
+    """Raised on the request path when a breaker refuses the call; a
+    Shed with reason=breaker, so the server's one shed handler maps it
+    to 503 + Retry-After (the remaining cooldown)."""
+
+    def __init__(self, name: str, retry_after_s: float):
+        super().__init__(
+            "breaker",
+            f"{name} circuit breaker is open (dependency failing); "
+            f"failing fast", retry_after_s=retry_after_s)
+        self.breaker = name
+
+
+class CircuitBreaker:
+    """Rolling-failure-rate breaker. Thread-safe; `allow()` before the
+    dependency call, `record(ok)` after (never for calls `allow()`
+    refused — a shed was not a dependency outcome)."""
+
+    def __init__(self, name: str, window_s: float = 10.0,
+                 failure_ratio: float = 0.5, min_requests: int = 4,
+                 cooldown_s: float = 5.0, clock=time.monotonic):
+        self.name = name
+        self.window_s = float(window_s)
+        self.failure_ratio = float(failure_ratio)
+        self.min_requests = max(1, int(min_requests))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: Deque[Tuple[float, bool]] = deque()
+        self._state = CLOSED
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._gauge = obs.gauge(
+            "serving_breaker_state",
+            "circuit-breaker state: 0=closed, 1=open, 2=half_open",
+            breaker=name)
+        self._gauge.set(0)
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._peek_state_locked()
+
+    def _peek_state_locked(self) -> str:
+        # open -> half_open is time-driven; surface it without waiting
+        # for the next allow() so healthz never shows a stale "open".
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            return HALF_OPEN
+        return self._state
+
+    def _transition_locked(self, to: str) -> None:
+        if to == self._state:
+            return
+        self._state = to
+        self._gauge.set(_STATE_CODE[to])
+        obs.counter(
+            "serving_breaker_transitions_total",
+            "circuit-breaker state transitions",
+            breaker=self.name, to=to).inc()
+
+    def retry_after_s(self) -> float:
+        """Seconds until the next probe could be let through."""
+        with self._lock:
+            if self._state != OPEN:
+                return 1.0
+            return max(self.cooldown_s
+                       - (self._clock() - self._opened_at), 1.0)
+
+    # -------------------------------------------------------------- API
+
+    def allow(self) -> bool:
+        """May this call proceed? In half-open, exactly one in-flight
+        probe is allowed; the probe slot is re-armed by record()."""
+        with self._lock:
+            if self._state == OPEN:
+                if self._clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self._transition_locked(HALF_OPEN)
+                self._probe_inflight = False
+            if self._state == HALF_OPEN:
+                if self._probe_inflight:
+                    return False
+                self._probe_inflight = True
+                return True
+            return True
+
+    def check(self) -> None:
+        """allow() or raise BreakerOpen — the request-path form."""
+        if not self.allow():
+            raise BreakerOpen(self.name, self.retry_after_s())
+
+    def abort(self) -> None:
+        """The guarded call ended WITHOUT a dependency verdict — e.g.
+        the request's own deadline expired mid-call, which says nothing
+        about the dependency's health. In half-open this re-arms the
+        probe slot (otherwise one aborted probe would wedge the breaker
+        in half_open forever, shedding every request after the
+        dependency recovered); in any other state it is a no-op."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probe_inflight = False
+
+    def record(self, ok: bool) -> None:
+        now = self._clock()
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probe_inflight = False
+                if ok:
+                    self._events.clear()
+                    self._transition_locked(CLOSED)
+                else:
+                    self._opened_at = now
+                    self._transition_locked(OPEN)
+                return
+            self._events.append((now, ok))
+            cutoff = now - self.window_s
+            while self._events and self._events[0][0] < cutoff:
+                self._events.popleft()
+            if self._state == CLOSED:
+                n = len(self._events)
+                failures = sum(1 for _, e_ok in self._events if not e_ok)
+                if (n >= self.min_requests
+                        and failures / n >= self.failure_ratio):
+                    self._opened_at = now
+                    self._probe_inflight = False
+                    self._transition_locked(OPEN)

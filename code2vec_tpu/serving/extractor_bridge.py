@@ -179,19 +179,24 @@ class PathExtractor:
                 _count_failure(retried=False)
                 raise
 
-    def _extract_paths_inner(self, path: str
+    def _extract_paths_inner(self, path: str,
+                             timeout: Optional[float] = None
                              ) -> Tuple[List[str], Dict[str, str]]:
+        # `timeout` overrides the configured hang timeout for this one
+        # attempt — the serving pool passes the request's remaining
+        # deadline budget when that is the tighter bound.
+        effective = self.timeout if timeout is None else timeout
         command = self._build_command(path)
         process = subprocess.Popen(command, stdout=subprocess.PIPE,
                                    stderr=subprocess.PIPE)
         try:
-            out, err = process.communicate(timeout=self.timeout)
+            out, err = process.communicate(timeout=effective)
         except subprocess.TimeoutExpired:
             process.kill()
             out, err = process.communicate()
             _C_TIMEOUTS.inc()
             raise ExtractionTimeout(
-                f"path extraction of {path} exceeded {self.timeout:g}s "
+                f"path extraction of {path} exceeded {effective:g}s "
                 f"and was killed; partial stderr: "
                 f"{err.decode(errors='replace').strip()!r}")
         output = out.decode().splitlines()

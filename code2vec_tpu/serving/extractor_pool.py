@@ -100,109 +100,132 @@ class _Worker:
         self.proc = subprocess.Popen(
             self.warm_command, stdin=subprocess.PIPE,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
-        ready = self._readline(deadline=time.monotonic() + 30.0)
+        timer = threading.Timer(30.0, self._watchdog_kill)
+        timer.start()
+        try:
+            ready = self._readline()
+        finally:
+            timer.cancel()
         if ready.strip() != "READY":
             self.kill()
             raise ExtractorCrash(
                 f"warm extractor worker failed its READY handshake "
                 f"(got {ready!r})")
 
-    def _readline(self, deadline: Optional[float] = None) -> str:
-        """Blocking readline with the request deadline enforced by a
-        watchdog kill: a wedged child is killed so the read returns EOF
-        instead of hanging the serving thread forever."""
+    def _readline(self) -> str:
+        """Blocking readline; a hung child is handled by the ONE
+        per-request watchdog timer in `_request` (a kill makes this
+        return EOF instead of hanging the serving thread forever)."""
         assert self.proc is not None and self.proc.stdout is not None
-        if deadline is None:
-            raw = self.proc.stdout.readline()
-        else:
-            timer = threading.Timer(max(deadline - time.monotonic(), 0.001),
-                                    self._watchdog_kill)
-            timer.start()
-            try:
-                raw = self.proc.stdout.readline()
-            finally:
-                timer.cancel()
-        return raw.decode(errors="replace")
+        return self.proc.stdout.readline().decode(errors="replace")
 
     def _watchdog_kill(self) -> None:
         self.timed_out = True
         self.kill()
 
-    def _request(self, header: bytes, payload: bytes = b"") -> List[str]:
+    def _request(self, header: bytes, payload: bytes = b"",
+                 timeout_s: Optional[float] = None) -> List[str]:
+        """One framed request/response exchange, guarded by a SINGLE
+        watchdog Timer covering the whole exchange, cancelled on the
+        fast path. (A timer per readline would create a fresh Timer
+        thread per response line — thousands of short-lived threads per
+        second under sustained load; thread-count stability is pinned
+        in tests/test_serving.py.) `timeout_s` overrides the pool-wide
+        timeout when the caller's remaining deadline budget is tighter."""
         assert self.proc is not None and self.proc.stdin is not None
         self.timed_out = False
-        deadline = (time.monotonic() + self.timeout
-                    if self.timeout else None)
+        timeout = self.timeout if timeout_s is None else timeout_s
+        timer = None
+        if timeout is not None:
+            timer = threading.Timer(max(timeout, 0.001),
+                                    self._watchdog_kill)
+            timer.start()
         try:
-            self.proc.stdin.write(header + payload)
-            self.proc.stdin.flush()
-        except (BrokenPipeError, OSError) as e:
-            raise ExtractorCrash(
-                f"warm extractor worker died before the request could be "
-                f"written: {e}") from e
-        status = self._readline(deadline)
-        if self.timed_out:
-            obs.counter(
-                "extractor_timeouts_total",
-                "extractor children killed after config.extractor_timeout_s"
-            ).inc()
-            raise ExtractionTimeout(
-                f"warm extraction exceeded {self.timeout:g}s; worker "
-                f"killed")
-        if not status:
-            rc = self.proc.poll()
-            raise ExtractorCrash(
-                f"warm extractor worker died mid-request "
-                f"(exit code {rc})")
-        if status.startswith("ERR"):
-            raise ValueError(f"extractor rejected the input: "
-                             f"{status[4:].strip() or 'no detail'}")
-        if not status.startswith("OK "):
-            raise ExtractorCrash(
-                f"warm extractor framing violation: {status!r}")
-        n = int(status[3:])
-        lines = []
-        for _ in range(n):
-            line = self._readline(deadline)
-            if self.timed_out or not line:
-                self.kill()
+            try:
+                self.proc.stdin.write(header + payload)
+                self.proc.stdin.flush()
+            except (BrokenPipeError, OSError) as e:
                 raise ExtractorCrash(
-                    "warm extractor worker died mid-response")
-            lines.append(line.rstrip("\n"))
-        return lines
+                    f"warm extractor worker died before the request could "
+                    f"be written: {e}") from e
+            status = self._readline()
+            if self.timed_out:
+                obs.counter(
+                    "extractor_timeouts_total",
+                    "extractor children killed after "
+                    "config.extractor_timeout_s").inc()
+                raise ExtractionTimeout(
+                    f"warm extraction exceeded {timeout:g}s; worker "
+                    f"killed")
+            if not status:
+                rc = self.proc.poll()
+                raise ExtractorCrash(
+                    f"warm extractor worker died mid-request "
+                    f"(exit code {rc})")
+            if status.startswith("ERR"):
+                raise ValueError(f"extractor rejected the input: "
+                                 f"{status[4:].strip() or 'no detail'}")
+            if not status.startswith("OK "):
+                raise ExtractorCrash(
+                    f"warm extractor framing violation: {status!r}")
+            n = int(status[3:])
+            lines = []
+            for _ in range(n):
+                line = self._readline()
+                if self.timed_out:
+                    # mid-response watchdog fire is a TIMEOUT (never
+                    # retried), not a crash — retrying a hang would
+                    # double the stall (bridge policy).
+                    raise ExtractionTimeout(
+                        f"warm extraction exceeded {timeout:g}s "
+                        f"mid-response; worker killed")
+                if not line:
+                    self.kill()
+                    raise ExtractorCrash(
+                        "warm extractor worker died mid-response")
+                lines.append(line.rstrip("\n"))
+            return lines
+        finally:
+            if timer is not None:
+                timer.cancel()
 
     # -------------------------------------------------------------- API
 
     def extract(self, *, path: Optional[str] = None,
-                source: Optional[str] = None, max_contexts: int
+                source: Optional[str] = None, max_contexts: int,
+                timeout_s: Optional[float] = None
                 ) -> Tuple[List[str], Dict[str, str]]:
         if self.cold is not None:
-            return self._extract_cold(path=path, source=source)
+            return self._extract_cold(path=path, source=source,
+                                      timeout_s=timeout_s)
         if path is not None:
-            raw = self._request(f"FILE {os.path.abspath(path)}\n".encode())
+            raw = self._request(f"FILE {os.path.abspath(path)}\n".encode(),
+                                timeout_s=timeout_s)
         else:
             assert source is not None
             payload = source.encode()
             raw = self._request(f"SRC {len(payload)}\n".encode(),
-                                payload + b"\n")
+                                payload + b"\n", timeout_s=timeout_s)
         if not raw:
             raise ValueError("extractor produced no methods "
                              "(empty or unparsable input)")
         return postprocess_extractor_output(raw, max_contexts)
 
     def _extract_cold(self, *, path: Optional[str],
-                      source: Optional[str]
+                      source: Optional[str],
+                      timeout_s: Optional[float] = None
                       ) -> Tuple[List[str], Dict[str, str]]:
         assert self.cold is not None
         # _extract_paths_inner = ONE attempt, no failure counting (that
         # lives in the bridge's retry wrapper, which the pool replaces).
         if path is not None:
-            return self.cold._extract_paths_inner(path)
+            return self.cold._extract_paths_inner(path,
+                                                  timeout=timeout_s)
         fd, tmp = tempfile.mkstemp(suffix=".java", prefix="c2v-serve-")
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(source or "")
-            return self.cold._extract_paths_inner(tmp)
+            return self.cold._extract_paths_inner(tmp, timeout=timeout_s)
         finally:
             try:
                 os.unlink(tmp)
@@ -313,9 +336,23 @@ class ExtractorPool:
                            self.max_path_width, self.timeout,
                            self.jar_path)
 
-    def _acquire(self, phases: Optional[dict]) -> _Worker:
+    def _acquire(self, phases: Optional[dict], deadline=None) -> _Worker:
         t0 = time.perf_counter()
-        if not self._free.acquire(timeout=300.0):
+        budget = 300.0
+        deadline_bound = False
+        if deadline is not None and deadline.bounded:
+            remaining = deadline.remaining()
+            if remaining < budget:
+                budget, deadline_bound = max(remaining, 0.001), True
+        if not self._free.acquire(timeout=budget):
+            if deadline_bound:
+                from code2vec_tpu.serving.admission import (
+                    DeadlineExceeded, expired_counter,
+                )
+                expired_counter("extract").inc()
+                raise DeadlineExceeded(
+                    "request deadline expired waiting for a free "
+                    "extractor worker")
             raise TimeoutError("no extractor worker became free in 300s")
         wait = time.perf_counter() - t0
         _H_WAIT.observe(wait)
@@ -345,28 +382,56 @@ class ExtractorPool:
 
     # -------------------------------------------------------------- API
 
-    def extract_file(self, path: str, phases: Optional[dict] = None
-                     ) -> Tuple[List[str], Dict[str, str]]:
-        return self._extract(phases, path=path)
+    def extract_file(self, path: str, phases: Optional[dict] = None,
+                     deadline=None) -> Tuple[List[str], Dict[str, str]]:
+        return self._extract(phases, path=path, deadline=deadline)
 
-    def extract_source(self, source: str, phases: Optional[dict] = None
-                       ) -> Tuple[List[str], Dict[str, str]]:
-        return self._extract(phases, source=source)
+    def extract_source(self, source: str, phases: Optional[dict] = None,
+                       deadline=None) -> Tuple[List[str], Dict[str, str]]:
+        return self._extract(phases, source=source, deadline=deadline)
+
+    def _effective_timeout(self, deadline) -> Tuple[Optional[float], bool]:
+        """min(pool timeout, remaining deadline budget) and whether the
+        DEADLINE is the binding constraint (a fire then surfaces as
+        DeadlineExceeded/504, not ExtractionTimeout/422)."""
+        if deadline is None or not deadline.bounded:
+            return None, False  # None -> worker uses the pool timeout
+        remaining = deadline.remaining()
+        if self.timeout is None or remaining < self.timeout:
+            return max(remaining, 0.001), True
+        return None, False
 
     def _extract(self, phases: Optional[dict], *,
-                 path: Optional[str] = None, source: Optional[str] = None
-                 ) -> Tuple[List[str], Dict[str, str]]:
+                 path: Optional[str] = None, source: Optional[str] = None,
+                 deadline=None) -> Tuple[List[str], Dict[str, str]]:
+        from code2vec_tpu.serving.admission import (
+            DeadlineExceeded, expired_counter,
+        )
         _C_REQS.inc()
         max_contexts = self.config.max_contexts
         for attempt in range(self.retries + 1):
-            worker = self._acquire(phases)
+            if deadline is not None and deadline.expired():
+                expired_counter("extract").inc()
+                raise DeadlineExceeded(
+                    "request deadline expired before extraction")
+            worker = self._acquire(phases, deadline=deadline)
+            timeout_s, deadline_bound = self._effective_timeout(deadline)
             t0 = time.perf_counter()
             try:
                 result = worker.extract(path=path, source=source,
-                                        max_contexts=max_contexts)
+                                        max_contexts=max_contexts,
+                                        timeout_s=timeout_s)
             except ExtractionTimeout:
-                # bridge policy: a hung worker is killed, never retried
+                # bridge policy: a hung worker is killed, never retried.
+                # When the binding constraint was the request's own
+                # deadline budget (not the pool-wide hang timeout), the
+                # honest status is 504, not an extraction failure.
                 worker.kill()
+                if deadline_bound:
+                    expired_counter("extract").inc()
+                    raise DeadlineExceeded(
+                        "request deadline expired during extraction "
+                        "(worker killed)")
                 raise
             except FileNotFoundError:
                 raise  # no extractor installed at all — not transient

@@ -2,9 +2,11 @@
 
 Request path:
 
-    POST /predict  --cache miss-->  extractor pool (warm --server
-    workers) --> dynamic batcher (coalesce + context-bucketed padded
-    shapes) --> jitted predict step --> JSON response --> LRU cache
+    POST /predict  --cache miss-->  admission gate (bounded queue +
+    deadline budget check) --> extractor pool (warm --server workers,
+    circuit-broken, deadline as timeout) --> dynamic batcher (coalesce +
+    context-bucketed padded shapes, deadline-aware) --> jitted predict
+    step (circuit-broken) --> JSON response --> LRU cache
 
 Endpoints (JSON unless noted; schema in README "Serving"):
 
@@ -13,20 +15,45 @@ Endpoints (JSON unless noted; schema in README "Serving"):
   when the model was created with --export_code_vectors).
 - `POST /embed`    same input; code vectors only (forces them on
   regardless of --export_code_vectors — the embedding IS the product).
-- `GET  /healthz`  liveness + pool/batcher/cache gauges; `"status":
-  "serving"` flips to `"draining"` during SIGTERM grace.
+- `POST /admin/reload`  `{"artifact": DIR}` — health-gated live model
+  hot-swap (serving/swap.py): loads + validates off the request path,
+  then swaps the model reference between batches. 202 accepted; poll
+  `/healthz` `model.swap_status`. SIGHUP re-reads `--artifact`.
+- `GET  /healthz`  liveness + pool/batcher/cache/breaker/admission
+  gauges; `"status": "serving"` flips to `"draining"` — and the HTTP
+  status to 503, the load-balancer eviction contract — during SIGTERM
+  grace.
 - `GET  /metrics`  Prometheus text format — the same registry/plumbing
   as the trainer's --metrics_port (obs/exporters.py).
 
+Resilience semantics (serving/admission.py, serving/breaker.py; README
+"Operating the server"):
+
+- every request carries a DEADLINE (`--serve_deadline_ms`, client
+  `X-Deadline-Ms` header, clamped by `--serve_deadline_max_ms`),
+  propagated through the whole pipeline; expiry mid-pipeline is an
+  honest 504 that never occupies a device slot;
+- overload SHEDS with 503 + Retry-After instead of queueing unboundedly
+  (`serving_requests_shed_total{reason=queue_full|deadline|breaker|
+  draining}`);
+- circuit breakers around the extractor pool and the device step fail
+  fast when a dependency is down — cache hits still serve while the
+  extractor breaker is open (graceful degradation);
+- every response carries the `model_fingerprint` of the exact weights
+  that produced it (hot-swap attribution).
+
 Every request is timed into per-phase SLO histograms
-(`serving_request_seconds{phase=queue_wait|extract|batch_wait|device|
-total}`) through the PR-2 MetricsRegistry, so p50/p99 per phase come
-free from any Prometheus scrape.
+(`serving_request_seconds{phase=queue_wait|extract|batch_wait|device}`),
+and the `total` phase carries a `status` label and is recorded for
+EVERY terminal status — errored and shed requests are part of the tail,
+not invisible.
 
 Shutdown mirrors the trainer's preemption-grace pattern
 (training/loop.py PreemptionWatcher): SIGTERM stops intake, in-flight
 requests finish (bounded by config.serve_drain_timeout_s), the batcher
-flushes, the extractor pool is torn down, and the process exits 0.
+flushes, the extractor pool is torn down, and the process exits 0 — or
+1 with the abandoned-request count in the final heartbeat when the
+drain timed out.
 """
 
 from __future__ import annotations
@@ -35,30 +62,54 @@ import http.server
 import json
 import os
 import signal
+import socket
 import socketserver
 import threading
 import time
-from typing import Dict, Optional
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Dict, Optional, Tuple
 
 from code2vec_tpu import obs
+from code2vec_tpu.serving.admission import (
+    AdmissionController, Deadline, DeadlineExceeded, Shed,
+    deadline_from_request, expired_counter,
+)
 from code2vec_tpu.serving.batcher import DynamicBatcher
+from code2vec_tpu.serving.breaker import CircuitBreaker
 from code2vec_tpu.serving.cache import PredictionCache, cache_key
-from code2vec_tpu.serving.extractor_bridge import ExtractorCrash
+from code2vec_tpu.serving.extractor_bridge import (
+    ExtractionTimeout, ExtractorCrash,
+)
 from code2vec_tpu.serving.extractor_pool import ExtractorPool
 from code2vec_tpu.serving.interactive import parse_prediction_results
+from code2vec_tpu.serving.swap import SwapError, SwapManager
+from code2vec_tpu.utils.faults import FaultInjected
 
-_PHASES = ("queue_wait", "extract", "batch_wait", "device", "total")
+_PIPELINE_PHASES = ("queue_wait", "extract", "batch_wait", "device")
+
+# Env hook (set by the serving supervisor): bind the listen socket with
+# SO_REUSEPORT so N replica processes share one port and the kernel
+# load-balances accepts across them.
+REUSEPORT_ENV = "C2V_SERVE_REUSEPORT"
+
+_PHASE_HELP = (
+    "per-request serving latency by phase: queue_wait (extractor "
+    "slot), extract (path extraction), batch_wait (coalescing), "
+    "device (model call), total (end to end; carries a `status` label "
+    "and is recorded for EVERY terminal status, shed/errored included)")
 
 
 def _phase_hist(phase: str):
-    return obs.histogram(
-        "serving_request_seconds",
-        "per-request serving latency by phase: queue_wait (extractor "
-        "slot), extract (path extraction), batch_wait (coalescing), "
-        "device (model call), total (end to end)", phase=phase)
+    return obs.histogram("serving_request_seconds", _PHASE_HELP,
+                         phase=phase)
 
 
-_H_PHASE = {p: _phase_hist(p) for p in _PHASES}
+_H_PHASE = {p: _phase_hist(p) for p in _PIPELINE_PHASES}
+
+
+def _total_hist(status: str):
+    return obs.histogram("serving_request_seconds", _PHASE_HELP,
+                         phase="total", status=status)
 
 
 def _requests_counter(endpoint: str, status: str):
@@ -74,17 +125,24 @@ class _HTTPError(Exception):
 
 
 class PredictionServer:
-    """Owns the pool + batcher + cache around one Code2VecModel.
+    """Owns the pool + batcher + cache + admission gate + breakers +
+    swap manager around one (swappable) model.
 
-    Separable from HTTP: `handle(endpoint, code)` returns the response
-    bytes, so tests and the bench can drive the full path in-process,
-    and the HTTP layer stays a thin framing shim.
+    Separable from HTTP: `handle_request(endpoint, code, ...)` returns
+    `(status, body, headers)`, so tests and the bench can drive the
+    full path — including shedding and deadline accounting — in
+    process, and the HTTP layer stays a thin framing shim.
     """
 
     def __init__(self, model, config=None, log=None):
-        self.model = model
         self.config = config or model.config
         self.log = log or self.config.log
+        # The model reference is (model, fingerprint), swapped
+        # atomically by swap_model(): the batcher reads it ONCE per
+        # dispatched batch, so a response can never mix weights.
+        self._model_lock = threading.Lock()
+        self._model_ref: Tuple[object, str] = (model,
+                                               model.model_fingerprint())
         self.pool = ExtractorPool(
             self.config, size=self.config.extractor_pool_size, log=self.log)
         # with_code_vectors=True: /predict and /embed rows coalesce into
@@ -92,72 +150,239 @@ class PredictionServer:
         # the step computes vectors anyway, the flag only materializes
         # them host-side, and _render decides per endpoint what ships.
         self.batcher = DynamicBatcher(
-            lambda lines: model.predict(
-                lines, batch_size=self.config.serve_batch_size,
-                with_code_vectors=True),
+            self._batched_predict,
             max_batch_rows=self.config.serve_batch_size,
-            max_delay_s=self.config.serve_max_delay_ms / 1000.0)
+            max_delay_s=self.config.serve_max_delay_ms / 1000.0,
+            buckets=model.context_buckets)
         self.cache = PredictionCache(self.config.serve_cache_entries)
         self.topk = self.config.top_k_words_considered_during_prediction
-        # Model-identity token mixed into every cache key: a hot-swapped
-        # checkpoint or re-exported artifact must never serve a stale
-        # cached prediction (the key hashes source + knobs only
-        # otherwise). Surfaced in /healthz so a deploy can assert which
-        # weights a replica answers with.
-        self.model_fingerprint = model.model_fingerprint()
+        self.admission = AdmissionController(
+            max_depth=self.config.serve_queue_depth,
+            concurrency=self.config.extractor_pool_size)
+        breaker_kw = dict(
+            window_s=self.config.serve_breaker_window_s,
+            failure_ratio=self.config.serve_breaker_failure_ratio,
+            min_requests=self.config.serve_breaker_min_requests,
+            cooldown_s=self.config.serve_breaker_cooldown_s)
+        self.extractor_breaker = CircuitBreaker("extractor", **breaker_kw)
+        self.device_breaker = CircuitBreaker("device", **breaker_kw)
+        self.swap = SwapManager(self)
         self._httpd: Optional[socketserver.BaseServer] = None
         self._inflight = 0
         self._inflight_cond = threading.Condition()
         self._draining = False
         self._drained = threading.Event()
+        self.abandoned_requests = 0
         self.started_at = time.time()
         self.port: Optional[int] = None
 
+    # ------------------------------------------------------------ model
+
+    @property
+    def model(self):
+        return self._model_ref[0]
+
+    @property
+    def model_fingerprint(self) -> str:
+        """Fingerprint of the weights currently serving — mixed into
+        every cache key and stamped on every response. Swappable."""
+        return self._model_ref[1]
+
+    def swap_model(self, new_model) -> str:
+        """Atomically replace the serving model (called by the
+        SwapManager AFTER validation). In-flight batches finish on the
+        model reference they already read; the next dispatched batch —
+        and the next cache key — uses the new one."""
+        fp = new_model.model_fingerprint()
+        with self._model_lock:
+            self._model_ref = (new_model, fp)
+            # the deadline-feasibility math must run against the NEW
+            # model's bucket grid (and fresh device-time samples — p95s
+            # keyed to the old grid would misprice every refusal)
+            self.batcher.rebucket(new_model.context_buckets)
+        return fp
+
+    def _batched_predict(self, lines):
+        """The batcher's predict_fn: ONE model-reference read per batch
+        (swap atomicity), device circuit breaker around the call, and
+        the computing model's fingerprint attached to every result so
+        responses are attributable to exactly one set of weights."""
+        self.device_breaker.check()
+        model, fp = self._model_ref
+        try:
+            results = model.predict(
+                lines, batch_size=self.config.serve_batch_size,
+                with_code_vectors=True)
+        except BaseException:
+            self.device_breaker.record(ok=False)
+            raise
+        self.device_breaker.record(ok=True)
+        return [(r, fp) for r in results]
+
     # ---------------------------------------------------------- predict
 
-    def handle(self, endpoint: str, code: str) -> bytes:
-        """Full serve path for one request; returns the response BYTES
-        (cached verbatim, so a hit is byte-equal to the miss that
-        populated it)."""
-        if not code.strip():
-            raise _HTTPError(400, "empty request body")
+    def handle_request(self, endpoint: str, code: str,
+                       deadline: Optional[Deadline] = None
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Full serve path for one request -> (http_status, body,
+        extra_headers). EVERY terminal status lands in
+        serving_request_seconds{phase=total,status=...} and
+        serving_requests_total — overload and errors are measured, not
+        invisible."""
         t0 = time.perf_counter()
         phases: Dict[str, float] = {}
+        status, body, headers = 500, b"", {}
+        try:
+            body = self._handle(endpoint, code, deadline, phases)
+            status = 200
+        except Shed as e:
+            e.count()
+            status = 503
+            headers["Retry-After"] = str(max(1, int(round(
+                e.retry_after_s))))
+            body = json.dumps({"error": str(e), "shed": e.reason}
+                              ).encode() + b"\n"
+        except DeadlineExceeded as e:
+            status = 504
+            body = json.dumps({"error": f"deadline exceeded: {e}"}
+                              ).encode() + b"\n"
+        except _HTTPError as e:
+            status = e.code
+            body = json.dumps({"error": str(e)}).encode() + b"\n"
+        except FaultInjected as e:
+            # chaos drills must surface as honest errors, never hangs
+            status = 500
+            body = json.dumps({"error": f"FaultInjected: {e}"}
+                              ).encode() + b"\n"
+        except Exception as e:  # noqa: BLE001 — 500, not a torn socket
+            status = 500
+            body = json.dumps({"error": f"{type(e).__name__}: {e}"}
+                              ).encode() + b"\n"
+        finally:
+            total = time.perf_counter() - t0
+            # snapshot: the batcher dispatcher can still write phase
+            # keys for a request that exited early via the result
+            # backstop — iterating the live dict could raise mid-walk
+            for phase, dur in list(phases.items()):
+                _H_PHASE[phase].observe(dur)
+            _total_hist(str(status)).observe(total)
+            _requests_counter(endpoint, str(status)).inc()
+        return status, body, headers
+
+    def _handle(self, endpoint: str, code: str,
+                deadline: Optional[Deadline],
+                phases: Dict[str, float]) -> bytes:
+        if not code.strip():
+            raise _HTTPError(400, "empty request body")
+        model, fp = self._model_ref
         key = cache_key(code, endpoint=endpoint, topk=self.topk,
-                        model=self.model_fingerprint)
+                        model=fp)
         cached = self.cache.get(key)
         if cached is not None:
-            _H_PHASE["total"].observe(time.perf_counter() - t0)
+            # Cache hits serve BEFORE admission and breakers: graceful
+            # degradation — a dead extractor pool cannot take the hit
+            # path down with it (pinned in tests/test_serving_chaos.py).
             return cached  # type: ignore[return-value]
+        self.admission.admit(deadline)
+        t_admit = time.perf_counter()
+        worked = True
         try:
-            lines, hash_to_string = self.pool.extract_source(
-                code, phases=phases)
+            lines, hash_to_string = self._extract(code, deadline, phases)
+            future = self.batcher.submit(lines, phases=phases,
+                                         deadline=deadline)
+            try:
+                if deadline is not None and deadline.bounded:
+                    # Backstop: the batcher settles expired futures
+                    # itself; this bounds a wedged device call so the
+                    # CLIENT still gets its 504 near the deadline.
+                    raw = future.result(
+                        timeout=max(deadline.remaining(), 0) + 5.0)
+                else:
+                    raw = future.result()
+            except _FutureTimeout:
+                expired_counter("device").inc()
+                raise DeadlineExceeded(
+                    "request expired waiting on the device step")
+            except RuntimeError as e:
+                if "draining" in str(e):
+                    raise Shed("draining", str(e))
+                raise
+            results = [r for r, _ in raw]
+            result_fp = raw[0][1] if raw else fp
+            body = json.dumps(
+                self._render(endpoint, results, hash_to_string,
+                             result_fp), sort_keys=True).encode() + b"\n"
+            if result_fp != fp:
+                # the model was hot-swapped between our cache probe and
+                # the device batch: key the entry by the weights that
+                # actually computed it, never the stale fingerprint
+                key = cache_key(code, endpoint=endpoint, topk=self.topk,
+                                model=result_fp)
+            self.cache.put(key, body)
+            return body
+        except Shed:
+            # a post-admission shed (batcher DeadlineInfeasible, an
+            # open breaker, draining) refused the request instead of
+            # working it: feeding its ~0ms turnaround into the
+            # queue-wait EWMA would make the admission estimate wildly
+            # optimistic under overload
+            worked = False
+            raise
+        finally:
+            self.admission.finish(
+                (time.perf_counter() - t_admit) if worked else -1.0)
+
+    def _extract(self, code: str, deadline: Optional[Deadline],
+                 phases: Dict[str, float]):
+        """Extractor-pool call behind its circuit breaker, with the
+        request's remaining deadline budget as the per-request
+        timeout."""
+        self.extractor_breaker.check()
+        try:
+            result = self.pool.extract_source(code, phases=phases,
+                                              deadline=deadline)
+        except DeadlineExceeded:
+            # the request's budget, not the extractor's health: no
+            # verdict recorded — but a half-open probe slot must be
+            # re-armed or the breaker wedges in half_open forever
+            self.extractor_breaker.abort()
+            raise
         except FileNotFoundError as e:
+            self.extractor_breaker.record(ok=False)
             raise _HTTPError(503, f"no extractor available: {e}")
         except (ExtractorCrash, OSError) as e:
             # infra failure (workers dying through every retry), NOT the
             # client's source: 503 tells a well-behaved client to retry.
             # Must precede the ValueError arm — ExtractorCrash subclasses
             # it so the REPL's catch-all keeps working.
+            self.extractor_breaker.record(ok=False)
             raise _HTTPError(503, f"extractor unavailable: {e}")
-        except ValueError as e:  # parse rejection / timeout: input-driven
+        except ExtractionTimeout as e:
+            # a hang is an infra failure for breaker purposes, but the
+            # client's source MIGHT be the pathological input: 422
+            self.extractor_breaker.record(ok=False)
             raise _HTTPError(422, f"extraction failed: {e}")
-        try:
-            raw = self.batcher.submit(lines, phases=phases).result()
-        except RuntimeError as e:  # draining
-            raise _HTTPError(503, str(e))
-        body = json.dumps(
-            self._render(endpoint, raw, hash_to_string),
-            sort_keys=True).encode() + b"\n"
-        self.cache.put(key, body)
-        phases["total"] = time.perf_counter() - t0
-        for phase, dur in phases.items():
-            _H_PHASE[phase].observe(dur)
-        return body
+        except ValueError as e:
+            # deterministic parse rejection: the extractor is HEALTHY
+            # (it answered); a storm of bad client input must not open
+            # the breaker and shed good clients.
+            self.extractor_breaker.record(ok=True)
+            raise _HTTPError(422, f"extraction failed: {e}")
+        except Exception:
+            # anything else (pool closed mid-drain, acquire timeout
+            # with an unbounded deadline) carries no dependency
+            # verdict — but a half-open probe slot must still re-arm
+            # or the breaker wedges shedding forever
+            self.extractor_breaker.abort()
+            raise
+        self.extractor_breaker.record(ok=True)
+        return result
 
-    def _render(self, endpoint: str, raw, hash_to_string) -> dict:
+    def _render(self, endpoint: str, raw, hash_to_string,
+                fingerprint: str) -> dict:
         if endpoint == "embed":
             return {"model": "code2vec_tpu",
+                    "model_fingerprint": fingerprint,
                     "vectors": [
                         ([] if r.code_vector is None
                          else [float(v) for v in r.code_vector])
@@ -180,7 +405,14 @@ class PredictionServer:
                     and r.code_vector is not None):
                 entry["code_vector"] = [float(v) for v in r.code_vector]
             methods.append(entry)
-        return {"model": "code2vec_tpu", "methods": methods}
+        return {"model": "code2vec_tpu",
+                "model_fingerprint": fingerprint, "methods": methods}
+
+    def handle(self, endpoint: str, code: str,
+               deadline: Optional[Deadline] = None) -> bytes:
+        """Body-or-raise convenience used by in-process callers; HTTP
+        goes through handle_request (which owns the SLO accounting)."""
+        return self._handle(endpoint, code, deadline, {})
 
     def handle_embed(self, code: str) -> bytes:
         return self.handle("embed", code)
@@ -188,10 +420,16 @@ class PredictionServer:
     # ------------------------------------------------------------- http
 
     def healthz(self) -> dict:
+        model = self.model
         return {
             "status": "draining" if self._draining else "serving",
             "uptime_s": time.time() - self.started_at,
             "pid": os.getpid(),
+            "model": {
+                "fingerprint": self.model_fingerprint,
+                "swap_status": self.swap.status(),
+            },
+            # kept at top level too: deploy tooling from PR 8 reads it
             "model_fingerprint": self.model_fingerprint,
             "extractor_pool": {"size": self.pool.size,
                                "warm": self.pool.warm},
@@ -202,7 +440,20 @@ class PredictionServer:
                             self.batcher.batches_dispatched},
             "cache": {"capacity": self.cache.capacity,
                       "entries": len(self.cache)},
-            "buckets": list(self.model.context_buckets),
+            "admission": {
+                "depth": self.admission.depth,
+                "max_depth": self.admission.max_depth,
+                "estimated_wait_ms": (
+                    None if (w := self.admission.estimated_wait_s())
+                    is None else w * 1000.0),
+            },
+            "deadlines": {
+                "default_ms": self.config.serve_deadline_ms,
+                "max_ms": self.config.serve_deadline_max_ms,
+            },
+            "breakers": {"extractor": self.extractor_breaker.state,
+                         "device": self.device_breaker.state},
+            "buckets": list(model.context_buckets),
             # compiled shapes AT THE SERVE BATCH SIZE — the serving
             # compilation budget, bounded by len(buckets). (An offline
             # predict through the same facade at another batch size
@@ -212,17 +463,19 @@ class PredictionServer:
             # concurrently, and a generator over the live dict could
             # raise mid-iteration.
             "compiled_predict_steps": sum(
-                1 for rows, _ in list(self.model._predict_steps)
+                1 for rows, _ in list(model._predict_steps)
                 if rows == self.config.serve_batch_size),
             "compiled_predict_steps_all": (
-                self.model.predict_compile_count()),
+                model.predict_compile_count()),
             "inflight": self._inflight,
         }
 
     def start(self, port: Optional[int] = None,
               host: Optional[str] = None) -> int:
         """Bind + serve on a daemon thread; returns the bound port
-        (port 0 picks a free one)."""
+        (port 0 picks a free one). With C2V_SERVE_REUSEPORT=1 in the
+        environment (set by the serving supervisor) the socket binds
+        with SO_REUSEPORT so replica processes share the port."""
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -232,24 +485,36 @@ class PredictionServer:
                 pass
 
             def _respond(self, code: int, body: bytes,
-                         ctype: str = "application/json") -> None:
+                         ctype: str = "application/json",
+                         extra_headers: Optional[Dict[str, str]] = None
+                         ) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _error(self, code: int, message: str) -> None:
+            def _error(self, code: int, message: str,
+                       extra_headers: Optional[Dict[str, str]] = None
+                       ) -> None:
                 self._respond(code, json.dumps(
-                    {"error": message}).encode() + b"\n")
+                    {"error": message}).encode() + b"\n",
+                    extra_headers=extra_headers)
 
             def do_GET(self):  # noqa: N802 (stdlib API name)
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/healthz":
-                        self._respond(200, json.dumps(
-                            server.healthz(),
-                            sort_keys=True).encode() + b"\n")
+                        hz = server.healthz()
+                        # the load-balancer eviction contract: a
+                        # draining replica is NOT ready — probes must
+                        # see 503 the moment SIGTERM lands, body still
+                        # carrying the full introspection payload
+                        code = 503 if hz["status"] == "draining" else 200
+                        self._respond(code, json.dumps(
+                            hz, sort_keys=True).encode() + b"\n")
                     elif path in ("/metrics", "/"):
                         self._respond(
                             200, obs.default_registry()
@@ -266,31 +531,81 @@ class PredictionServer:
             def do_POST(self):  # noqa: N802 (stdlib API name)
                 path = self.path.split("?", 1)[0]
                 endpoint = path.lstrip("/")
+                if path == "/admin/reload":
+                    self._admin_reload()
+                    return
                 if endpoint not in ("predict", "embed"):
                     self._error(404, f"no such endpoint: {path}")
                     return
+                deadline = deadline_from_request(
+                    server.config, self.headers.get("X-Deadline-Ms"))
                 if not server._enter_request():
+                    Shed("draining", "").count()
                     _requests_counter(endpoint, "draining").inc()
-                    self._error(503, "server is draining")
+                    self._error(503, "server is draining",
+                                extra_headers={"Retry-After": "1"})
                     return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(length)
-                    code = server._decode_body(raw, self.headers)
-                    body = server.handle(endpoint, code)
-                except _HTTPError as e:
-                    _requests_counter(endpoint, str(e.code)).inc()
-                    self._error(e.code, str(e))
-                except Exception as e:  # noqa: BLE001 — 500, not a hang
-                    _requests_counter(endpoint, "500").inc()
-                    self._error(500, f"{type(e).__name__}: {e}")
-                else:
-                    _requests_counter(endpoint, "200").inc()
-                    self._respond(200, body)
+                    try:
+                        length = int(self.headers.get(
+                            "Content-Length", 0))
+                        raw = self.rfile.read(length)
+                        code_text = server._decode_body(raw, self.headers)
+                    except _HTTPError as e:
+                        _requests_counter(endpoint, str(e.code)).inc()
+                        self._error(e.code, str(e))
+                        return
+                    status, body, headers = server.handle_request(
+                        endpoint, code_text, deadline)
+                    self._respond(status, body, extra_headers=headers)
                 finally:
                     server._exit_request()
 
-        httpd = http.server.ThreadingHTTPServer(
+            def _admin_reload(self) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(
+                        self.rfile.read(length).decode("utf-8",
+                                                       errors="replace")
+                        or "{}")
+                    if not isinstance(payload, dict):
+                        raise _HTTPError(
+                            400, 'body must be {"artifact": DIR}')
+                    target = payload.get("artifact")
+                    status = server.swap.request_reload(target)
+                except json.JSONDecodeError as e:
+                    self._error(400, f"bad JSON body: {e}")
+                except SwapError as e:
+                    code = 409 if "in flight" in str(e) else 400
+                    self._error(code, str(e))
+                except _HTTPError as e:
+                    self._error(e.code, str(e))
+                except Exception as e:  # noqa: BLE001
+                    self._error(500, f"{type(e).__name__}: {e}")
+                else:
+                    self._respond(202, json.dumps(
+                        {"accepted": True, "swap_status": status},
+                        sort_keys=True).encode() + b"\n")
+
+        reuseport = os.environ.get(REUSEPORT_ENV) == "1"
+
+        class _Listener(http.server.ThreadingHTTPServer):
+            # the stdlib default accept backlog (5) refuses connections
+            # at the KERNEL under a burst — overload must reach the
+            # admission gate so it can shed honestly with a 503
+            request_queue_size = 128
+
+            def server_bind(self):
+                if reuseport:
+                    try:
+                        self.socket.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                    except (AttributeError, OSError) as e:
+                        server.log(f"SO_REUSEPORT unavailable ({e}); "
+                                   f"plain bind")
+                http.server.ThreadingHTTPServer.server_bind(self)
+
+        httpd = _Listener(
             (host if host is not None else self.config.serve_host,
              port if port is not None else self.config.serve_port),
             Handler)
@@ -301,8 +616,9 @@ class PredictionServer:
                          name="serving-http", daemon=True).start()
         self.log(f"Prediction server listening on "
                  f"http://{httpd.server_address[0]}:{self.port} "
-                 f"(POST /predict, POST /embed, GET /healthz, "
-                 f"GET /metrics)")
+                 f"(POST /predict, POST /embed, POST /admin/reload, "
+                 f"GET /healthz, GET /metrics"
+                 f"{', SO_REUSEPORT' if reuseport else ''})")
         return self.port
 
     @staticmethod
@@ -337,7 +653,8 @@ class PredictionServer:
         """Graceful stop: refuse new requests, wait for in-flight ones
         (bounded), flush the batcher, tear down pool + listener.
         Idempotent; returns True when everything in flight finished
-        inside the budget."""
+        inside the budget. On timeout, `abandoned_requests` records how
+        many were left behind (surfaced in the final heartbeat)."""
         with self._inflight_cond:
             if self._draining:
                 self._drained.wait(timeout)
@@ -354,8 +671,9 @@ class PredictionServer:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     clean = False
+                    self.abandoned_requests = self._inflight
                     self.log(f"Drain timeout: {self._inflight} "
-                             f"request(s) still in flight")
+                             f"request(s) still in flight (abandoned)")
                     break
                 self._inflight_cond.wait(timeout=remaining)
         self.batcher.drain(timeout=max(deadline - time.monotonic(), 1.0))
@@ -371,38 +689,110 @@ class PredictionServer:
         return clean
 
 
-def serve_main(config, model=None) -> int:
+def _heartbeat_fields(server: PredictionServer) -> dict:
+    reg = obs.default_registry().collect()
+
+    def total(name):
+        fam = reg.get(name, {})
+        return int(sum(child.value for child in fam.values()))
+
+    return {
+        "port": server.port,
+        "inflight": server._inflight,
+        "model_fingerprint": server.model_fingerprint,
+        "swap_state": server.swap.status()["state"],
+        "breakers": {"extractor": server.extractor_breaker.state,
+                     "device": server.device_breaker.state},
+        "requests_total": total("serving_requests_total"),
+        "requests_shed_total": total("serving_requests_shed_total"),
+        "requests_expired_total": total("serving_requests_expired_total"),
+    }
+
+
+def serve_main(config, model=None, *, stop: Optional[threading.Event]
+               = None, install_signals: Optional[bool] = None) -> int:
     """The `serve` CLI subcommand body: build the model, start the
-    server, park the main thread until SIGTERM/SIGINT, drain, exit.
-    Returns the process exit code."""
+    server, park until SIGTERM/SIGINT (or the injected `stop` event —
+    the testable form), drain, exit. Returns the process exit code.
+
+    While parked, a heartbeat ticker rewrites --heartbeat_file every
+    config.serve_heartbeat_interval_s (the supervisor's staleness
+    signal — a replica whose heartbeat stops is HUNG and gets
+    restarted; fault point `replica_heartbeat` in utils/faults.py
+    simulates exactly that). SIGHUP triggers a live hot-swap re-reading
+    --artifact."""
+    from code2vec_tpu.utils.faults import fault_point
+
     if model is None:
         from code2vec_tpu.model_facade import Code2VecModel
         model = Code2VecModel(config)
     server = PredictionServer(model, config)
-    stop = threading.Event()
+    if stop is None:
+        stop = threading.Event()
+    if install_signals is None:
+        install_signals = (threading.current_thread()
+                           is threading.main_thread())
 
     def _on_signal(signum, frame):
         config.log(f"Signal {signal.Signals(signum).name} received: "
                    f"draining")
         stop.set()
 
-    prev_term = signal.signal(signal.SIGTERM, _on_signal)
-    prev_int = signal.signal(signal.SIGINT, _on_signal)
+    def _on_hup(signum, frame):
+        if config.serve_artifact:
+            config.log("SIGHUP: reloading --artifact "
+                       f"{config.serve_artifact}")
+            try:
+                server.swap.request_reload(config.serve_artifact)
+            except SwapError as e:
+                config.log(f"SIGHUP reload rejected: {e}")
+        else:
+            config.log("SIGHUP ignored: no --artifact to reload "
+                       "(use POST /admin/reload)")
+
+    prev_term = prev_int = prev_hup = None
+    if install_signals:
+        prev_term = signal.signal(signal.SIGTERM, _on_signal)
+        prev_int = signal.signal(signal.SIGINT, _on_signal)
+        if hasattr(signal, "SIGHUP"):
+            prev_hup = signal.signal(signal.SIGHUP, _on_hup)
     server.start()
+
+    hb_stop = threading.Event()
+
+    def _heartbeat_loop():
+        while not hb_stop.wait(config.serve_heartbeat_interval_s):
+            # An armed fault here kills the ticker (raise) or the whole
+            # replica (exit) — the supervisor's stale-heartbeat /
+            # crash detection drills.
+            fault_point("replica_heartbeat")
+            obs.exporters.write_heartbeat(
+                config.heartbeat_file,
+                status="draining" if server._draining else "serving",
+                **_heartbeat_fields(server))
+
     if config.heartbeat_file:
         obs.exporters.write_heartbeat(
-            config.heartbeat_file, status="serving", port=server.port)
+            config.heartbeat_file, status="serving",
+            **_heartbeat_fields(server))
+        threading.Thread(target=_heartbeat_loop, name="serving-heartbeat",
+                         daemon=True).start()
     try:
         stop.wait()
     finally:
         clean = server.drain()
-        signal.signal(signal.SIGTERM, prev_term)
-        signal.signal(signal.SIGINT, prev_int)
+        hb_stop.set()
+        if install_signals:
+            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGINT, prev_int)
+            if prev_hup is not None:
+                signal.signal(signal.SIGHUP, prev_hup)
         if config.metrics_file:
             obs.exporters.write_prometheus(config.metrics_file)
         if config.heartbeat_file:
             obs.exporters.write_heartbeat(
                 config.heartbeat_file,
                 status="done" if clean else "error",
-                port=server.port)
+                abandoned_requests=server.abandoned_requests,
+                **_heartbeat_fields(server))
     return 0 if clean else 1

@@ -1,0 +1,513 @@
+"""Supervised multi-replica serving: `serve --replicas N`.
+
+One serving process is one failure domain: an OOM kill, a wedged device
+call or a poisoned request takes the whole service down until an
+operator notices. The supervisor turns the single-process server into a
+self-healing N-replica service:
+
+- **Fork**: the parent never builds a model. It re-execs N copies of
+  its own command (``--replicas`` stripped, ``C2V_SERVE_REPLICA=<i>``
+  set so a replica can never recurse into supervising), each a full
+  single-model server with its own extractor pool and cache.
+- **Share the port**: every replica binds the SAME listen port with
+  ``SO_REUSEPORT`` (the kernel load-balances accepted connections).
+  Where the platform lacks it — or when ``C2V_SERVE_FORCE_PROXY=1``
+  forces the fallback, which the chaos suite uses for deterministic
+  routing — replicas bind free ports and the supervisor runs its own
+  lightweight round-robin HTTP proxy on the public port, skipping dead
+  replicas and retrying the next one on connection failure.
+- **Monitor**: each replica writes the PR-2 JSON heartbeat
+  (``--heartbeat_file``, rewritten every serve_heartbeat_interval_s)
+  and inherits a liveness pipe. A replica whose process exits is
+  CRASHED; one whose heartbeat goes ~3 intervals stale is HUNG (killed,
+  then treated as crashed). Either is restarted with exponential
+  backoff, up to ``--serve_max_restarts`` restarts per replica — after
+  which the supervisor ESCALATES: kills everything and exits nonzero
+  (a replica that cannot stay up is a deploy problem, and pretending
+  otherwise hides it from the rollout system).
+- **Drain**: SIGTERM to the supervisor fans out as SIGTERM to every
+  replica (each runs its own in-flight drain bounded by
+  serve_drain_timeout_s); the supervisor exits 0 only when every
+  replica exited 0.
+
+The supervisor's own heartbeat records per-replica pid/port/restarts so
+"which replica is which process" is answerable from the file alone —
+the serving chaos suite (tests/test_serving_chaos.py) reads it to pick
+a SIGKILL victim and to assert convergence back to N live replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from code2vec_tpu import obs
+
+REPLICA_ENV = "C2V_SERVE_REPLICA"
+FORCE_PROXY_ENV = "C2V_SERVE_FORCE_PROXY"
+# Seconds a replica gets from spawn to its first heartbeat before the
+# supervisor declares a hung STARTUP (model build + jit warmup can
+# legitimately take tens of seconds on a cold replica).
+STARTUP_GRACE_S = 120.0
+
+_C_RESTARTS = obs.counter(
+    "serving_replica_restarts_total",
+    "replica processes restarted by the serving supervisor "
+    "(crash or stale heartbeat)")
+
+
+def strip_flag(argv: List[str], flag: str,
+               has_value: bool = True) -> List[str]:
+    """Remove every occurrence of `flag` (and its value, both
+    `--flag V` and `--flag=V` forms) from an argv list."""
+    out: List[str] = []
+    skip = False
+    for arg in argv:
+        if skip:
+            skip = False
+            continue
+        if arg == flag:
+            skip = has_value
+            continue
+        if has_value and arg.startswith(flag + "="):
+            continue
+        out.append(arg)
+    return out
+
+
+def _free_port(host: str) -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class _Replica:
+    def __init__(self, index: int, heartbeat_path: str, log_path: str):
+        self.index = index
+        self.heartbeat_path = heartbeat_path
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.pipe_r: Optional[int] = None
+        self.port: Optional[int] = None
+        self.restarts = 0
+        self.spawned_at = 0.0
+        self.restart_at: Optional[float] = None  # backoff gate
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def heartbeat(self) -> Optional[dict]:
+        try:
+            with open(self.heartbeat_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+class Supervisor:
+    """Owns N replica processes + (in proxy mode) the public listener."""
+
+    def __init__(self, config, argv: Optional[List[str]] = None,
+                 child_command: Optional[List[str]] = None):
+        self.config = config
+        self.log = config.log
+        self.n = int(config.serve_replicas)
+        if child_command is not None:
+            self.child_command = list(child_command)
+        else:
+            self.child_command = ([sys.executable, "-m",
+                                   "code2vec_tpu.cli"]
+                                  + strip_flag(list(argv or []),
+                                               "--replicas"))
+        base = (os.path.dirname(os.path.abspath(config.heartbeat_file))
+                if config.heartbeat_file else None)
+        self.run_dir = base or tempfile.mkdtemp(prefix="c2v-serve-sup-")
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.heartbeat_path = (config.heartbeat_file or os.path.join(
+            self.run_dir, "supervisor.heartbeat.json"))
+        self.reuseport = (hasattr(socket, "SO_REUSEPORT")
+                          and os.environ.get(FORCE_PROXY_ENV) != "1")
+        self.port = int(config.serve_port)
+        if self.reuseport and self.port == 0:
+            # replicas must all bind ONE concrete port; resolve now
+            self.port = _free_port(config.serve_host)
+        self.replicas = [
+            _Replica(i,
+                     os.path.join(self.run_dir,
+                                  f"replica{i}.heartbeat.json"),
+                     os.path.join(self.run_dir, f"replica{i}.log"))
+            for i in range(self.n)]
+        self._stop = threading.Event()
+        self._escalated = False
+        self._proxy = None
+        self._rr_lock = threading.Lock()
+        self._rr_next = 0
+
+    # ------------------------------------------------------------ spawn
+
+    def _spawn(self, replica: _Replica) -> None:
+        try:
+            os.remove(replica.heartbeat_path)
+        except OSError:
+            pass
+        replica.port = None
+        cmd = list(self.child_command)
+        cmd += ["--heartbeat_file", replica.heartbeat_path]
+        env = dict(os.environ)
+        env[REPLICA_ENV] = str(replica.index)
+        if self.reuseport:
+            cmd += ["--serve_port", str(self.port)]
+            env["C2V_SERVE_REUSEPORT"] = "1"
+            replica.port = self.port
+        else:
+            cmd += ["--serve_port", "0"]  # report via heartbeat
+            env.pop("C2V_SERVE_REUSEPORT", None)
+        r, w = os.pipe()  # liveness pipe: EOF = replica gone
+        os.set_inheritable(w, True)
+        logf = open(replica.log_path, "ab")
+        try:
+            replica.proc = subprocess.Popen(
+                cmd, env=env, pass_fds=(w,), stdout=logf, stderr=logf)
+        finally:
+            logf.close()
+            os.close(w)
+        if replica.pipe_r is not None:
+            try:
+                os.close(replica.pipe_r)
+            except OSError:
+                pass
+        replica.pipe_r = r
+        replica.spawned_at = time.monotonic()
+        replica.restart_at = None
+        self.log(f"Replica {replica.index} spawned "
+                 f"(pid {replica.proc.pid}"
+                 f"{f', port {replica.port}' if replica.port else ''})")
+
+    def _kill(self, replica: _Replica, sig=signal.SIGKILL) -> None:
+        if replica.proc is not None and replica.proc.poll() is None:
+            try:
+                replica.proc.send_signal(sig)
+            except OSError:
+                pass
+
+    def _fan_out_sighup(self) -> None:
+        self.log("SIGHUP: fanning reload out to all replicas")
+        for replica in self.replicas:
+            self._kill(replica, signal.SIGHUP)
+
+    # ---------------------------------------------------------- monitor
+
+    def _stale_after(self) -> float:
+        return 3.0 * self.config.serve_heartbeat_interval_s + 2.0
+
+    def _check_replica(self, replica: _Replica, now: float
+                       ) -> Optional[str]:
+        """Returns a failure description or None (healthy/waiting)."""
+        if replica.restart_at is not None:
+            return None  # in backoff; spawned when due
+        if replica.proc is None:
+            return None
+        rc = replica.proc.poll()
+        if rc is not None:
+            return f"exited rc={rc}"
+        hb = replica.heartbeat()
+        if hb is None:
+            if now - replica.spawned_at > STARTUP_GRACE_S:
+                self._kill(replica)
+                return (f"no heartbeat within the "
+                        f"{STARTUP_GRACE_S:g}s startup grace (hung "
+                        f"startup; killed)")
+            return None
+        if replica.port is None:
+            port = hb.get("port")
+            if port:
+                replica.port = int(port)
+                self.log(f"Replica {replica.index} listening on port "
+                         f"{replica.port}")
+        age = time.time() - float(hb.get("wall_time", 0))
+        if age > self._stale_after():
+            self._kill(replica)
+            return (f"heartbeat stale ({age:.1f}s > "
+                    f"{self._stale_after():.1f}s; hung; killed)")
+        return None
+
+    def _handle_failure(self, replica: _Replica, why: str) -> bool:
+        """Schedule a backoff restart; False when the budget is
+        exhausted (escalate)."""
+        if replica.proc is not None:
+            replica.proc.wait()  # reap
+        if replica.pipe_r is not None:
+            # drop the dead replica's liveness pipe from the monitor's
+            # select set NOW: at EOF it is permanently readable, and
+            # leaving it in would busy-spin the loop for the whole
+            # backoff window
+            try:
+                os.close(replica.pipe_r)
+            except OSError:
+                pass
+            replica.pipe_r = None
+        if replica.restarts >= self.config.serve_max_restarts:
+            self.log(f"Replica {replica.index} {why}; restart budget "
+                     f"({self.config.serve_max_restarts}) exhausted — "
+                     f"escalating to supervisor exit")
+            return False
+        replica.restarts += 1
+        _C_RESTARTS.inc()
+        backoff = min(0.5 * (2 ** (replica.restarts - 1)), 10.0)
+        replica.restart_at = time.monotonic() + backoff
+        self.log(f"Replica {replica.index} {why}; restart "
+                 f"{replica.restarts}/{self.config.serve_max_restarts} "
+                 f"in {backoff:.1f}s")
+        return True
+
+    def _write_heartbeat(self, status: str, **extra) -> None:
+        obs.exporters.write_heartbeat(
+            self.heartbeat_path, status=status,
+            role="serving-supervisor",
+            mode="reuseport" if self.reuseport else "proxy",
+            port=self.port,
+            replicas=[{
+                "index": r.index,
+                "pid": r.proc.pid if r.proc is not None else None,
+                "port": r.port,
+                "alive": r.alive,
+                "restarts": r.restarts,
+                "heartbeat_file": r.heartbeat_path,
+            } for r in self.replicas], **extra)
+
+    # ------------------------------------------------------------ proxy
+
+    def _live_ports(self) -> List[int]:
+        return [r.port for r in self.replicas
+                if r.alive and r.port is not None]
+
+    def _start_proxy(self) -> None:
+        import http.server
+
+        sup = self
+
+        class ProxyHandler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, body, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _forward(self, method: str) -> None:
+                import http.client
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                fwd_headers = {}
+                for name in ("Content-Type", "X-Deadline-Ms"):
+                    if self.headers.get(name):
+                        fwd_headers[name] = self.headers[name]
+                ports = sup._live_ports()
+                if not ports:
+                    self._reply(503, json.dumps(
+                        {"error": "no live replica"}).encode() + b"\n",
+                        {"Retry-After": "1"})
+                    return
+                with sup._rr_lock:
+                    start = sup._rr_next
+                    sup._rr_next += 1
+                last_err = None
+                for k in range(len(ports)):
+                    port = ports[(start + k) % len(ports)]
+                    try:
+                        conn = http.client.HTTPConnection(
+                            sup.config.serve_host, port, timeout=300)
+                        try:
+                            conn.request(method, self.path, body=body,
+                                         headers=fwd_headers)
+                            resp = conn.getresponse()
+                            payload = resp.read()
+                            headers = {}
+                            if resp.getheader("Retry-After"):
+                                headers["Retry-After"] = \
+                                    resp.getheader("Retry-After")
+                            ctype = resp.getheader(
+                                "Content-Type", "application/json")
+                            self.send_response(resp.status)
+                            self.send_header("Content-Type", ctype)
+                            self.send_header("Content-Length",
+                                             str(len(payload)))
+                            for hk, hv in headers.items():
+                                self.send_header(hk, hv)
+                            self.end_headers()
+                            self.wfile.write(payload)
+                            return
+                        finally:
+                            conn.close()
+                    except OSError as e:
+                        # dead/draining replica: honest retry on the
+                        # next one — the client never sees a torn or
+                        # corrupt response from a killed replica
+                        last_err = e
+                        continue
+                self._reply(503, json.dumps(
+                    {"error": f"all replicas unreachable "
+                              f"({last_err})"}).encode() + b"\n",
+                    {"Retry-After": "1"})
+
+            def do_GET(self):  # noqa: N802
+                self._forward("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._forward("POST")
+
+        class _ProxyServer(http.server.ThreadingHTTPServer):
+            # match the replica listeners: a burst must not be refused
+            # at the kernel before the proxy can route or 503 it
+            request_queue_size = 128
+
+        proxy = _ProxyServer(
+            (self.config.serve_host, self.port), ProxyHandler)
+        proxy.daemon_threads = True
+        self.port = proxy.server_address[1]
+        self._proxy = proxy
+        threading.Thread(target=proxy.serve_forever,
+                         name="serving-supervisor-proxy",
+                         daemon=True).start()
+        self.log(f"Supervisor proxy on "
+                 f"http://{self.config.serve_host}:{self.port} "
+                 f"(round-robin over {self.n} replicas)")
+
+    # -------------------------------------------------------------- run
+
+    def run(self) -> int:
+        installed = threading.current_thread() is threading.main_thread()
+        prev = {}
+        if installed:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev[sig] = signal.signal(
+                    sig, lambda s, f: self._stop.set())
+            if hasattr(signal, "SIGHUP"):
+                # fan a reload out to EVERY replica: in reuseport mode
+                # POST /admin/reload reaches whichever replica the
+                # kernel hands the connection to, so the supervisor is
+                # the one address that can drive a fleet-wide hot-swap
+                prev[signal.SIGHUP] = signal.signal(
+                    signal.SIGHUP, lambda s, f: self._fan_out_sighup())
+        try:
+            return self._run_inner()
+        finally:
+            for sig, handler in prev.items():
+                signal.signal(sig, handler)
+
+    def _run_inner(self) -> int:
+        if not self.reuseport:
+            self._start_proxy()
+        mode = "SO_REUSEPORT" if self.reuseport else "proxy"
+        self.log(f"Serving supervisor: {self.n} replica(s), {mode} on "
+                 f"port {self.port}, restart budget "
+                 f"{self.config.serve_max_restarts}/replica")
+        for replica in self.replicas:
+            self._spawn(replica)
+        self._write_heartbeat("supervising")
+        last_hb = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                # liveness pipes double as the wakeup: a dying replica
+                # EOFs its pipe and the select returns immediately
+                # instead of waiting out the poll tick
+                fds = [r.pipe_r for r in self.replicas
+                       if r.pipe_r is not None]
+                try:
+                    select.select(fds, [], [], 0.2)
+                except (OSError, ValueError):
+                    pass
+                now = time.monotonic()
+                for replica in self.replicas:
+                    if (replica.restart_at is not None
+                            and now >= replica.restart_at):
+                        self._spawn(replica)
+                        continue
+                    why = self._check_replica(replica, now)
+                    if why is not None:
+                        if not self._handle_failure(replica, why):
+                            self._escalated = True
+                            self._stop.set()
+                            break
+                if now - last_hb >= 1.0:
+                    self._write_heartbeat("supervising")
+                    last_hb = now
+        finally:
+            rc = self._shutdown()
+        return rc
+
+    def _shutdown(self) -> int:
+        escalated = self._escalated
+        self.log("Supervisor shutdown: "
+                 + ("restart budget exhausted — killing replicas"
+                    if escalated else
+                    "fanning SIGTERM out as a coordinated drain"))
+        for replica in self.replicas:
+            self._kill(replica,
+                       signal.SIGKILL if escalated else signal.SIGTERM)
+        budget = self.config.serve_drain_timeout_s + 15.0
+        deadline = time.monotonic() + budget
+        clean = not escalated
+        for replica in self.replicas:
+            if replica.proc is None:
+                continue
+            if replica.restart_at is not None:
+                # already dead and reaped, waiting out its restart
+                # backoff: its stale crash rc is not a DRAIN failure
+                # (the crash was handled by the restart policy)
+                continue
+            try:
+                rc = replica.proc.wait(
+                    timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                self._kill(replica)
+                replica.proc.wait()
+                rc = replica.proc.returncode
+            if rc != 0:
+                clean = False
+                self.log(f"Replica {replica.index} exited rc={rc}")
+            if replica.pipe_r is not None:
+                try:
+                    os.close(replica.pipe_r)
+                except OSError:
+                    pass
+                replica.pipe_r = None
+        if self._proxy is not None:
+            try:
+                self._proxy.shutdown()
+                self._proxy.server_close()
+            except Exception:
+                pass
+        self._write_heartbeat(
+            "error" if (escalated or not clean) else "done",
+            escalated=escalated)
+        self.log(f"Supervisor exit: "
+                 f"{'clean' if clean and not escalated else 'FAILED'}")
+        return 0 if clean and not escalated else 1
+
+
+def supervisor_main(config, argv: Optional[List[str]] = None,
+                    child_command: Optional[List[str]] = None) -> int:
+    """`serve --replicas N` parent body (cli.main dispatches here
+    BEFORE building any model). `child_command` overrides the re-exec
+    command — the chaos suite points it at a lightweight replica
+    driver; production re-execs this CLI."""
+    return Supervisor(config, argv=argv,
+                      child_command=child_command).run()

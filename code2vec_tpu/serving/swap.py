@@ -1,0 +1,168 @@
+"""Health-gated live model hot-swap: change weights without dropping
+traffic.
+
+Before this, changing the model a replica serves meant killing the
+process — a full drain, cold start, and cache loss per deploy. The
+SwapManager loads a NEW release bundle entirely off the request path,
+validates it, and only then swaps the server's model reference between
+batches:
+
+    POST /admin/reload {"artifact": DIR}     (or SIGHUP: re-read
+                                              --artifact from config)
+      -> state "loading":    release/artifact.py load_artifact — every
+         field-validated table/meta check PR 8 does at startup runs
+         here, on a worker thread, while the OLD model keeps serving
+      -> state "validating": a golden-prediction smoke batch through
+         the new model (BucketedPredictMixin.smoke_schema) compared
+         against the RUNNING model's output schema — top-k width, code
+         vector size, finite scores. A bundle that loads but predicts
+         garbage shapes is rejected here.
+      -> state "ready":      PredictionServer.swap_model flips the
+         model reference under its lock. The batcher reads the
+         reference once per dispatched batch, so every response is
+         attributable to exactly one fingerprint (old or new, never a
+         mix within a response), and the PR-8 fingerprint cache keying
+         guarantees no stale cache hits.
+      -> state "failed":     the OLD model is still serving, untouched;
+         the failure reason is surfaced in /healthz
+         (model.swap_status) and `serving_swap_total{outcome=failed}`.
+
+Fault point `swap_validate` (utils/faults.py) fires at the top of the
+load+validate worker so the chaos suite can prove a mid-swap fault
+leaves the old model serving and the failure visible — never a torn
+half-swapped server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+from code2vec_tpu import obs
+from code2vec_tpu.utils.faults import fault_point
+
+
+def _swap_counter(outcome: str):
+    return obs.counter("serving_swap_total",
+                       "live model hot-swap attempts by outcome",
+                       outcome=outcome)
+
+
+class SwapError(ValueError):
+    """A reload request that cannot even be attempted (busy, bad
+    target); maps to an HTTP 4xx, distinct from an async validation
+    failure surfaced in swap status."""
+
+
+class SwapManager:
+    """Owns the reload worker thread and the swap status surfaced in
+    /healthz. One swap in flight at a time; a second reload while one
+    is loading/validating is rejected (409) rather than queued —
+    deploy tooling should poll `model.swap_status` and re-issue."""
+
+    def __init__(self, server, build_model: Optional[Callable] = None):
+        self.server = server
+        self.config = server.config
+        self.log = server.log
+        # Injection seam: tests swap between in-process models; the
+        # default builds a ReleaseModel from an artifact dir with the
+        # PR-8 load-time validation.
+        self._build_model = build_model or self._build_release_model
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._status = {"state": "idle", "target": None, "error": None,
+                        "completed_at": None, "swapped_fingerprint": None}
+
+    # ------------------------------------------------------------ state
+
+    def status(self) -> dict:
+        with self._lock:
+            return dict(self._status)
+
+    def _set(self, **fields) -> None:
+        with self._lock:
+            self._status.update(fields)
+
+    # -------------------------------------------------------------- API
+
+    def request_reload(self, artifact_dir: Optional[str]) -> dict:
+        """Kick off an async reload; returns the (new) status. Raises
+        SwapError when no target is given or a swap is in flight."""
+        if not artifact_dir:
+            raise SwapError(
+                "no artifact to reload: POST /admin/reload with "
+                '{"artifact": DIR} (SIGHUP re-reads --artifact, which '
+                "this replica was not started with)")
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                raise SwapError(
+                    f"a swap is already in flight "
+                    f"(state={self._status['state']}, "
+                    f"target={self._status['target']}); poll "
+                    f"/healthz model.swap_status and retry")
+            self._status.update(state="loading", target=artifact_dir,
+                                error=None, completed_at=None)
+            self._worker = threading.Thread(
+                target=self._reload_worker, args=(artifact_dir,),
+                name="serving-swap", daemon=True)
+            self._worker.start()
+        return self.status()
+
+    # ----------------------------------------------------------- worker
+
+    def _build_release_model(self, artifact_dir: str):
+        from code2vec_tpu.release.runtime import ReleaseModel
+        # A COPY of the config: ReleaseModel asserts artifact authority
+        # by mutating max_contexts/topk/serve_batch_size on its config,
+        # and the live server's config must keep describing the model
+        # actually serving until the swap commits.
+        config = dataclasses.replace(self.config,
+                                     serve_artifact=artifact_dir)
+        return ReleaseModel(config, log=self.log)
+
+    def _reload_worker(self, artifact_dir: str) -> None:
+        old_model = self.server.model
+        try:
+            fault_point("swap_validate")
+            new_model = self._build_model(artifact_dir)
+            self._set(state="validating")
+            self._validate(old_model, new_model)
+        except BaseException as e:  # noqa: BLE001 — ANY load/validate
+            # failure must leave the old model serving and be visible.
+            _swap_counter("failed").inc()
+            self._set(state="failed",
+                      error=f"{type(e).__name__}: {e}",
+                      completed_at=time.time())
+            self.log(f"Model swap to {artifact_dir} REJECTED "
+                     f"({type(e).__name__}: {e}); old model "
+                     f"{self.server.model_fingerprint} keeps serving")
+            return
+        fp = self.server.swap_model(new_model)
+        _swap_counter("success").inc()
+        self._set(state="ready", completed_at=time.time(),
+                  swapped_fingerprint=fp)
+        self.log(f"Model swapped live to {artifact_dir} "
+                 f"(fingerprint {fp})")
+
+    @staticmethod
+    def _validate(old_model, new_model) -> None:
+        """Golden-prediction smoke batch: the new model must produce the
+        same OUTPUT SCHEMA the running one does — same top-k width (a
+        narrower k would silently truncate every client's list), same
+        code-vector size (/embed consumers index into it), finite
+        scores (a corrupt table predicts NaN, not an exception)."""
+        old = old_model.smoke_schema()
+        new = new_model.smoke_schema()
+        if not new["scores_finite"]:
+            raise SwapError(
+                "smoke batch produced non-finite prediction scores "
+                "(corrupt or incompatible tables)")
+        for field in ("topk", "code_vector_size"):
+            if new[field] != old[field]:
+                raise SwapError(
+                    f"output schema mismatch: new model {field}="
+                    f"{new[field]} vs running model's {old[field]} — "
+                    f"clients depend on the running schema; re-export "
+                    f"the artifact to match or deploy as a new service")

@@ -51,6 +51,25 @@ load_model, model_facade._train_batches):
                        to the current host count before the resumed
                        epoch's first batch; same untouched-artifact
                        contract as `reshard_restore`.
+
+Fault points in the serving resilience stack (serving/admission.py,
+serving/swap.py, serving/server.py; tests/test_serving_chaos.py):
+
+- `admission_enqueue` — crossed on every admission-gate admit. An armed
+                       fault here must surface as an honest JSON error
+                       response (never a hang, never a torn body) —
+                       the admission layer failing is itself a serving
+                       fault mode.
+- `swap_validate`    — top of the hot-swap load+validate worker. A kill
+                       or raise mid-swap must leave the OLD model
+                       serving untouched, with the failure visible in
+                       /healthz `model.swap_status`.
+- `replica_heartbeat`— crossed by the serving heartbeat ticker before
+                       each rewrite. `raise` wedges the ticker (the
+                       heartbeat goes stale -> the supervisor's
+                       hung-replica detection fires); `exit` kills the
+                       whole replica (the supervisor's crash-restart
+                       path).
 """
 
 from __future__ import annotations

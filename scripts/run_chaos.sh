@@ -29,6 +29,10 @@ MULTI_HOST_BUDGET=900
 # Elastic N->M resume: three phase-1 training pods + per-scenario resume
 # children, each a full facade run — the longest suite of the three.
 ELASTIC_BUDGET=1200
+# Serving resilience: in-process admission/breaker/swap drills plus the
+# supervised-replica SIGKILL / stale-heartbeat subprocess drills (fake
+# model children — fast to spawn, so the budget covers hangs, not work).
+SERVING_BUDGET=600
 
 rc=0
 
@@ -51,6 +55,7 @@ run_suite "$SINGLE_HOST_BUDGET" tests/test_chaos.py "$@"
 run_suite "$MULTI_HOST_BUDGET" tests/test_multihost_chaos.py \
     tests/test_multiprocess.py "$@"
 run_suite "$ELASTIC_BUDGET" tests/test_elastic_resume.py "$@"
+run_suite "$SERVING_BUDGET" tests/test_serving_chaos.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

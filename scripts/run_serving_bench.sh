@@ -10,6 +10,8 @@
 # answerable from CI logs alone.
 #
 # Usage: scripts/run_serving_bench.sh [extra args passed to the bench]
+#        scripts/run_serving_bench.sh resilience   # PR-9 overload +
+#        kill-replica scenarios -> results/serving_resilience.json
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."

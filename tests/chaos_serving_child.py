@@ -1,0 +1,107 @@
+"""Replica child for the serving chaos suite (tests/test_serving_chaos.py).
+
+One supervised replica: a full PredictionServer + warm fake-extractor
+pool + batcher + admission/breaker/swap stack, serving a FAKE model so
+the process starts in well under a second (no jax import — see the
+C2V_HOST_WORKER gate below). The supervisor spawns N of these via its
+`child_command` seam and appends `--heartbeat_file PATH` and
+`--serve_port N` exactly as it would for the production
+`python -m code2vec_tpu.cli serve` re-exec, so the heartbeat/monitor/
+drain protocol under test is the real one.
+
+Usage: python tests/chaos_serving_child.py OVERRIDES_JSON \
+           [--heartbeat_file PATH] [--serve_port N]
+"""
+
+import json
+import os
+import sys
+
+# Must precede the package import: replicas serve a fake model, so the
+# multi-second jax initialization is pure startup-latency noise in the
+# supervisor restart-convergence timings the chaos suite asserts on.
+os.environ.setdefault("C2V_HOST_WORKER", "1")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+class _FakeResult:
+    def __init__(self, name, contexts, topk):
+        self.original_name = name
+        self.topk_predicted_words = [f"predicted|w{i}"
+                                     for i in range(topk)]
+        self.topk_predicted_words_scores = [0.5 / (i + 1)
+                                            for i in range(topk)]
+        self.attention_per_context = {}
+        for i, ctx in enumerate(contexts):
+            bits = ctx.split(",")
+            if len(bits) == 3:
+                self.attention_per_context[tuple(bits)] = 1.0 / (i + 1)
+        self.code_vector = [0.25] * 8
+
+
+class FakeModel:
+    """The minimal surface PredictionServer needs: deterministic
+    predictions derived from the extractor lines, instant."""
+
+    def __init__(self, config, fingerprint):
+        self.config = config
+        self._fp = fingerprint
+        self.context_buckets = (4, 8, config.max_contexts)
+        self._predict_steps = {}
+
+        class _SpecialWords:
+            oov = "<OOV>"
+
+        class _TargetVocab:
+            special_words = _SpecialWords()
+
+        class _Vocabs:
+            target_vocab = _TargetVocab()
+
+        self.vocabs = _Vocabs()
+
+    def model_fingerprint(self):
+        return self._fp
+
+    def predict_compile_count(self):
+        return 0
+
+    def predict(self, lines, batch_size=None, with_code_vectors=False):
+        out = []
+        for line in lines:
+            parts = line.split()
+            out.append(_FakeResult(parts[0], parts[1:], topk=3))
+        return out
+
+    def smoke_schema(self):
+        [r] = self.predict(["swapsmoke a,b,c"], batch_size=1,
+                           with_code_vectors=True)
+        return {"topk": len(r.topk_predicted_words),
+                "code_vector_size": len(r.code_vector),
+                "scores_finite": True}
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    overrides = json.loads(open(argv[0]).read())
+    # the two flags the supervisor appends to every child command
+    if "--heartbeat_file" in argv:
+        overrides["heartbeat_file"] = argv[argv.index(
+            "--heartbeat_file") + 1]
+    if "--serve_port" in argv:
+        overrides["serve_port"] = int(argv[argv.index("--serve_port") + 1])
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving.server import serve_main
+
+    config = Config(serve=True, verbose_mode=0, **overrides)
+    model = FakeModel(
+        config, fingerprint=f"fake-replica-model-pid{os.getpid()}")
+    return serve_main(config, model=model)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
