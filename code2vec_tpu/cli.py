@@ -158,6 +158,76 @@ def arguments_parser() -> ArgumentParser:
                         help="skip the jax.export AOT lowerings in the "
                              "exported artifact (consumers then always "
                              "trace+compile at cold start)")
+    # -- retrieval stack (README "Retrieval") --
+    parser.add_argument("--embed_out", dest="embed_out", metavar="DIR",
+                        help="batch embedding job (the `embed` "
+                             "subcommand body): run the --test corpus's "
+                             "packed .c2vb through the eval pipeline at "
+                             "device speed and write a sharded vector "
+                             "store here (resumable per shard; model "
+                             "from --load or --artifact)")
+    parser.add_argument("--embed_dtype", choices=["float32", "float16"],
+                        default=None,
+                        help="vector-store payload dtype (default "
+                             "float32; float16 halves the store)")
+    parser.add_argument("--embed_shard_rows", type=int, default=None,
+                        metavar="N",
+                        help="rows per committed vector-store shard — "
+                             "the embed job's resume granularity "
+                             "(default 65536)")
+    parser.add_argument("--vectors_text", action="store_true",
+                        help="--export_code_vectors compat: write the "
+                             "reference's `.vectors` text layout "
+                             "instead of the sharded store format")
+    parser.add_argument("--embeddings_out", dest="embeddings_out",
+                        metavar="DIR",
+                        help="dump the token + target embedding tables "
+                             "in word2vec text format here (the "
+                             "`export-embeddings` subcommand body; the "
+                             "reference's --save_w2v/--save_t2v pair)")
+    parser.add_argument("--vectors", dest="index_vectors", metavar="DIR",
+                        help="index-build input: the vector store the "
+                             "`embed` subcommand wrote")
+    parser.add_argument("--index_out", dest="index_out", metavar="DIR",
+                        help="index-build output: write the ANN index "
+                             "artifact here (IVF-flat, or brute-force "
+                             "on small corpora)")
+    parser.add_argument("--nlist", dest="index_nlist", type=int,
+                        default=None, metavar="N",
+                        help="IVF coarse-quantizer size (default 0 = "
+                             "sqrt(rows) auto)")
+    parser.add_argument("--nprobe", dest="index_nprobe", type=int,
+                        default=None, metavar="N",
+                        help="inverted lists probed per query — the "
+                             "recall/latency knob (default 8; baked "
+                             "into the index as its default, clients "
+                             "override per request)")
+    parser.add_argument("--kmeans_iters", dest="index_kmeans_iters",
+                        type=int, default=None, metavar="N",
+                        help="jitted Lloyd iterations for the coarse "
+                             "quantizer (default 10)")
+    parser.add_argument("--index_metric", dest="index_metric",
+                        choices=["cosine", "dot"], default=None,
+                        help="similarity metric baked into the index "
+                             "(default cosine)")
+    parser.add_argument("--retrieval_index", dest="retrieval_index",
+                        metavar="DIR",
+                        help="serve: mount this index so the server "
+                             "answers POST /neighbors (snippet -> "
+                             "embed -> ANN search); the index's "
+                             "embedding fingerprint must match the "
+                             "serving model's")
+    parser.add_argument("--retrieval_topk", dest="retrieval_topk",
+                        type=int, default=None, metavar="K",
+                        help="default neighbors per method from "
+                             "/neighbors (default 10; JSON body `k` "
+                             "overrides)")
+    parser.add_argument("--retrieval_swap_policy",
+                        choices=["refuse", "detach"], default=None,
+                        help="hot-swap vs mounted index on fingerprint "
+                             "mismatch: refuse the swap (default) or "
+                             "commit it and detach the index "
+                             "(/neighbors then answers 503)")
     parser.add_argument("--topk_block", dest="topk_block_size", type=int,
                         default=None, metavar="ROWS",
                         help="target-table rows per block of the "
@@ -299,15 +369,29 @@ def config_from_args(argv=None) -> Config:
         argv = sys.argv[1:]
     # Subcommand sugar: `code2vec_tpu serve --load M` == `--serve
     # --load M`; `code2vec_tpu export --load M --artifact_out D` builds
-    # a release artifact (README "Release artifacts").
-    serve_subcommand = bool(argv) and argv[0] == "serve"
-    export_subcommand = bool(argv) and argv[0] == "export"
-    if serve_subcommand or export_subcommand:
+    # a release artifact (README "Release artifacts"); `embed`,
+    # `index-build` and `export-embeddings` are the retrieval-stack
+    # jobs (README "Retrieval").
+    subcommands = ("serve", "export", "embed", "index-build",
+                   "export-embeddings")
+    subcommand = argv[0] if argv and argv[0] in subcommands else None
+    if subcommand:
         argv = argv[1:]
+    serve_subcommand = subcommand == "serve"
     args = arguments_parser().parse_args(argv)
-    if export_subcommand and not args.export_artifact_path:
+    if subcommand == "export" and not args.export_artifact_path:
         raise SystemExit(
             "the `export` subcommand requires --artifact_out DIR")
+    if subcommand == "embed" and not args.embed_out:
+        raise SystemExit("the `embed` subcommand requires --embed_out "
+                         "DIR (plus --test CORPUS and --load/--artifact)")
+    if subcommand == "index-build" and not (args.index_vectors
+                                            and args.index_out):
+        raise SystemExit("the `index-build` subcommand requires "
+                         "--vectors DIR and --index_out DIR")
+    if subcommand == "export-embeddings" and not args.embeddings_out:
+        raise SystemExit("the `export-embeddings` subcommand requires "
+                         "--embeddings_out DIR (plus --load MODEL)")
     knobs = {knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
                                       "on_nonfinite_loss",
                                       "extractor_timeout_s",
@@ -332,7 +416,17 @@ def config_from_args(argv=None) -> Config:
                                       "serve_heartbeat_interval_s",
                                       "serve_artifact",
                                       "export_artifact_path",
-                                      "topk_block_size")
+                                      "topk_block_size",
+                                      "embed_out", "embed_dtype",
+                                      "embed_shard_rows",
+                                      "embeddings_out",
+                                      "index_vectors", "index_out",
+                                      "index_nlist", "index_nprobe",
+                                      "index_kmeans_iters",
+                                      "index_metric",
+                                      "retrieval_index",
+                                      "retrieval_topk",
+                                      "retrieval_swap_policy")
              if (value := getattr(args, knob)) is not None}
     config = Config(
         predict=args.predict,
@@ -359,6 +453,7 @@ def config_from_args(argv=None) -> Config:
         explicit_knobs=tuple(sorted(knobs)),
         release_quantize=not args.no_quantize,
         release_aot=not args.no_aot,
+        vectors_text=args.vectors_text,
         async_checkpointing=args.async_checkpointing,
         cursor_resume=not args.no_cursor_resume,
         seed=args.seed,
@@ -407,11 +502,30 @@ def main(argv=None) -> None:
     from code2vec_tpu.parallel import distributed
     distributed.initialize()
 
+    if config.index_out:
+        # `index-build` is a pure vector-store -> ANN-artifact job: no
+        # model, no checkpoint — the store manifest carries the
+        # embedding fingerprint the index inherits.
+        from code2vec_tpu.retrieval.index import build_index
+        build_index(config.index_vectors, config.index_out,
+                    nlist=config.index_nlist,
+                    nprobe=config.index_nprobe,
+                    kmeans_iters=config.index_kmeans_iters,
+                    seed=config.seed, metric=config.index_metric,
+                    log=config.log)
+        return
+
     if config.serve_artifact:
         # Release-artifact runtime: no checkpoint, no training state —
         # the artifact carries tables + vocabs + AOT lowerings.
         from code2vec_tpu.release.runtime import ReleaseModel
         model = ReleaseModel(config)
+        if config.embed_out:
+            # embed from the quantized bundle: fused-dequant tables +
+            # blockwise top-k, no checkpoint in RSS
+            from code2vec_tpu.retrieval.embed_job import run_embed_job
+            run_embed_job(model)
+            return
         if not (config.predict or config.serve or config.is_testing):
             config.log("--artifact given without `serve`, --predict or "
                        "--test; nothing to do")
@@ -435,6 +549,15 @@ def main(argv=None) -> None:
     if config.export_artifact_path:
         from code2vec_tpu.release.artifact import export_artifact
         export_artifact(model, config.export_artifact_path)
+        return
+
+    if config.embed_out:
+        from code2vec_tpu.retrieval.embed_job import run_embed_job
+        run_embed_job(model)
+        return
+
+    if config.embeddings_out:
+        model.export_embeddings(config.embeddings_out)
         return
 
     if config.is_training:

@@ -315,6 +315,58 @@ class Config:
     # instead of retracing each bucket. Artifacts embed the lowering
     # platform; a replica on a different backend falls back to jit.
     release_aot: bool = True
+    # -- retrieval (code2vec_tpu/retrieval; no reference equivalent —
+    # the reference only dumps code vectors as text via
+    # --export_code_vectors) --
+    # `embed` subcommand output: write the corpus's code vectors into a
+    # sharded vector store here (retrieval/store.py). The corpus is
+    # --test's packed .c2vb; the model is --load or --artifact.
+    embed_out: Optional[str] = None
+    # Vector-store payload dtype: float16 halves the store (and the
+    # index's HBM footprint) at ~1e-3 cosine error; float32 is exact.
+    embed_dtype: str = "float32"
+    # Rows per committed store shard — the embed job's resume
+    # granularity (a killed job re-embeds at most this many rows).
+    embed_shard_rows: int = 65536
+    # --export_code_vectors compat: write the reference's `.vectors`
+    # text layout (one space-joined vector per line) instead of the
+    # sharded store format.
+    vectors_text: bool = False
+    # `export-embeddings` subcommand output dir: token + target
+    # embedding tables in word2vec text format (the reference's
+    # --save_w2v/--save_t2v pair as one artifact).
+    embeddings_out: Optional[str] = None
+    # `index-build` subcommand input/output: the vector store to index
+    # and the index artifact dir to write (retrieval/index.py).
+    index_vectors: Optional[str] = None
+    index_out: Optional[str] = None
+    # IVF coarse-quantizer size; 0 = sqrt(rows) auto. Small corpora
+    # (or nlist <= 1) fall back to the brute-force exact backend.
+    index_nlist: int = 0
+    # Inverted lists probed per query (recall/latency knob; clients
+    # override per request via the JSON body's `nprobe`). The default
+    # is recorded into the index artifact at build time.
+    index_nprobe: int = 8
+    # Jitted Lloyd iterations for the coarse quantizer.
+    index_kmeans_iters: int = 10
+    # Similarity metric baked into the index: cosine (vectors
+    # normalized at build, distance = 1 - score) or raw dot.
+    index_metric: str = "cosine"
+    # `serve` input: mount a built index so the server answers
+    # POST /neighbors (retrieval/api.py). The index's recorded
+    # embedding fingerprint must match the serving model's.
+    retrieval_index: Optional[str] = None
+    # Default neighbors returned per method by /neighbors (JSON body
+    # `k` overrides per request).
+    retrieval_topk: int = 10
+    # What a model hot-swap does when the new weights' fingerprint
+    # diverges from the mounted index's: "refuse" rejects the swap
+    # (the index is part of the serving contract), "detach" commits
+    # the swap and detaches the index (reason in /healthz; /neighbors
+    # answers 503 until a matching index is mounted). Either way,
+    # neighbors are NEVER computed across embedding spaces.
+    retrieval_swap_policy: str = "refuse"
+
     # Knob names the user set EXPLICITLY on the command line (filled by
     # cli.config_from_args). Lets a consumer distinguish "holds the
     # dataclass default because nobody asked" from "the operator typed
@@ -442,10 +494,11 @@ class Config:
     def verify(self) -> None:
         # reference: config.py:232-239, plus mesh-shape checks.
         if (not self.is_training and not self.is_loading
-                and not self.serve_artifact):
+                and not self.serve_artifact and not self.index_out):
             raise ValueError(
                 "Must train or load a model (or serve a release "
-                "artifact via --artifact).")
+                "artifact via --artifact; `index-build` alone needs "
+                "no model).")
         if self.is_loading and not os.path.isdir(self.model_load_dir):
             raise ValueError(
                 f"Model load dir `{self.model_load_dir}` does not exist.")
@@ -587,6 +640,85 @@ class Config:
                 "--artifact is inference-only (serve/--predict/--test) "
                 "and cannot be combined with training (--data): a "
                 "release artifact has no optimizer state to train.")
+        if self.embed_dtype not in ("float32", "float16"):
+            raise ValueError("embed_dtype must be float32 or float16.")
+        if self.embed_shard_rows < 1:
+            raise ValueError(
+                "embed_shard_rows must be >= 1 (it is the embed job's "
+                "resume granularity).")
+        if self.embed_out and not self.is_testing:
+            raise ValueError(
+                "embed (--embed_out) needs a corpus: pass --test FILE "
+                "(its packed .c2vb is the embed input).")
+        if self.embed_out and not (self.is_loading or self.serve_artifact):
+            raise ValueError(
+                "embed (--embed_out) needs a model: --load CKPT or "
+                "--artifact DIR (an untrained model's vectors index "
+                "noise).")
+        if self.embed_out and self.is_training:
+            raise ValueError(
+                "embed (--embed_out) is a one-shot job and cannot be "
+                "combined with training (--data); train first, then "
+                "embed the corpus.")
+        if self.embed_out and (self.serve or self.predict):
+            raise ValueError(
+                "embed (--embed_out) is a one-shot job and cannot be "
+                "combined with serve/--predict: main() runs the embed "
+                "job and exits, so the server/REPL would be silently "
+                "skipped. Run them as separate invocations.")
+        if self.index_out and (self.is_training or self.serve
+                               or self.predict or self.is_testing
+                               or self.embed_out or self.embeddings_out):
+            raise ValueError(
+                "index-build (--index_out) is a standalone job and "
+                "cannot be combined with training/serve/--predict/"
+                "--test/--embed_out/--embeddings_out: main() builds "
+                "the index and exits, silently skipping the rest. Run "
+                "them as separate invocations.")
+        if self.embeddings_out and (self.is_training or self.serve
+                                    or self.predict or self.is_testing
+                                    or self.embed_out):
+            raise ValueError(
+                "export-embeddings (--embeddings_out) is a one-shot "
+                "job and cannot be combined with training/serve/"
+                "--predict/--test/--embed_out: main() writes the "
+                "tables and exits, silently skipping the rest. Run "
+                "them as separate invocations.")
+        if self.index_out and not self.index_vectors:
+            raise ValueError(
+                "index-build (--index_out) requires --vectors DIR (the "
+                "store the `embed` subcommand wrote).")
+        if self.index_vectors and not self.index_out:
+            raise ValueError(
+                "--vectors is only consumed by index-build; pass "
+                "--index_out DIR for the artifact to write.")
+        if self.index_nlist < 0:
+            raise ValueError(
+                "index_nlist must be >= 0 (0 = sqrt(rows) auto).")
+        if self.index_nprobe < 1:
+            raise ValueError("index_nprobe must be >= 1.")
+        if self.index_kmeans_iters < 1:
+            raise ValueError("index_kmeans_iters must be >= 1.")
+        if self.index_metric not in ("cosine", "dot"):
+            raise ValueError("index_metric must be cosine or dot.")
+        if self.retrieval_index and not self.serve:
+            raise ValueError(
+                "--retrieval_index applies to the serve subcommand "
+                "only (it mounts the /neighbors index).")
+        if self.retrieval_topk < 1:
+            raise ValueError("retrieval_topk must be >= 1.")
+        if self.retrieval_swap_policy not in ("refuse", "detach"):
+            raise ValueError(
+                "retrieval_swap_policy must be refuse or detach.")
+        if self.embeddings_out and not self.is_loading:
+            raise ValueError(
+                "export-embeddings (--embeddings_out) requires --load: "
+                "the tables come from a trained checkpoint.")
+        if self.embeddings_out and self.serve_artifact:
+            raise ValueError(
+                "export-embeddings (--embeddings_out) reads the fp32 "
+                "checkpoint tables; a release artifact's are quantized "
+                "— run it against --load.")
 
     # ---------------------------------------------------------------- logging
 

@@ -52,6 +52,7 @@ class Evaluator:
 
     def evaluate(self, params, batches: Iterable,
                  code_vectors_path: Optional[str] = None,
+                 code_vectors_sink: Optional[Callable] = None,
                  prefetch: bool = True) -> ModelEvaluationResults:
         """Pipelined evaluation: a worker thread parses/packs batches
         (DevicePrefetcher, same division of labor as the trainer), and
@@ -65,7 +66,8 @@ class Evaluator:
                       hist=obs.histogram("eval_seconds",
                                          "one full evaluation pass")):
             results = self._evaluate_inner(params, batches,
-                                           code_vectors_path, prefetch)
+                                           code_vectors_path,
+                                           code_vectors_sink, prefetch)
         obs.counter("eval_runs_total", "completed evaluation passes").inc()
         # Last-eval quality gauges: the same scalars the TB eval/ tags
         # carry, visible to a Prometheus scrape between TB flushes.
@@ -75,6 +77,7 @@ class Evaluator:
 
     def _evaluate_inner(self, params, batches: Iterable,
                         code_vectors_path: Optional[str],
+                        code_vectors_sink: Optional[Callable],
                         prefetch: bool) -> ModelEvaluationResults:
         config = self.config
         topk_metric = TopKAccuracyEvaluationMetric(
@@ -122,6 +125,11 @@ class Evaluator:
                 code_vectors = self._host_rows(out.code_vectors)[valid]
                 for vec in code_vectors:
                     vectors_file.write(" ".join(map(str, vec)) + "\n")
+            if code_vectors_sink is not None:
+                # structured export (retrieval vector store): valid
+                # rows' vectors + their method ids, in eval order
+                code_vectors_sink(
+                    self._host_rows(out.code_vectors)[valid], names)
             if total_batches % config.num_batches_to_log_progress == 0:
                 elapsed = time.time() - start_time
                 config.log(f"Evaluated {total_predictions} examples... "

@@ -792,10 +792,50 @@ class Code2VecModel(BucketedPredictMixin):
         config = self.config
         evaluator = Evaluator(config, self.vocabs, self._get_eval_step(),
                               mesh=self.mesh)
-        vectors_path = (config.test_data_path + ".vectors"
-                        if config.export_code_vectors else None)
+        if not config.export_code_vectors:
+            return evaluator.evaluate(params, self._eval_batches())
+        vectors_base = config.test_data_path + ".vectors"
+        from code2vec_tpu.retrieval.store import (
+            MANIFEST_NAME, VectorStoreWriter,
+        )
+        if getattr(config, "vectors_text", False):
+            # reference compat (tensorflow_model.py:160-162): one
+            # space-joined vector per line. A prior default-format
+            # export left a store DIRECTORY at this path; exporting
+            # overwrites its own output either way, so clear it —
+            # but only a directory that really is our store.
+            if os.path.isdir(vectors_base):
+                if not os.path.isfile(os.path.join(vectors_base,
+                                                   MANIFEST_NAME)):
+                    raise ValueError(
+                        f"{vectors_base} is a directory that is not a "
+                        f"code2vec vector store; refusing to replace "
+                        f"it with the text export")
+                shutil.rmtree(vectors_base)
+            return evaluator.evaluate(params, self._eval_batches(),
+                                      code_vectors_path=vectors_base)
+        # Default: the sharded retrieval store format (retrieval/
+        # store.py) — the SAME on-disk layout the `embed` batch job
+        # writes, so offline export feeds `index-build` directly and
+        # carries the embedding fingerprint the index needs. A prior
+        # --vectors_text export left a FILE here; same overwrite
+        # semantics.
+        if os.path.isfile(vectors_base):
+            os.unlink(vectors_base)
+        writer = VectorStoreWriter(
+            vectors_base, dim=config.code_vector_size,
+            dtype=getattr(config, "embed_dtype", "float32"),
+            model_fingerprint=self.model_fingerprint(),
+            source=config.test_data_path,
+            shard_rows=getattr(config, "embed_shard_rows", 65536),
+            resume=False, log=self.log)
         results = evaluator.evaluate(params, self._eval_batches(),
-                                     code_vectors_path=vectors_path)
+                                     code_vectors_sink=writer.append)
+        manifest = writer.finalize()
+        self.log(f"Code vectors exported as a vector store at "
+                 f"{vectors_base} ({manifest['rows']} rows, "
+                 f"{len(manifest['shards'])} shard(s); --vectors_text "
+                 f"restores the reference text layout)")
         return results
 
     # ---------------------------------------------------------- predict
@@ -807,6 +847,13 @@ class Code2VecModel(BucketedPredictMixin):
 
     def _call_predict_step(self, step, arrays):
         return step(self.state.params, *arrays)
+
+    def eval_callable(self):
+        """(eval_step, params) pair for callers that drive the eval step
+        directly over packed batches — the Evaluator's division of labor,
+        shared with the batch embed job (retrieval/embed_job.py). The
+        release runtime exposes the same surface over artifact tables."""
+        return self._get_eval_step(), self.state.params
 
     def model_fingerprint(self) -> str:
         ident = os.path.abspath(self.config.model_load_path
@@ -846,3 +893,18 @@ class Code2VecModel(BucketedPredictMixin):
         with open(dest_save_path, "w") as f:
             common_mod.save_word2vec_file(f, index_to_word, matrix)
         self.log(f"Saved {vocab_type} word2vec format to {dest_save_path}")
+
+    def export_embeddings(self, out_dir: str) -> Dict[str, str]:
+        """The `export-embeddings` subcommand body: the reference's
+        --save_w2v (token table) and --save_t2v (target table) as one
+        artifact directory — `tokens.w2v` + `targets.w2v` in word2vec
+        text format, real-vocab rows only
+        (_get_vocab_embedding_as_np_array trims the padded tail)."""
+        os.makedirs(out_dir, exist_ok=True)
+        paths = {"tokens": os.path.join(out_dir, "tokens.w2v"),
+                 "targets": os.path.join(out_dir, "targets.w2v")}
+        self.save_word2vec_format(paths["tokens"], VocabType.Token)
+        self.save_word2vec_format(paths["targets"], VocabType.Target)
+        self.log(f"Embedding tables exported to {out_dir} "
+                 f"(word2vec text format)")
+        return paths

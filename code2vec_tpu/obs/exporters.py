@@ -40,7 +40,11 @@ def _atomic_write(path: str, data: str) -> None:
     dirpart = os.path.dirname(path)
     if dirpart:
         os.makedirs(dirpart, exist_ok=True)
-    tmp = f"{path}.tmp-{os.getpid()}"
+    # pid alone is not unique WITHIN a process: the serving heartbeat
+    # ticker and the final shutdown beat can write concurrently, and a
+    # shared tmp name lets one thread rename the other's file away
+    # (observed as a FileNotFoundError on the second os.replace)
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
     with open(tmp, "w") as f:
         f.write(data)
     os.replace(tmp, path)

@@ -353,6 +353,12 @@ class ReleaseModel(BucketedPredictMixin):
         step = self._get_bucketed_predict_step(rows, m)
         return self._call_predict_step(step, arrays)
 
+    def eval_callable(self):
+        """(eval_step, params) — the facade's surface for direct eval
+        drivers (Evaluator, retrieval/embed_job.py). Params are the
+        artifact's, bound inside `eval_step`, so the slot is None."""
+        return self.eval_step, None
+
     def evaluate(self):
         """Score the artifact on config.test_data_path with the
         reference-definition metrics (the facade `--test` surface for a

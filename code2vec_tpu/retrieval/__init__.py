@@ -1,0 +1,21 @@
+"""Code-vector retrieval stack: the paper's "vectors are the product"
+workload (code similarity / clone detection / near-duplicate mining)
+built on the serving and release subsystems.
+
+Three pillars (README "Retrieval"):
+
+- `store.py`  — sharded, memmappable vector store written by the batch
+  embedding job (`embed` CLI subcommand, `embed_job.py`): fp32/fp16
+  `(N, code_vector_size)` shards + a method-id sidecar + a manifest
+  recording the embedding model's fingerprint. Resumable per shard.
+- `index.py`  — IVF-flat ANN index built in JAX (`index-build`
+  subcommand): jitted-Lloyd k-means coarse quantizer, inverted lists,
+  queries scored by one batched matmul over the probed lists with the
+  blockwise top-k merge from ops/topk; plus a brute-force exact backend
+  (small-corpus fallback and recall ground truth).
+- `api.py`    — the serving mount (`serve --retrieval_index DIR`):
+  POST /neighbors = snippet -> extractor pool -> embed batch -> ANN
+  search, sharing the admission/deadline/breaker/cache machinery, with
+  the model-fingerprint/index-fingerprint agreement enforced on every
+  response so neighbors are never computed in a stale embedding space.
+"""

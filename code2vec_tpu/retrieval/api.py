@@ -1,0 +1,171 @@
+"""Serving mount for the retrieval index: the /neighbors data plane.
+
+`serve --retrieval_index DIR` mounts a built index into the
+PredictionServer. A /neighbors request rides the EXACT /predict
+pipeline — cache probe, admission gate, deadline budget, extractor
+pool behind its breaker, dynamic batcher, device step behind its
+breaker — and only then searches the index with the batch's code
+vectors, so the second traffic class inherits every resilience
+property PR 7/9 built (and its very different batching profile
+exercises them).
+
+Embedding-space safety is the handle's whole job:
+
+- MOUNT: the index's recorded `model_fingerprint` must equal the live
+  model's — a mismatch refuses to mount (startup config error, loud).
+- SWAP: serving/swap.py consults the handle before committing a model
+  hot-swap; policy `refuse` (default) rejects the swap, policy `detach`
+  lets the swap commit but atomically detaches the index (reason in
+  /healthz `retrieval.detach_reason`, `serving_retrieval_detached_total`).
+- SERVE: every /neighbors response re-checks that the fingerprint of the
+  model that actually computed the batch equals the index fingerprint —
+  the airtight last line against any race between the cache probe, the
+  batcher's model-ref read and a concurrent swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from code2vec_tpu import obs
+from code2vec_tpu.retrieval.index import NeighborIndex, load_index
+
+_H_SEARCH = obs.histogram(
+    "retrieval_search_seconds",
+    "ANN search latency per /neighbors batch (device matmul + host "
+    "id resolution)")
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+class EmbeddingSpaceMismatch(RuntimeError):
+    """A /neighbors answer would have crossed embedding spaces (model
+    fingerprint != index fingerprint); maps to 503 — the index needs a
+    rebuild or the model a rollback."""
+
+
+class RetrievalHandle:
+    """The server's handle on one mounted index. `detach()` is
+    one-way and atomic with respect to `require_attached()` readers;
+    a detached handle keeps its status (and the reason) for /healthz."""
+
+    def __init__(self, index: NeighborIndex, default_topk: int = 10):
+        self.index = index
+        self.default_topk = int(default_topk)
+        self._lock = threading.Lock()
+        self._attached = True
+        self._detach_reason: Optional[str] = None
+
+    @classmethod
+    def mount(cls, path: str, model_fingerprint: str,
+              default_topk: int = 10, log=None) -> "RetrievalHandle":
+        """Load + fingerprint-check an index for a live model. Raises
+        IndexArtifactError (named field) on any validation failure,
+        including an embedding-space mismatch."""
+        index = load_index(path, expect_fingerprint=model_fingerprint)
+        if log is not None:
+            log(f"Retrieval index mounted from {path}: "
+                f"{index.rows} rows, backend {index.backend}, "
+                f"nlist {index.nlist}, default nprobe {index.nprobe}, "
+                f"metric {index.metric} (fingerprint "
+                f"{index.fingerprint})")
+        return cls(index, default_topk=default_topk)
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def attached(self) -> bool:
+        with self._lock:
+            return self._attached
+
+    @property
+    def fingerprint(self) -> str:
+        return self.index.fingerprint
+
+    def detach(self, reason: str) -> None:
+        with self._lock:
+            if not self._attached:
+                return
+            self._attached = False
+            self._detach_reason = reason
+        obs.counter("serving_retrieval_detached_total",
+                    "retrieval indexes detached from a live server",
+                    reason="fingerprint_mismatch").inc()
+
+    def status(self) -> dict:
+        with self._lock:
+            attached, reason = self._attached, self._detach_reason
+        return {
+            "status": "attached" if attached else "detached",
+            "detach_reason": reason,
+            "fingerprint": self.index.fingerprint,
+            "path": self.index.path,
+            "backend": self.index.backend,
+            "metric": self.index.metric,
+            "rows": self.index.rows,
+            "nlist": self.index.nlist,
+            "nprobe": self.index.nprobe,
+            "default_topk": self.default_topk,
+        }
+
+    # ----------------------------------------------------------- search
+
+    def require_attached(self) -> None:
+        with self._lock:
+            if not self._attached:
+                raise EmbeddingSpaceMismatch(
+                    f"retrieval index detached: {self._detach_reason}")
+
+    def neighbors(self, code_vectors: np.ndarray, result_fingerprint: str,
+                  k: Optional[int] = None, nprobe: Optional[int] = None
+                  ) -> List[List[dict]]:
+        """Per-query neighbor lists for one batch of code vectors
+        computed by the model identified by `result_fingerprint`. The
+        fingerprint check here is per-RESPONSE: whatever interleaving of
+        cache probe / batcher model-ref read / hot swap produced these
+        vectors, they only turn into neighbors if they came out of the
+        index's own embedding space."""
+        self.require_attached()
+        if result_fingerprint != self.index.fingerprint:
+            raise EmbeddingSpaceMismatch(
+                f"batch was embedded by {result_fingerprint!r} but the "
+                f"index holds vectors from {self.index.fingerprint!r}")
+        # Client-controlled knobs are BUCKETED to powers of two before
+        # they reach the jitted search: NeighborIndex compiles one
+        # function per distinct (k, nprobe) and a client walking
+        # k=1,2,3,... would otherwise force an XLA compile per request
+        # and grow the executable cache without bound — the same
+        # compilation-budget discipline the serving batcher's context
+        # buckets enforce. Results are sliced back to the requested k.
+        k = self.default_topk if k is None else max(1, int(k))
+        k = min(k, self.index.rows)
+        k_eff = min(_pow2_ceil(k), self.index.rows)
+        nprobe_eff = None
+        if nprobe is not None:
+            nprobe_eff = min(_pow2_ceil(max(1, int(nprobe))),
+                             self.index.nlist)
+        t0 = time.perf_counter()
+        pos, scores = self.index.search(
+            np.asarray(code_vectors, dtype=np.float32), k_eff,
+            nprobe=nprobe_eff)
+        dists = self.index.distances(scores)
+        out: List[List[dict]] = []
+        for row_pos, row_scores, row_dists in zip(pos, scores, dists):
+            row = []
+            for p, s, d in zip(row_pos[:k], row_scores[:k],
+                               row_dists[:k]):
+                if p < 0:
+                    continue  # fewer candidates than k in the probed lists
+                row.append({"id": self.index.ids[int(p)],
+                            "store_row": int(self.index.store_rows[int(p)]),
+                            "score": float(s),
+                            "distance": float(d)})
+            out.append(row)
+        _H_SEARCH.observe(time.perf_counter() - t0)
+        return out

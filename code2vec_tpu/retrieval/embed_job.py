@@ -1,0 +1,130 @@
+"""Corpus-scale batch embedding job: packed `.c2vb` corpus -> vector store.
+
+The `embed` CLI subcommand body. Runs an entire packed corpus through
+the model's eval pipeline at device speed — the same fixed-shape jitted
+eval step the Evaluator drives (facade checkpoint via --load, or a PR-8
+release artifact via --artifact: int8 fused-dequant tables + blockwise
+top-k, no checkpoint in RSS) — and writes the code vectors into a
+sharded `retrieval/store.py` vector store whose manifest records the
+embedding model's fingerprint.
+
+Resumable at shard granularity: a killed job restarted with the same
+--embed_out skips every row already inside a committed shard (the eval
+iteration order is deterministic — strided file order, no shuffle — so
+"skip the first `rows_done` valid rows" resumes exactly). Skipped rows
+cost a host-side batch walk, never device work.
+
+Instrumented through obs/: `retrieval_embed_rows_total`,
+`retrieval_embed_seconds{phase=device|assemble}` (device dispatch+wait
+vs host-side fetch/ids/shard-write), `retrieval_embed_rows_per_sec`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from code2vec_tpu import obs
+from code2vec_tpu.data.reader import EstimatorAction
+from code2vec_tpu.retrieval.store import VectorStoreWriter
+from code2vec_tpu.training.step import device_put_batch
+
+_H_PHASE_HELP = ("batch embedding job latency by phase: device (eval "
+                 "step dispatch + wait), assemble (host fetch, id "
+                 "resolution, shard write)")
+
+
+def _phase_hist(phase: str):
+    return obs.histogram("retrieval_embed_seconds", _H_PHASE_HELP,
+                         phase=phase)
+
+
+def run_embed_job(model, corpus_path: Optional[str] = None,
+                  out_dir: Optional[str] = None, log=None) -> dict:
+    """Embed `corpus_path` (default config.test_data_path) with `model`
+    into a vector store at `out_dir` (default config.embed_out).
+    Returns a summary dict {rows, resumed_rows, shards, seconds,
+    rows_per_sec, fingerprint, path}."""
+    config = model.config
+    log = log or config.log
+    corpus = corpus_path or config.test_data_path
+    out = out_dir or config.embed_out
+    if not corpus:
+        raise ValueError("embed needs a corpus: pass --test FILE (the "
+                         "packed .c2vb sits next to it)")
+    if not out:
+        raise ValueError("embed needs --embed_out DIR")
+    fingerprint = model.model_fingerprint()
+    writer = VectorStoreWriter(
+        out, dim=config.code_vector_size, dtype=config.embed_dtype,
+        model_fingerprint=fingerprint, source=corpus,
+        shard_rows=config.embed_shard_rows, log=log)
+    resumed_rows = writer.rows_done
+    if resumed_rows:
+        log(f"Embed job resuming past {resumed_rows} committed row(s)")
+
+    ds = model._packed_dataset(corpus)
+    batch_size = int(config.test_batch_size)
+    eval_step, params = model.eval_callable()
+    target_vocab = model.vocabs.target_vocab
+
+    h_device = _phase_hist("device")
+    h_assemble = _phase_hist("assemble")
+    rows_counter = obs.counter(
+        "retrieval_embed_rows_total",
+        "corpus rows embedded into a vector store")
+    rate_gauge = obs.gauge(
+        "retrieval_embed_rows_per_sec",
+        "last embed job's end-to-end throughput")
+
+    to_skip = resumed_rows
+    written = 0
+    t0 = time.perf_counter()
+    batches = ds.iter_batches(batch_size, EstimatorAction.Evaluate,
+                              with_target_strings=True)
+    for batch in batches:
+        valid = np.asarray(batch.example_valid)
+        n_valid = int(valid.sum())
+        if to_skip >= n_valid:
+            # already inside a committed shard: no device work on resume
+            to_skip -= n_valid
+            continue
+        t_dev = time.perf_counter()
+        arrays = device_put_batch(batch, model.mesh)
+        out_step = eval_step(params, *arrays)
+        code_vectors = out_step.code_vectors
+        jax.block_until_ready(code_vectors)
+        h_device.observe(time.perf_counter() - t_dev)
+
+        t_asm = time.perf_counter()
+        vectors = np.asarray(code_vectors)[valid]
+        if batch.target_strings is not None:
+            ids = [s for s, v in zip(batch.target_strings, valid) if v]
+        else:
+            ids = [target_vocab.lookup_word(int(i))
+                   for i, v in zip(batch.target_index, valid) if v]
+        if to_skip:
+            vectors, ids = vectors[to_skip:], ids[to_skip:]
+            to_skip = 0
+        writer.append(vectors, ids)
+        written += len(ids)
+        rows_counter.inc(len(ids))
+        h_assemble.observe(time.perf_counter() - t_asm)
+
+    manifest = writer.finalize()
+    seconds = time.perf_counter() - t0
+    rows_per_sec = written / max(seconds, 1e-9)
+    rate_gauge.set(rows_per_sec)
+    log(f"Embed job done: {written} row(s) embedded "
+        f"({resumed_rows} resumed) into {len(manifest['shards'])} "
+        f"shard(s) at {out} in {seconds:.1f}s "
+        f"({rows_per_sec:.0f} rows/s, dtype {config.embed_dtype}, "
+        f"fingerprint {fingerprint})")
+    return {"rows": int(manifest["rows"]), "resumed_rows": resumed_rows,
+            "embedded_rows": written,
+            "shards": len(manifest["shards"]), "seconds": seconds,
+            "rows_per_sec": rows_per_sec, "fingerprint": fingerprint,
+            "path": writer.path}

@@ -1,0 +1,472 @@
+"""IVF-flat approximate-nearest-neighbor index over a code-vector store.
+
+Built in JAX end to end (the `index-build` CLI subcommand):
+
+- COARSE QUANTIZER: k-means trained with jitted Lloyd steps — one
+  `fori_loop` of (assign by batched matmul, centroid update by
+  `segment_sum`) compiled once per (rows, dim, nlist) shape. Empty
+  clusters keep their previous centroid.
+- INVERTED LISTS: every vector is assigned to its nearest centroid; the
+  store is re-ordered list-contiguously (CSR layout: `list_offsets`
+  (nlist+1,) + vectors/ids in list order), so probing a list is a
+  contiguous slice.
+- QUERY: centroid scores = one (B, nlist) matmul -> top-nprobe lists per
+  query; candidates gathered from a padded list matrix; candidate scores
+  = one batched matmul over the probed rows; the final top-k runs
+  through `ops/topk.blockwise_top_k_from_logits` — the same blockwise
+  merge the PR-8 prediction head streams the 246K-name classifier with.
+- BRUTE-FORCE BACKEND: `ops/topk.blockwise_matmul_top_k` over the whole
+  store (the vector table never materializes a (B, N) score row) — the
+  small-corpus fallback at build time AND the exact ground truth
+  `measure_recall` scores IVF against. With nprobe = nlist the IVF
+  candidate set is the whole store, so both backends return identical
+  neighbor sets (pinned in tests/test_retrieval.py).
+
+The index artifact directory mirrors the PR-8 release-artifact contract:
+`index_meta.json` is field-validated on load (kind/format/backend/
+metric/dims/dtype, named-field IndexArtifactError) and carries the
+embedding store's `model_fingerprint`, which the serving mount checks
+against the live model so neighbors are never computed across two
+embedding spaces.
+
+Similarity is cosine by default (vectors L2-normalized at build, queries
+at search; score = cosine in [-1, 1], distance = 1 - score) or raw dot
+(`--index_metric dot`; distance = -score).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from code2vec_tpu import obs
+from code2vec_tpu.retrieval.store import VectorStore, _atomic_write_json
+
+INDEX_META_NAME = "index_meta.json"
+INDEX_KIND = "code2vec_ivf_index"
+INDEX_FORMAT = 1
+BACKEND_IVF = "ivf_flat"
+BACKEND_BRUTE = "brute_force"
+METRICS = ("cosine", "dot")
+# Below this row count IVF cannot beat one small matmul: index-build
+# falls back to the brute-force backend (still a valid index artifact).
+MIN_IVF_ROWS = 256
+_TOPK_BLOCK = 4096
+
+
+class IndexArtifactError(ValueError):
+    """Index artifact rejected with the offending field named."""
+
+    def __init__(self, field: str, message: str):
+        super().__init__(f"retrieval index field `{field}`: {message}")
+        self.field = field
+
+
+def _normalize(x: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(x, axis=-1, keepdims=True)
+    return x / np.maximum(norms, 1e-12)
+
+
+# ------------------------------------------------------------------ k-means
+
+def train_kmeans(vectors: np.ndarray, nlist: int, iters: int = 10,
+                 seed: int = 0, spherical: bool = False) -> np.ndarray:
+    """Lloyd k-means over (N, D) f32 vectors; returns (nlist, D) f32
+    centroids. The whole iteration loop is one jitted function — each
+    Lloyd step is an (N, nlist) matmul assign + segment_sum update.
+    `spherical=True` re-normalizes centroids after every update
+    (spherical k-means — the standard coarse quantizer for cosine
+    similarity: unnormalized means drift inward and skew list sizes)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = np.ascontiguousarray(vectors, dtype=np.float32)
+    n = x.shape[0]
+    nlist = int(min(nlist, n))
+    rng = np.random.default_rng(seed)
+    init = x[rng.permutation(n)[:nlist]]
+
+    @partial(jax.jit, static_argnames=("steps", "sph"))
+    def lloyd(xd, c0, steps, sph):
+        def body(_, c):
+            assign = _assign_jax(xd, c)
+            ones = jnp.ones((xd.shape[0],), jnp.float32)
+            sums = jax.ops.segment_sum(xd, assign,
+                                       num_segments=c.shape[0])
+            counts = jax.ops.segment_sum(ones, assign,
+                                         num_segments=c.shape[0])
+            fresh = sums / jnp.maximum(counts, 1.0)[:, None]
+            if sph:
+                fresh = fresh / jnp.maximum(
+                    jnp.linalg.norm(fresh, axis=1, keepdims=True), 1e-12)
+            # empty cluster: keep the old centroid (it can re-acquire
+            # members on a later step; dropping it would shrink nlist)
+            return jnp.where((counts > 0)[:, None], fresh, c)
+        return jax.lax.fori_loop(0, steps, body, c0)
+
+    return np.asarray(lloyd(jnp.asarray(x), jnp.asarray(init),
+                            steps=int(iters), sph=bool(spherical)))
+
+
+def _assign_jax(x, c):
+    """Nearest centroid per row by L2: argmin(|x-c|^2) == argmin over
+    (|c|^2 - 2 x.c) since |x|^2 is constant per row."""
+    import jax.numpy as jnp
+    d = (c * c).sum(axis=1)[None, :] - 2.0 * x @ c.T
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+
+def assign_lists(vectors: np.ndarray, centroids: np.ndarray) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+    fn = jax.jit(_assign_jax)
+    return np.asarray(fn(jnp.asarray(vectors, dtype=jnp.float32),
+                         jnp.asarray(centroids)))
+
+
+# -------------------------------------------------------------------- build
+
+def build_index(store_dir: str, out_dir: str, nlist: int = 0,
+                nprobe: int = 8, kmeans_iters: int = 10, seed: int = 0,
+                metric: str = "cosine", log=None) -> dict:
+    """Build an index artifact at `out_dir` from the vector store at
+    `store_dir`; returns the index meta dict."""
+    log = log or print
+    if metric not in METRICS:
+        raise IndexArtifactError("metric",
+                                 f"must be one of {METRICS}, got {metric!r}")
+    store = VectorStore.open(store_dir)
+    n = store.rows
+    if n == 0:
+        raise IndexArtifactError("rows", f"vector store {store_dir} is "
+                                         f"empty; nothing to index")
+    x = store.load(np.float32)
+    if metric == "cosine":
+        x = _normalize(x)
+    if nlist <= 0:
+        nlist = max(1, int(math.isqrt(n)))
+    nlist = min(nlist, n)
+    backend = BACKEND_IVF if (n >= MIN_IVF_ROWS and nlist > 1) \
+        else BACKEND_BRUTE
+
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    store_order: np.ndarray
+    if backend == BACKEND_IVF:
+        centroids = train_kmeans(x, nlist, iters=kmeans_iters, seed=seed,
+                                 spherical=(metric == "cosine"))
+        nlist = centroids.shape[0]
+        assign = assign_lists(x, centroids)
+        # stable sort: within a list, rows keep store order — ties in
+        # the scored matmul then resolve identically run to run
+        store_order = np.argsort(assign, kind="stable").astype(np.int64)
+        counts = np.bincount(assign, minlength=nlist)
+        offsets = np.zeros(nlist + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        np.save(os.path.join(out_dir, "centroids.npy"),
+                centroids.astype(np.float32))
+        np.save(os.path.join(out_dir, "list_offsets.npy"), offsets)
+    else:
+        nlist = 1
+        store_order = np.arange(n, dtype=np.int64)
+    # vectors re-ordered list-contiguously, persisted in the STORE's
+    # dtype (fp16 stays fp16 on disk; search computes in f32). `x` is
+    # already the f32 (cosine: normalized) matrix loaded above — reuse
+    # it instead of a second store.load() walk over the shards.
+    ordered = x[store_order].astype(np.dtype(store.dtype))
+    np.save(os.path.join(out_dir, "vectors.npy"), ordered)
+    ids = store.ids
+    with open(os.path.join(out_dir, "ids.txt.tmp"), "w") as f:
+        for row in store_order:
+            f.write(ids[int(row)] + "\n")
+    os.replace(os.path.join(out_dir, "ids.txt.tmp"),
+               os.path.join(out_dir, "ids.txt"))
+    np.save(os.path.join(out_dir, "store_rows.npy"), store_order)
+
+    nprobe = max(1, min(int(nprobe), nlist))
+    meta = {
+        "kind": INDEX_KIND,
+        "format": INDEX_FORMAT,
+        "backend": backend,
+        "metric": metric,
+        "dim": store.dim,
+        "dtype": store.dtype,
+        "rows": n,
+        "nlist": int(nlist),
+        "nprobe": nprobe,
+        "kmeans_iters": int(kmeans_iters),
+        "seed": int(seed),
+        "model_fingerprint": store.fingerprint,
+        "source_store": store.path,
+        "build_seconds": round(time.perf_counter() - t0, 3),
+    }
+    # meta last: a kill mid-build leaves a directory load_index rejects
+    # (missing meta) instead of a torn index that loads
+    _atomic_write_json(os.path.join(out_dir, INDEX_META_NAME), meta)
+    log(f"Built {backend} index at {out_dir}: {n} rows, dim {store.dim}, "
+        f"nlist {nlist}, default nprobe {nprobe}, metric {metric}, "
+        f"{meta['build_seconds']}s (fingerprint {store.fingerprint})")
+    return meta
+
+
+# --------------------------------------------------------------------- load
+
+class NeighborIndex:
+    """Loaded, validated index artifact with a `search` surface shared
+    by both backends. Thread-safe for concurrent searches (all state is
+    read-only after load; jit caches are internally locked by jax)."""
+
+    def __init__(self, path: str, meta: dict, vectors: np.ndarray,
+                 ids: List[str], store_rows: np.ndarray,
+                 centroids: Optional[np.ndarray],
+                 offsets: Optional[np.ndarray]):
+        self.path = path
+        self.meta = meta
+        self.ids = ids
+        self.store_rows = store_rows
+        self.backend = meta["backend"]
+        self.metric = meta["metric"]
+        self.dim = int(meta["dim"])
+        self.rows = int(meta["rows"])
+        self.nlist = int(meta["nlist"])
+        self.nprobe = int(meta["nprobe"])
+        self.fingerprint = str(meta["model_fingerprint"])
+        import jax.numpy as jnp
+        self._vectors = jnp.asarray(np.asarray(vectors, dtype=np.float32))
+        self._centroids = (None if centroids is None
+                           else jnp.asarray(centroids))
+        self._offsets = offsets
+        self._list_pad: Optional[np.ndarray] = None
+        self._search_fns: dict = {}
+
+    # ------------------------------------------------------- candidates
+
+    def _padded_lists(self):
+        """(nlist, max_list_len) DEVICE matrix of member positions, -1
+        padded — built lazily once; turns 'gather nprobe ragged lists'
+        into one fixed-shape take. Cached as a device array like
+        `_vectors`: with skewed lists it is O(rows) bytes, and
+        re-transferring it per search would tax every /neighbors
+        batch."""
+        if self._list_pad is None:
+            import jax.numpy as jnp
+            lens = np.diff(self._offsets)
+            maxlen = max(int(lens.max()), 1)
+            pad = np.full((self.nlist, maxlen), -1, dtype=np.int32)
+            for i in range(self.nlist):
+                lo, hi = int(self._offsets[i]), int(self._offsets[i + 1])
+                pad[i, :hi - lo] = np.arange(lo, hi, dtype=np.int32)
+            self._list_pad = jnp.asarray(pad)
+        return self._list_pad
+
+    # ------------------------------------------------------------ search
+
+    def search(self, queries: np.ndarray, k: int,
+               nprobe: Optional[int] = None, exact: bool = False
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Top-k neighbors of (B, dim) query vectors.
+
+        Returns (positions, scores): positions (B, k) int32 into
+        `self.ids`/`self.store_rows` (-1 where fewer than k candidates
+        exist), scores (B, k) f32 descending (cosine or dot per the
+        index metric). `exact=True` forces the brute-force path — the
+        recall ground truth."""
+        import jax.numpy as jnp
+        q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+        if q.shape[1] != self.dim:
+            raise ValueError(f"query dim {q.shape[1]} != index dim "
+                             f"{self.dim}")
+        if self.metric == "cosine":
+            q = _normalize(q)
+        k = max(1, min(int(k), self.rows))
+        if exact or self.backend == BACKEND_BRUTE:
+            backend = "brute"
+            vals, pos = self._search_brute(jnp.asarray(q), k)
+        else:
+            backend = "ivf"
+            np_probe = self.nprobe if nprobe is None else \
+                max(1, min(int(nprobe), self.nlist))
+            vals, pos = self._search_ivf(jnp.asarray(q), k, np_probe)
+        obs.counter("retrieval_searches_total",
+                    "ANN searches by backend",
+                    backend=backend).inc()
+        vals = np.asarray(vals)
+        pos = np.asarray(pos)
+        # candidate shortfall (tiny probed set) surfaces as -inf scores;
+        # normalize to position -1 so callers need no score sentinel
+        pos = np.where(np.isfinite(vals), pos, -1).astype(np.int32)
+        return pos, vals
+
+    def _search_brute(self, q, k: int):
+        from code2vec_tpu.ops.topk import blockwise_matmul_top_k
+        fn = self._search_fns.get(("brute", k))
+        if fn is None:
+            import jax
+
+            def brute(qd, table):
+                out = blockwise_matmul_top_k(
+                    qd, table, k, min(_TOPK_BLOCK, table.shape[0]))
+                return out.values, out.indices
+            fn = self._search_fns[("brute", k)] = jax.jit(brute)
+        return fn(q, self._vectors)
+
+    def _search_ivf(self, q, k: int, nprobe: int):
+        import jax
+        import jax.numpy as jnp
+        from code2vec_tpu.ops.topk import blockwise_top_k_from_logits
+        pad = self._padded_lists()
+        fn = self._search_fns.get(("ivf", k, nprobe, int(pad.shape[1])))
+        if fn is None:
+            def ivf(qd, table, centroids, list_pad):
+                # one (B, nlist) matmul picks the probed lists per query
+                cscores = qd @ centroids.T
+                _, probe = jax.lax.top_k(cscores, nprobe)
+                # (B, nprobe * maxlen) candidate positions, -1 padded
+                cand = list_pad[probe].reshape(qd.shape[0], -1)
+                live = cand >= 0
+                rows = table[jnp.maximum(cand, 0)]          # (B, P, D)
+                scores = jnp.einsum("bd,bpd->bp", qd, rows)
+                scores = jnp.where(live, scores, -jnp.inf)
+                kk = min(k, scores.shape[1])
+                vals, pos = blockwise_top_k_from_logits(
+                    scores, kk, _TOPK_BLOCK)
+                idx = jnp.take_along_axis(cand, pos, axis=1)
+                if kk < k:  # fewer candidates than k: pad the result
+                    padw = k - kk
+                    vals = jnp.pad(vals, ((0, 0), (0, padw)),
+                                   constant_values=-jnp.inf)
+                    idx = jnp.pad(idx, ((0, 0), (0, padw)),
+                                  constant_values=-1)
+                return vals, idx
+            fn = self._search_fns[("ivf", k, nprobe, int(pad.shape[1]))] \
+                = jax.jit(ivf)
+        return fn(q, self._vectors, self._centroids, pad)
+
+    def distances(self, scores: np.ndarray) -> np.ndarray:
+        """Metric-appropriate distance of a score array: 1 - cosine, or
+        -dot. -inf scores (missing candidates) map to +inf distance."""
+        with np.errstate(invalid="ignore"):
+            d = (1.0 - scores) if self.metric == "cosine" else -scores
+        return np.where(np.isfinite(scores), d, np.inf)
+
+
+def load_index(path: str,
+               expect_fingerprint: Optional[str] = None) -> NeighborIndex:
+    base = os.path.abspath(path)
+    meta_path = os.path.join(base, INDEX_META_NAME)
+    if not os.path.isfile(meta_path):
+        raise IndexArtifactError(
+            "kind", f"{base} is not a retrieval index ({INDEX_META_NAME} "
+                    f"missing); indexes are built by the `index-build` "
+                    f"subcommand")
+    with open(meta_path) as f:
+        try:
+            meta = json.load(f)
+        except json.JSONDecodeError as e:
+            raise IndexArtifactError("kind",
+                                     f"unparseable {INDEX_META_NAME}: {e}")
+    if meta.get("kind") != INDEX_KIND:
+        raise IndexArtifactError("kind", f"expected {INDEX_KIND!r}, got "
+                                         f"{meta.get('kind')!r}")
+    if int(meta.get("format", -1)) > INDEX_FORMAT:
+        raise IndexArtifactError(
+            "format", f"index format {meta.get('format')} is newer than "
+                      f"this build understands (<= {INDEX_FORMAT})")
+    for field in ("backend", "metric", "dim", "dtype", "rows", "nlist",
+                  "nprobe", "model_fingerprint"):
+        if field not in meta:
+            raise IndexArtifactError(
+                field, f"missing from {INDEX_META_NAME} (torn build?)")
+    if meta["backend"] not in (BACKEND_IVF, BACKEND_BRUTE):
+        raise IndexArtifactError("backend",
+                                 f"unknown backend {meta['backend']!r}")
+    if meta["metric"] not in METRICS:
+        raise IndexArtifactError("metric",
+                                 f"unknown metric {meta['metric']!r}")
+    if expect_fingerprint is not None and \
+            meta["model_fingerprint"] != expect_fingerprint:
+        raise IndexArtifactError(
+            "model_fingerprint",
+            f"index was built over vectors from "
+            f"{meta['model_fingerprint']!r} but the serving model is "
+            f"{expect_fingerprint!r} — refusing to answer /neighbors "
+            f"across embedding spaces")
+    rows, dim = int(meta["rows"]), int(meta["dim"])
+    vec_path = os.path.join(base, "vectors.npy")
+    if not os.path.isfile(vec_path):
+        raise IndexArtifactError("vectors", "vectors.npy missing")
+    vectors = np.load(vec_path, mmap_mode="r")
+    if tuple(vectors.shape) != (rows, dim):
+        raise IndexArtifactError(
+            "vectors.shape", f"expected ({rows}, {dim}) per meta, file "
+                             f"holds {tuple(vectors.shape)}")
+    if vectors.dtype != np.dtype(meta["dtype"]):
+        raise IndexArtifactError(
+            "vectors.dtype", f"expected {meta['dtype']} per meta, file "
+                             f"holds {vectors.dtype}")
+    ids_path = os.path.join(base, "ids.txt")
+    if not os.path.isfile(ids_path):
+        raise IndexArtifactError("ids", "ids.txt missing")
+    with open(ids_path) as f:
+        ids = f.read().splitlines()
+    if len(ids) != rows:
+        raise IndexArtifactError(
+            "ids", f"{len(ids)} ids for {rows} vectors (torn sidecar)")
+    store_rows_path = os.path.join(base, "store_rows.npy")
+    if not os.path.isfile(store_rows_path):
+        raise IndexArtifactError("store_rows", "store_rows.npy missing")
+    store_rows = np.load(store_rows_path)
+    if store_rows.shape != (rows,):
+        raise IndexArtifactError(
+            "store_rows.shape", f"expected ({rows},), file holds "
+                                f"{tuple(store_rows.shape)}")
+    centroids = offsets = None
+    if meta["backend"] == BACKEND_IVF:
+        cpath = os.path.join(base, "centroids.npy")
+        opath = os.path.join(base, "list_offsets.npy")
+        if not os.path.isfile(cpath):
+            raise IndexArtifactError("centroids", "centroids.npy missing")
+        if not os.path.isfile(opath):
+            raise IndexArtifactError("list_offsets",
+                                     "list_offsets.npy missing")
+        centroids = np.load(cpath)
+        nlist = int(meta["nlist"])
+        if tuple(centroids.shape) != (nlist, dim):
+            raise IndexArtifactError(
+                "centroids.shape", f"expected ({nlist}, {dim}), file "
+                                   f"holds {tuple(centroids.shape)}")
+        offsets = np.load(opath)
+        if offsets.shape != (nlist + 1,) or int(offsets[-1]) != rows:
+            raise IndexArtifactError(
+                "list_offsets",
+                f"expected ({nlist + 1},) ending at {rows}, file holds "
+                f"{tuple(offsets.shape)} ending at "
+                f"{int(offsets[-1]) if len(offsets) else 'nothing'}")
+    obs.gauge("retrieval_index_rows",
+              "rows in the mounted/loaded retrieval index").set(rows)
+    return NeighborIndex(base, meta, np.asarray(vectors), ids,
+                         store_rows, centroids, offsets)
+
+
+def measure_recall(index: NeighborIndex, queries: np.ndarray, k: int,
+                   nprobe: Optional[int] = None) -> float:
+    """recall@k of the index's ANN path against its own brute-force
+    exact ground truth: |ANN ∩ exact| / (|queries| * k), neighbor
+    identity by position set."""
+    approx_pos, _ = index.search(queries, k, nprobe=nprobe)
+    exact_pos, _ = index.search(queries, k, exact=True)
+    hits = 0
+    total = 0
+    for a, e in zip(approx_pos, exact_pos):
+        truth = set(int(i) for i in e if i >= 0)
+        if not truth:
+            continue
+        hits += len(truth & set(int(i) for i in a if i >= 0))
+        total += len(truth)
+    return hits / max(total, 1)

@@ -15,6 +15,15 @@ Endpoints (JSON unless noted; schema in README "Serving"):
   when the model was created with --export_code_vectors).
 - `POST /embed`    same input; code vectors only (forces them on
   regardless of --export_code_vectors — the embedding IS the product).
+  Carries `embedding_fingerprint` so clients can detect cross-model
+  vector mixing (the same field `/neighbors` stamps).
+- `POST /neighbors`  same input; nearest stored methods per input
+  method via the mounted retrieval index (`serve --retrieval_index
+  DIR`): snippet -> extractor pool -> embed batch -> ANN search ->
+  method ids + scores + distances. JSON bodies may add `"k"` /
+  `"nprobe"` knobs. Requires the index fingerprint to match the
+  weights that embedded the batch — never answers across embedding
+  spaces (503 instead).
 - `POST /admin/reload`  `{"artifact": DIR}` — health-gated live model
   hot-swap (serving/swap.py): loads + validates off the request path,
   then swaps the model reference between batches. 202 accepted; poll
@@ -68,6 +77,8 @@ import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Dict, Optional, Tuple
+
+import numpy as np
 
 from code2vec_tpu import obs
 from code2vec_tpu.serving.admission import (
@@ -156,6 +167,17 @@ class PredictionServer:
             buckets=model.context_buckets)
         self.cache = PredictionCache(self.config.serve_cache_entries)
         self.topk = self.config.top_k_words_considered_during_prediction
+        # Retrieval mount (serve --retrieval_index DIR): /neighbors
+        # serves ANN code search from this index. Mounting validates the
+        # index artifact AND that its recorded embedding fingerprint is
+        # the live model's — a stale index is a startup error, loud.
+        self.retrieval = None
+        if getattr(self.config, "retrieval_index", None):
+            from code2vec_tpu.retrieval.api import RetrievalHandle
+            self.retrieval = RetrievalHandle.mount(
+                self.config.retrieval_index, self._model_ref[1],
+                default_topk=getattr(self.config, "retrieval_topk", 10),
+                log=self.log)
         self.admission = AdmissionController(
             max_depth=self.config.serve_queue_depth,
             concurrency=self.config.extractor_pool_size)
@@ -200,6 +222,22 @@ class PredictionServer:
             # model's bucket grid (and fresh device-time samples — p95s
             # keyed to the old grid would misprice every refusal)
             self.batcher.rebucket(new_model.context_buckets)
+            # Embedding-space backstop, atomic with the flip: a mounted
+            # index whose vectors came from different weights must never
+            # answer /neighbors again (the SwapManager's `refuse` policy
+            # normally rejects such a swap before it gets here; under
+            # `detach` — or any future caller bypassing validation —
+            # this is what keeps the invariant).
+            if (self.retrieval is not None and self.retrieval.attached
+                    and self.retrieval.fingerprint != fp):
+                self.retrieval.detach(
+                    f"model hot-swapped to fingerprint {fp}, index "
+                    f"holds vectors from "
+                    f"{self.retrieval.fingerprint}; rebuild the index "
+                    f"(embed + index-build) against the new model")
+                self.log("Retrieval index DETACHED on hot-swap: "
+                         "embedding fingerprints diverged; /neighbors "
+                         "now answers 503 (see /healthz retrieval)")
         return fp
 
     def _batched_predict(self, lines):
@@ -222,7 +260,8 @@ class PredictionServer:
     # ---------------------------------------------------------- predict
 
     def handle_request(self, endpoint: str, code: str,
-                       deadline: Optional[Deadline] = None
+                       deadline: Optional[Deadline] = None,
+                       params: Optional[Dict] = None
                        ) -> Tuple[int, bytes, Dict[str, str]]:
         """Full serve path for one request -> (http_status, body,
         extra_headers). EVERY terminal status lands in
@@ -233,7 +272,8 @@ class PredictionServer:
         phases: Dict[str, float] = {}
         status, body, headers = 500, b"", {}
         try:
-            body = self._handle(endpoint, code, deadline, phases)
+            body = self._handle(endpoint, code, deadline, phases,
+                                params=params)
             status = 200
         except Shed as e:
             e.count()
@@ -269,14 +309,42 @@ class PredictionServer:
             _requests_counter(endpoint, str(status)).inc()
         return status, body, headers
 
+    def _neighbor_knobs(self, params: Optional[Dict]) -> Dict:
+        """Per-request retrieval knobs (JSON body `k`/`nprobe`),
+        defaulted and clamped; part of the cache key — a different k or
+        nprobe is a different answer."""
+        params = params or {}
+        try:
+            k = int(params.get("k", self.retrieval.default_topk))
+            nprobe = params.get("nprobe")
+            nprobe = None if nprobe is None else int(nprobe)
+        except (TypeError, ValueError):
+            raise _HTTPError(400, "k and nprobe must be integers")
+        if k < 1 or (nprobe is not None and nprobe < 1):
+            raise _HTTPError(400, "k and nprobe must be >= 1")
+        return {"k": k, "nprobe": nprobe}
+
     def _handle(self, endpoint: str, code: str,
                 deadline: Optional[Deadline],
-                phases: Dict[str, float]) -> bytes:
+                phases: Dict[str, float],
+                params: Optional[Dict] = None) -> bytes:
         if not code.strip():
             raise _HTTPError(400, "empty request body")
+        knobs: Dict = {}
+        if endpoint == "neighbors":
+            if self.retrieval is None:
+                raise _HTTPError(
+                    404, "no retrieval index mounted; start the server "
+                         "with serve --retrieval_index DIR")
+            try:
+                self.retrieval.require_attached()
+            except Exception as e:
+                raise _HTTPError(503, str(e))
+            knobs = self._neighbor_knobs(params)
+            knobs["index"] = self.retrieval.fingerprint
         model, fp = self._model_ref
         key = cache_key(code, endpoint=endpoint, topk=self.topk,
-                        model=fp)
+                        model=fp, **knobs)
         cached = self.cache.get(key)
         if cached is not None:
             # Cache hits serve BEFORE admission and breakers: graceful
@@ -311,13 +379,14 @@ class PredictionServer:
             result_fp = raw[0][1] if raw else fp
             body = json.dumps(
                 self._render(endpoint, results, hash_to_string,
-                             result_fp), sort_keys=True).encode() + b"\n"
+                             result_fp, knobs=knobs),
+                sort_keys=True).encode() + b"\n"
             if result_fp != fp:
                 # the model was hot-swapped between our cache probe and
                 # the device batch: key the entry by the weights that
                 # actually computed it, never the stale fingerprint
                 key = cache_key(code, endpoint=endpoint, topk=self.topk,
-                                model=result_fp)
+                                model=result_fp, **knobs)
             self.cache.put(key, body)
             return body
         except Shed:
@@ -379,15 +448,64 @@ class PredictionServer:
         return result
 
     def _render(self, endpoint: str, raw, hash_to_string,
-                fingerprint: str) -> dict:
+                fingerprint: str, knobs: Optional[Dict] = None) -> dict:
         if endpoint == "embed":
+            # embedding_fingerprint is the embedding-SPACE identity —
+            # the same field /neighbors stamps — so a client holding
+            # vectors from two /embed calls (or an offline store) can
+            # detect cross-model vector mixing before cosine math lies
+            # to it.
             return {"model": "code2vec_tpu",
                     "model_fingerprint": fingerprint,
+                    "embedding_fingerprint": fingerprint,
                     "vectors": [
                         ([] if r.code_vector is None
                          else [float(v) for v in r.code_vector])
                         for r in raw],
                     "method_names": [r.original_name for r in raw]}
+        if endpoint == "neighbors":
+            from code2vec_tpu.retrieval.api import EmbeddingSpaceMismatch
+            knobs = knobs or {}
+            k = knobs.get("k") or self.retrieval.default_topk
+            nprobe = knobs.get("nprobe")
+            if not raw:
+                # zero extracted methods (an empty class, an interface):
+                # an empty answer, not a search over a (0, ?) batch
+                return {"model": "code2vec_tpu",
+                        "model_fingerprint": fingerprint,
+                        "embedding_fingerprint":
+                            self.retrieval.index.fingerprint,
+                        "index": {"rows": self.retrieval.index.rows,
+                                  "backend": self.retrieval.index.backend,
+                                  "metric": self.retrieval.index.metric,
+                                  "k": k,
+                                  "nprobe": (self.retrieval.index.nprobe
+                                             if nprobe is None
+                                             else nprobe)},
+                        "methods": []}
+            vectors = np.asarray(
+                [r.code_vector for r in raw], dtype=np.float32)
+            try:
+                neighbor_lists = self.retrieval.neighbors(
+                    vectors, fingerprint, k=k, nprobe=nprobe)
+            except EmbeddingSpaceMismatch as e:
+                raise _HTTPError(503, str(e))
+            return {
+                "model": "code2vec_tpu",
+                "model_fingerprint": fingerprint,
+                "embedding_fingerprint":
+                    self.retrieval.index.fingerprint,
+                "index": {"rows": self.retrieval.index.rows,
+                          "backend": self.retrieval.index.backend,
+                          "metric": self.retrieval.index.metric,
+                          "k": k,
+                          "nprobe": (self.retrieval.index.nprobe
+                                     if nprobe is None else nprobe)},
+                "methods": [
+                    {"original_name": r.original_name,
+                     "neighbors": neighbors}
+                    for r, neighbors in zip(raw, neighbor_lists)],
+            }
         oov = self.model.vocabs.target_vocab.special_words.oov
         methods = []
         for r, parsed in zip(raw, parse_prediction_results(
@@ -409,10 +527,11 @@ class PredictionServer:
                 "model_fingerprint": fingerprint, "methods": methods}
 
     def handle(self, endpoint: str, code: str,
-               deadline: Optional[Deadline] = None) -> bytes:
+               deadline: Optional[Deadline] = None,
+               params: Optional[Dict] = None) -> bytes:
         """Body-or-raise convenience used by in-process callers; HTTP
         goes through handle_request (which owns the SLO accounting)."""
-        return self._handle(endpoint, code, deadline, {})
+        return self._handle(endpoint, code, deadline, {}, params=params)
 
     def handle_embed(self, code: str) -> bytes:
         return self.handle("embed", code)
@@ -453,6 +572,10 @@ class PredictionServer:
             },
             "breakers": {"extractor": self.extractor_breaker.state,
                          "device": self.device_breaker.state},
+            # /neighbors data plane: attached/detached (+ the detach
+            # reason — deploy tooling reads this after a hot-swap)
+            "retrieval": (None if self.retrieval is None
+                          else self.retrieval.status()),
             "buckets": list(model.context_buckets),
             # compiled shapes AT THE SERVE BATCH SIZE — the serving
             # compilation budget, bounded by len(buckets). (An offline
@@ -534,7 +657,7 @@ class PredictionServer:
                 if path == "/admin/reload":
                     self._admin_reload()
                     return
-                if endpoint not in ("predict", "embed"):
+                if endpoint not in ("predict", "embed", "neighbors"):
                     self._error(404, f"no such endpoint: {path}")
                     return
                 deadline = deadline_from_request(
@@ -550,13 +673,14 @@ class PredictionServer:
                         length = int(self.headers.get(
                             "Content-Length", 0))
                         raw = self.rfile.read(length)
-                        code_text = server._decode_body(raw, self.headers)
+                        code_text, params = server._decode_body(
+                            raw, self.headers)
                     except _HTTPError as e:
                         _requests_counter(endpoint, str(e.code)).inc()
                         self._error(e.code, str(e))
                         return
                     status, body, headers = server.handle_request(
-                        endpoint, code_text, deadline)
+                        endpoint, code_text, deadline, params=params)
                     self._respond(status, body, extra_headers=headers)
                 finally:
                     server._exit_request()
@@ -622,7 +746,10 @@ class PredictionServer:
         return self.port
 
     @staticmethod
-    def _decode_body(raw: bytes, headers) -> str:
+    def _decode_body(raw: bytes, headers) -> Tuple[str, Optional[Dict]]:
+        """(code, extra params). JSON bodies may carry per-request
+        knobs beside "code" (today: /neighbors' `k` and `nprobe`);
+        plain-text bodies have none."""
         text = raw.decode("utf-8", errors="replace")
         ctype = (headers.get("Content-Type") or "").split(";")[0].strip()
         if ctype == "application/json":
@@ -632,8 +759,10 @@ class PredictionServer:
                 raise _HTTPError(400, f"bad JSON body: {e}")
             if not isinstance(payload, dict) or "code" not in payload:
                 raise _HTTPError(400, 'JSON body must be {"code": "..."}')
-            return str(payload["code"])
-        return text
+            params = {k: v for k, v in payload.items()
+                      if k in ("k", "nprobe")}
+            return str(payload["code"]), (params or None)
+        return text, None
 
     def _enter_request(self) -> bool:
         with self._inflight_cond:

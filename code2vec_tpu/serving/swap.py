@@ -146,8 +146,7 @@ class SwapManager:
         self.log(f"Model swapped live to {artifact_dir} "
                  f"(fingerprint {fp})")
 
-    @staticmethod
-    def _validate(old_model, new_model) -> None:
+    def _validate(self, old_model, new_model) -> None:
         """Golden-prediction smoke batch: the new model must produce the
         same OUTPUT SCHEMA the running one does — same top-k width (a
         narrower k would silently truncate every client's list), same
@@ -166,3 +165,32 @@ class SwapManager:
                     f"{new[field]} vs running model's {old[field]} — "
                     f"clients depend on the running schema; re-export "
                     f"the artifact to match or deploy as a new service")
+        self._validate_retrieval(new_model)
+
+    def _validate_retrieval(self, new_model) -> None:
+        """Embedding-space gate for a mounted retrieval index: a swap to
+        weights whose vectors the index does not hold would have
+        /neighbors comparing apples to oranges. Policy `refuse`
+        (default) rejects the swap — the index is part of the serving
+        contract, deploy a matching one first; policy `detach` lets the
+        weights swap and PredictionServer.swap_model detaches the index
+        atomically with the flip (reason in /healthz retrieval)."""
+        r = getattr(self.server, "retrieval", None)
+        if r is None or not r.attached:
+            return
+        new_fp = new_model.model_fingerprint()
+        if new_fp == r.fingerprint:
+            return
+        policy = getattr(self.config, "retrieval_swap_policy", "refuse")
+        if policy == "refuse":
+            raise SwapError(
+                f"mounted retrieval index holds vectors from "
+                f"{r.fingerprint!r}; swapping to {new_fp!r} would serve "
+                f"/neighbors from a stale embedding space. Rebuild the "
+                f"index against the new model (embed + index-build) and "
+                f"restart with it, or run with "
+                f"--retrieval_swap_policy detach to trade /neighbors "
+                f"availability for the swap")
+        self.log(f"Swap to {new_fp} diverges from the mounted retrieval "
+                 f"index ({r.fingerprint}); policy=detach — the index "
+                 f"will detach when the swap commits")

@@ -33,6 +33,10 @@ ELASTIC_BUDGET=1200
 # supervised-replica SIGKILL / stale-heartbeat subprocess drills (fake
 # model children — fast to spawn, so the budget covers hangs, not work).
 SERVING_BUDGET=600
+# Retrieval stack: store/index round-trips, embed-job resume, and the
+# /neighbors + hot-swap embedding-space drills (tiny in-process models
+# + the scripted fake extractor).
+RETRIEVAL_BUDGET=600
 
 rc=0
 
@@ -56,6 +60,7 @@ run_suite "$MULTI_HOST_BUDGET" tests/test_multihost_chaos.py \
     tests/test_multiprocess.py "$@"
 run_suite "$ELASTIC_BUDGET" tests/test_elastic_resume.py "$@"
 run_suite "$SERVING_BUDGET" tests/test_serving_chaos.py "$@"
+run_suite "$RETRIEVAL_BUDGET" tests/test_retrieval.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

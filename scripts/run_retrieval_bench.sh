@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Run the retrieval-stack bench with a hard timeout and crash
+# diagnostics, matching scripts/run_chaos.sh conventions.
+#
+# The bench extracts a generated-Java corpus with the real native
+# extractor, runs the batch embedding job, builds the IVF index,
+# measures recall@10 across the nprobe sweep against the brute-force
+# ground truth, and drives POST /neighbors over real HTTP — a hang
+# usually means a wedged extractor child or a stuck serving dispatch,
+# so the run is wall-clock bounded and, on failure, any metrics
+# snapshots the bench left under the run dir are dumped.
+#
+# Usage: scripts/run_retrieval_bench.sh [extra args passed to the bench]
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+RUN_DIR="$(mktemp -d "${TMPDIR:-/tmp}/c2v-retrieval.XXXXXX")"
+LOG="$RUN_DIR/bench.log"
+# The bench exports a Prometheus snapshot here at exit; on failure the
+# dump below surfaces it (embed phase histograms, search latency,
+# serving SLO histograms).
+export C2V_CHAOS_DIAG_DIR="$RUN_DIR"
+
+# Wall-clock backstop: extraction + embed + index + recall sweep +
+# serving load finish in a few minutes on a dev CPU; the timeout
+# catches an extractor/serving hang, not a slow run.
+BUDGET=1800
+
+echo "=== retrieval bench (budget ${BUDGET}s) ==="
+timeout -k 20 "$BUDGET" \
+    env JAX_PLATFORMS=cpu python experiments/retrieval_bench.py "$@" \
+    2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "BENCH TIMED OUT (rc=$rc): likely an extractor/serving hang" \
+        | tee -a "$LOG"
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "=== retrieval bench FAILED (rc=$rc): dumping diagnostics ==="
+    find "$RUN_DIR" -maxdepth 4 -type f \
+        \( -name '*heartbeat*.json' -o -name 'hb*.json' \
+           -o -name '*.prom' -o -name '*metrics*' \) 2>/dev/null \
+        | while read -r f; do
+        echo "--- $f ---"
+        cat "$f"
+        echo
+    done
+    echo "full log: $LOG"
+else
+    rm -rf "$RUN_DIR"
+fi
+exit "$rc"

@@ -87,8 +87,9 @@ def test_train_eval_save_load_predict(tmp_path, use_packed):
     assert int(header[1]) == config.token_embeddings_size
 
     # load into a fresh model and check eval matches; also exercise the
-    # code-vector export (reference: tensorflow_model.py:138-139 writes
-    # <test>.vectors, one space-separated vector per evaluated example)
+    # code-vector export — by default the sharded retrieval store
+    # format (retrieval/store.py; --vectors_text restores the
+    # reference's text layout, pinned in tests/test_retrieval.py)
     load_config = Config(
         model_load_path=save_path,
         test_data_path=prefix + ".val.c2v",
@@ -103,10 +104,12 @@ def test_train_eval_save_load_predict(tmp_path, use_packed):
     np.testing.assert_allclose(results2.topk_acc, results.topk_acc, atol=1e-6)
     vectors_path = load_config.test_data_path + ".vectors"
     assert os.path.exists(vectors_path)
-    rows = open(vectors_path).read().splitlines()
-    assert len(rows) == load_config.num_test_examples
-    assert all(len(r.split()) == 3 * load_config.token_embeddings_size
-               for r in rows)
+    from code2vec_tpu.retrieval.store import VectorStore
+    store = VectorStore.open(vectors_path)
+    assert store.rows == load_config.num_test_examples
+    assert store.dim == 3 * load_config.token_embeddings_size
+    assert store.fingerprint == loaded.model_fingerprint()
+    assert np.isfinite(store.load()).all()
 
     # predict on a raw line (no filtering)
     line = "unknownname tok0,path0,tok0 tok1,path1,tok1" + " " * 6

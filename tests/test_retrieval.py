@@ -1,0 +1,723 @@
+"""Retrieval-stack tests: vector store, batch embed job, IVF/brute ANN
+index, /neighbors serving, embedding-space fingerprint safety.
+
+Store/index artifacts get the PR-8 treatment (round-trip + named-field
+rejection matrix); the IVF index is scored for recall@k against its own
+brute-force ground truth on a synthetic clustered corpus and pinned
+EXACT (identical neighbor sets) at nprobe = nlist; the serving tests
+drive POST /neighbors end to end over the scripted fake extractor from
+test_serving and pin that a fingerprint-mismatched hot-swap can never
+serve neighbors from a stale embedding space (refuse policy) or serves
+them not at all (detach policy).
+"""
+
+import dataclasses
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import obs
+from code2vec_tpu.retrieval.index import (
+    BACKEND_BRUTE, BACKEND_IVF, IndexArtifactError, build_index,
+    load_index, measure_recall, train_kmeans,
+)
+from code2vec_tpu.retrieval.store import (
+    StoreError, VectorStore, VectorStoreWriter,
+)
+
+pytestmark = pytest.mark.retrieval
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _clustered(n_clusters=12, per=40, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_clusters, dim)) * 5.0
+    pts = np.concatenate(
+        [c + rng.normal(size=(per, dim)) * 0.3 for c in centers])
+    return pts.astype(np.float32)
+
+
+def _write_store(path, vectors, fingerprint="fp:test", dtype="float32",
+                 shard_rows=100, ids=None):
+    w = VectorStoreWriter(str(path), dim=vectors.shape[1], dtype=dtype,
+                          model_fingerprint=fingerprint,
+                          shard_rows=shard_rows)
+    w.append(vectors,
+             ids if ids is not None
+             else [f"m{i}" for i in range(len(vectors))])
+    return w.finalize()
+
+
+def _counter_value(name, **labels):
+    fams = obs.default_registry().collect()
+    key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    child = fams.get(name, {}).get(key)
+    return child.value if child is not None else 0.0
+
+
+# ------------------------------------------------------------------ store
+
+
+def test_store_round_trip_across_shard_boundaries(tmp_path):
+    pts = _clustered(n_clusters=3, per=50)  # 150 rows, shard_rows=100
+    manifest = _write_store(tmp_path / "store", pts)
+    assert manifest["rows"] == 150 and len(manifest["shards"]) == 2
+    s = VectorStore.open(str(tmp_path / "store"))
+    assert (s.rows, s.dim, s.dtype) == (150, 16, "float32")
+    assert s.fingerprint == "fp:test"
+    assert s.ids[0] == "m0" and s.ids[-1] == "m149"
+    np.testing.assert_allclose(s.load(), pts)
+    # per-shard memmap view sums to the whole
+    assert sum(sh.shape[0] for sh in s.iter_shards()) == 150
+
+
+def test_store_fp16_halves_bytes_with_bounded_error(tmp_path):
+    pts = _clustered(n_clusters=2, per=30)
+    _write_store(tmp_path / "s16", pts, dtype="float16")
+    s = VectorStore.open(str(tmp_path / "s16"))
+    assert s.dtype == "float16"
+    full = s.load(np.float32)
+    # fp16 has ~3 decimal digits; these values are O(5)
+    np.testing.assert_allclose(full, pts, atol=5e-2)
+    raw = next(iter(s.iter_shards()))
+    assert raw.dtype == np.float16
+
+
+def test_store_rejection_matrix(tmp_path):
+    pts = _clustered(n_clusters=2, per=30)
+    base = tmp_path / "sv"
+    _write_store(base, pts, shard_rows=30)
+
+    def reopen(**kw):
+        return VectorStore.open(str(base), **kw)
+
+    # fingerprint pinning (the consumer names its embedding space)
+    with pytest.raises(StoreError, match="model_fingerprint"):
+        reopen(expect_fingerprint="fp:other")
+    # not-a-store
+    with pytest.raises(StoreError, match="`kind`"):
+        VectorStore.open(str(tmp_path / "nope"))
+    # torn ids sidecar
+    ids_file = base / "shard_00000.ids"
+    good_ids = ids_file.read_text()
+    ids_file.write_text("only_one_line\n")
+    with pytest.raises(StoreError, match="ids.*rows|rows"):
+        reopen()
+    ids_file.write_text(good_ids)
+    reopen()
+    # wrong dtype on disk vs manifest
+    shard = base / "shard_00000.npy"
+    arr = np.load(shard)
+    np.save(shard, arr.astype(np.float16))
+    with pytest.raises(StoreError, match="dtype"):
+        reopen()
+    np.save(shard, arr.astype(np.float32))
+    reopen()
+    # truncated shard
+    np.save(shard, arr[:-3])
+    with pytest.raises(StoreError, match="shape"):
+        reopen()
+    np.save(shard, arr)
+    # manifest field surgery
+    mpath = base / "vector_manifest.json"
+    manifest = json.loads(mpath.read_text())
+    for field, value in (("kind", "garbage"), ("format", 99),
+                         ("complete", False)):
+        doctored = dict(manifest)
+        doctored[field] = value
+        mpath.write_text(json.dumps(doctored))
+        with pytest.raises(StoreError, match=field):
+            reopen()
+    mpath.write_text(json.dumps(manifest))
+    reopen()
+
+
+def test_store_incomplete_readable_only_with_allow_partial(tmp_path):
+    w = VectorStoreWriter(str(tmp_path / "part"), dim=4, dtype="float32",
+                          model_fingerprint="fp:t", shard_rows=5)
+    w.append(np.ones((5, 4), np.float32), [str(i) for i in range(5)])
+    # no finalize: one committed shard, store still "building"
+    with pytest.raises(StoreError, match="complete"):
+        VectorStore.open(str(tmp_path / "part"))
+    s = VectorStore.open(str(tmp_path / "part"), allow_partial=True)
+    assert s.rows == 5
+
+
+def test_writer_resume_keeps_committed_shards(tmp_path):
+    path = str(tmp_path / "res")
+    pts = _clustered(n_clusters=1, per=25, dim=4)  # 25 rows
+    w = VectorStoreWriter(path, dim=4, dtype="float32",
+                          model_fingerprint="fp:r", shard_rows=10)
+    w.append(pts[:23], [f"m{i}" for i in range(23)])
+    # 2 shards committed (20 rows); 3 buffered rows die with the writer
+    assert w.rows_done == 20
+    w2 = VectorStoreWriter(path, dim=4, dtype="float32",
+                           model_fingerprint="fp:r", shard_rows=10)
+    assert w2.rows_done == 20
+    w2.append(pts[20:], [f"m{i}" for i in range(20, 25)])
+    w2.finalize()
+    s = VectorStore.open(path)
+    assert s.rows == 25
+    np.testing.assert_allclose(s.load(), pts)
+    assert s.ids == [f"m{i}" for i in range(25)]
+    # resume must never mix embedding spaces
+    with pytest.raises(StoreError, match="model_fingerprint"):
+        VectorStoreWriter(path, dim=4, dtype="float32",
+                          model_fingerprint="fp:OTHER", shard_rows=10)
+    # and a complete store refuses silent appends
+    with pytest.raises(StoreError, match="complete"):
+        VectorStoreWriter(path, dim=4, dtype="float32",
+                          model_fingerprint="fp:r", shard_rows=10)
+    # resume=False rebuilds from scratch (offline export semantics)
+    w3 = VectorStoreWriter(path, dim=4, dtype="float32",
+                           model_fingerprint="fp:r", shard_rows=10,
+                           resume=False)
+    w3.append(pts[:10], [f"x{i}" for i in range(10)])
+    w3.finalize()
+    assert VectorStore.open(path).rows == 10
+
+
+# ------------------------------------------------------------------ index
+
+
+def test_kmeans_deterministic_and_jitted():
+    pts = _clustered()
+    c1 = train_kmeans(pts, 12, iters=5, seed=3)
+    c2 = train_kmeans(pts, 12, iters=5, seed=3)
+    np.testing.assert_array_equal(c1, c2)
+    assert c1.shape == (12, 16) and np.isfinite(c1).all()
+
+
+def test_ivf_recall_on_clustered_corpus(tmp_path):
+    pts = _clustered(n_clusters=12, per=40)
+    _write_store(tmp_path / "store", pts)
+    meta = build_index(str(tmp_path / "store"), str(tmp_path / "idx"),
+                       nlist=12, nprobe=8, kmeans_iters=8, seed=0,
+                       log=lambda m: None)
+    assert meta["backend"] == BACKEND_IVF
+    idx = load_index(str(tmp_path / "idx"))
+    queries = pts[::17]
+    # the acceptance bar: recall@10 >= 0.95 at the default nprobe
+    assert measure_recall(idx, queries, 10) >= 0.95
+    # identity query: a stored vector's own row is its top-1
+    pos, scores = idx.search(pts[:5], 1)
+    assert [idx.ids[p] for p in pos[:, 0]] == [f"m{i}" for i in range(5)]
+    assert np.all(scores[:, 0] > 0.999)  # cosine of self
+
+
+def test_ivf_equals_brute_force_at_full_probe(tmp_path):
+    """nprobe = nlist probes every inverted list: the candidate set is
+    the whole store and the two backends must return identical neighbor
+    sets — the exactness contract of the acceptance criteria."""
+    pts = _clustered(n_clusters=12, per=40, seed=7)
+    _write_store(tmp_path / "store", pts)
+    build_index(str(tmp_path / "store"), str(tmp_path / "idx"),
+                nlist=12, kmeans_iters=6, log=lambda m: None)
+    idx = load_index(str(tmp_path / "idx"))
+    queries = pts[::11]
+    approx, av = idx.search(queries, 10, nprobe=idx.nlist)
+    exact, ev = idx.search(queries, 10, exact=True)
+    for a, e in zip(approx, exact):
+        assert set(a.tolist()) == set(e.tolist())
+    # and the kept scores agree (same dot products, sorted descending)
+    np.testing.assert_allclose(np.sort(av, axis=1),
+                               np.sort(ev, axis=1), rtol=1e-5)
+    assert measure_recall(idx, queries, 10, nprobe=idx.nlist) == 1.0
+
+
+def test_small_corpus_falls_back_to_brute_force(tmp_path):
+    pts = _clustered(n_clusters=2, per=20, dim=8)  # 40 < MIN_IVF_ROWS
+    _write_store(tmp_path / "store", pts)
+    meta = build_index(str(tmp_path / "store"), str(tmp_path / "idx"),
+                       nlist=8, log=lambda m: None)
+    assert meta["backend"] == BACKEND_BRUTE
+    idx = load_index(str(tmp_path / "idx"))
+    pos, scores = idx.search(pts[3], 5)  # 1-D query auto-batches
+    assert pos.shape == (1, 5)
+    assert idx.ids[pos[0, 0]] == "m3"
+
+
+def test_index_carries_store_fingerprint_and_fp16(tmp_path):
+    pts = _clustered(n_clusters=2, per=30, dim=8)
+    _write_store(tmp_path / "store", pts, fingerprint="fp:abc",
+                 dtype="float16")
+    build_index(str(tmp_path / "store"), str(tmp_path / "idx"),
+                log=lambda m: None)
+    idx = load_index(str(tmp_path / "idx"))
+    assert idx.fingerprint == "fp:abc"
+    with pytest.raises(IndexArtifactError, match="model_fingerprint"):
+        load_index(str(tmp_path / "idx"), expect_fingerprint="fp:zzz")
+    load_index(str(tmp_path / "idx"), expect_fingerprint="fp:abc")
+
+
+def test_index_rejection_matrix(tmp_path):
+    pts = _clustered(n_clusters=12, per=40)
+    _write_store(tmp_path / "store", pts)
+    base = tmp_path / "idx"
+    build_index(str(tmp_path / "store"), str(base), nlist=12,
+                log=lambda m: None)
+    load_index(str(base))
+    with pytest.raises(IndexArtifactError, match="`kind`"):
+        load_index(str(tmp_path / "nothere"))
+    # truncated vectors payload
+    vecs = np.load(base / "vectors.npy")
+    np.save(base / "vectors.npy", vecs[:-1])
+    with pytest.raises(IndexArtifactError, match="vectors.shape"):
+        load_index(str(base))
+    np.save(base / "vectors.npy", vecs)
+    # torn ids
+    ids_text = (base / "ids.txt").read_text()
+    (base / "ids.txt").write_text("just_one\n")
+    with pytest.raises(IndexArtifactError, match="ids"):
+        load_index(str(base))
+    (base / "ids.txt").write_text(ids_text)
+    # inconsistent offsets
+    offsets = np.load(base / "list_offsets.npy")
+    np.save(base / "list_offsets.npy", offsets[:-1])
+    with pytest.raises(IndexArtifactError, match="list_offsets"):
+        load_index(str(base))
+    np.save(base / "list_offsets.npy", offsets)
+    # meta surgery
+    mpath = base / "index_meta.json"
+    meta = json.loads(mpath.read_text())
+    for field, value in (("kind", "junk"), ("format", 99),
+                         ("backend", "hnsw"), ("metric", "hamming")):
+        doctored = dict(meta)
+        doctored[field] = value
+        mpath.write_text(json.dumps(doctored))
+        with pytest.raises(IndexArtifactError, match=field):
+            load_index(str(base))
+    doctored = dict(meta)
+    del doctored["nprobe"]
+    mpath.write_text(json.dumps(doctored))
+    with pytest.raises(IndexArtifactError, match="nprobe"):
+        load_index(str(base))
+    mpath.write_text(json.dumps(meta))
+    load_index(str(base))
+
+
+# -------------------------------------------------------------- embed job
+
+
+@pytest.fixture(scope="module")
+def retrieval_model(tmp_path_factory):
+    import test_serving as ts
+    from code2vec_tpu.model_facade import Code2VecModel
+    tmp_path = tmp_path_factory.mktemp("retrieval-model")
+    ts._write_synthetic_dataset(tmp_path)
+    config = ts._serving_config(tmp_path, embed_shard_rows=8)
+    config.test_data_path = str(tmp_path / "synthetic.train.c2v")
+    return Code2VecModel(config)
+
+
+def test_embed_job_end_to_end(retrieval_model, tmp_path):
+    from code2vec_tpu.retrieval.embed_job import run_embed_job
+    model = retrieval_model
+    out = str(tmp_path / "vecs")
+    summary = run_embed_job(model, out_dir=out)
+    s = VectorStore.open(out)
+    assert s.rows == summary["rows"] == 32  # every synthetic row embeds
+    assert s.dim == model.config.code_vector_size
+    assert s.fingerprint == model.model_fingerprint()
+    assert all(i.startswith("name|") for i in s.ids)  # targets sidecar
+    vecs = s.load()
+    assert np.isfinite(vecs).all() and np.abs(vecs).sum() > 0
+    assert summary["resumed_rows"] == 0
+    assert _counter_value("retrieval_embed_rows_total") >= 32
+
+
+def test_embed_job_resumes_past_committed_shards(retrieval_model,
+                                                 tmp_path, monkeypatch):
+    from code2vec_tpu.retrieval.embed_job import run_embed_job
+    model = retrieval_model
+    out = str(tmp_path / "vecs-resume")
+    real_step, real_params = model.eval_callable()
+    calls = {"n": 0, "fail_after": 2}
+
+    def wrapped(params, *arrays):
+        calls["n"] += 1
+        if calls["fail_after"] and calls["n"] > calls["fail_after"]:
+            raise RuntimeError("injected mid-job crash")
+        return real_step(params, *arrays)
+
+    monkeypatch.setattr(model, "eval_callable",
+                        lambda: (wrapped, real_params))
+    # first run dies after 2 device batches (16 rows = 2 full shards at
+    # embed_shard_rows=8, test_batch_size=8)
+    with pytest.raises(RuntimeError, match="injected"):
+        run_embed_job(model, out_dir=out)
+    committed = VectorStore.open(out, allow_partial=True).rows
+    assert committed == 16
+    # second run resumes: only the REMAINING batches touch the device
+    calls.update(n=0, fail_after=0)
+    summary = run_embed_job(model, out_dir=out)
+    assert summary["resumed_rows"] == committed
+    assert calls["n"] == 2  # 4 batches total, 2 were already committed
+    s = VectorStore.open(out)
+    assert s.rows == 32
+    # resumed store is byte-identical to a single-pass embed
+    fresh = str(tmp_path / "vecs-fresh")
+    run_embed_job(model, out_dir=fresh)
+    np.testing.assert_array_equal(s.load(), VectorStore.open(fresh).load())
+    assert s.ids == VectorStore.open(fresh).ids
+
+
+# ----------------------------------------------------- offline exports
+
+
+def test_export_code_vectors_writes_store_format(retrieval_model):
+    model = retrieval_model
+    config = model.config
+    config.export_code_vectors = True
+    config.vectors_text = False
+    try:
+        model.evaluate()
+    finally:
+        config.export_code_vectors = False
+    store_path = config.test_data_path + ".vectors"
+    s = VectorStore.open(store_path)
+    assert s.rows == 32 and s.dim == config.code_vector_size
+    assert s.fingerprint == model.model_fingerprint()
+
+
+def test_export_code_vectors_text_compat(retrieval_model):
+    model = retrieval_model
+    config = model.config
+    config.export_code_vectors = True
+    config.vectors_text = True
+    try:
+        model.evaluate()
+    finally:
+        config.export_code_vectors = False
+        config.vectors_text = False
+    vectors_path = config.test_data_path + ".vectors"
+    with open(vectors_path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 32
+    assert all(len(line.split()) == config.code_vector_size
+               for line in lines)
+
+
+def test_export_embeddings_word2vec_format(retrieval_model, tmp_path):
+    from code2vec_tpu.vocab import VocabType
+    model = retrieval_model
+    out = str(tmp_path / "emb")
+    paths = model.export_embeddings(out)
+    for vocab_type, key in ((VocabType.Token, "tokens"),
+                            (VocabType.Target, "targets")):
+        matrix = model._get_vocab_embedding_as_np_array(vocab_type)
+        with open(paths[key]) as f:
+            header = f.readline().split()
+            assert [int(x) for x in header] == list(matrix.shape)
+            first = f.readline().split()
+            assert first[0] == model.vocabs.get(
+                vocab_type).index_to_word[0]
+            np.testing.assert_allclose(
+                np.array(first[1:], dtype=np.float64), matrix[0],
+                rtol=1e-6)
+            assert sum(1 for _ in f) == matrix.shape[0] - 1
+
+
+# -------------------------------------------------------- /neighbors
+
+
+@pytest.fixture(scope="module")
+def fake_extractor_module(tmp_path_factory):
+    import os
+    import test_serving as ts
+    path = tmp_path_factory.mktemp("fakex") / "fake-c2v-extract"
+    path.write_text(ts.FAKE_EXTRACTOR)
+    path.chmod(0o755)
+    old = os.environ.get("C2V_NATIVE_EXTRACTOR")
+    os.environ["C2V_NATIVE_EXTRACTOR"] = str(path)
+    yield str(path)
+    if old is None:
+        os.environ.pop("C2V_NATIVE_EXTRACTOR", None)
+    else:
+        os.environ["C2V_NATIVE_EXTRACTOR"] = old
+
+
+def _snippet(name, nctx):
+    return f"class A {{ int {name}() {{ return 1; }} }} NCTX{nctx}"
+
+
+@pytest.fixture(scope="module")
+def neighbor_server(retrieval_model, fake_extractor_module,
+                    tmp_path_factory):
+    """Corpus rows built from the fake extractor's own output for known
+    snippets -> querying the same snippet must find its row as the
+    nearest neighbor (an identical vector)."""
+    from code2vec_tpu.retrieval.embed_job import run_embed_job
+    from code2vec_tpu.serving.extractor_pool import ExtractorPool
+    from code2vec_tpu.serving.server import PredictionServer
+    model = retrieval_model
+    tmp = tmp_path_factory.mktemp("neigh")
+    names = [f"corpusMethod{i}" for i in range(6)]
+    with ExtractorPool(model.config, size=1) as pool:
+        rows = []
+        for i, name in enumerate(names):
+            lines, _ = pool.extract_source(_snippet(name, 2 + i % 4))
+            rows.append(lines[0].rstrip("\n"))
+    corpus = tmp / "neigh.test.c2v"
+    corpus.write_text("\n".join(rows) + "\n")
+    store_dir, idx_dir = str(tmp / "store"), str(tmp / "idx")
+    run_embed_job(model, corpus_path=str(corpus), out_dir=store_dir)
+    build_index(store_dir, idx_dir, log=lambda m: None)
+    config = model.config
+    config.retrieval_index = idx_dir
+    srv = PredictionServer(model, config, log=lambda m: None)
+    srv.start(port=0)
+    yield srv
+    srv.drain(timeout=10)
+    config.retrieval_index = None
+
+
+def _post(port, endpoint, body, ctype="text/plain"):
+    import urllib.error
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{endpoint}", data=body.encode(),
+        method="POST", headers={"Content-Type": ctype})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_neighbors_http_end_to_end(neighbor_server):
+    srv = neighbor_server
+    # the same snippet corpusMethod2 was embedded from: identical
+    # contexts -> identical vector -> the near-duplicate is FIRST
+    status, body = _post(srv.port, "neighbors",
+                         _snippet("corpusMethod2", 4))
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["embedding_fingerprint"] == \
+        srv.retrieval.index.fingerprint
+    assert payload["model_fingerprint"] == srv.model_fingerprint
+    [method] = payload["methods"]
+    assert method["original_name"] == "corpusMethod2"
+    top = method["neighbors"][0]
+    assert top["id"] == "corpusMethod2"
+    assert top["score"] > 0.999 and top["distance"] < 1e-3
+    assert {"id", "store_row", "score", "distance"} <= set(top)
+    # scores sorted descending, distances consistent with the metric
+    scores = [n["score"] for n in method["neighbors"]]
+    assert scores == sorted(scores, reverse=True)
+    # k override via JSON body
+    status, body = _post(
+        srv.port, "neighbors",
+        json.dumps({"code": _snippet("corpusMethod0", 2), "k": 2}),
+        "application/json")
+    assert status == 200
+    [method] = json.loads(body)["methods"]
+    assert len(method["neighbors"]) == 2
+    assert method["neighbors"][0]["id"] == "corpusMethod0"
+    # bad knobs are a 400, not a search
+    status, _ = _post(srv.port, "neighbors",
+                      json.dumps({"code": "class A {}", "k": "lots"}),
+                      "application/json")
+    assert status == 400
+    # healthz advertises the mount
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=30) as r:
+        hz = json.loads(r.read())
+    assert hz["retrieval"]["status"] == "attached"
+    assert hz["retrieval"]["fingerprint"] == \
+        srv.retrieval.index.fingerprint
+    assert hz["retrieval"]["rows"] == 6
+
+
+def test_neighbors_zero_methods_is_empty_not_500(neighbor_server):
+    """A snippet extracting to zero methods must render an empty
+    neighbor list, never crash the search on a (0, ?) batch."""
+    srv = neighbor_server
+    payload = srv._render("neighbors", [], {}, srv.model_fingerprint,
+                          knobs={})
+    assert payload["methods"] == []
+    assert payload["embedding_fingerprint"] == \
+        srv.retrieval.index.fingerprint
+
+
+def test_neighbors_knobs_bucketed_to_bounded_compiles(neighbor_server):
+    """Client k/nprobe values bucket to powers of two before the jitted
+    search: a knob sweep must not compile one function per value (the
+    serving compilation-budget discipline), while the response still
+    honors the exact requested k."""
+    srv = neighbor_server
+    idx = srv.retrieval.index
+    code = _snippet("corpusMethod4", 5)
+    fns_before = len(idx._search_fns)
+    for k in (3, 4):  # both bucket to k_eff=4
+        status, body = _post(
+            srv.port, "neighbors",
+            json.dumps({"code": code, "k": k}), "application/json")
+        assert status == 200
+        [method] = json.loads(body)["methods"]
+        assert len(method["neighbors"]) == k
+    assert len(idx._search_fns) - fns_before <= 1
+
+
+def test_neighbors_cache_hit_is_byte_equal(neighbor_server):
+    srv = neighbor_server
+    code = _snippet("corpusMethod1", 3)
+    _, body1 = _post(srv.port, "neighbors", code)
+    hits0 = _counter_value("serving_cache_hits_total")
+    _, body2 = _post(srv.port, "neighbors", code)
+    assert body2 == body1
+    assert _counter_value("serving_cache_hits_total") == hits0 + 1
+    # a different k is a different answer -> different cache entry
+    _, body3 = _post(srv.port, "neighbors",
+                     json.dumps({"code": code, "k": 1}),
+                     "application/json")
+    assert body3 != body1
+
+
+def test_neighbors_404_without_mount(retrieval_model,
+                                     fake_extractor_module):
+    from code2vec_tpu.serving.server import PredictionServer
+    config = retrieval_model.config
+    saved = config.retrieval_index
+    config.retrieval_index = None
+    srv = PredictionServer(retrieval_model, config, log=lambda m: None)
+    try:
+        status, body, _ = srv.handle_request("neighbors", "class A {}")
+        assert status == 404
+        assert b"retrieval_index" in body
+    finally:
+        srv.drain(timeout=5)
+        config.retrieval_index = saved
+
+
+def test_mount_refuses_foreign_fingerprint(retrieval_model, tmp_path):
+    from code2vec_tpu.retrieval.api import RetrievalHandle
+    pts = _clustered(n_clusters=2, per=20, dim=retrieval_model.config
+                     .code_vector_size)
+    _write_store(tmp_path / "store", pts, fingerprint="fp:foreign")
+    build_index(str(tmp_path / "store"), str(tmp_path / "idx"),
+                log=lambda m: None)
+    with pytest.raises(IndexArtifactError, match="model_fingerprint"):
+        RetrievalHandle.mount(str(tmp_path / "idx"),
+                              retrieval_model.model_fingerprint())
+
+
+class _FakeSwapModel:
+    """Stands in for a validated new model whose weights (fingerprint)
+    differ from the mounted index's embedding space."""
+
+    def __init__(self, schema, buckets, fingerprint="ckpt:swapped"):
+        self._schema = dict(schema)
+        self.context_buckets = tuple(buckets)
+        self._fp = fingerprint
+        self._predict_steps = {}
+
+    def model_fingerprint(self):
+        return self._fp
+
+    def smoke_schema(self):
+        return dict(self._schema)
+
+    def predict_compile_count(self):
+        return 0
+
+
+def _wait_swap(server, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        state = server.swap.status()["state"]
+        if state in ("ready", "failed"):
+            return state
+        time.sleep(0.05)
+    raise AssertionError(f"swap stuck in {server.swap.status()}")
+
+
+def test_swap_refused_on_embedding_fingerprint_mismatch(neighbor_server):
+    """Default policy: a hot-swap whose weights mismatch the mounted
+    index is REJECTED — old model keeps serving, /neighbors stays
+    consistent, reason lands in swap_status."""
+    srv = neighbor_server
+    old_fp = srv.model_fingerprint
+    schema = srv.model.smoke_schema()
+    fake = _FakeSwapModel(schema, srv.model.context_buckets)
+    from code2vec_tpu.serving.swap import SwapManager
+    srv.swap = SwapManager(srv, build_model=lambda d: fake)
+    srv.swap.request_reload("/fake/new-artifact")
+    assert _wait_swap(srv) == "failed"
+    assert "embedding space" in srv.swap.status()["error"]
+    assert srv.model_fingerprint == old_fp
+    assert srv.retrieval.attached
+    status, _ = _post(srv.port, "neighbors", _snippet("corpusMethod3", 5))
+    assert status == 200
+
+
+def test_swap_detach_policy_never_serves_stale_space(neighbor_server):
+    """Policy detach: the swap commits but the index detaches ATOMICALLY
+    with the model flip — /neighbors answers 503 with the reason in
+    /healthz, never neighbors from the old embedding space."""
+    srv = neighbor_server
+    schema = srv.model.smoke_schema()
+    old_model, old_fp = srv._model_ref
+    fake = _FakeSwapModel(schema, srv.model.context_buckets)
+    from code2vec_tpu.serving.swap import SwapManager
+    srv.config.retrieval_swap_policy = "detach"
+    detached0 = _counter_value("serving_retrieval_detached_total",
+                               reason="fingerprint_mismatch")
+    try:
+        srv.swap = SwapManager(srv, build_model=lambda d: fake)
+        srv.swap.request_reload("/fake/new-artifact")
+        assert _wait_swap(srv) == "ready"
+        assert srv.model_fingerprint == "ckpt:swapped"
+        assert not srv.retrieval.attached
+        st = srv.retrieval.status()
+        assert st["status"] == "detached"
+        assert "rebuild the index" in st["detach_reason"]
+        assert _counter_value("serving_retrieval_detached_total",
+                              reason="fingerprint_mismatch") == \
+            detached0 + 1
+        status, body = _post(srv.port, "neighbors",
+                             _snippet("corpusMethod3", 5))
+        assert status == 503
+        assert b"detached" in body
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz",
+                timeout=30) as r:
+            hz = json.loads(r.read())
+        assert hz["retrieval"]["status"] == "detached"
+    finally:
+        # restore the real model/index pairing for any later test
+        srv.config.retrieval_swap_policy = "refuse"
+        srv._model_ref = (old_model, old_fp)
+        srv.retrieval._attached = True
+        srv.retrieval._detach_reason = None
+
+
+# --------------------------------------------------------------- CLI
+
+
+def test_cli_subcommand_contracts():
+    from code2vec_tpu.cli import config_from_args
+    config = config_from_args(
+        ["index-build", "--vectors", "/tmp/v", "--index_out", "/tmp/i",
+         "--nlist", "32", "--nprobe", "4"])
+    config.verify()
+    assert (config.index_vectors, config.index_out) == ("/tmp/v", "/tmp/i")
+    assert (config.index_nlist, config.index_nprobe) == (32, 4)
+    with pytest.raises(SystemExit):
+        config_from_args(["embed", "--load", "/tmp/m"])
+    with pytest.raises(SystemExit):
+        config_from_args(["index-build", "--vectors", "/tmp/v"])
+    with pytest.raises(SystemExit):
+        config_from_args(["export-embeddings", "--load", "/tmp/m"])
+    config = config_from_args(
+        ["embed", "--load", "/tmp", "--test", "corpus.c2v",
+         "--embed_out", "/tmp/vecs", "--embed_dtype", "float16"])
+    assert config.embed_out == "/tmp/vecs"
+    assert config.embed_dtype == "float16"
+    with pytest.raises(ValueError, match="retrieval_index"):
+        config_from_args(["--load", "/tmp",
+                          "--retrieval_index", "/tmp/i"]).verify()

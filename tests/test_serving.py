@@ -440,9 +440,17 @@ def test_http_end_to_end(server):
     status, body = _post(server.port, "embed",
                          json.dumps({"code": code}), "application/json")
     assert status == 200
-    vectors = json.loads(body)["vectors"]
+    embed_payload = json.loads(body)
+    vectors = embed_payload["vectors"]
     assert len(vectors) == 1
     assert len(vectors[0]) == server.config.code_vector_size
+    # the embedding-space identity rides every /embed response (the
+    # same field /neighbors stamps) so clients can detect cross-model
+    # vector mixing
+    assert embed_payload["embedding_fingerprint"] == \
+        server.model_fingerprint
+    assert embed_payload["embedding_fingerprint"] == \
+        embed_payload["model_fingerprint"]
 
     # healthz + metrics ride the same listener
     with urllib.request.urlopen(
