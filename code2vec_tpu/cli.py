@@ -137,6 +137,28 @@ def arguments_parser() -> ArgumentParser:
                              "rewrites; the supervisor restarts a "
                              "replica whose heartbeat goes ~3 "
                              "intervals stale (default 5)")
+    parser.add_argument("--serve_debug_trace", action="store_true",
+                        default=None,
+                        help="honor ?debug=trace on serving endpoints: "
+                             "the JSON response gains a `trace` field "
+                             "with the request's span tree. OFF by "
+                             "default (exposes worker pids / batch "
+                             "composition; debug replicas only — "
+                             "README 'Telemetry')")
+    parser.add_argument("--serve_flight_dir", metavar="DIR",
+                        help="directory for flight-recorder dumps "
+                             "(incident-triggered + POST /admin/dump); "
+                             "default: next to --heartbeat_file")
+    parser.add_argument("--serve_flight_records", type=int, default=None,
+                        metavar="N",
+                        help="terminal request records the incident "
+                             "flight recorder retains (default 512)")
+    parser.add_argument("--serve_telemetry_port", type=int, default=None,
+                        metavar="PORT",
+                        help="supervisor fleet-telemetry listener "
+                             "(merged GET /metrics + GET /fleet under "
+                             "--replicas); default: public port + 1, "
+                             "0 picks a free port")
     parser.add_argument("--artifact", dest="serve_artifact", metavar="DIR",
                         help="serve/evaluate from a release artifact "
                              "(produced by the `export` subcommand) "
@@ -414,6 +436,10 @@ def config_from_args(argv=None) -> Config:
                                       "serve_replicas",
                                       "serve_max_restarts",
                                       "serve_heartbeat_interval_s",
+                                      "serve_debug_trace",
+                                      "serve_flight_dir",
+                                      "serve_flight_records",
+                                      "serve_telemetry_port",
                                       "serve_artifact",
                                       "export_artifact_path",
                                       "topk_block_size",

@@ -284,6 +284,28 @@ class Config:
     # The supervisor treats a heartbeat older than ~3 intervals as a
     # HUNG replica and restarts it.
     serve_heartbeat_interval_s: float = 5.0
+    # -- serving telemetry (obs/reqtrace.py, obs/flight.py,
+    # serving/telemetry.py; README "Telemetry") --
+    # Honor `?debug=trace` on /predict//embed//neighbors: the response
+    # gains a `trace` field with the request's full span tree. OFF by
+    # default — the tree exposes internals (worker pids, batch
+    # composition, cache behavior) that do not belong on a public
+    # endpoint; enable on debug/staging replicas only.
+    serve_debug_trace: bool = False
+    # Directory for flight-recorder dumps (incident-triggered and
+    # POST /admin/dump). None = next to --heartbeat_file when set,
+    # else incident auto-dumps are disabled (/admin/dump still writes,
+    # into the system temp dir).
+    serve_flight_dir: Optional[str] = None
+    # Terminal request records the flight recorder retains (the black
+    # box ring; anomaly events ring separately at 256).
+    serve_flight_records: int = 512
+    # Supervisor telemetry listener (merged GET /metrics + GET /fleet —
+    # the documented scrape address under --replicas, fixing the
+    # SO_REUSEPORT one-replica-scrape gap). None = public port + 1;
+    # 0 = pick a free port (logged + in the supervisor heartbeat's
+    # telemetry_port).
+    serve_telemetry_port: Optional[int] = None
     # Rows per streamed target-table block in the blockwise top-k
     # prediction head (ops/topk.py): the eval/predict steps fold the
     # ~246K-name classifier through a running top-k merge + logsumexp
@@ -603,6 +625,16 @@ class Config:
                 "escalate on first replica death).")
         if self.serve_heartbeat_interval_s <= 0:
             raise ValueError("serve_heartbeat_interval_s must be > 0.")
+        if self.serve_flight_records < 1:
+            raise ValueError(
+                "serve_flight_records must be >= 1 (the flight "
+                "recorder ring needs at least one slot).")
+        if self.serve_telemetry_port is not None and not (
+                0 <= self.serve_telemetry_port <= 65535):
+            raise ValueError(
+                "serve_telemetry_port must be in [0, 65535] "
+                "(0 picks a free port; unset defaults to the public "
+                "port + 1).")
         if self.topk_block_size < 0:
             raise ValueError(
                 "topk_block_size must be >= 0 (0 forces the full-logits "

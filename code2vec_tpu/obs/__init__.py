@@ -26,18 +26,21 @@ path, and the serving bridge all record into the same registry.
 
 from __future__ import annotations
 
-from code2vec_tpu.obs import exporters, metrics, tracer
+from code2vec_tpu.obs import exporters, flight, metrics, reqtrace, tracer
+from code2vec_tpu.obs.flight import FlightRecorder, default_flight_recorder
 from code2vec_tpu.obs.metrics import (
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
     default_registry,
 )
+from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.obs.tracer import SpanTracer, default_tracer, span
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "SpanTracer",
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
+    "RequestTrace", "SpanTracer",
     "DEFAULT_BUCKETS", "counter", "gauge", "histogram", "span",
-    "default_registry", "default_tracer", "exporters", "metrics",
-    "tracer",
+    "default_registry", "default_flight_recorder", "default_tracer",
+    "exporters", "flight", "metrics", "reqtrace", "tracer",
 ]
 
 

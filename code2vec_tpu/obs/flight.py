@@ -1,0 +1,254 @@
+"""Incident flight recorder: a black box for serving postmortems.
+
+Aggregate metrics say THAT something went wrong (shed rate spiked, a
+breaker opened); logs are unbounded and usually rotated away by the
+time anyone looks. The flight recorder keeps the last-N terminal
+request records and anomaly events in two bounded rings, and writes
+them to a timestamped JSON file when an incident fires — so "what
+exactly was in flight when the breaker opened" is answerable from one
+file, with trace ids that link each record to its span tree in the
+Chrome trace.
+
+- **Request records** (`record_request`): one small dict per TERMINAL
+  request — trace id, endpoint, HTTP status, per-phase timings, shed /
+  breaker reason, the serving fingerprint. Ring capacity
+  `--serve_flight_records` (default 512).
+- **Anomaly events** (`event`): breaker transitions, hot-swap
+  start/fail/commit, drain start/timeout, expired deadlines, replica
+  restarts. Bounded separately so a request storm cannot evict the
+  anomalies that explain it.
+- **Incidents** (`incident`): a breaker opening, a drain timeout, a
+  supervisor replica escalation. An incident records an event, counts
+  `flight_incidents_total{kind}`, and — when a dump directory is
+  configured — schedules ONE dump a short delay later (default 0.75s),
+  so the file captures both the lead-up and the immediate fallout (the
+  shed storm an open breaker causes). Incidents landing while a dump is
+  pending coalesce into it.
+- **Dumps** (`dump`, `POST /admin/dump`): the rings serialized
+  atomically to `flight-<utc>-<reason>.json` in the configured
+  directory (`--serve_flight_dir`, defaulting to the heartbeat file's
+  directory). `flight_dumps_total` counts them.
+
+Stdlib-only, thread-safe, and process-wide like the metrics registry:
+`default_flight_recorder()` is what the serving stack records into.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from code2vec_tpu.obs import metrics as _metrics
+
+FLIGHT_SCHEMA_VERSION = 1
+
+
+def _c_incidents(kind: str):
+    return _metrics.default_registry().counter(
+        "flight_incidents_total",
+        "serving incidents recorded by the flight recorder "
+        "(breaker_open, drain_timeout, replica_escalation, ...)",
+        kind=kind)
+
+
+def _c_dumps():
+    return _metrics.default_registry().counter(
+        "flight_dumps_total",
+        "flight-recorder ring dumps written (incident-triggered or "
+        "POST /admin/dump)")
+
+
+class FlightRecorder:
+    """Two bounded rings (requests, events) + incident-triggered dump."""
+
+    def __init__(self, capacity: int = 512, events_capacity: int = 256):
+        self._lock = threading.Lock()
+        self._requests: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity)))
+        self._events: collections.deque = collections.deque(
+            maxlen=max(1, int(events_capacity)))
+        self._dump_dir: Optional[str] = None
+        self._dump_delay_s = 0.75
+        self._log = lambda msg: None
+        self._pending: Optional[threading.Timer] = None
+        self._pending_reason: Optional[str] = None
+        self._coalesced = 0
+        self.requests_recorded = 0
+        self.events_recorded = 0
+
+    _UNSET = object()
+
+    def configure(self, dump_dir=_UNSET,
+                  capacity: Optional[int] = None,
+                  dump_delay_s: Optional[float] = None,
+                  log=None) -> None:
+        """(Re)configure the process recorder — the serving entry points
+        call this once at startup. An EXPLICIT dump_dir=None disables
+        incident auto-dumps (the recorder is process-wide; a fresh
+        server must not inherit a predecessor's dump dir). Resizing
+        preserves the newest records."""
+        with self._lock:
+            if dump_dir is not FlightRecorder._UNSET:
+                self._dump_dir = dump_dir
+            if capacity is not None and \
+                    int(capacity) != self._requests.maxlen:
+                self._requests = collections.deque(
+                    self._requests, maxlen=max(1, int(capacity)))
+            if dump_delay_s is not None:
+                self._dump_delay_s = max(0.0, float(dump_delay_s))
+            if log is not None:
+                self._log = log
+
+    @property
+    def dump_dir(self) -> Optional[str]:
+        return self._dump_dir
+
+    # ---------------------------------------------------------- recording
+
+    def record_request(self, *, trace_id: str, endpoint: str,
+                       status: int, duration_s: float,
+                       phases: Optional[dict] = None,
+                       reason: Optional[str] = None,
+                       fingerprint: Optional[str] = None,
+                       **extra) -> None:
+        rec = {
+            "t": time.time(),
+            "trace_id": trace_id,
+            "endpoint": endpoint,
+            "status": int(status),
+            "duration_ms": round(duration_s * 1e3, 3),
+        }
+        if phases:
+            rec["phases_ms"] = {k: round(v * 1e3, 3)
+                                for k, v in phases.items()}
+        if reason:
+            rec["reason"] = reason
+        if fingerprint:
+            rec["fingerprint"] = fingerprint
+        rec.update(extra)
+        with self._lock:
+            self._requests.append(rec)
+            self.requests_recorded += 1
+
+    def event(self, kind: str, **detail) -> None:
+        rec = {"t": time.time(), "kind": kind}
+        rec.update(detail)
+        with self._lock:
+            self._events.append(rec)
+            self.events_recorded += 1
+
+    def incident(self, kind: str, immediate: bool = False,
+                 **detail) -> None:
+        """An anomaly serious enough to preserve the rings: record the
+        event, count it, and (when a dump dir is configured) schedule
+        one delayed dump capturing lead-up AND fallout. `immediate`
+        dumps synchronously instead — for incidents on an exit path
+        (drain timeout, supervisor escalation) where a delayed timer
+        would die with the process."""
+        self.event(kind, incident=True, **detail)
+        _c_incidents(kind).inc()
+        self._log(f"Flight recorder incident: {kind} "
+                  f"({detail if detail else 'no detail'})")
+        with self._lock:
+            if self._dump_dir is None:
+                return
+            if immediate:
+                pending, self._pending = self._pending, None
+                self._pending_reason = None
+            else:
+                if self._pending is not None:
+                    self._coalesced += 1
+                    return
+                self._pending_reason = kind
+                self._pending = threading.Timer(self._dump_delay_s,
+                                                self._fire_pending_dump)
+                self._pending.daemon = True
+                self._pending.start()
+                return
+        if pending is not None:
+            pending.cancel()
+        try:
+            self.dump(reason=kind)
+        except Exception as e:  # noqa: BLE001 — see _fire_pending_dump
+            self._log(f"Flight recorder dump FAILED ({e})")
+
+    def _fire_pending_dump(self) -> None:
+        with self._lock:
+            reason = self._pending_reason or "incident"
+            self._pending = None
+            self._pending_reason = None
+        try:
+            self.dump(reason=reason)
+        except Exception as e:  # noqa: BLE001 — a failed dump must
+            # never take the serving thread pool down with it
+            self._log(f"Flight recorder dump FAILED ({e})")
+
+    # -------------------------------------------------------------- dump
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "schema_version": FLIGHT_SCHEMA_VERSION,
+                "pid": os.getpid(),
+                "written_at": time.time(),
+                "requests_recorded": self.requests_recorded,
+                "events_recorded": self.events_recorded,
+                "incidents_coalesced": self._coalesced,
+                "requests": list(self._requests),
+                "events": list(self._events),
+            }
+
+    def dump(self, reason: str = "manual",
+             path: Optional[str] = None) -> str:
+        """Atomically write the rings as JSON; returns the path. With no
+        explicit path, writes `flight-<utcstamp>-<reason>.json` into the
+        configured dump dir (or the system temp dir as a last resort —
+        an operator's /admin/dump must produce a file somewhere)."""
+        payload = self.snapshot()
+        payload["reason"] = reason
+        if path is None:
+            base = self._dump_dir
+            if base is None:
+                import tempfile
+                base = tempfile.gettempdir()
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+            safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in reason)[:40] or "incident"
+            # pid in the name: replicas share the supervisor run dir,
+            # and a fleet-wide incident (shared-backend outage) dumps
+            # from several processes in the same second — one black box
+            # must never overwrite another's
+            path = os.path.join(
+                base, f"flight-{stamp}-"
+                      f"{int(time.time() * 1000) % 1000:03d}-"
+                      f"p{os.getpid()}-{safe}.json")
+        path = os.path.abspath(path)
+        dirpart = os.path.dirname(path)
+        if dirpart:
+            os.makedirs(dirpart, exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        _c_dumps().inc()
+        self._log(f"Flight recorder dumped {len(payload['requests'])} "
+                  f"request(s) + {len(payload['events'])} event(s) to "
+                  f"{path} (reason: {reason})")
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._requests.clear()
+            self._events.clear()
+
+
+_DEFAULT = FlightRecorder()
+
+
+def default_flight_recorder() -> FlightRecorder:
+    return _DEFAULT

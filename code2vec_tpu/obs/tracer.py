@@ -8,6 +8,12 @@ HOST spends wall time — data wait vs. dispatch vs. loss sync vs.
 checkpoint saves vs. eval — which is exactly the split the device trace
 cannot see.
 
+Spans may carry request-scoped identity (obs/reqtrace.py): a trace id,
+a span id and a parent span id, plus free-form attrs. Identified spans
+export with an `args` payload so one serving request is reconstructable
+as a TREE from the bulk Chrome trace (filter by `trace_id` in
+Perfetto), not just a flat phase list.
+
 Cost model: recording is OFF by default; a disabled tracer's
 `maybe_record` is one attribute check. When enabled, each span is one
 tuple append into a bounded deque (the ring buffer caps memory on long
@@ -15,6 +21,13 @@ runs — a multi-day run keeps the most recent `capacity` spans). Span
 TIMING (perf_counter pairs) is done by the caller / the `span` context
 manager regardless, because the same measurement usually feeds a
 histogram that is always on.
+
+The ring DROPS the oldest span when full — silently from the file's
+point of view, so the drops are first-class metrics:
+`obs_spans_dropped_total` counts every overwritten span and
+`obs_span_ring_high_water` records the fullest the ring has been; a
+truncated Chrome trace is detectable from a /metrics scrape alone (and
+from the trace file itself: `otherData.spans_dropped`).
 """
 
 from __future__ import annotations
@@ -29,10 +42,42 @@ from typing import Optional
 from code2vec_tpu.obs import metrics as _metrics
 
 
+# Cached handles: once the ring is full, the drop counter increments on
+# EVERY record() — a registry get-or-create per span (key build + the
+# registry lock) inside the tracer lock would be a permanent tax for
+# the rest of the process lifetime. Lazy so importing this module
+# registers nothing.
+_C_DROPPED = None
+_G_HIGH_WATER = None
+
+
+def _c_dropped():
+    global _C_DROPPED
+    if _C_DROPPED is None:
+        _C_DROPPED = _metrics.default_registry().counter(
+            "obs_spans_dropped_total",
+            "spans overwritten in the tracer ring buffer (the Chrome "
+            "trace export is missing at least this many oldest spans)")
+    return _C_DROPPED
+
+
+def _g_high_water():
+    global _G_HIGH_WATER
+    if _G_HIGH_WATER is None:
+        _G_HIGH_WATER = _metrics.default_registry().gauge(
+            "obs_span_ring_high_water",
+            "max spans ever resident in the tracer ring buffer; at "
+            "capacity together with obs_spans_dropped_total > 0 the "
+            "exported trace is truncated")
+    return _G_HIGH_WATER
+
+
 class SpanTracer:
-    """Bounded ring buffer of (name, start, duration, thread) spans."""
+    """Bounded ring buffer of (name, start, duration, thread[, ids])
+    spans."""
 
     def __init__(self, capacity: int = 65536):
+        self.capacity = int(capacity)
         self._buf: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         # perf_counter epoch: Chrome trace wants microsecond timestamps on
@@ -40,6 +85,8 @@ class SpanTracer:
         # the metadata so runs can still be aligned to the clock.
         self._epoch = time.perf_counter()
         self._epoch_wall = time.time()
+        self._dropped = 0
+        self._high_water = 0
         self.enabled = False
 
     def enable(self) -> None:
@@ -55,19 +102,45 @@ class SpanTracer:
     def __len__(self) -> int:
         return len(self._buf)
 
-    def maybe_record(self, name: str, start_s: float, dur_s: float) -> None:
+    @property
+    def dropped(self) -> int:
+        """Spans overwritten by the ring since construction."""
+        return self._dropped
+
+    @property
+    def high_water(self) -> int:
+        return self._high_water
+
+    def maybe_record(self, name: str, start_s: float, dur_s: float,
+                     **ids) -> None:
         """Record a completed span (perf_counter start + duration). No-op
         when disabled — the one-attr check keeps instrumented call sites
         free to call this unconditionally."""
         if not self.enabled:
             return
-        self.record(name, start_s, dur_s)
+        self.record(name, start_s, dur_s, **ids)
 
-    def record(self, name: str, start_s: float, dur_s: float) -> None:
+    def record(self, name: str, start_s: float, dur_s: float,
+               trace_id: Optional[str] = None,
+               span_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
+               attrs: Optional[dict] = None) -> None:
         item = (name, start_s, dur_s, threading.get_ident(),
-                threading.current_thread().name)
+                threading.current_thread().name,
+                trace_id, span_id, parent_id, attrs)
         with self._lock:
+            if len(self._buf) == self.capacity:
+                self._dropped += 1
+                _c_dropped().inc()
             self._buf.append(item)
+            n = len(self._buf)
+            if n > self._high_water:
+                self._high_water = n
+                g = _g_high_water()
+                # several tracer instances share the process gauge; it
+                # tracks the fullest ring anywhere in the process
+                if n > g.value:
+                    g.set(n)
 
     # ------------------------------------------------------------ export
 
@@ -83,17 +156,29 @@ class SpanTracer:
         escaping go through json.dumps."""
         with self._lock:
             spans = list(self._buf)
+            dropped = self._dropped
         pid = os.getpid()
         parts = []
         seen_tids = {}
-        for name, start_s, dur_s, tid, tname in spans:
+        for (name, start_s, dur_s, tid, tname,
+             trace_id, span_id, parent_id, attrs) in spans:
             if tid not in seen_tids:
                 seen_tids[tid] = tname
+            args = ""
+            if trace_id or span_id or parent_id or attrs:
+                payload = dict(attrs or {})
+                if trace_id:
+                    payload["trace_id"] = trace_id
+                if span_id:
+                    payload["span_id"] = span_id
+                if parent_id:
+                    payload["parent_id"] = parent_id
+                args = ',"args":%s' % json.dumps(payload, sort_keys=True)
             parts.append(
                 '{"name":%s,"ph":"X","cat":"host","ts":%.3f,"dur":%.3f,'
-                '"pid":%d,"tid":%d}'
+                '"pid":%d,"tid":%d%s}'
                 % (json.dumps(name), (start_s - self._epoch) * 1e6,
-                   dur_s * 1e6, pid, tid))
+                   dur_s * 1e6, pid, tid, args))
         for tid, tname in seen_tids.items():
             parts.append(
                 '{"name":"thread_name","ph":"M","pid":%d,"tid":%d,'
@@ -103,8 +188,9 @@ class SpanTracer:
             '"args":{"name":"code2vec_tpu host"}}' % pid)
         return ('{"traceEvents":[%s],"displayTimeUnit":"ms",'
                 '"otherData":{"trace_epoch_unix_s":%r,'
+                '"spans_dropped":%d,'
                 '"producer":"code2vec_tpu.obs.tracer"}}'
-                % (",".join(parts), self._epoch_wall))
+                % (",".join(parts), self._epoch_wall, dropped))
 
     def chrome_trace(self) -> dict:
         """The trace as a parsed object (in-process inspection, tests);

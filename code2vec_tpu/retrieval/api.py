@@ -123,8 +123,8 @@ class RetrievalHandle:
                     f"retrieval index detached: {self._detach_reason}")
 
     def neighbors(self, code_vectors: np.ndarray, result_fingerprint: str,
-                  k: Optional[int] = None, nprobe: Optional[int] = None
-                  ) -> List[List[dict]]:
+                  k: Optional[int] = None, nprobe: Optional[int] = None,
+                  trace=None) -> List[List[dict]]:
         """Per-query neighbor lists for one batch of code vectors
         computed by the model identified by `result_fingerprint`. The
         fingerprint check here is per-RESPONSE: whatever interleaving of
@@ -155,6 +155,12 @@ class RetrievalHandle:
             np.asarray(code_vectors, dtype=np.float32), k_eff,
             nprobe=nprobe_eff)
         dists = self.index.distances(scores)
+        if trace is not None:
+            trace.add_span(
+                "ann_search", t0, time.perf_counter() - t0,
+                attrs={"k": k_eff, "nprobe": nprobe_eff,
+                       "rows": self.index.rows,
+                       "queries": int(len(pos))})
         out: List[List[dict]] = []
         for row_pos, row_scores, row_dists in zip(pos, scores, dists):
             row = []

@@ -96,17 +96,19 @@ def bucket_for(n_contexts: int, buckets: Sequence[int]) -> int:
 
 class _Pending:
     __slots__ = ("lines", "future", "t_submit", "phases", "deadline",
-                 "bucket")
+                 "bucket", "trace")
 
     def __init__(self, lines: List[str], phases: Optional[dict],
                  deadline: Optional[Deadline] = None,
-                 bucket: Optional[int] = None):
+                 bucket: Optional[int] = None,
+                 trace=None):
         self.lines = lines
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.phases = phases
         self.deadline = deadline
         self.bucket = bucket
+        self.trace = trace
 
 
 class _DeviceTimeTracker:
@@ -186,8 +188,9 @@ class DynamicBatcher:
 
     def submit(self, lines: Sequence[str],
                phases: Optional[dict] = None,
-               deadline: Optional[Deadline] = None) -> Future:
-        item = _Pending(list(lines), phases, deadline)
+               deadline: Optional[Deadline] = None,
+               trace=None) -> Future:
+        item = _Pending(list(lines), phases, deadline, trace=trace)
         if not item.lines:
             item.future.set_result([])
             return item.future
@@ -332,9 +335,12 @@ class DynamicBatcher:
             _H_BATCH_WAIT.observe(wait)
             if item.phases is not None:
                 item.phases["batch_wait"] = wait
+            if item.trace is not None:
+                item.trace.add_span("batch_wait", item.t_submit, wait)
             all_lines.extend(item.lines)
         _C_BATCHES.inc()
         self.batches_dispatched += 1
+        batch_id = self.batches_dispatched
         _C_ROWS.inc(len(all_lines))
         _H_BATCH_ROWS.observe(len(all_lines))
         try:
@@ -356,6 +362,8 @@ class DynamicBatcher:
         batch_bucket = max((i.bucket for i in batch
                             if i.bucket is not None), default=None)
         self.device_times.record(batch_bucket, dur)
+        self._record_batch_spans(batch, batch_id, batch_bucket,
+                                 len(all_lines), t_dispatch, dur)
         off = 0
         for item in batch:
             n = len(item.lines)
@@ -364,3 +372,37 @@ class DynamicBatcher:
             if item.future.set_running_or_notify_cancel():
                 item.future.set_result(results[off:off + n])
             off += n
+
+    def _record_batch_spans(self, batch: List[_Pending], batch_id: int,
+                            bucket: Optional[int], rows: int,
+                            t_dispatch: float, dur: float) -> None:
+        """Fan the coalesced device call into the member traces: ONE
+        shared batch span id is stamped into every member request's
+        trace (the batch node N request trees share), each member's
+        `device` span hangs under it, and the process tracer records the
+        batch exactly once — tagged with every member trace id so the
+        bulk Chrome trace links batch to requests."""
+        traced = [item for item in batch if item.trace is not None]
+        if not traced:
+            return
+        from code2vec_tpu.obs import reqtrace, tracer
+        batch_span_id = reqtrace.mint_span_id()
+        members = [item.trace.trace_id for item in traced]
+        attrs = {"batch_id": batch_id, "rows": rows,
+                 "requests": len(batch)}
+        if bucket is not None:
+            attrs["bucket"] = bucket
+        for item in traced:
+            # every member's batch-span attrs hold a REFERENCE to the
+            # one shared members list (O(rows) per batch, not O(rows^2));
+            # it only gets serialized per response on the
+            # --serve_debug_trace + ?debug=trace path
+            item.trace.add_span("batch", t_dispatch, dur,
+                                span_id=batch_span_id,
+                                attrs=dict(attrs, members=members),
+                                forward=False)
+            item.trace.add_span("device", t_dispatch, dur,
+                                parent_id=batch_span_id)
+        tracer.default_tracer().maybe_record(
+            "serving_batch", t_dispatch, dur, span_id=batch_span_id,
+            attrs=dict(attrs, member_trace_ids=members))

@@ -60,13 +60,19 @@ class CircuitBreaker:
 
     def __init__(self, name: str, window_s: float = 10.0,
                  failure_ratio: float = 0.5, min_requests: int = 4,
-                 cooldown_s: float = 5.0, clock=time.monotonic):
+                 cooldown_s: float = 5.0, clock=time.monotonic,
+                 on_transition=None):
         self.name = name
         self.window_s = float(window_s)
         self.failure_ratio = float(failure_ratio)
         self.min_requests = max(1, int(min_requests))
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        # Called as on_transition(name, to_state) AFTER the state flip
+        # (under the breaker lock — keep it cheap and non-reentrant);
+        # the server points it at the flight recorder so every breaker
+        # transition is an anomaly event and an OPEN is an incident.
+        self.on_transition = on_transition
         self._lock = threading.Lock()
         self._events: Deque[Tuple[float, bool]] = deque()
         self._state = CLOSED
@@ -102,6 +108,11 @@ class CircuitBreaker:
             "serving_breaker_transitions_total",
             "circuit-breaker state transitions",
             breaker=self.name, to=to).inc()
+        if self.on_transition is not None:
+            try:
+                self.on_transition(self.name, to)
+            except Exception:  # noqa: BLE001 — telemetry must never
+                pass           # turn a state flip into a request error
 
     def retry_after_s(self) -> float:
         """Seconds until the next probe could be let through."""

@@ -383,12 +383,16 @@ class ExtractorPool:
     # -------------------------------------------------------------- API
 
     def extract_file(self, path: str, phases: Optional[dict] = None,
-                     deadline=None) -> Tuple[List[str], Dict[str, str]]:
-        return self._extract(phases, path=path, deadline=deadline)
+                     deadline=None, trace=None
+                     ) -> Tuple[List[str], Dict[str, str]]:
+        return self._extract(phases, path=path, deadline=deadline,
+                             trace=trace)
 
     def extract_source(self, source: str, phases: Optional[dict] = None,
-                       deadline=None) -> Tuple[List[str], Dict[str, str]]:
-        return self._extract(phases, source=source, deadline=deadline)
+                       deadline=None, trace=None
+                       ) -> Tuple[List[str], Dict[str, str]]:
+        return self._extract(phases, source=source, deadline=deadline,
+                             trace=trace)
 
     def _effective_timeout(self, deadline) -> Tuple[Optional[float], bool]:
         """min(pool timeout, remaining deadline budget) and whether the
@@ -403,7 +407,8 @@ class ExtractorPool:
 
     def _extract(self, phases: Optional[dict], *,
                  path: Optional[str] = None, source: Optional[str] = None,
-                 deadline=None) -> Tuple[List[str], Dict[str, str]]:
+                 deadline=None, trace=None
+                 ) -> Tuple[List[str], Dict[str, str]]:
         from code2vec_tpu.serving.admission import (
             DeadlineExceeded, expired_counter,
         )
@@ -414,7 +419,11 @@ class ExtractorPool:
                 expired_counter("extract").inc()
                 raise DeadlineExceeded(
                     "request deadline expired before extraction")
+            t_wait0 = time.perf_counter()
             worker = self._acquire(phases, deadline=deadline)
+            if trace is not None:
+                trace.add_span("extract_wait", t_wait0,
+                               time.perf_counter() - t_wait0)
             timeout_s, deadline_bound = self._effective_timeout(deadline)
             t0 = time.perf_counter()
             try:
@@ -458,6 +467,15 @@ class ExtractorPool:
                 _H_EXTRACT.observe(dur)
                 if phases is not None:
                     phases["extract"] = phases.get("extract", 0.0) + dur
+                if trace is not None:
+                    trace.add_span(
+                        "extract", t0, dur,
+                        attrs={"attempt": attempt + 1,
+                               "mode": "cold" if worker.cold is not None
+                               else "warm",
+                               "worker_pid": (worker.proc.pid
+                                              if worker.proc is not None
+                                              else None)})
                 self._release(worker)
             return result
         raise AssertionError("unreachable")  # pragma: no cover

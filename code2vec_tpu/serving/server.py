@@ -33,7 +33,21 @@ Endpoints (JSON unless noted; schema in README "Serving"):
   status to 503, the load-balancer eviction contract — during SIGTERM
   grace.
 - `GET  /metrics`  Prometheus text format — the same registry/plumbing
-  as the trainer's --metrics_port (obs/exporters.py).
+  as the trainer's --metrics_port (obs/exporters.py). Under
+  `--replicas N` scrape the SUPERVISOR's merged endpoint instead
+  (serving/telemetry.py; this per-replica one samples a single
+  kernel-chosen replica).
+- `POST /admin/dump`  write the incident flight recorder's rings
+  (obs/flight.py: last-N terminal request records + anomaly events) to
+  a timestamped JSON file now; body `{"path": ...}`.
+
+Request-scoped tracing (obs/reqtrace.py, README "Telemetry"): every
+request carries a trace id — inbound W3C `traceparent` honored,
+otherwise minted — echoed in the `X-Trace-Id` + `traceparent` response
+headers on EVERY terminal status; the request's span tree (admission,
+cache lookup, extractor pool, batcher, the shared device-batch span,
+render) lands in the ring tracer for the bulk Chrome export and, with
+`--serve_debug_trace` + `?debug=trace`, in the response itself.
 
 Resilience semantics (serving/admission.py, serving/breaker.py; README
 "Operating the server"):
@@ -81,6 +95,8 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from code2vec_tpu import obs
+from code2vec_tpu.obs.flight import default_flight_recorder
+from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.serving.admission import (
     AdmissionController, Deadline, DeadlineExceeded, Shed,
     deadline_from_request, expired_counter,
@@ -181,11 +197,25 @@ class PredictionServer:
         self.admission = AdmissionController(
             max_depth=self.config.serve_queue_depth,
             concurrency=self.config.extractor_pool_size)
+        # Flight recorder (obs/flight.py): terminal request records +
+        # anomaly events, dumped on incident (README "Telemetry"). Dump
+        # dir defaults next to the heartbeat file so the supervisor's
+        # run dir collects every replica's black boxes.
+        self.flight = default_flight_recorder()
+        flight_dir = getattr(self.config, "serve_flight_dir", None)
+        if not flight_dir and self.config.heartbeat_file:
+            flight_dir = os.path.dirname(
+                os.path.abspath(self.config.heartbeat_file))
+        self.flight.configure(
+            dump_dir=flight_dir,
+            capacity=getattr(self.config, "serve_flight_records", 512),
+            log=self.log)
         breaker_kw = dict(
             window_s=self.config.serve_breaker_window_s,
             failure_ratio=self.config.serve_breaker_failure_ratio,
             min_requests=self.config.serve_breaker_min_requests,
-            cooldown_s=self.config.serve_breaker_cooldown_s)
+            cooldown_s=self.config.serve_breaker_cooldown_s,
+            on_transition=self._on_breaker_transition)
         self.extractor_breaker = CircuitBreaker("extractor", **breaker_kw)
         self.device_breaker = CircuitBreaker("device", **breaker_kw)
         self.swap = SwapManager(self)
@@ -240,6 +270,16 @@ class PredictionServer:
                          "now answers 503 (see /healthz retrieval)")
         return fp
 
+    def _on_breaker_transition(self, name: str, to: str) -> None:
+        """Breaker flips are flight-recorder anomalies; an OPEN is an
+        incident (auto-dump when a dump dir is configured) — the black
+        box captures both the failures that opened it and the shed storm
+        that follows."""
+        if to == "open":
+            self.flight.incident("breaker_open", breaker=name)
+        else:
+            self.flight.event("breaker_transition", breaker=name, to=to)
+
     def _batched_predict(self, lines):
         """The batcher's predict_fn: ONE model-reference read per batch
         (swap atomicity), device circuit breaker around the call, and
@@ -261,52 +301,80 @@ class PredictionServer:
 
     def handle_request(self, endpoint: str, code: str,
                        deadline: Optional[Deadline] = None,
-                       params: Optional[Dict] = None
+                       params: Optional[Dict] = None,
+                       trace: Optional[RequestTrace] = None
                        ) -> Tuple[int, bytes, Dict[str, str]]:
         """Full serve path for one request -> (http_status, body,
         extra_headers). EVERY terminal status lands in
         serving_request_seconds{phase=total,status=...} and
         serving_requests_total — overload and errors are measured, not
-        invisible."""
+        invisible. Every request carries a trace (inbound `traceparent`
+        or minted here): the id rides the X-Trace-Id response header,
+        the span tree lands in the ring tracer, and the terminal record
+        goes into the flight recorder."""
         t0 = time.perf_counter()
+        if trace is None:
+            trace = RequestTrace()
+        root = trace.span("request", endpoint=endpoint)
+        root.__enter__()
         phases: Dict[str, float] = {}
         status, body, headers = 500, b"", {}
+        reason: Optional[str] = None
         try:
             body = self._handle(endpoint, code, deadline, phases,
-                                params=params)
+                                params=params, trace=trace)
             status = 200
         except Shed as e:
             e.count()
             status = 503
+            reason = e.reason
             headers["Retry-After"] = str(max(1, int(round(
                 e.retry_after_s))))
-            body = json.dumps({"error": str(e), "shed": e.reason}
+            body = json.dumps({"error": str(e), "shed": e.reason,
+                               "trace_id": trace.trace_id}
                               ).encode() + b"\n"
         except DeadlineExceeded as e:
             status = 504
-            body = json.dumps({"error": f"deadline exceeded: {e}"}
+            reason = "deadline_expired"
+            self.flight.event("deadline_expired",
+                              trace_id=trace.trace_id, endpoint=endpoint)
+            body = json.dumps({"error": f"deadline exceeded: {e}",
+                               "trace_id": trace.trace_id}
                               ).encode() + b"\n"
         except _HTTPError as e:
             status = e.code
-            body = json.dumps({"error": str(e)}).encode() + b"\n"
+            body = json.dumps({"error": str(e),
+                               "trace_id": trace.trace_id}
+                              ).encode() + b"\n"
         except FaultInjected as e:
             # chaos drills must surface as honest errors, never hangs
             status = 500
-            body = json.dumps({"error": f"FaultInjected: {e}"}
+            body = json.dumps({"error": f"FaultInjected: {e}",
+                               "trace_id": trace.trace_id}
                               ).encode() + b"\n"
         except Exception as e:  # noqa: BLE001 — 500, not a torn socket
             status = 500
-            body = json.dumps({"error": f"{type(e).__name__}: {e}"}
+            body = json.dumps({"error": f"{type(e).__name__}: {e}",
+                               "trace_id": trace.trace_id}
                               ).encode() + b"\n"
         finally:
             total = time.perf_counter() - t0
+            root.attrs["status"] = status
+            root.__exit__(None, None, None)
             # snapshot: the batcher dispatcher can still write phase
             # keys for a request that exited early via the result
             # backstop — iterating the live dict could raise mid-walk
-            for phase, dur in list(phases.items()):
+            phases = dict(list(phases.items()))
+            for phase, dur in phases.items():
                 _H_PHASE[phase].observe(dur)
             _total_hist(str(status)).observe(total)
             _requests_counter(endpoint, str(status)).inc()
+            self.flight.record_request(
+                trace_id=trace.trace_id, endpoint=endpoint,
+                status=status, duration_s=total, phases=phases,
+                reason=reason, fingerprint=self.model_fingerprint)
+            headers.setdefault("X-Trace-Id", trace.trace_id)
+            headers.setdefault("traceparent", trace.traceparent())
         return status, body, headers
 
     def _neighbor_knobs(self, params: Optional[Dict]) -> Dict:
@@ -327,7 +395,10 @@ class PredictionServer:
     def _handle(self, endpoint: str, code: str,
                 deadline: Optional[Deadline],
                 phases: Dict[str, float],
-                params: Optional[Dict] = None) -> bytes:
+                params: Optional[Dict] = None,
+                trace: Optional[RequestTrace] = None) -> bytes:
+        if trace is None:
+            trace = RequestTrace()
         if not code.strip():
             raise _HTTPError(400, "empty request body")
         knobs: Dict = {}
@@ -345,19 +416,23 @@ class PredictionServer:
         model, fp = self._model_ref
         key = cache_key(code, endpoint=endpoint, topk=self.topk,
                         model=fp, **knobs)
-        cached = self.cache.get(key)
+        with trace.span("cache_lookup") as sp:
+            cached = self.cache.get(key)
+            sp.attrs["hit"] = cached is not None
         if cached is not None:
             # Cache hits serve BEFORE admission and breakers: graceful
             # degradation — a dead extractor pool cannot take the hit
             # path down with it (pinned in tests/test_serving_chaos.py).
             return cached  # type: ignore[return-value]
-        self.admission.admit(deadline)
+        with trace.span("admission"):
+            self.admission.admit(deadline)
         t_admit = time.perf_counter()
         worked = True
         try:
-            lines, hash_to_string = self._extract(code, deadline, phases)
+            lines, hash_to_string = self._extract(code, deadline, phases,
+                                                  trace=trace)
             future = self.batcher.submit(lines, phases=phases,
-                                         deadline=deadline)
+                                         deadline=deadline, trace=trace)
             try:
                 if deadline is not None and deadline.bounded:
                     # Backstop: the batcher settles expired futures
@@ -377,10 +452,11 @@ class PredictionServer:
                 raise
             results = [r for r, _ in raw]
             result_fp = raw[0][1] if raw else fp
-            body = json.dumps(
-                self._render(endpoint, results, hash_to_string,
-                             result_fp, knobs=knobs),
-                sort_keys=True).encode() + b"\n"
+            with trace.span("render"):
+                body = json.dumps(
+                    self._render(endpoint, results, hash_to_string,
+                                 result_fp, knobs=knobs, trace=trace),
+                    sort_keys=True).encode() + b"\n"
             if result_fp != fp:
                 # the model was hot-swapped between our cache probe and
                 # the device batch: key the entry by the weights that
@@ -402,14 +478,16 @@ class PredictionServer:
                 (time.perf_counter() - t_admit) if worked else -1.0)
 
     def _extract(self, code: str, deadline: Optional[Deadline],
-                 phases: Dict[str, float]):
+                 phases: Dict[str, float],
+                 trace: Optional[RequestTrace] = None):
         """Extractor-pool call behind its circuit breaker, with the
         request's remaining deadline budget as the per-request
         timeout."""
         self.extractor_breaker.check()
         try:
             result = self.pool.extract_source(code, phases=phases,
-                                              deadline=deadline)
+                                              deadline=deadline,
+                                              trace=trace)
         except DeadlineExceeded:
             # the request's budget, not the extractor's health: no
             # verdict recorded — but a half-open probe slot must be
@@ -448,7 +526,8 @@ class PredictionServer:
         return result
 
     def _render(self, endpoint: str, raw, hash_to_string,
-                fingerprint: str, knobs: Optional[Dict] = None) -> dict:
+                fingerprint: str, knobs: Optional[Dict] = None,
+                trace: Optional[RequestTrace] = None) -> dict:
         if endpoint == "embed":
             # embedding_fingerprint is the embedding-SPACE identity —
             # the same field /neighbors stamps — so a client holding
@@ -487,7 +566,8 @@ class PredictionServer:
                 [r.code_vector for r in raw], dtype=np.float32)
             try:
                 neighbor_lists = self.retrieval.neighbors(
-                    vectors, fingerprint, k=k, nprobe=nprobe)
+                    vectors, fingerprint, k=k, nprobe=nprobe,
+                    trace=trace)
             except EmbeddingSpaceMismatch as e:
                 raise _HTTPError(503, str(e))
             return {
@@ -572,6 +652,17 @@ class PredictionServer:
             },
             "breakers": {"extractor": self.extractor_breaker.state,
                          "device": self.device_breaker.state},
+            # request-scoped telemetry (README "Telemetry"): whether
+            # ?debug=trace is honored, and the flight recorder's state
+            "telemetry": {
+                "debug_trace": bool(getattr(self.config,
+                                            "serve_debug_trace", False)),
+                "flight": {
+                    "dump_dir": self.flight.dump_dir,
+                    "requests_recorded": self.flight.requests_recorded,
+                    "events_recorded": self.flight.events_recorded,
+                },
+            },
             # /neighbors data plane: attached/detached (+ the detach
             # reason — deploy tooling reads this after a hot-swap)
             "retrieval": (None if self.retrieval is None
@@ -652,21 +743,40 @@ class PredictionServer:
                     self._error(500, f"{type(e).__name__}: {e}")
 
             def do_POST(self):  # noqa: N802 (stdlib API name)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 endpoint = path.lstrip("/")
                 if path == "/admin/reload":
                     self._admin_reload()
                     return
+                if path == "/admin/dump":
+                    self._admin_dump()
+                    return
                 if endpoint not in ("predict", "embed", "neighbors"):
                     self._error(404, f"no such endpoint: {path}")
                     return
+                # Inbound W3C traceparent joins the caller's distributed
+                # trace; otherwise a trace id is minted. Either way the
+                # id is echoed in X-Trace-Id + traceparent (even on the
+                # shed/error paths below).
+                trace = RequestTrace.from_headers(
+                    self.headers.get("traceparent"))
+
+                def trace_headers(**extra):
+                    # built lazily: the fallback traceparent span id is
+                    # only minted on the early-terminal paths that
+                    # answer before handle_request opens the root span
+                    return dict({"X-Trace-Id": trace.trace_id,
+                                 "traceparent": trace.traceparent()},
+                                **extra)
+
                 deadline = deadline_from_request(
                     server.config, self.headers.get("X-Deadline-Ms"))
                 if not server._enter_request():
                     Shed("draining", "").count()
                     _requests_counter(endpoint, "draining").inc()
                     self._error(503, "server is draining",
-                                extra_headers={"Retry-After": "1"})
+                                extra_headers=trace_headers(
+                                    **{"Retry-After": "1"}))
                     return
                 try:
                     try:
@@ -677,13 +787,45 @@ class PredictionServer:
                             raw, self.headers)
                     except _HTTPError as e:
                         _requests_counter(endpoint, str(e.code)).inc()
-                        self._error(e.code, str(e))
+                        self._error(e.code, str(e),
+                                    extra_headers=trace_headers())
                         return
                     status, body, headers = server.handle_request(
-                        endpoint, code_text, deadline, params=params)
+                        endpoint, code_text, deadline, params=params,
+                        trace=trace)
+                    if ("debug=trace" in query.split("&")
+                            and server.config.serve_debug_trace):
+                        # post-cache injection: hits and misses both
+                        # carry THIS request's tree, and the cached
+                        # bytes stay trace-free/byte-stable
+                        body = server._inject_trace(body, trace)
                     self._respond(status, body, extra_headers=headers)
                 finally:
                     server._exit_request()
+
+            def _admin_dump(self) -> None:
+                """POST /admin/dump: write the flight-recorder rings to
+                a timestamped JSON file now; body {"path": ...}."""
+                try:
+                    # drain the (ignored) request body: unread bytes
+                    # would desync the next request on this HTTP/1.1
+                    # keep-alive connection
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)
+                    path = server.flight.dump(reason="admin")
+                    # counts from the file itself, so the response can
+                    # never disagree with what was actually written
+                    with open(path) as f:
+                        written = json.load(f)
+                except Exception as e:  # noqa: BLE001
+                    self._error(500, f"{type(e).__name__}: {e}")
+                else:
+                    self._respond(200, json.dumps(
+                        {"path": path,
+                         "requests": len(written["requests"]),
+                         "events": len(written["events"])},
+                        sort_keys=True).encode() + b"\n")
 
             def _admin_reload(self) -> None:
                 try:
@@ -746,6 +888,21 @@ class PredictionServer:
         return self.port
 
     @staticmethod
+    def _inject_trace(body: bytes, trace: RequestTrace) -> bytes:
+        """`?debug=trace` (gated by --serve_debug_trace): append the
+        request's span tree to the JSON response. Runs AFTER the cache
+        layer, so cached bytes never embed a stale trace and the hit
+        path stays byte-equal to the miss path for normal requests."""
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return body
+        if not isinstance(payload, dict):
+            return body
+        payload["trace"] = trace.to_dict()
+        return json.dumps(payload, sort_keys=True).encode() + b"\n"
+
+    @staticmethod
     def _decode_body(raw: bytes, headers) -> Tuple[str, Optional[Dict]]:
         """(code, extra params). JSON bodies may carry per-request
         knobs beside "code" (today: /neighbors' `k` and `nprobe`);
@@ -793,6 +950,8 @@ class PredictionServer:
                   else self.config.serve_drain_timeout_s)
         self.log(f"Drain: refusing new requests, waiting up to "
                  f"{budget:g}s for {self._inflight} in-flight")
+        self.flight.event("drain_start", inflight=self._inflight,
+                          budget_s=budget)
         deadline = time.monotonic() + budget
         clean = True
         with self._inflight_cond:
@@ -803,6 +962,9 @@ class PredictionServer:
                     self.abandoned_requests = self._inflight
                     self.log(f"Drain timeout: {self._inflight} "
                              f"request(s) still in flight (abandoned)")
+                    self.flight.incident(
+                        "drain_timeout", immediate=True,
+                        abandoned=self._inflight)
                     break
                 self._inflight_cond.wait(timeout=remaining)
         self.batcher.drain(timeout=max(deadline - time.monotonic(), 1.0))
@@ -885,9 +1047,26 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
         prev_int = signal.signal(signal.SIGINT, _on_signal)
         if hasattr(signal, "SIGHUP"):
             prev_hup = signal.signal(signal.SIGHUP, _on_hup)
+    if config.trace_export:
+        # bulk per-request span trees ride the same ring the trainer
+        # uses; exported as ONE Chrome trace at shutdown
+        obs.default_tracer().enable()
     server.start()
 
     hb_stop = threading.Event()
+
+    def _publish():
+        if config.heartbeat_file:
+            obs.exporters.write_heartbeat(
+                config.heartbeat_file,
+                status="draining" if server._draining else "serving",
+                **_heartbeat_fields(server))
+        if config.metrics_file:
+            # the replica's fleet-telemetry feed: an atomic snapshot the
+            # supervisor merges into its /metrics and /fleet views
+            # (serving/telemetry.py) — rewritten every ticker interval,
+            # not just at exit
+            obs.exporters.write_prometheus(config.metrics_file)
 
     def _heartbeat_loop():
         while not hb_stop.wait(config.serve_heartbeat_interval_s):
@@ -895,15 +1074,10 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
             # replica (exit) — the supervisor's stale-heartbeat /
             # crash detection drills.
             fault_point("replica_heartbeat")
-            obs.exporters.write_heartbeat(
-                config.heartbeat_file,
-                status="draining" if server._draining else "serving",
-                **_heartbeat_fields(server))
+            _publish()
 
-    if config.heartbeat_file:
-        obs.exporters.write_heartbeat(
-            config.heartbeat_file, status="serving",
-            **_heartbeat_fields(server))
+    if config.heartbeat_file or config.metrics_file:
+        _publish()
         threading.Thread(target=_heartbeat_loop, name="serving-heartbeat",
                          daemon=True).start()
     try:
@@ -918,6 +1092,10 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
                 signal.signal(signal.SIGHUP, prev_hup)
         if config.metrics_file:
             obs.exporters.write_prometheus(config.metrics_file)
+        if config.trace_export:
+            obs.default_tracer().export_chrome_trace(config.trace_export)
+            config.log(f"Serving span trace written to "
+                       f"{config.trace_export}")
         if config.heartbeat_file:
             obs.exporters.write_heartbeat(
                 config.heartbeat_file,

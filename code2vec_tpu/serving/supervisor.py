@@ -29,6 +29,19 @@ self-healing N-replica service:
   replica (each runs its own in-flight drain bounded by
   serve_drain_timeout_s); the supervisor exits 0 only when every
   replica exited 0.
+- **Fleet telemetry** (serving/telemetry.py, README "Telemetry"): each
+  replica publishes an atomic Prometheus snapshot (--metrics_file,
+  appended per replica below) every heartbeat interval; the supervisor
+  serves the MERGE at ``GET /metrics`` on its telemetry listener
+  (--serve_telemetry_port, default public port + 1) plus a
+  ``GET /fleet`` JSON view (per-replica breaker state, shed rate,
+  heartbeat staleness, restarts, fingerprint). This is the documented
+  scrape address under reuseport — a scrape of the shared public port
+  reaches ONE kernel-chosen replica and samples a random shard of the
+  fleet. In proxy mode the public port answers both paths itself.
+  Replica restarts are flight-recorder events and an escalation is an
+  incident with a synchronous ring dump into the run dir
+  (obs/flight.py).
 
 The supervisor's own heartbeat records per-replica pid/port/restarts so
 "which replica is which process" is answerable from the file alone —
@@ -91,10 +104,12 @@ def _free_port(host: str) -> int:
 
 
 class _Replica:
-    def __init__(self, index: int, heartbeat_path: str, log_path: str):
+    def __init__(self, index: int, heartbeat_path: str, log_path: str,
+                 metrics_path: Optional[str] = None):
         self.index = index
         self.heartbeat_path = heartbeat_path
         self.log_path = log_path
+        self.metrics_path = metrics_path
         self.proc: Optional[subprocess.Popen] = None
         self.pipe_r: Optional[int] = None
         self.port: Optional[int] = None
@@ -125,10 +140,17 @@ class Supervisor:
         if child_command is not None:
             self.child_command = list(child_command)
         else:
+            stripped = strip_flag(list(argv or []), "--replicas")
+            # each replica gets its OWN --metrics_file (the fleet
+            # telemetry feed) and --trace_export — a user-supplied path
+            # would have every replica overwrite the same file (the
+            # atomic tmp+rename makes the clobber silent: last replica
+            # to exit wins)
+            stripped = strip_flag(stripped, "--metrics_file")
+            stripped = strip_flag(stripped, "--trace_export")
             self.child_command = ([sys.executable, "-m",
-                                   "code2vec_tpu.cli"]
-                                  + strip_flag(list(argv or []),
-                                               "--replicas"))
+                                   "code2vec_tpu.cli"] + stripped)
+        self.trace_export = bool(getattr(config, "trace_export", None))
         base = (os.path.dirname(os.path.abspath(config.heartbeat_file))
                 if config.heartbeat_file else None)
         self.run_dir = base or tempfile.mkdtemp(prefix="c2v-serve-sup-")
@@ -145,13 +167,22 @@ class Supervisor:
             _Replica(i,
                      os.path.join(self.run_dir,
                                   f"replica{i}.heartbeat.json"),
-                     os.path.join(self.run_dir, f"replica{i}.log"))
+                     os.path.join(self.run_dir, f"replica{i}.log"),
+                     os.path.join(self.run_dir,
+                                  f"replica{i}.metrics.prom"))
             for i in range(self.n)]
         self._stop = threading.Event()
         self._escalated = False
         self._proxy = None
         self._rr_lock = threading.Lock()
         self._rr_next = 0
+        self._telemetry = None
+        # Supervisor-side flight recorder: replica restarts are anomaly
+        # events, an escalation is an incident with a synchronous dump
+        # into the run dir (the replicas' own dumps land there too when
+        # --heartbeat_file puts their run files in one place).
+        self.flight = obs.default_flight_recorder()
+        self.flight.configure(dump_dir=self.run_dir, log=self.log)
 
     # ------------------------------------------------------------ spawn
 
@@ -163,6 +194,21 @@ class Supervisor:
         replica.port = None
         cmd = list(self.child_command)
         cmd += ["--heartbeat_file", replica.heartbeat_path]
+        if replica.metrics_path:
+            # the replica's fleet-telemetry feed: an atomic Prometheus
+            # snapshot rewritten every heartbeat interval, merged by
+            # the supervisor's /metrics + /fleet (serving/telemetry.py).
+            # A restarted replica's counters restart from zero — the
+            # stale pre-crash file would double-count, so drop it.
+            try:
+                os.remove(replica.metrics_path)
+            except OSError:
+                pass
+            cmd += ["--metrics_file", replica.metrics_path]
+        if self.trace_export:
+            cmd += ["--trace_export",
+                    os.path.join(self.run_dir,
+                                 f"replica{replica.index}.trace.json")]
         env = dict(os.environ)
         env[REPLICA_ENV] = str(replica.index)
         if self.reuseport:
@@ -260,9 +306,15 @@ class Supervisor:
             self.log(f"Replica {replica.index} {why}; restart budget "
                      f"({self.config.serve_max_restarts}) exhausted — "
                      f"escalating to supervisor exit")
+            self.flight.incident(
+                "replica_escalation", immediate=True,
+                replica=replica.index, why=why,
+                restarts=replica.restarts)
             return False
         replica.restarts += 1
         _C_RESTARTS.inc()
+        self.flight.event("replica_restart", replica=replica.index,
+                          why=why, restart=replica.restarts)
         backoff = min(0.5 * (2 ** (replica.restarts - 1)), 10.0)
         replica.restart_at = time.monotonic() + backoff
         self.log(f"Replica {replica.index} {why}; restart "
@@ -276,6 +328,8 @@ class Supervisor:
             role="serving-supervisor",
             mode="reuseport" if self.reuseport else "proxy",
             port=self.port,
+            telemetry_port=(self._telemetry.port
+                            if self._telemetry else None),
             replicas=[{
                 "index": r.index,
                 "pid": r.proc.pid if r.proc is not None else None,
@@ -284,6 +338,88 @@ class Supervisor:
                 "restarts": r.restarts,
                 "heartbeat_file": r.heartbeat_path,
             } for r in self.replicas], **extra)
+
+    # -------------------------------------------------------- telemetry
+
+    def merged_metrics(self) -> str:
+        """Fleet-accurate /metrics: every replica's latest snapshot file
+        parsed and merged (counters/histograms summed, gauges labeled
+        replica="<i>"), plus the supervisor's own registry as
+        replica="supervisor" — fixes the reuseport one-replica-scrape
+        gap (README "Telemetry")."""
+        from code2vec_tpu.serving import telemetry
+        snapshots = {}
+        for replica in self.replicas:
+            if not replica.metrics_path:
+                continue
+            try:
+                with open(replica.metrics_path) as f:
+                    snapshots[str(replica.index)] = f.read()
+            except OSError:
+                continue  # not written yet / replica restarting
+        snapshots["supervisor"] = \
+            obs.default_registry().render_prometheus()
+        return telemetry.merge_prometheus_snapshots(snapshots)
+
+    def fleet_view(self) -> dict:
+        """GET /fleet: the signal set the ROADMAP fleet item consumes —
+        per-replica liveness, heartbeat staleness, breaker state, shed
+        rate, restart count and model fingerprint, from the heartbeats
+        the supervisor already monitors."""
+        from code2vec_tpu.serving import telemetry
+        now = time.time()
+        return {
+            "mode": "reuseport" if self.reuseport else "proxy",
+            "port": self.port,
+            "telemetry_port": (self._telemetry.port
+                               if self._telemetry else None),
+            "replica_count": self.n,
+            "escalated": self._escalated,
+            "stale_after_s": self._stale_after(),
+            "replicas": [dict(
+                telemetry.fleet_replica_view(r.heartbeat(), now),
+                index=r.index,
+                pid=r.proc.pid if r.proc is not None else None,
+                port=r.port,
+                alive=r.alive,
+                restarts=r.restarts,
+                in_backoff=r.restart_at is not None,
+            ) for r in self.replicas],
+        }
+
+    def _resolve_telemetry_port(self) -> int:
+        configured = getattr(self.config, "serve_telemetry_port", None)
+        if configured is not None:
+            return int(configured)
+        # default: the public port + 1 — a deterministic scrape address
+        # next to the service (0 below falls back to a free port when
+        # the public port was itself dynamic)
+        return self.port + 1 if self.port else 0
+
+    def _start_telemetry(self) -> None:
+        from code2vec_tpu.serving.telemetry import TelemetryServer
+        explicit = getattr(self.config, "serve_telemetry_port",
+                           None) is not None
+        port = self._resolve_telemetry_port()
+        try:
+            self._telemetry = TelemetryServer(
+                self.merged_metrics, self.fleet_view,
+                host=self.config.serve_host, port=port)
+        except OSError as e:
+            if explicit or port == 0:
+                # an operator-pinned scrape address that cannot bind is
+                # a startup error (like the public port) — a silent
+                # fallback would leave Prometheus scraping the wrong
+                # process while the fleet reports healthy
+                raise
+            self.log(f"Telemetry port {port} (public port + 1 default) "
+                     f"unavailable ({e}); binding a free port instead")
+            self._telemetry = TelemetryServer(
+                self.merged_metrics, self.fleet_view,
+                host=self.config.serve_host, port=0)
+        self.log(f"Fleet telemetry on http://{self.config.serve_host}:"
+                 f"{self._telemetry.port} (GET /metrics merged across "
+                 f"replicas, GET /fleet)")
 
     # ------------------------------------------------------------ proxy
 
@@ -316,7 +452,8 @@ class Supervisor:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 fwd_headers = {}
-                for name in ("Content-Type", "X-Deadline-Ms"):
+                for name in ("Content-Type", "X-Deadline-Ms",
+                             "traceparent"):
                     if self.headers.get(name):
                         fwd_headers[name] = self.headers[name]
                 ports = sup._live_ports()
@@ -340,9 +477,14 @@ class Supervisor:
                             resp = conn.getresponse()
                             payload = resp.read()
                             headers = {}
-                            if resp.getheader("Retry-After"):
-                                headers["Retry-After"] = \
-                                    resp.getheader("Retry-After")
+                            # trace headers ride back through the
+                            # proxy: the id must reach the client on
+                            # EVERY terminal status or proxy mode
+                            # breaks the correlation contract
+                            for name in ("Retry-After", "X-Trace-Id",
+                                         "traceparent"):
+                                if resp.getheader(name):
+                                    headers[name] = resp.getheader(name)
                             ctype = resp.getheader(
                                 "Content-Type", "application/json")
                             self.send_response(resp.status)
@@ -368,7 +510,36 @@ class Supervisor:
                     {"Retry-After": "1"})
 
             def do_GET(self):  # noqa: N802
+                # fleet views are answered HERE, not forwarded: a
+                # round-robined /metrics would sample one replica —
+                # the exact gap the merged endpoint exists to fix
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/fleet"):
+                    try:
+                        if path == "/metrics":
+                            self._reply_raw(
+                                200, sup.merged_metrics().encode(),
+                                "text/plain; version=0.0.4; "
+                                "charset=utf-8")
+                        else:
+                            self._reply(200, json.dumps(
+                                sup.fleet_view(),
+                                sort_keys=True).encode() + b"\n")
+                    except Exception as e:  # noqa: BLE001 — a scraper
+                        # must get an HTTP error, never a torn
+                        # connection
+                        self._reply(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ).encode() + b"\n")
+                    return
                 self._forward("GET")
+
+            def _reply_raw(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_POST(self):  # noqa: N802
                 self._forward("POST")
@@ -415,6 +586,7 @@ class Supervisor:
     def _run_inner(self) -> int:
         if not self.reuseport:
             self._start_proxy()
+        self._start_telemetry()
         mode = "SO_REUSEPORT" if self.reuseport else "proxy"
         self.log(f"Serving supervisor: {self.n} replica(s), {mode} on "
                  f"port {self.port}, restart budget "
@@ -495,6 +667,8 @@ class Supervisor:
                 self._proxy.server_close()
             except Exception:
                 pass
+        if self._telemetry is not None:
+            self._telemetry.close()
         self._write_heartbeat(
             "error" if (escalated or not clean) else "done",
             escalated=escalated)

@@ -123,7 +123,11 @@ class SwapManager:
         return ReleaseModel(config, log=self.log)
 
     def _reload_worker(self, artifact_dir: str) -> None:
+        from code2vec_tpu.obs.flight import default_flight_recorder
+        flight = default_flight_recorder()
         old_model = self.server.model
+        flight.event("swap_start", target=artifact_dir,
+                     old_fingerprint=self.server.model_fingerprint)
         try:
             fault_point("swap_validate")
             new_model = self._build_model(artifact_dir)
@@ -135,6 +139,8 @@ class SwapManager:
             self._set(state="failed",
                       error=f"{type(e).__name__}: {e}",
                       completed_at=time.time())
+            flight.event("swap_failed", target=artifact_dir,
+                         error=f"{type(e).__name__}: {e}")
             self.log(f"Model swap to {artifact_dir} REJECTED "
                      f"({type(e).__name__}: {e}); old model "
                      f"{self.server.model_fingerprint} keeps serving")
@@ -143,6 +149,8 @@ class SwapManager:
         _swap_counter("success").inc()
         self._set(state="ready", completed_at=time.time(),
                   swapped_fingerprint=fp)
+        flight.event("swap_committed", target=artifact_dir,
+                     fingerprint=fp)
         self.log(f"Model swapped live to {artifact_dir} "
                  f"(fingerprint {fp})")
 

@@ -1,0 +1,297 @@
+"""Fleet telemetry aggregation: the supervisor's merged /metrics + /fleet.
+
+THE PROBLEM (PR 9's documented gap): under `serve --replicas N` with
+SO_REUSEPORT, every replica binds the SAME port and the kernel picks
+which one answers a connection — so a Prometheus scrape of `/metrics`
+(and a probe of `/healthz`) reaches ONE kernel-chosen replica. Fleet
+signals — shed rate, breaker state, phase p99s — were sampled from a
+random shard of the truth, and the ROADMAP's cross-host fleet item
+plans to autoscale and route off exactly those signals.
+
+THE FIX: each replica already publishes an atomic Prometheus snapshot
+(`--metrics_file`, the PR-2 file exporter, rewritten every heartbeat
+interval — the supervisor appends a per-replica path to every child
+command). This module parses those snapshots and merges them:
+
+- **counters** and **histograms** (bucket counts, `_sum`, `_count`) are
+  SUMMED across replicas — `serving_requests_total` on the merged
+  endpoint equals the sum of the per-replica counters (pinned in
+  tests/test_telemetry.py);
+- **gauges** are NOT summable (the mean of two breaker states is
+  nonsense) — each replica's gauge exports with an added
+  `replica="<i>"` label.
+
+The supervisor serves the merge at `GET /metrics` on its telemetry
+listener (`--serve_telemetry_port`, default public port + 1) — the
+documented scrape address for a replicated deployment — plus
+`GET /fleet`: a JSON view of per-replica breaker state, shed rate,
+heartbeat staleness, restart count and model fingerprint (read from the
+replica heartbeats the supervisor already monitors). In proxy mode the
+public port intercepts `/metrics` and `/fleet` too, so the old scrape
+address keeps working there.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# One exposition-format sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+LabelsKey = Tuple[Tuple[str, str], ...]
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def _escape(v: str) -> str:
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return float("inf")
+    if raw == "-Inf":
+        return float("-inf")
+    return float(raw)
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Family:
+    """One parsed metric family: kind, help, and (labels -> value)
+    samples. `base_name` strips the _bucket/_sum/_count suffix a
+    histogram sample line carries."""
+
+    __slots__ = ("name", "kind", "help", "samples")
+
+    def __init__(self, name: str, kind: str = "untyped", help: str = ""):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        # sample "sub-name" (e.g. foo_bucket) -> {labels_key: value}
+        self.samples: Dict[str, Dict[LabelsKey, float]] = {}
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Family]:
+    """Parse exposition-format text (what obs.render_prometheus and any
+    conformant exporter emit) into families. Histogram sample lines
+    (`x_bucket`/`x_sum`/`x_count`) attach to the `x` family declared by
+    the TYPE line. Unparsable lines are skipped, not fatal — a merge
+    must survive one torn/foreign snapshot."""
+    families: Dict[str, Family] = {}
+    # histogram/summary sample names map back to the declaring family
+    subname_to_family: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            fam = families.setdefault(name, Family(name))
+            fam.help = help_text
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            fam = families.setdefault(name, Family(name))
+            fam.kind = kind.strip() or "untyped"
+            if fam.kind == "histogram":
+                for suffix in ("_bucket", "_sum", "_count"):
+                    subname_to_family[name + suffix] = name
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        sample_name, _, labels_raw, value_raw = m.groups()
+        fam_name = subname_to_family.get(sample_name, sample_name)
+        fam = families.setdefault(fam_name, Family(fam_name))
+        labels: LabelsKey = tuple(sorted(
+            (k, _unescape(v))
+            for k, v in _LABEL_RE.findall(labels_raw or "")))
+        try:
+            value = _parse_value(value_raw)
+        except ValueError:
+            continue
+        fam.samples.setdefault(sample_name, {})[labels] = value
+    return families
+
+
+def merge_prometheus_snapshots(snapshots: Dict[str, str],
+                               gauge_label: str = "replica") -> str:
+    """Merge per-replica exposition-text snapshots into ONE exposition
+    text: counter + histogram samples summed across replicas by (sample
+    name, labels); gauge/untyped samples kept per replica with an added
+    `replica="<id>"` label. Returns render-ready text."""
+    merged: Dict[str, Family] = {}
+    for replica_id in sorted(snapshots):
+        for name, fam in parse_prometheus_text(
+                snapshots[replica_id]).items():
+            out = merged.setdefault(name, Family(name, fam.kind,
+                                                 fam.help))
+            if out.kind == "untyped" and fam.kind != "untyped":
+                out.kind = fam.kind
+            if not out.help:
+                out.help = fam.help
+            summable = fam.kind in ("counter", "histogram")
+            for sample_name, by_labels in fam.samples.items():
+                dest = out.samples.setdefault(sample_name, {})
+                for labels, value in by_labels.items():
+                    if summable:
+                        dest[labels] = dest.get(labels, 0.0) + value
+                    else:
+                        key = tuple(sorted(
+                            labels + ((gauge_label, str(replica_id)),)))
+                        dest[key] = value
+    lines: List[str] = []
+    for name in sorted(merged):
+        fam = merged[name]
+        if fam.help:
+            lines.append(f"# HELP {name} {fam.help}")
+        lines.append(f"# TYPE {name} {fam.kind}")
+        for sample_name in sorted(fam.samples):
+            by_labels = fam.samples[sample_name]
+            for labels in sorted(by_labels):
+                label_str = ""
+                if labels:
+                    inner = ",".join(f'{k}="{_escape(v)}"'
+                                     for k, v in labels)
+                    label_str = "{" + inner + "}"
+                lines.append(f"{sample_name}{label_str} "
+                             f"{_format_value(by_labels[labels])}")
+    return "\n".join(lines) + "\n"
+
+
+def sum_family(text_or_families, name: str,
+               **label_filter) -> float:
+    """Sum one family's samples (optionally filtered by labels) from
+    exposition text — the supervisor's /fleet shed-rate math and the
+    tests both use it."""
+    families = (parse_prometheus_text(text_or_families)
+                if isinstance(text_or_families, str) else text_or_families)
+    fam = families.get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for labels in fam.samples.get(name, {}):
+        d = dict(labels)
+        if all(d.get(k) == str(v) for k, v in label_filter.items()):
+            total += fam.samples[name][labels]
+    return total
+
+
+def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
+    """The per-replica slice of GET /fleet, derived from one replica
+    heartbeat (serving/server.py _heartbeat_fields). None-tolerant: a
+    replica that has not written a heartbeat yet reports nulls, not a
+    crash."""
+    if not heartbeat:
+        return {"status": None, "heartbeat_age_s": None,
+                "model_fingerprint": None, "breakers": None,
+                "requests_total": None, "requests_shed_total": None,
+                "requests_expired_total": None,
+                "shed_rate": None, "swap_state": None, "inflight": None}
+    total = heartbeat.get("requests_total")
+    shed = heartbeat.get("requests_shed_total")
+    shed_rate = None
+    if isinstance(total, (int, float)) and total:
+        shed_rate = round(float(shed or 0) / float(total), 6)
+    elif isinstance(total, (int, float)):
+        shed_rate = 0.0
+    return {
+        "status": heartbeat.get("status"),
+        "heartbeat_age_s": round(
+            max(now - float(heartbeat.get("wall_time", 0.0)), 0.0), 3),
+        "model_fingerprint": heartbeat.get("model_fingerprint"),
+        "breakers": heartbeat.get("breakers"),
+        "requests_total": total,
+        "requests_shed_total": shed,
+        "requests_expired_total": heartbeat.get(
+            "requests_expired_total"),
+        "shed_rate": shed_rate,
+        "swap_state": heartbeat.get("swap_state"),
+        "inflight": heartbeat.get("inflight"),
+    }
+
+
+class TelemetryServer:
+    """The supervisor's telemetry listener: GET /metrics (merged
+    exposition text), GET /fleet (JSON). Callback-driven so the
+    supervisor owns the data and this stays a framing shim, exactly
+    like PredictionServer's HTTP layer."""
+
+    def __init__(self, merged_metrics_fn, fleet_fn,
+                 host: str = "127.0.0.1", port: int = 0):
+        telem = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _respond(self, code: int, body: bytes,
+                         ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path in ("/metrics", "/"):
+                        self._respond(
+                            200, telem.merged_metrics_fn().encode(),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+                    elif path == "/fleet":
+                        self._respond(200, json.dumps(
+                            telem.fleet_fn(),
+                            sort_keys=True).encode() + b"\n")
+                    else:
+                        self._respond(404, json.dumps(
+                            {"error": f"no such endpoint: {path}"}
+                        ).encode() + b"\n")
+                except Exception as e:  # noqa: BLE001 — a scraper must
+                    # get an HTTP error, never a torn connection
+                    self._respond(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode() + b"\n")
+
+        self.merged_metrics_fn = merged_metrics_fn
+        self.fleet_fn = fleet_fn
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="serving-telemetry", daemon=True).start()
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass  # teardown must never mask the supervisor exit path

@@ -116,7 +116,7 @@ def _counter(name: str, **labels) -> float:
 
 
 def run_scenario(model, sources, n_clients: int, cache_entries: int,
-                 log) -> dict:
+                 log, keep_latencies: bool = False) -> dict:
     import dataclasses
 
     from code2vec_tpu.serving.server import PredictionServer
@@ -183,6 +183,10 @@ def run_scenario(model, sources, n_clients: int, cache_entries: int,
             "cache_hit_rate": round(hits / n_req, 3) if n_req else 0.0,
             "batches_dispatched": server.batcher.batches_dispatched,
         }
+        if keep_latencies:
+            # raw per-request samples for cross-scenario pooling (the
+            # tracing A/B); not written into serving.json
+            result["_latencies"] = latencies
         log(f"  clients={n_clients} cache={'on' if cache_entries else 'off'}"
             f": p50={result['p50_ms']}ms p99={result['p99_ms']}ms "
             f"{result['methods_per_s']} methods/s "
@@ -645,6 +649,123 @@ def resilience_main() -> None:
             os.path.join(diag, "serving_resilience_metrics.prom"))
 
 
+TRACING_OUT_PATH = os.path.join(
+    REPO, "experiments", "results", "serving_tracing.json")
+
+
+def tracing_main() -> None:
+    """PR-2-discipline tracing-overhead A/B: the cache-OFF serving
+    path (every request pays the full traced pipeline) with
+    request-scoped span collection ON vs OFF (RequestTrace.collect —
+    the C2V_SERVE_NO_REQTRACE escape hatch), PAIRED per request inside
+    one concurrent load stream. Acceptance: cache-off p50 regresses
+    < 2%."""
+    from code2vec_tpu.obs.reqtrace import RequestTrace
+
+    def log(msg: str) -> None:
+        print(msg, flush=True)
+
+    import dataclasses
+    import itertools
+
+    from code2vec_tpu.serving.server import PredictionServer
+
+    log("Building model + corpus (tracing overhead A/B) ...")
+    model = build_model()
+    sources = make_corpus()
+    # ONE server, and the arms alternate PER REQUEST (a per-instance
+    # `collect` shadowing the class flag) inside the same concurrent
+    # load stream: both arms sample identical machine conditions, GIL
+    # pressure and batch composition, so slow drift and abrupt noise
+    # (GC, frequency steps) cancel exactly — block- or scenario-level
+    # A/Bs on this path drift by more than the effect being measured.
+    # Latency is taken at the handle_request boundary (the resilience
+    # bench's server-side convention), tagged by arm in the wrapper.
+    config = dataclasses.replace(model.config, serve_cache_entries=0)
+    server = PredictionServer(model, config, log=lambda m: None)
+    port = server.start(port=0)
+    pooled = {"off": [], "on": []}
+    lock = threading.Lock()
+    counter = itertools.count()
+    orig_handle = server.handle_request
+
+    def paired_handle(endpoint, code, deadline=None, params=None,
+                      trace=None):
+        arm = ("off", "on")[next(counter) % 2]
+        trace = RequestTrace()
+        trace.collect = arm == "on"   # instance shadows the class flag
+        t0 = time.perf_counter()
+        out = orig_handle(endpoint, code, deadline=deadline,
+                          params=params, trace=trace)
+        dt = time.perf_counter() - t0
+        with lock:
+            pooled[arm].append(dt)
+        return out
+
+    n_clients, reqs_per_client = 4, 240
+    try:
+        for src in sources:   # warmup: compiles + pool spin-up
+            _post(port, src)
+        server.handle_request = paired_handle
+
+        def client(ci):
+            rng = random.Random(500 + ci)
+            order = list(range(len(sources)))
+            rng.shuffle(order)
+            for k in range(reqs_per_client):
+                _post(port, sources[order[k % len(order)]])
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        log(f"  paired load done: "
+            f"{len(pooled['off'])} off / {len(pooled['on'])} on samples")
+    finally:
+        server.handle_request = orig_handle
+        server.drain(timeout=30)
+    stats = {}
+    for arm, samples in pooled.items():
+        ordered = sorted(samples)
+        stats[arm] = {
+            "samples": len(ordered),
+            "p50_ms": round(_pct(ordered, 0.50) * 1e3, 2),
+            "p90_ms": round(_pct(ordered, 0.90) * 1e3, 2),
+            "p99_ms": round(_pct(ordered, 0.99) * 1e3, 2),
+            "mean_ms": round(statistics.mean(ordered) * 1e3, 2),
+        }
+    p50_off, p50_on = stats["off"]["p50_ms"], stats["on"]["p50_ms"]
+    regression_pct = round((p50_on - p50_off) / p50_off * 100.0, 2)
+    out = {
+        "bench": "serving_tracing_overhead",
+        "scenario": "cache_off, %d clients x %d requests, one warmed "
+                    "server, arms alternated PER REQUEST (paired), "
+                    "server-side handle_request latency"
+                    % (n_clients, reqs_per_client),
+        "p50_off_ms": p50_off,
+        "p50_on_ms": p50_on,
+        "p99_off_ms": stats["off"]["p99_ms"],
+        "p99_on_ms": stats["on"]["p99_ms"],
+        "mean_off_ms": stats["off"]["mean_ms"],
+        "mean_on_ms": stats["on"]["mean_ms"],
+        "samples_per_arm": stats["off"]["samples"],
+        "p50_regression_pct": regression_pct,
+        "acceptance_bar_pct": 2.0,
+        "accepted": regression_pct < 2.0,
+        "arms": stats,
+    }
+    os.makedirs(os.path.dirname(TRACING_OUT_PATH), exist_ok=True)
+    with open(TRACING_OUT_PATH, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    log(f"Tracing overhead: p50 off={p50_off}ms on={p50_on}ms "
+        f"({regression_pct:+.2f}%, bar <2%) -> "
+        f"{'ACCEPTED' if out['accepted'] else 'REGRESSION'}")
+    log(f"Wrote {TRACING_OUT_PATH}")
+
+
 def main() -> None:
     def log(msg: str) -> None:
         print(msg, flush=True)
@@ -695,5 +816,7 @@ if __name__ == "__main__":
         resilience_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "loadgen":
         loadgen_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "tracing":
+        tracing_main()
     else:
         main()

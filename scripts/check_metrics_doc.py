@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Static metric-name documentation check (tier-1 via
+tests/test_metrics_doc.py).
+
+Every metric registered under `code2vec_tpu/` must appear in the
+README's canonical metrics reference (the table between the
+`<!-- metrics-table:begin -->` / `<!-- metrics-table:end -->` markers
+in the "Telemetry" section), and every name in that table must still be
+registered somewhere in the code — new metrics cannot ship
+undocumented, and the table cannot rot as metrics are renamed away.
+
+Registered names are extracted by AST walk: any call
+`<something>.counter("name", ...)` / `.gauge(...)` / `.histogram(...)`
+with a literal first argument (the repo convention — obs module
+helpers, MetricsRegistry methods and the tracer's internal handles all
+match). A non-literal first argument is an ERROR: a dynamically-named
+metric cannot be statically checked, so the name must be lifted into a
+literal (labels are the supported dynamic dimension).
+
+Usage: python scripts/check_metrics_doc.py  (exit 0 = consistent)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "code2vec_tpu")
+README = os.path.join(REPO_ROOT, "README.md")
+
+BEGIN_MARKER = "<!-- metrics-table:begin -->"
+END_MARKER = "<!-- metrics-table:end -->"
+
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+# registry-internal plumbing whose first positional arg is a metric
+# name but which is always reached through the public helpers above
+_IGNORED_FILES = {os.path.join("obs", "metrics.py"),
+                  os.path.join("obs", "__init__.py")}
+
+# Dynamically-named registrations the AST walk cannot see through,
+# declared here as the closed set of names they produce (the evaluator
+# turns every ModelEvaluationResults.tb_scalars() tag into an
+# `eval_<tag>` gauge). A file listed here may use non-literal names;
+# the names still participate in BOTH check directions, so this list
+# rots loudly (a vanished gauge becomes a STALE DOC error once dropped
+# from the README, and an undeclared new tag shows up UNDOCUMENTED in
+# any scrape-diff review).
+_DYNAMIC_REGISTRATIONS = {
+    os.path.join("evaluation", "evaluator.py"): (
+        "eval_top1_acc", "eval_topk_acc", "eval_subtoken_precision",
+        "eval_subtoken_recall", "eval_subtoken_f1", "eval_loss"),
+}
+
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# the metric name is the FIRST cell of a table row — backticked names
+# elsewhere in the row are label keys / prose, not declarations
+_TABLE_NAME_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|",
+                            re.MULTILINE)
+
+
+def registered_metric_names() -> Dict[str, List[str]]:
+    """{metric name: [files registering it]} from an AST walk of the
+    package. Raises SystemExit on a dynamic (non-literal) name."""
+    names: Dict[str, List[str]] = {}
+    errors: List[str] = []
+    for root, _dirs, files in os.walk(PACKAGE_DIR):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, PACKAGE_DIR)
+            if rel in _IGNORED_FILES:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REGISTER_METHODS
+                        and node.args):
+                    continue
+                # skip x.method() calls that are clearly not metric
+                # registration: first arg must be a string literal or
+                # it is an error
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    if _METRIC_NAME_RE.match(arg.value):
+                        names.setdefault(arg.value, []).append(rel)
+                    continue
+                if rel in _DYNAMIC_REGISTRATIONS:
+                    continue  # declared below, names added after walk
+                errors.append(
+                    f"{rel}:{node.lineno}: non-literal metric name in "
+                    f".{node.func.attr}(...) — lift the name into a "
+                    f"string literal (labels are the dynamic "
+                    f"dimension), or declare the closed name set in "
+                    f"check_metrics_doc._DYNAMIC_REGISTRATIONS")
+    if errors:
+        raise SystemExit("\n".join(errors))
+    for rel, declared in _DYNAMIC_REGISTRATIONS.items():
+        for name in declared:
+            names.setdefault(name, []).append(rel)
+    return names
+
+
+def documented_metric_names() -> Set[str]:
+    """Backticked names inside the README's marked metrics table."""
+    with open(README) as f:
+        text = f.read()
+    try:
+        begin = text.index(BEGIN_MARKER) + len(BEGIN_MARKER)
+        end = text.index(END_MARKER, begin)
+    except ValueError:
+        raise SystemExit(
+            f"README.md is missing the {BEGIN_MARKER} / {END_MARKER} "
+            f"markers around the metrics reference table "
+            f"(README 'Telemetry')")
+    return set(_TABLE_NAME_RE.findall(text[begin:end]))
+
+
+def check() -> List[str]:
+    """Returns a list of problems (empty = consistent)."""
+    registered = registered_metric_names()
+    documented = documented_metric_names()
+    problems: List[str] = []
+    for name in sorted(set(registered) - documented):
+        problems.append(
+            f"UNDOCUMENTED: {name} (registered in "
+            f"{', '.join(sorted(set(registered[name])))}) is missing "
+            f"from the README metrics table")
+    for name in sorted(documented - set(registered)):
+        problems.append(
+            f"STALE DOC: {name} appears in the README metrics table "
+            f"but is not registered anywhere under code2vec_tpu/")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} metric-documentation problem(s). "
+              f"Update the README 'Telemetry' metrics table "
+              f"(between the metrics-table markers).")
+        return 1
+    print(f"OK: {len(registered_metric_names())} registered metric "
+          f"names all documented, no stale table entries.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

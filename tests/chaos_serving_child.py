@@ -87,10 +87,13 @@ class FakeModel:
 def main() -> int:
     argv = sys.argv[1:]
     overrides = json.loads(open(argv[0]).read())
-    # the two flags the supervisor appends to every child command
+    # the flags the supervisor appends to every child command
     if "--heartbeat_file" in argv:
         overrides["heartbeat_file"] = argv[argv.index(
             "--heartbeat_file") + 1]
+    if "--metrics_file" in argv:
+        overrides["metrics_file"] = argv[argv.index(
+            "--metrics_file") + 1]
     if "--serve_port" in argv:
         overrides["serve_port"] = int(argv[argv.index("--serve_port") + 1])
 

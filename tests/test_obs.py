@@ -169,6 +169,210 @@ def test_span_records_on_exception():
     assert len(tracer) == 1            # the span still closed + recorded
 
 
+def test_tracer_counts_dropped_spans_and_high_water():
+    """The ring drops oldest spans silently from the FILE's point of
+    view — the drops must be first-class metrics so a truncated Chrome
+    trace is detectable from /metrics alone (and from the trace file's
+    otherData.spans_dropped)."""
+    dropped_before = obs.counter("obs_spans_dropped_total").value
+    tracer = SpanTracer(capacity=4)
+    tracer.enable()
+    for i in range(10):
+        tracer.record(f"s{i}", 0.0, 0.001)
+    assert len(tracer) == 4
+    assert tracer.dropped == 6
+    assert tracer.high_water == 4
+    assert obs.counter("obs_spans_dropped_total").value \
+        == dropped_before + 6
+    assert obs.gauge("obs_span_ring_high_water").value >= 4
+    doc = tracer.chrome_trace()
+    assert doc["otherData"]["spans_dropped"] == 6
+    # under capacity: nothing dropped, high-water tracks the fill level
+    small = SpanTracer(capacity=16)
+    small.enable()
+    small.record("only", 0.0, 0.001)
+    assert small.dropped == 0 and small.high_water == 1
+
+
+def test_tracer_id_tagged_spans_export_args():
+    tracer = SpanTracer()
+    tracer.enable()
+    tracer.record("tagged", 0.0, 0.002, trace_id="a" * 32,
+                  span_id="b" * 16, parent_id="c" * 16,
+                  attrs={"endpoint": "predict"})
+    tracer.record("plain", 0.0, 0.001)
+    doc = tracer.chrome_trace()
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e["ph"] == "X"}
+    args = by_name["tagged"]["args"]
+    assert args["trace_id"] == "a" * 32
+    assert args["span_id"] == "b" * 16
+    assert args["parent_id"] == "c" * 16
+    assert args["endpoint"] == "predict"
+    assert "args" not in by_name["plain"]
+
+
+# ------------------------------------------------------------- reqtrace
+
+def test_traceparent_parse_and_format():
+    from code2vec_tpu.obs import reqtrace
+    parsed = reqtrace.parse_traceparent(
+        "00-" + "a1" * 16 + "-" + "b2" * 8 + "-01")
+    assert parsed == {"trace_id": "a1" * 16,
+                      "parent_span_id": "b2" * 8}
+    # malformed / absent / all-zero headers are ignored, never fatal
+    for bad in (None, "", "garbage", "00-xyz-abc-01",
+                "00-" + "0" * 32 + "-" + "b2" * 8 + "-01",
+                "00-" + "a1" * 16 + "-" + "0" * 16 + "-01"):
+        assert reqtrace.parse_traceparent(bad) is None
+    out = reqtrace.format_traceparent("a1" * 16, "b2" * 8)
+    assert reqtrace.parse_traceparent(out) == parsed
+    tid, sid = reqtrace.mint_trace_id(), reqtrace.mint_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    assert tid != reqtrace.mint_trace_id()  # 128-bit: never collides
+
+
+def test_request_trace_span_tree_and_ring_forwarding():
+    from code2vec_tpu.obs import reqtrace
+    from code2vec_tpu.obs.reqtrace import RequestTrace
+    ring = SpanTracer()
+    ring.enable()
+    rt = RequestTrace(tracer=ring)
+    assert rt.minted and len(rt.trace_id) == 32
+    with rt.span("request", endpoint="predict") as root:
+        with rt.span("cache_lookup") as sp:
+            sp.attrs["hit"] = False
+        # a shareable id is minted by the CALLER (the batcher's idiom
+        # for the shared batch span) — add_span itself defers minting
+        # to export time
+        shared = reqtrace.mint_span_id()
+        rt.add_span("batch", 0.0, 0.005, span_id=shared,
+                    attrs={"batch_id": 7}, forward=False)
+        rt.add_span("device", 0.0, 0.005, parent_id=shared)
+        root.attrs["status"] = 200
+    doc = rt.to_dict()
+    assert doc["trace_id"] == rt.trace_id
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert set(by_name) == {"request", "cache_lookup", "batch", "device"}
+    root_id = doc["root_span_id"]
+    assert by_name["request"]["span_id"] == root_id
+    assert by_name["request"]["parent_id"] is None
+    assert by_name["cache_lookup"]["parent_id"] == root_id
+    assert by_name["batch"]["parent_id"] == root_id
+    assert by_name["device"]["parent_id"] == by_name["batch"]["span_id"]
+    assert by_name["request"]["attrs"]["status"] == 200
+    # the ring got every span EXCEPT the forward=False batch copy,
+    # tagged with the trace id
+    ring_events = [e for e in ring.chrome_trace()["traceEvents"]
+                   if e["ph"] == "X"]
+    ring_names = {e["name"] for e in ring_events}
+    assert ring_names == {"request", "cache_lookup", "device"}
+    for e in ring_events:
+        assert e["args"]["trace_id"] == rt.trace_id
+
+
+def test_request_trace_honors_inbound_parent():
+    from code2vec_tpu.obs.reqtrace import RequestTrace
+    header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    rt = RequestTrace.from_headers(header)
+    assert rt.trace_id == "ab" * 16
+    assert not rt.minted
+    with rt.span("request"):
+        pass
+    doc = rt.to_dict()
+    # the root hangs under the CALLER's span: distributed tracing
+    assert doc["spans"][0]["parent_id"] == "cd" * 8
+    assert doc["remote_parent"] == "cd" * 8
+    echoed = rt.traceparent()
+    assert echoed.split("-")[1] == "ab" * 16
+    assert echoed.split("-")[2] == doc["root_span_id"]
+    # malformed header -> minted id, not an error
+    rt2 = RequestTrace.from_headers("not-a-traceparent")
+    assert rt2.minted and rt2.trace_id != rt.trace_id
+
+
+# ------------------------------------------------------- flight recorder
+
+def test_flight_recorder_rings_bounded_and_dump_schema(tmp_path):
+    from code2vec_tpu.obs.flight import FlightRecorder
+    rec = FlightRecorder(capacity=4, events_capacity=8)
+    rec.configure(dump_dir=str(tmp_path))
+    for i in range(10):
+        rec.record_request(trace_id=f"t{i}", endpoint="predict",
+                           status=200, duration_s=0.01,
+                           phases={"extract": 0.002},
+                           fingerprint="fp1")
+    rec.event("swap_start", target="/x")
+    path = rec.dump(reason="manual")
+    doc = json.load(open(path))
+    assert doc["schema_version"] == 1
+    assert doc["reason"] == "manual"
+    assert doc["requests_recorded"] == 10
+    # ring: only the newest 4 survive
+    assert [r["trace_id"] for r in doc["requests"]] \
+        == ["t6", "t7", "t8", "t9"]
+    req = doc["requests"][-1]
+    assert req["status"] == 200
+    assert req["phases_ms"]["extract"] == pytest.approx(2.0)
+    assert req["fingerprint"] == "fp1"
+    assert doc["events"] == [{"t": doc["events"][0]["t"],
+                              "kind": "swap_start", "target": "/x"}]
+
+
+def test_flight_incident_schedules_one_coalesced_dump(tmp_path):
+    import time as _time
+    from code2vec_tpu.obs.flight import FlightRecorder
+    dumps_before = obs.counter("flight_dumps_total").value
+    rec = FlightRecorder(capacity=8)
+    rec.configure(dump_dir=str(tmp_path), dump_delay_s=0.15)
+    rec.incident("breaker_open", breaker="extractor")
+    # the delay window captures the FALLOUT: sheds recorded after the
+    # incident still make the dump
+    rec.record_request(trace_id="shed1", endpoint="predict", status=503,
+                       duration_s=0.0, reason="breaker")
+    rec.incident("breaker_open", breaker="device")  # coalesces
+    deadline = _time.time() + 5
+    files = []
+    while _time.time() < deadline:
+        files = list(tmp_path.glob("flight-*.json"))
+        if files:
+            break
+        _time.sleep(0.02)
+    assert len(files) == 1, "exactly one coalesced dump"
+    doc = json.load(open(files[0]))
+    assert doc["reason"] == "breaker_open"
+    assert [r["trace_id"] for r in doc["requests"]] == ["shed1"]
+    kinds = [e["kind"] for e in doc["events"]]
+    assert kinds.count("breaker_open") == 2
+    assert all(e["incident"] for e in doc["events"])
+    assert doc["incidents_coalesced"] == 1
+    assert obs.counter("flight_dumps_total").value == dumps_before + 1
+    assert obs.counter("flight_incidents_total",
+                       kind="breaker_open").value >= 2
+
+
+def test_flight_incident_immediate_dumps_synchronously(tmp_path):
+    from code2vec_tpu.obs.flight import FlightRecorder
+    rec = FlightRecorder()
+    rec.configure(dump_dir=str(tmp_path), dump_delay_s=30.0)
+    rec.record_request(trace_id="a1", endpoint="predict", status=504,
+                       duration_s=2.0, reason="deadline_expired")
+    rec.incident("drain_timeout", immediate=True, abandoned=1)
+    files = list(tmp_path.glob("flight-*drain_timeout.json"))
+    assert len(files) == 1  # no timer wait: exit paths dump NOW
+    doc = json.load(open(files[0]))
+    assert doc["requests"][0]["trace_id"] == "a1"
+
+
+def test_flight_no_dump_dir_records_but_never_dumps(tmp_path):
+    from code2vec_tpu.obs.flight import FlightRecorder
+    rec = FlightRecorder()
+    rec.incident("breaker_open", breaker="x")
+    snap = rec.snapshot()
+    assert snap["events"][0]["kind"] == "breaker_open"
+    assert not list(tmp_path.iterdir())
+
+
 # ------------------------------------------------------------ exporters
 
 def test_write_prometheus_is_atomic_and_complete(tmp_path):

@@ -533,6 +533,52 @@ def test_neighbors_http_end_to_end(neighbor_server):
     assert hz["retrieval"]["rows"] == 6
 
 
+def test_neighbors_carries_trace_ids_and_ann_span(neighbor_server,
+                                                  monkeypatch):
+    """Satellite pin: /neighbors rides the same request-scoped tracing
+    as /predict — inbound traceparent honored and echoed in X-Trace-Id,
+    and the debug tree includes the ann_search span."""
+    import urllib.error
+    srv = neighbor_server
+    monkeypatch.setattr(srv.config, "serve_debug_trace", True)
+    inbound_trace, inbound_span = "ef" * 16, "12" * 8
+
+    def post(query="", headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/neighbors{query}",
+            data=_snippet("corpusMethod3", 5).encode(), method="POST",
+            headers=dict({"Content-Type": "text/plain"},
+                         **(headers or {})))
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    status, body, headers = post(
+        query="?debug=trace",
+        headers={"traceparent":
+                 f"00-{inbound_trace}-{inbound_span}-01"})
+    assert status == 200
+    assert headers["X-Trace-Id"] == inbound_trace
+    trace = json.loads(body)["trace"]
+    assert trace["trace_id"] == inbound_trace
+    by_name = {s["name"]: s for s in trace["spans"]}
+    # the whole pipeline plus the retrieval-specific search span
+    assert {"request", "cache_lookup", "extract", "batch", "device",
+            "ann_search", "render"} <= set(by_name)
+    assert by_name["ann_search"]["attrs"]["rows"] == 6
+    assert by_name["ann_search"]["attrs"]["queries"] == 1
+    assert by_name["request"]["parent_id"] == inbound_span
+    # minted ids when no header; the debug field is gated off by default
+    monkeypatch.setattr(srv.config, "serve_debug_trace", False)
+    status, body, headers = post(query="?debug=trace")
+    assert status == 200
+    assert "trace" not in json.loads(body)
+    tid = headers["X-Trace-Id"]
+    assert len(tid) == 32 and tid != inbound_trace
+
+
 def test_neighbors_zero_methods_is_empty_not_500(neighbor_server):
     """A snippet extracting to zero methods must render an empty
     neighbor list, never crash the search on a (0, ?) batch."""

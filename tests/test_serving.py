@@ -569,6 +569,203 @@ def test_sigterm_drain_finishes_inflight(served_model, fake_extractor,
                                timeout=5)
 
 
+# ---------------------------------------------------- request tracing
+
+
+def _post_full(port, endpoint, body, ctype="text/plain", headers=None,
+               query=""):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{endpoint}{query}", data=body.encode(),
+        method="POST", headers=dict({"Content-Type": ctype},
+                                    **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture()
+def traced_server(served_model, fake_extractor):
+    """A server with --serve_debug_trace on (the ?debug=trace gate)."""
+    import dataclasses
+    from code2vec_tpu.serving.server import PredictionServer
+    config = dataclasses.replace(served_model.config,
+                                 serve_debug_trace=True)
+    srv = PredictionServer(served_model, config, log=lambda m: None)
+    srv.start(port=0)
+    yield srv
+    srv.drain(timeout=10)
+
+
+def test_trace_id_minted_and_debug_tree_names_every_phase(traced_server):
+    """Acceptance pin: a request through the real HTTP server returns an
+    X-Trace-Id whose span tree (via the debug-trace knob) names every
+    pipeline phase it crossed, including the batch it rode."""
+    status, body, headers = _post_full(
+        traced_server.port, "predict",
+        "class T { int traced(int n) { return n; } }",
+        query="?debug=trace")
+    assert status == 200
+    trace_id = headers["X-Trace-Id"]
+    assert len(trace_id) == 32 and int(trace_id, 16)
+    payload = json.loads(body)
+    trace = payload["trace"]
+    assert trace["trace_id"] == trace_id
+    by_name = {}
+    for s in trace["spans"]:
+        by_name.setdefault(s["name"], s)
+    # every pipeline phase the request crossed, as a tree
+    assert {"request", "cache_lookup", "admission", "extract_wait",
+            "extract", "batch_wait", "batch", "device",
+            "render"} <= set(by_name)
+    root = by_name["request"]
+    assert root["span_id"] == trace["root_span_id"]
+    assert root["attrs"] == {"endpoint": "predict", "status": 200}
+    for child in ("cache_lookup", "admission", "extract_wait",
+                  "extract", "batch_wait", "batch", "render"):
+        assert by_name[child]["parent_id"] == root["span_id"], child
+    # the device span hangs under the SHARED batch span
+    batch = by_name["batch"]
+    assert by_name["device"]["parent_id"] == batch["span_id"]
+    assert trace_id in batch["attrs"]["members"]
+    assert batch["attrs"]["rows"] == 1
+    assert by_name["cache_lookup"]["attrs"]["hit"] is False
+    assert by_name["extract"]["attrs"]["mode"] == "warm"
+    assert by_name["extract"]["attrs"]["worker_pid"] > 0
+    # the traceparent response header names the root span
+    version, tid, sid, flags = headers["traceparent"].split("-")
+    assert (version, flags) == ("00", "01")
+    assert tid == trace_id and sid == trace["root_span_id"]
+    # the normal (non-debug) response stays trace-free
+    status, body2, headers2 = _post_full(
+        traced_server.port, "predict",
+        "class T { int traced(int n) { return n; } }")
+    assert "trace" not in json.loads(body2)
+    assert headers2["X-Trace-Id"] != trace_id  # fresh id per request
+
+
+def test_inbound_traceparent_honored_and_echoed(traced_server):
+    """A caller-supplied W3C traceparent joins ITS trace: same trace id
+    end to end, the server's root span parented under the caller's
+    span, and the echoed traceparent naming the server's root span."""
+    inbound_trace, inbound_span = "ab" * 16, "cd" * 8
+    status, body, headers = _post_full(
+        traced_server.port, "predict",
+        "class I { int inbound() { return 1; } }",
+        headers={"traceparent":
+                 f"00-{inbound_trace}-{inbound_span}-01"},
+        query="?debug=trace")
+    assert status == 200
+    assert headers["X-Trace-Id"] == inbound_trace
+    trace = json.loads(body)["trace"]
+    assert trace["trace_id"] == inbound_trace
+    assert trace["remote_parent"] == inbound_span
+    [root] = [s for s in trace["spans"] if s["name"] == "request"]
+    assert root["parent_id"] == inbound_span
+    assert headers["traceparent"] == \
+        f"00-{inbound_trace}-{root['span_id']}-01"
+    # malformed traceparent: minted id, not a 400
+    status, _, headers = _post_full(
+        traced_server.port, "predict",
+        "class I { int inbound2() { return 1; } }",
+        headers={"traceparent": "zz-garbage"})
+    assert status == 200
+    assert headers["X-Trace-Id"] != inbound_trace
+
+
+def test_minted_ids_unique_across_coalesced_batch(traced_server):
+    """Concurrent requests coalesced into one device batch each keep
+    their OWN trace id; the shared batch span id ties the trees
+    together and its `members` attr lists exactly the requests that
+    rode it."""
+    codes = [f"class B{i} {{ int rode{i}(int n) {{ return n; }} }}"
+             for i in range(4)]
+    results = [None] * 4
+
+    def post(i):
+        results[i] = _post_full(traced_server.port, "predict", codes[i],
+                                query="?debug=trace")
+
+    threads = [threading.Thread(target=post, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r[0] == 200 for r in results)
+    trace_ids = [r[2]["X-Trace-Id"] for r in results]
+    assert len(set(trace_ids)) == 4, "minted ids must be unique"
+    batches = {}  # batch span id -> (members attr, rider trace ids)
+    for (_, body, headers) in results:
+        trace = json.loads(body)["trace"]
+        assert trace["trace_id"] == headers["X-Trace-Id"]
+        [batch] = [s for s in trace["spans"] if s["name"] == "batch"]
+        [device] = [s for s in trace["spans"] if s["name"] == "device"]
+        assert device["parent_id"] == batch["span_id"]
+        members, riders = batches.setdefault(
+            batch["span_id"], (batch["attrs"]["members"], set()))
+        assert batch["attrs"]["members"] == members
+        riders.add(trace["trace_id"])
+    # each batch span's members list is EXACTLY the requests that rode
+    # it — no request missing, none from another batch
+    for members, riders in batches.values():
+        assert set(members) == riders
+    assert {t for _, r in batches.values() for t in r} == set(trace_ids)
+
+
+def test_cache_hit_fast_path_carries_trace_id(traced_server):
+    code = "class H { int hits(int n) { return n * 2; } }"
+    status, _, h1 = _post_full(traced_server.port, "predict", code)
+    assert status == 200
+    hits0 = _counter_value("serving_cache_hits_total")
+    status, body, h2 = _post_full(traced_server.port, "predict", code,
+                                  query="?debug=trace")
+    assert status == 200
+    assert _counter_value("serving_cache_hits_total") == hits0 + 1
+    # the hit got its own fresh id...
+    assert h2["X-Trace-Id"] != h1["X-Trace-Id"]
+    trace = json.loads(body)["trace"]
+    assert trace["trace_id"] == h2["X-Trace-Id"]
+    by_name = {s["name"]: s for s in trace["spans"]}
+    # ...and an honest short tree: cache hit, no pipeline phases
+    assert by_name["cache_lookup"]["attrs"]["hit"] is True
+    assert "extract" not in by_name and "device" not in by_name
+    # error paths carry the id too (here: 400 empty body)
+    status, _, h3 = _post_full(traced_server.port, "predict", "   ")
+    assert status == 400 and len(h3["X-Trace-Id"]) == 32
+
+
+def test_debug_trace_gated_off_by_default(server):
+    """Security gate: without --serve_debug_trace the ?debug=trace query
+    is ignored — the span tree exposes internals (worker pids, batch
+    composition) that must not leak from a production endpoint."""
+    assert not server.config.serve_debug_trace
+    status, body, headers = _post_full(
+        server.port, "predict",
+        "class G { int gated() { return 1; } }", query="?debug=trace")
+    assert status == 200
+    assert "trace" not in json.loads(body)
+    assert "X-Trace-Id" in headers  # the id itself still rides
+
+
+def test_telemetry_cli_flags_parse():
+    from code2vec_tpu.cli import config_from_args
+    config = config_from_args([
+        "serve", "--load", "/tmp/nonexistent-model",
+        "--serve_debug_trace", "--serve_flight_dir", "/tmp/fl",
+        "--serve_flight_records", "64", "--serve_telemetry_port", "0"])
+    assert config.serve_debug_trace is True
+    assert config.serve_flight_dir == "/tmp/fl"
+    assert config.serve_flight_records == 64
+    assert config.serve_telemetry_port == 0
+    # defaults: debug trace OFF, flight dir/telemetry port unset
+    config2 = config_from_args(["--serve", "--load", "/tmp/x"])
+    assert config2.serve_debug_trace is False
+    assert config2.serve_flight_dir is None
+    assert config2.serve_telemetry_port is None
+
+
 # -------------------------------------------------------------- REPL
 
 

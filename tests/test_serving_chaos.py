@@ -334,6 +334,105 @@ def test_admission_fault_point_surfaces_as_honest_error(chaos_server):
     assert "FaultInjected" in json.loads(body)["error"]
 
 
+# ------------------------------------------------- flight recorder
+
+
+def test_breaker_open_under_load_dumps_shed_trace_ids(chaos_server,
+                                                      tmp_path):
+    """Acceptance pin: kicking a breaker open under load produces a
+    flight-recorder dump containing the shed requests' trace ids — the
+    incident dump is DELAYED so the black box captures both the
+    failures that opened the breaker and the shed storm it caused."""
+    flight_dir = tmp_path / "flight"
+    srv, _ = chaos_server(serve_flight_dir=str(flight_dir),
+                          serve_cache_entries=0,
+                          serve_breaker_cooldown_s=10.0)
+    srv.flight.configure(dump_delay_s=0.6)
+    # extractor crash storm (retries=0, min_requests=2) opens the breaker
+    for i in range(2):
+        status, _, _ = _post(
+            srv.port, "predict",
+            f"class C{i} {{ int crash{i}() {{ return 1; }} }} "
+            f"CRASH_ALWAYS")
+        assert status == 503
+    assert srv.extractor_breaker.state == "open"
+    # load against the open breaker: fail-fast sheds, each with its id
+    shed_ids = []
+    for i in range(3):
+        status, body, headers = _post(
+            srv.port, "predict",
+            f"class S{i} {{ int shed{i}() {{ return 1; }} }}")
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["shed"] == "breaker"
+        assert payload["trace_id"] == headers["X-Trace-Id"]
+        shed_ids.append(headers["X-Trace-Id"])
+    deadline = time.time() + 10
+    files = []
+    while time.time() < deadline:
+        files = sorted(flight_dir.glob("flight-*.json"))
+        if files:
+            break
+        time.sleep(0.05)
+    assert files, "a breaker open must produce a flight dump"
+    doc = json.loads(files[0].read_text())
+    assert doc["reason"] == "breaker_open"
+    recorded = {r["trace_id"]: r for r in doc["requests"]}
+    for tid in shed_ids:
+        assert tid in recorded, "shed request missing from the dump"
+        assert recorded[tid]["status"] == 503
+        assert recorded[tid]["reason"] == "breaker"
+        assert recorded[tid]["endpoint"] == "predict"
+    assert any(e["kind"] == "breaker_open" and e.get("incident")
+               and e["breaker"] == "extractor" for e in doc["events"])
+
+
+def test_admin_dump_endpoint_writes_flight_file(chaos_server, tmp_path):
+    flight_dir = tmp_path / "dumps"
+    srv, _ = chaos_server(serve_flight_dir=str(flight_dir))
+    status, _, headers = _post(
+        srv.port, "predict", "class D { int dumped() { return 1; } }")
+    assert status == 200
+    wanted = headers["X-Trace-Id"]
+    status, body, _ = _post(srv.port, "admin/dump", "")
+    assert status == 200
+    payload = json.loads(body)
+    assert os.path.dirname(payload["path"]) == str(flight_dir)
+    doc = json.loads(open(payload["path"]).read())
+    assert doc["reason"] == "admin"
+    assert payload["requests"] == len(doc["requests"]) >= 1
+    assert wanted in {r["trace_id"] for r in doc["requests"]}
+
+
+def test_drain_timeout_incident_dumps_synchronously(
+        chaos_server, tmp_path, monkeypatch):
+    """A drain timeout is an exit-path incident: the dump must land
+    BEFORE the process would exit (no delayed timer), with the
+    abandoned request still in the ring."""
+    monkeypatch.setenv("C2V_FAKE_SLEEP", "2.0")
+    flight_dir = tmp_path / "drainflight"
+    srv, _ = chaos_server(serve_flight_dir=str(flight_dir))
+    result = {}
+
+    def slow_post():
+        result["r"] = _post(
+            srv.port, "predict",
+            "class A { int abandoned() { return 1; } } SLOW_MARKER")
+
+    t = threading.Thread(target=slow_post)
+    t.start()
+    deadline = time.time() + 5
+    while srv._inflight == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert srv.drain(timeout=0.2) is False
+    files = list(flight_dir.glob("flight-*drain_timeout.json"))
+    assert len(files) == 1, "exit-path incidents dump synchronously"
+    doc = json.loads(files[0].read_text())
+    assert any(e["kind"] == "drain_timeout" and e["abandoned"] == 1
+               for e in doc["events"])
+    t.join(timeout=30)
+
+
 # ------------------------------------------------------------ breakers
 
 
