@@ -153,12 +153,82 @@ def arguments_parser() -> ArgumentParser:
                         metavar="N",
                         help="terminal request records the incident "
                              "flight recorder retains (default 512)")
+    parser.add_argument("--serve_flight_max_dumps", type=int,
+                        default=None, metavar="N",
+                        help="flight dumps retained per dump dir: past "
+                             "the cap the oldest flight-*.json files "
+                             "are deleted after each new dump "
+                             "(default 64; 0 = unbounded)")
     parser.add_argument("--serve_telemetry_port", type=int, default=None,
                         metavar="PORT",
                         help="supervisor fleet-telemetry listener "
                              "(merged GET /metrics + GET /fleet under "
                              "--replicas); default: public port + 1, "
                              "0 picks a free port")
+    # -- cross-host serving fleet (README "Fleet") --
+    parser.add_argument("--fleet_hosts", type=int, default=None,
+                        metavar="N",
+                        help="`fleet` subcommand: host supervisors "
+                             "launched per model group (default 2); "
+                             "each host is a full `serve --replicas N` "
+                             "supervisor behind the fleet router")
+    parser.add_argument("--fleet_port", type=int, default=None,
+                        metavar="PORT",
+                        help="fleet router public port (default: "
+                             "--serve_port; 0 picks a free port)")
+    parser.add_argument("--fleet_models", default=None, metavar="LIST",
+                        help="multi-model fleet: comma list of "
+                             "name=artifact_dir groups, each getting "
+                             "--fleet_hosts hosts; the router keys on "
+                             "the X-Model request header (empty = one "
+                             "'default' group from --artifact)")
+    parser.add_argument("--fleet_poll_interval",
+                        dest="fleet_poll_interval_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="control-plane poll + scaling-decision "
+                             "cadence (default 1)")
+    parser.add_argument("--fleet_scale_min", type=int, default=None,
+                        metavar="N",
+                        help="per-host replica floor for "
+                             "telemetry-driven scaling (default 1)")
+    parser.add_argument("--fleet_scale_max", type=int, default=None,
+                        metavar="N",
+                        help="per-host replica ceiling for "
+                             "telemetry-driven scaling (default 4)")
+    parser.add_argument("--fleet_scale_up_shed_rate", type=float,
+                        default=None, metavar="RATIO",
+                        help="scale a host up when its window shed "
+                             "rate exceeds this fraction (default "
+                             "0.05)")
+    parser.add_argument("--fleet_scale_up_p95_ms", type=float,
+                        default=None, metavar="MS",
+                        help="scale a host up when its window "
+                             "total-phase p95 exceeds this many ms "
+                             "(default 0 = disabled)")
+    parser.add_argument("--fleet_scale_up_ticks", type=int,
+                        default=None, metavar="N",
+                        help="consecutive over-threshold ticks before "
+                             "a scale-up (hysteresis; default 2)")
+    parser.add_argument("--fleet_scale_down_ticks", type=int,
+                        default=None, metavar="N",
+                        help="consecutive zero-request ticks before a "
+                             "scale-down (hysteresis; default 10)")
+    parser.add_argument("--fleet_scale_cooldown",
+                        dest="fleet_scale_cooldown_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="cooldown after every scaling action "
+                             "(default 15)")
+    parser.add_argument("--fleet_swap_timeout",
+                        dest="fleet_swap_timeout_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="per-host convergence budget of the "
+                             "canary-first coordinated hot-swap "
+                             "(default 120)")
+    parser.add_argument("--fleet_max_host_restarts", type=int,
+                        default=None, metavar="N",
+                        help="restarts the control plane grants each "
+                             "host before escalating to fleet exit "
+                             "(default 5)")
     parser.add_argument("--artifact", dest="serve_artifact", metavar="DIR",
                         help="serve/evaluate from a release artifact "
                              "(produced by the `export` subcommand) "
@@ -394,12 +464,15 @@ def config_from_args(argv=None) -> Config:
     # a release artifact (README "Release artifacts"); `embed`,
     # `index-build` and `export-embeddings` are the retrieval-stack
     # jobs (README "Retrieval").
-    subcommands = ("serve", "export", "embed", "index-build",
+    subcommands = ("serve", "fleet", "export", "embed", "index-build",
                    "export-embeddings")
     subcommand = argv[0] if argv and argv[0] in subcommands else None
     if subcommand:
         argv = argv[1:]
-    serve_subcommand = subcommand == "serve"
+    # `fleet` = a serving deployment whose parent is the control plane
+    # (README "Fleet"); each host it launches re-runs this CLI as
+    # `serve`.
+    serve_subcommand = subcommand in ("serve", "fleet")
     args = arguments_parser().parse_args(argv)
     if subcommand == "export" and not args.export_artifact_path:
         raise SystemExit(
@@ -439,7 +512,20 @@ def config_from_args(argv=None) -> Config:
                                       "serve_debug_trace",
                                       "serve_flight_dir",
                                       "serve_flight_records",
+                                      "serve_flight_max_dumps",
                                       "serve_telemetry_port",
+                                      "fleet_hosts", "fleet_port",
+                                      "fleet_models",
+                                      "fleet_poll_interval_s",
+                                      "fleet_scale_min",
+                                      "fleet_scale_max",
+                                      "fleet_scale_up_shed_rate",
+                                      "fleet_scale_up_p95_ms",
+                                      "fleet_scale_up_ticks",
+                                      "fleet_scale_down_ticks",
+                                      "fleet_scale_cooldown_s",
+                                      "fleet_swap_timeout_s",
+                                      "fleet_max_host_restarts",
                                       "serve_artifact",
                                       "export_artifact_path",
                                       "topk_block_size",
@@ -457,6 +543,7 @@ def config_from_args(argv=None) -> Config:
     config = Config(
         predict=args.predict,
         serve=args.serve or serve_subcommand,
+        fleet=subcommand == "fleet",
         model_save_path=args.save_path,
         model_load_path=args.load_path,
         train_data_path_prefix=args.data_path,
@@ -513,12 +600,26 @@ def main(argv=None) -> None:
     config = config_from_args(argv)
     config.verify()
 
+    # Cross-host fleet: the control-plane PARENT never builds a model;
+    # it launches one `serve` supervisor per host behind the
+    # health-gated router and drives scaling + coordinated hot-swap
+    # (serving/fleet/, README "Fleet").
+    if (config.serve and config.fleet
+            and "C2V_FLEET_HOST" not in os.environ
+            and "C2V_SERVE_REPLICA" not in os.environ):
+        from code2vec_tpu.serving.fleet.control import fleet_main
+        sys.exit(fleet_main(config, argv=list(argv)))
+
     # Supervised multi-replica serving: the PARENT never builds a model
     # (each replica is its own process with its own model + extractor
     # pool); it forks N re-execed copies of this command with
     # --replicas stripped, monitors their heartbeats, restarts crashed
-    # or hung ones, and fans SIGTERM out as a coordinated drain.
-    if (config.serve and config.serve_replicas > 1
+    # or hung ones, and fans SIGTERM out as a coordinated drain. A
+    # fleet HOST always supervises (even at --replicas 1) so the
+    # control plane gets its telemetry listener + scaling headroom.
+    if (config.serve
+            and (config.serve_replicas > 1
+                 or "C2V_FLEET_HOST" in os.environ)
             and "C2V_SERVE_REPLICA" not in os.environ):
         from code2vec_tpu.serving.supervisor import supervisor_main
         sys.exit(supervisor_main(config, argv=list(argv)))

@@ -300,12 +300,62 @@ class Config:
     # Terminal request records the flight recorder retains (the black
     # box ring; anomaly events ring separately at 256).
     serve_flight_records: int = 512
+    # Flight dumps retained per dump directory: past the cap the
+    # OLDEST flight-*.json files are deleted after each new dump, so a
+    # long-running supervisor run dir cannot grow without bound.
+    # 0 = unbounded.
+    serve_flight_max_dumps: int = 64
     # Supervisor telemetry listener (merged GET /metrics + GET /fleet —
     # the documented scrape address under --replicas, fixing the
     # SO_REUSEPORT one-replica-scrape gap). None = public port + 1;
     # 0 = pick a free port (logged + in the supervisor heartbeat's
     # telemetry_port).
     serve_telemetry_port: Optional[int] = None
+    # -- serving fleet (code2vec_tpu/serving/fleet; README "Fleet") --
+    # Run the fleet control plane + router (`fleet` subcommand): N
+    # host supervisors per model group behind one health-gated router,
+    # telemetry-driven per-host replica scaling, canary-first
+    # coordinated hot-swap.
+    fleet: bool = False
+    # Hosts launched per model group. The default LocalHostLauncher
+    # runs them as local processes (dev/test/single-machine); remote
+    # substrates plug in through fleet/control.py's HostLauncher seam.
+    fleet_hosts: int = 2
+    # Router public port. None = serve_port (the fleet takes over the
+    # serving stack's public address); 0 picks a free port.
+    fleet_port: Optional[int] = None
+    # Multi-model fleet: comma list of name=artifact_dir groups, each
+    # getting fleet_hosts hosts; the router keys on the X-Model
+    # request header. Empty = one "default" group from --artifact.
+    fleet_models: str = ""
+    # Seconds between control-plane polls of each host's /fleet +
+    # /metrics (also the scaling decision cadence).
+    fleet_poll_interval_s: float = 1.0
+    # Per-host replica-count bounds for telemetry-driven scaling (and
+    # the sanity bounds for manual POST /admin/scale overrides).
+    fleet_scale_min: int = 1
+    fleet_scale_max: int = 4
+    # Scale-up triggers, evaluated over the window since the previous
+    # poll tick: shed rate above this fraction...
+    fleet_scale_up_shed_rate: float = 0.05
+    # ...or total-phase p95 above this many milliseconds (0 disables
+    # the p95 trigger; shed rate alone then drives scale-up).
+    fleet_scale_up_p95_ms: float = 0.0
+    # Hysteresis: consecutive over-threshold ticks required to scale
+    # up, consecutive zero-request ticks required to scale down, and a
+    # cooldown after every action so a noisy signal cannot flap the
+    # replica count.
+    fleet_scale_up_ticks: int = 2
+    fleet_scale_down_ticks: int = 10
+    fleet_scale_cooldown_s: float = 15.0
+    # Seconds the coordinated-swap driver waits for ONE host's
+    # replicas to converge on the new fingerprint before declaring the
+    # rollout failed (halt at the canary; rollback past it).
+    fleet_swap_timeout_s: float = 120.0
+    # Restarts the control plane grants each host before escalating to
+    # fleet exit (the supervisor's deploy-problem philosophy, one
+    # level up).
+    fleet_max_host_restarts: int = 5
     # Rows per streamed target-table block in the blockwise top-k
     # prediction head (ops/topk.py): the eval/predict steps fold the
     # ~246K-name classifier through a running top-k merge + logsumexp
@@ -516,11 +566,13 @@ class Config:
     def verify(self) -> None:
         # reference: config.py:232-239, plus mesh-shape checks.
         if (not self.is_training and not self.is_loading
-                and not self.serve_artifact and not self.index_out):
+                and not self.serve_artifact and not self.index_out
+                and not (self.fleet and self.fleet_models)):
             raise ValueError(
                 "Must train or load a model (or serve a release "
                 "artifact via --artifact; `index-build` alone needs "
-                "no model).")
+                "no model; `fleet` may carry its models in "
+                "--fleet_models).")
         if self.is_loading and not os.path.isdir(self.model_load_dir):
             raise ValueError(
                 f"Model load dir `{self.model_load_dir}` does not exist.")
@@ -629,6 +681,57 @@ class Config:
             raise ValueError(
                 "serve_flight_records must be >= 1 (the flight "
                 "recorder ring needs at least one slot).")
+        if self.serve_flight_max_dumps < 0:
+            raise ValueError(
+                "serve_flight_max_dumps must be >= 0 (0 = unbounded, "
+                "no retention sweep).")
+        if self.fleet and not self.serve:
+            raise ValueError(
+                "fleet knobs apply to the `fleet` subcommand (which "
+                "implies serving).")
+        if self.fleet_hosts < 1:
+            raise ValueError("fleet_hosts must be >= 1.")
+        if self.fleet_port is not None and not (
+                0 <= self.fleet_port <= 65535):
+            raise ValueError(
+                "fleet_port must be in [0, 65535] (0 picks a free "
+                "port; unset defaults to serve_port).")
+        if self.fleet_models:
+            try:
+                from code2vec_tpu.serving.fleet.control import (
+                    parse_fleet_models,
+                )
+                parse_fleet_models(self.fleet_models)
+            except ValueError as e:
+                raise ValueError(str(e))
+        if self.fleet_poll_interval_s <= 0:
+            raise ValueError("fleet_poll_interval must be > 0.")
+        if self.fleet_scale_min < 1:
+            raise ValueError("fleet_scale_min must be >= 1.")
+        if self.fleet_scale_max < self.fleet_scale_min:
+            raise ValueError(
+                "fleet_scale_max must be >= fleet_scale_min.")
+        if not (0 <= self.fleet_scale_up_shed_rate <= 1):
+            raise ValueError(
+                "fleet_scale_up_shed_rate must be in [0, 1].")
+        if self.fleet_scale_up_p95_ms < 0:
+            raise ValueError(
+                "fleet_scale_up_p95_ms must be >= 0 (0 disables the "
+                "p95 scale-up trigger).")
+        if self.fleet_scale_up_ticks < 1 or self.fleet_scale_down_ticks < 1:
+            raise ValueError(
+                "fleet_scale_up_ticks and fleet_scale_down_ticks must "
+                "be >= 1 (they are the hysteresis).")
+        if self.fleet_scale_cooldown_s < 0:
+            raise ValueError("fleet_scale_cooldown must be >= 0.")
+        if self.fleet_swap_timeout_s <= 0:
+            raise ValueError(
+                "fleet_swap_timeout must be > 0 (a rollout that never "
+                "times out wedges the swap driver on a dead host).")
+        if self.fleet_max_host_restarts < 0:
+            raise ValueError(
+                "fleet_max_host_restarts must be >= 0 (0 = escalate "
+                "on first host death).")
         if self.serve_telemetry_port is not None and not (
                 0 <= self.serve_telemetry_port <= 65535):
             raise ValueError(

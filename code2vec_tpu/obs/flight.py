@@ -73,6 +73,7 @@ class FlightRecorder:
             maxlen=max(1, int(events_capacity)))
         self._dump_dir: Optional[str] = None
         self._dump_delay_s = 0.75
+        self._max_dumps = 0  # 0 = unbounded (no retention sweep)
         self._log = lambda msg: None
         self._pending: Optional[threading.Timer] = None
         self._pending_reason: Optional[str] = None
@@ -85,6 +86,7 @@ class FlightRecorder:
     def configure(self, dump_dir=_UNSET,
                   capacity: Optional[int] = None,
                   dump_delay_s: Optional[float] = None,
+                  max_dumps: Optional[int] = None,
                   log=None) -> None:
         """(Re)configure the process recorder — the serving entry points
         call this once at startup. An EXPLICIT dump_dir=None disables
@@ -100,6 +102,8 @@ class FlightRecorder:
                     self._requests, maxlen=max(1, int(capacity)))
             if dump_delay_s is not None:
                 self._dump_delay_s = max(0.0, float(dump_delay_s))
+            if max_dumps is not None:
+                self._max_dumps = max(0, int(max_dumps))
             if log is not None:
                 self._log = log
 
@@ -239,7 +243,37 @@ class FlightRecorder:
         self._log(f"Flight recorder dumped {len(payload['requests'])} "
                   f"request(s) + {len(payload['events'])} event(s) to "
                   f"{path} (reason: {reason})")
+        self._prune(os.path.dirname(path))
         return path
+
+    def _prune(self, dirpath: str) -> None:
+        """Retention sweep (`--serve_flight_max_dumps`): a long-running
+        supervisor run dir collects incident dumps without bound —
+        every breaker storm leaves one — so past the cap the OLDEST
+        `flight-*.json` files in the dump's directory are deleted.
+        0 = unbounded (the pre-knob behavior)."""
+        if self._max_dumps <= 0:
+            return
+        try:
+            dumps = []
+            for name in os.listdir(dirpath):
+                if not (name.startswith("flight-")
+                        and name.endswith(".json")):
+                    continue
+                full = os.path.join(dirpath, name)
+                try:
+                    dumps.append((os.path.getmtime(full), name, full))
+                except OSError:
+                    continue  # concurrently pruned by a sibling replica
+            dumps.sort()  # oldest first (mtime, then name for ties)
+            for _, _, full in dumps[:max(0, len(dumps)
+                                         - self._max_dumps)]:
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+        except OSError as e:
+            self._log(f"Flight dump retention sweep failed ({e})")
 
     def clear(self) -> None:
         with self._lock:

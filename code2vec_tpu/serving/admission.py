@@ -39,6 +39,7 @@ surfaces as an honest error, never a hang or a corrupt response.
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time
 from typing import Optional
@@ -68,6 +69,18 @@ def expired_counter(stage: str):
         "admitted requests whose deadline ran out mid-pipeline (504); "
         "stage says how far they got before expiring",
         stage=stage)
+
+
+def retry_after_seconds(base_s: float, jitter_frac: float = 0.5) -> int:
+    """Retry-After header value for a shed: the base estimate plus up
+    to `jitter_frac` of it in random jitter, rounded up to integer
+    seconds (>= 1). A fleet-wide shed (open breaker, drain, overload)
+    otherwise teaches every client the SAME retry instant, and the
+    synchronized retry storm hits the recovering server at full
+    amplitude — jitter decorrelates the herd."""
+    base = max(1.0, float(base_s))
+    return max(1, int(math.ceil(
+        base * (1.0 + random.random() * max(0.0, jitter_frac)))))
 
 
 class Shed(Exception):

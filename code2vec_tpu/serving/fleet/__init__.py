@@ -1,0 +1,38 @@
+"""Cross-host serving fleet: control plane, health-gated router,
+coordinated hot-swap.
+
+The layer above `serving/supervisor.py` (one host's replica
+supervisor), closing the ROADMAP "cross-host serving fleet" item:
+
+- `control.py` — launches/adopts per-host supervisors through a
+  pluggable HostLauncher, tracks health off each host's PR-12
+  telemetry plane (`/fleet` + heartbeat staleness), restarts dead
+  hosts with backoff, and scales each host's replica count off shed
+  rate / phase p95 with hysteresis (`POST /admin/scale` to the host
+  supervisor).
+- `router.py` — the fleet's one public address: weighted routing away
+  from hosts with open breakers or stale heartbeats,
+  connection-failure retry bounded by the request's remaining
+  `X-Deadline-Ms` budget, coordinated drain, multi-model routing on
+  the `X-Model` header — with the 503-honesty and trace-propagation
+  contracts intact end to end.
+- `swap.py` — fleet-wide coordinated hot-swap: canary host first,
+  halt-and-report on first failure, rollback instead of a permanently
+  mixed fleet, mixed-fingerprint windows observable in `GET /fleet`.
+
+Entry point: the `fleet` CLI subcommand (`control.fleet_main`).
+README "Fleet" is the runbook.
+"""
+
+from code2vec_tpu.serving.fleet.control import (
+    ControlPlane, HostLauncher, HostSpec, LocalHostLauncher,
+    fleet_main, parse_fleet_models,
+)
+from code2vec_tpu.serving.fleet.router import FleetRouter
+from code2vec_tpu.serving.fleet.swap import FleetSwapBusy, FleetSwapDriver
+
+__all__ = [
+    "ControlPlane", "FleetRouter", "FleetSwapBusy", "FleetSwapDriver",
+    "HostLauncher", "HostSpec", "LocalHostLauncher", "fleet_main",
+    "parse_fleet_models",
+]

@@ -1,0 +1,870 @@
+"""Fleet control plane: launch/adopt per-host supervisors, track their
+health, scale their replica counts off the telemetry they export.
+
+The PR-12 telemetry plane gave every host ONE endpoint carrying the
+whole signal set a fleet needs (`/fleet` JSON: per-replica liveness,
+heartbeat staleness, breaker state, shed counters, fingerprint, swap
+state; merged `/metrics` for the phase histograms). This control plane
+is the consumer that endpoint was built for:
+
+- **Placement**: each host is a `serve --replicas N` SUPERVISOR
+  process, launched through a pluggable `HostLauncher` — locally a
+  subprocess (the test/chaos/dev path; the `fleet` CLI subcommand
+  re-execs itself per host), remotely whatever the deployment
+  substrate provides (ssh, a k8s Job, ...) as long as the host's
+  heartbeat file is visible to the control plane and its ports are
+  reachable. A host whose process dies is restarted with exponential
+  backoff up to `--fleet_max_host_restarts`, then the control plane
+  ESCALATES (exits nonzero) — the supervisor's deploy-problem
+  philosophy, one level up.
+- **Health**: each poll tick reads the host heartbeat (staleness) and
+  its `/fleet` + `/metrics`. Health feeds the router's weights: a
+  healthy host weighs 1.0; an open breaker or stale heartbeat
+  down-weights to 0.1 (cache hits still serve there); dead and
+  draining hosts weigh 0.
+- **Scaling**: per host, per tick, over the WINDOW since the last tick
+  (counters are lifetime-cumulative — lifetime rates would never show
+  a regression fading): shed rate above `--fleet_scale_up_shed_rate`
+  or total-phase p95 above `--fleet_scale_up_p95_ms` for
+  `--fleet_scale_up_ticks` consecutive ticks scales UP one replica;
+  zero requests for `--fleet_scale_down_ticks` consecutive ticks
+  scales DOWN one. Bounded by `--fleet_scale_min/max`, with a
+  `--fleet_scale_cooldown` after every action — hysteresis on both
+  edges so a noisy signal cannot flap the replica count. Actions are
+  `POST /admin/scale` to the host's supervisor.
+- **Coordinated swap + drain**: `request_swap` hands off to the
+  canary-first FleetSwapDriver (serving/fleet/swap.py); `drain_host`
+  marks a host draining (router weight 0 — no new work), SIGTERMs its
+  supervisor (which coordinates the replica drains), and retires it
+  when the process exits.
+
+`fleet_main` is the `fleet` CLI subcommand body: control plane + the
+health-gated router (serving/fleet/router.py) on the public port.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu import obs
+from code2vec_tpu.serving import telemetry
+from code2vec_tpu.serving.fleet.router import DEFAULT_MODEL, FleetRouter
+from code2vec_tpu.serving.fleet.swap import FleetSwapDriver
+
+FLEET_HOST_ENV = "C2V_FLEET_HOST"
+# Seconds a host gets from spawn to its first supervisor heartbeat
+# (replica fork + model build happen below it; the supervisor itself
+# heartbeats within ~a second of starting).
+HOST_STARTUP_GRACE_S = 120.0
+# Router weight of a host with an open breaker or stale heartbeat:
+# routed AWAY from, not excluded — its caches still serve and it may be
+# the only capacity left standing.
+UNHEALTHY_WEIGHT = 0.1
+
+_C_HOST_RESTARTS = obs.counter(
+    "fleet_host_restarts_total",
+    "host supervisor processes restarted by the fleet control plane "
+    "(process death or stale host heartbeat)")
+
+
+def _c_scale_actions(direction: str):
+    return obs.counter(
+        "fleet_scale_actions_total",
+        "telemetry-driven per-host replica scaling actions the control "
+        "plane applied (up: shed rate / p95 over threshold; down: "
+        "sustained idle)",
+        direction=direction)
+
+
+def _g_hosts(model: str, state: str):
+    return obs.gauge(
+        "fleet_hosts",
+        "fleet hosts by model group and health state "
+        "(healthy | degraded | down | draining)",
+        model=model, state=state)
+
+
+_HOST_STATES = ("healthy", "degraded", "down", "draining")
+
+
+def parse_fleet_models(spec: str) -> Dict[str, str]:
+    """`--fleet_models name=artifact_dir,...` -> {name: dir}. Empty
+    spec -> {} (single default group from --artifact/--load)."""
+    out: Dict[str, str] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, artifact = entry.partition("=")
+        name, artifact = name.strip(), artifact.strip()
+        if not sep or not name or not artifact:
+            raise ValueError(
+                f"bad --fleet_models entry {entry!r}: expected "
+                f"name=artifact_dir[,name=artifact_dir...]")
+        if name in out:
+            raise ValueError(
+                f"duplicate model name {name!r} in --fleet_models")
+        out[name] = artifact
+    return out
+
+
+class HostLauncher:
+    """Pluggable host-process launcher — the remote seam. The contract:
+    `launch` starts the host supervisor command and returns a
+    process-like handle (pid, poll(), wait(), send_signal()); the
+    command's `--heartbeat_file` must end up readable by the control
+    plane (shared fs for remote substrates) and the ports the host
+    reports in it reachable."""
+
+    def launch(self, command: List[str], env: Dict[str, str],
+               log_path: str):
+        raise NotImplementedError
+
+
+class LocalHostLauncher(HostLauncher):
+    """Subprocess launcher: every "host" is a local process. The dev,
+    test and chaos-drill substrate — and an honest single-machine
+    deployment (one supervisor per NUMA domain / accelerator)."""
+
+    def launch(self, command: List[str], env: Dict[str, str],
+               log_path: str):
+        logf = open(log_path, "ab")
+        try:
+            return subprocess.Popen(command, env=env,
+                                    stdout=logf, stderr=logf)
+        finally:
+            logf.close()
+
+
+class HostSpec:
+    """What to run for one host: id, model group, the supervisor
+    command (WITHOUT --heartbeat_file — the control plane owns run
+    files), and the address its reported ports are reachable at."""
+
+    def __init__(self, host_id: str, command: List[str],
+                 model: str = DEFAULT_MODEL,
+                 address: str = "127.0.0.1",
+                 boot_artifact: Optional[str] = None):
+        self.id = host_id
+        self.command = list(command)
+        self.model = model
+        self.address = address
+        # the artifact baked into `command` — when the model group has
+        # since been swapped to a different one, a (re)spawned host
+        # gets a reload-target file so its replicas converge onto the
+        # fleet's CURRENT artifact instead of reviving the boot one
+        self.boot_artifact = boot_artifact
+
+
+class _Host:
+    def __init__(self, spec: HostSpec, run_dir: str):
+        self.spec = spec
+        self.id = spec.id
+        self.model = spec.model
+        self.address = spec.address
+        # each host gets its OWN run dir: the supervisor roots its
+        # replica heartbeats/metrics/flight dumps next to its
+        # heartbeat file, and two hosts sharing a dir would clobber
+        # each other's replica files
+        self.host_dir = os.path.join(run_dir, f"host-{spec.id}")
+        os.makedirs(self.host_dir, exist_ok=True)
+        self.heartbeat_path = os.path.join(
+            self.host_dir, "supervisor.heartbeat.json")
+        self.log_path = os.path.join(self.host_dir, "host.log")
+        self.proc = None
+        self.port: Optional[int] = None
+        self.telemetry_port: Optional[int] = None
+        self.restarts = 0
+        self.restart_at: Optional[float] = None  # backoff gate
+        self.spawned_at = 0.0
+        self.draining = False
+        self.retired = False
+        self.state = "down"
+        self.weight = 0.0
+        self.view: Optional[dict] = None     # last /fleet JSON
+        self.metrics_text: str = ""          # last /metrics text
+        # scaling windows (deltas between ticks) + hysteresis state
+        self.prev_requests: Optional[float] = None
+        self.prev_sheds: float = 0.0
+        self.prev_buckets: Optional[Dict[str, float]] = None
+        self.up_ticks = 0
+        self.idle_ticks = 0
+        self.cooldown_until = 0.0
+        self.desired_replicas: Optional[int] = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def heartbeat(self) -> Optional[dict]:
+        try:
+            with open(self.heartbeat_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+class ControlPlane:
+    """Owns the host processes + their health/scaling state; the
+    router consumes it through hosts_for/fleet_view/..."""
+
+    def __init__(self, config, specs: List[HostSpec],
+                 launcher: Optional[HostLauncher] = None, log=None):
+        self.config = config
+        self.log = log or config.log
+        self.launcher = launcher or LocalHostLauncher()
+        base = (os.path.dirname(os.path.abspath(config.heartbeat_file))
+                if config.heartbeat_file else None)
+        self.run_dir = base or tempfile.mkdtemp(prefix="c2v-fleet-")
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.heartbeat_path = (config.heartbeat_file or os.path.join(
+            self.run_dir, "fleet.heartbeat.json"))
+        self.hosts = [_Host(spec, self.run_dir) for spec in specs]
+        self.models = sorted({h.model for h in self.hosts})
+        # per-model artifact currently rolled out — the rollback target
+        # for a failed coordinated swap (fleet/swap.py)
+        self._artifacts: Dict[str, Optional[str]] = {}
+        self._stop = threading.Event()
+        self._escalated = False
+        self._lock = threading.Lock()
+        self.swap = FleetSwapDriver(self)
+        self.router: Optional[FleetRouter] = None
+        self._poll_pool = None  # lazily created, lives for the run
+        self.flight = obs.default_flight_recorder()
+        self.flight.configure(
+            dump_dir=self.run_dir,
+            max_dumps=getattr(config, "serve_flight_max_dumps", 64),
+            log=self.log)
+
+    def set_initial_artifact(self, model: str,
+                             artifact: Optional[str]) -> None:
+        self._artifacts[model] = artifact
+
+    # ------------------------------------------------------------ spawn
+
+    def _spawn(self, host: _Host) -> None:
+        try:
+            os.remove(host.heartbeat_path)
+        except OSError:
+            pass
+        host.port = host.telemetry_port = None
+        host.view = None
+        host.metrics_text = ""
+        host.prev_requests = None
+        host.prev_buckets = None
+        from code2vec_tpu.serving.server import RELOAD_TARGET_FILENAME
+        from code2vec_tpu.serving.supervisor import child_env
+        current = self._artifacts.get(host.model)
+        target_path = os.path.join(host.host_dir,
+                                   RELOAD_TARGET_FILENAME)
+        if current and current != host.spec.boot_artifact:
+            # desired-state reconciliation across a host restart: the
+            # fleet committed a swap after this host's command was
+            # built, so its supervisor must deliver the CURRENT
+            # artifact to every replica at first heartbeat
+            obs.exporters._atomic_write(
+                target_path,
+                json.dumps({"artifact": current,
+                            "requested_at": time.time()}) + "\n")
+        else:
+            try:
+                os.remove(target_path)
+            except OSError:
+                pass
+        command = host.spec.command + ["--heartbeat_file",
+                                       host.heartbeat_path]
+        env = child_env(os.environ)
+        env[FLEET_HOST_ENV] = host.id
+        host.proc = self.launcher.launch(command, env, host.log_path)
+        host.spawned_at = time.monotonic()
+        host.restart_at = None
+        self.log(f"Fleet host {host.id} (model {host.model}) spawned "
+                 f"(pid {host.proc.pid})")
+
+    def start(self) -> None:
+        for host in self.hosts:
+            self._spawn(host)
+        self._write_heartbeat("controlling")
+
+    # ------------------------------------------------------------- http
+
+    def _fetch(self, host: _Host, path: str,
+               timeout: float = 3.0) -> Optional[bytes]:
+        if host.telemetry_port is None:
+            return None
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host.address}:{host.telemetry_port}"
+                    f"{path}", timeout=timeout) as r:
+                return r.read()
+        except (OSError, ValueError):
+            return None
+
+    def _post(self, host: _Host, path: str, payload: dict,
+              timeout: float = 10.0) -> Tuple[bool, str]:
+        if host.telemetry_port is None:
+            return False, "telemetry port unknown"
+        req = urllib.request.Request(
+            f"http://{host.address}:{host.telemetry_port}{path}",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return True, r.read().decode("utf-8", errors="replace")
+        except urllib.error.HTTPError as e:
+            return False, f"HTTP {e.code}: " + e.read().decode(
+                "utf-8", errors="replace")[:200]
+        except (OSError, ValueError) as e:
+            return False, str(e)
+
+    # ------------------------------------------------------------- poll
+
+    def _stale_after_s(self) -> float:
+        # supervisors rewrite their heartbeat ~every second; three
+        # missed writes (plus poll slack) = a hung host
+        return max(5.0, 3.0 * self.config.fleet_poll_interval_s + 2.0)
+
+    def poll_once(self) -> None:
+        now = time.monotonic()
+        hosts = list(self.hosts)
+        if len(hosts) > 1:
+            # concurrent: each check blocks on up to two 3s HTTP
+            # fetches — serialized, ONE wedged host would stall health
+            # derivation, restart detection and scaling for the fleet.
+            # The pool lives for the run (not per tick).
+            if self._poll_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._poll_pool = ThreadPoolExecutor(
+                    max_workers=min(8, len(hosts)),
+                    thread_name_prefix="fleet-poll")
+            list(self._poll_pool.map(
+                lambda h: self._check_host(h, now), hosts))
+        elif hosts:
+            self._check_host(hosts[0], now)
+        self._update_host_gauges()
+        self._write_heartbeat("controlling")
+
+    def _check_host(self, host: _Host, now: float) -> None:
+        if host.retired:
+            host.state, host.weight = "draining", 0.0
+            return
+        if host.draining:
+            host.state, host.weight = "draining", 0.0
+            if host.proc is not None and host.proc.poll() is not None:
+                host.proc.wait()
+                host.retired = True
+                self.flight.event("host_retired", host=host.id,
+                                  rc=host.proc.returncode)
+                self.log(f"Fleet host {host.id} drained and retired "
+                         f"(rc={host.proc.returncode})")
+            return
+        if host.restart_at is not None:
+            host.state, host.weight = "down", 0.0
+            if now >= host.restart_at:
+                self._spawn(host)
+            return
+        rc = host.proc.poll() if host.proc is not None else 0
+        if rc is not None:
+            self._handle_host_death(host, f"exited rc={rc}")
+            return
+        hb = host.heartbeat()
+        if hb is None:
+            host.state, host.weight = "down", 0.0
+            if now - host.spawned_at > HOST_STARTUP_GRACE_S:
+                self._kill(host)
+                self._handle_host_death(
+                    host, "no heartbeat within the startup grace "
+                          "(hung startup; killed)")
+            return
+        host.port = hb.get("port") or host.port
+        host.telemetry_port = (hb.get("telemetry_port")
+                               or host.telemetry_port)
+        hb_age = time.time() - float(hb.get("wall_time", 0.0))
+        if hb_age > self._stale_after_s():
+            self._kill(host)
+            self._handle_host_death(
+                host, f"host heartbeat stale ({hb_age:.1f}s; hung; "
+                      f"killed)")
+            return
+        # health off the host's own telemetry plane
+        raw = self._fetch(host, "/fleet")
+        if raw is not None:
+            try:
+                host.view = json.loads(raw)
+            except ValueError:
+                pass
+        raw = self._fetch(host, "/metrics")
+        if raw is not None:
+            host.metrics_text = raw.decode("utf-8", errors="replace")
+        breaker_open = False
+        replicas_serving = 0
+        if host.view:
+            for replica in host.view.get("replicas", []):
+                breakers = replica.get("breakers") or {}
+                if "open" in breakers.values():
+                    breaker_open = True
+                if replica.get("status") == "serving":
+                    replicas_serving += 1
+            view_desired = host.view.get("desired_replicas")
+            if host.desired_replicas is None:
+                host.desired_replicas = view_desired
+            elif (view_desired is not None
+                    and view_desired != host.desired_replicas
+                    and now >= host.cooldown_until):
+                # a restarted host supervisor boots its command-line
+                # replica count: re-assert the scaled count so a crash
+                # does not silently shed the capacity the autoscaler
+                # (or an operator) added. Cooldown-gated — right after
+                # a scale action the cached view lags one tick.
+                ok, _ = self._post(host, "/admin/scale",
+                                   {"replicas":
+                                    host.desired_replicas})
+                if ok:
+                    host.cooldown_until = (
+                        now + self.config.fleet_scale_cooldown_s)
+                    self.log(f"Re-asserted host {host.id} replica "
+                             f"count {host.desired_replicas} after "
+                             f"restart (was {view_desired})")
+        if (host.view is None or host.port is None
+                or replicas_serving == 0):
+            # zero serving replicas = the host cannot answer a predict
+            # no matter how healthy its SUPERVISOR looks (proxy mode
+            # would answer well-formed 503s the router does not retry;
+            # weight 0 routes around the whole replica-restart window)
+            host.state, host.weight = "down", 0.0
+        elif breaker_open:
+            host.state, host.weight = "degraded", UNHEALTHY_WEIGHT
+        else:
+            host.state, host.weight = "healthy", 1.0
+        self._scale_tick(host, now)
+
+    def _kill(self, host: _Host, sig=signal.SIGKILL) -> None:
+        if host.proc is not None and host.proc.poll() is None:
+            try:
+                host.proc.send_signal(sig)
+            except OSError:
+                pass
+
+    def _handle_host_death(self, host: _Host, why: str) -> None:
+        if host.proc is not None:
+            host.proc.wait()
+        host.state, host.weight = "down", 0.0
+        if host.restarts >= self.config.fleet_max_host_restarts:
+            self.log(f"Fleet host {host.id} {why}; restart budget "
+                     f"({self.config.fleet_max_host_restarts}) "
+                     f"exhausted — escalating")
+            self.flight.incident("host_escalation", immediate=True,
+                                 host=host.id, why=why,
+                                 restarts=host.restarts)
+            self._escalated = True
+            self._stop.set()
+            return
+        host.restarts += 1
+        _C_HOST_RESTARTS.inc()
+        self.flight.incident("host_down", host=host.id, why=why,
+                             restart=host.restarts)
+        backoff = min(0.5 * (2 ** (host.restarts - 1)), 10.0)
+        host.restart_at = time.monotonic() + backoff
+        self.log(f"Fleet host {host.id} {why}; restart "
+                 f"{host.restarts}/"
+                 f"{self.config.fleet_max_host_restarts} in "
+                 f"{backoff:.1f}s")
+
+    # ---------------------------------------------------------- scaling
+
+    def _scale_tick(self, host: _Host, now: float) -> None:
+        """One hysteresis-damped scaling decision for one host, over
+        the window since the last tick."""
+        cfg = self.config
+        view = host.view
+        if not view or host.state == "down":
+            host.prev_requests = None  # stale window; resample
+            return
+        totals = sheds = 0.0
+        for replica in view.get("replicas", []):
+            totals += float(replica.get("requests_total") or 0)
+            sheds += float(replica.get("requests_shed_total") or 0)
+        buckets = telemetry.histogram_buckets(
+            host.metrics_text, "serving_request_seconds",
+            phase="total") if cfg.fleet_scale_up_p95_ms > 0 else {}
+        if host.prev_requests is None or totals < host.prev_requests:
+            # first tick, or a replica restart zeroed counters: seed
+            # the window, decide next tick
+            host.prev_requests, host.prev_sheds = totals, sheds
+            host.prev_buckets = buckets
+            host.up_ticks = host.idle_ticks = 0
+            return
+        d_req = totals - host.prev_requests
+        d_shed = max(0.0, sheds - host.prev_sheds)
+        shed_rate = (d_shed / d_req) if d_req > 0 else 0.0
+        p95_ms = None
+        if cfg.fleet_scale_up_p95_ms > 0:
+            p95 = telemetry.quantile_from_buckets(
+                buckets, host.prev_buckets, 0.95)
+            p95_ms = None if p95 is None else p95 * 1000.0
+        host.prev_requests, host.prev_sheds = totals, sheds
+        host.prev_buckets = buckets
+        up = (shed_rate > cfg.fleet_scale_up_shed_rate
+              or (p95_ms is not None
+                  and p95_ms > cfg.fleet_scale_up_p95_ms))
+        idle = d_req == 0
+        host.up_ticks = host.up_ticks + 1 if up else 0
+        host.idle_ticks = host.idle_ticks + 1 if idle else 0
+        if now < host.cooldown_until:
+            return
+        desired = host.desired_replicas or view.get(
+            "desired_replicas") or len(view.get("replicas", ())) or 1
+        if (host.up_ticks >= cfg.fleet_scale_up_ticks
+                and desired < cfg.fleet_scale_max):
+            self._apply_scale(host, desired + 1, "up",
+                              f"shed_rate={shed_rate:.3f} "
+                              f"p95_ms={p95_ms}", now)
+        elif (host.idle_ticks >= cfg.fleet_scale_down_ticks
+                and desired > cfg.fleet_scale_min):
+            self._apply_scale(host, desired - 1, "down",
+                              f"idle for {host.idle_ticks} tick(s)",
+                              now)
+
+    def _apply_scale(self, host: _Host, n: int, direction: str,
+                     why: str, now: Optional[float] = None) -> None:
+        ok, detail = self._post(host, "/admin/scale", {"replicas": n})
+        if not ok:
+            self.log(f"Scale {direction} of host {host.id} to {n} "
+                     f"FAILED ({detail})")
+            return
+        host.desired_replicas = n
+        host.up_ticks = host.idle_ticks = 0
+        host.cooldown_until = ((now if now is not None
+                                else time.monotonic())
+                               + self.config.fleet_scale_cooldown_s)
+        _c_scale_actions(direction).inc()
+        self.flight.event("fleet_scale", host=host.id,
+                          direction=direction, replicas=n, why=why)
+        self.log(f"Scaled host {host.id} {direction} to {n} "
+                 f"replica(s): {why}")
+
+    def _update_host_gauges(self) -> None:
+        counts: Dict[Tuple[str, str], int] = {}
+        for host in self.hosts:
+            counts[(host.model, host.state)] = counts.get(
+                (host.model, host.state), 0) + 1
+        for model in self.models:
+            for state in _HOST_STATES:
+                _g_hosts(model, state).set(
+                    counts.get((model, state), 0))
+
+    # --------------------------------------------------- router surface
+
+    def hosts_for(self, model: str):
+        """Router candidates: None for an unknown model, else
+        [(weight, host_id, (address, port))] — zero-weight hosts
+        included (the router drops them) so callers can see why."""
+        if model not in self.models:
+            return None
+        return [(host.weight, host.id, (host.address, host.port))
+                for host in self.hosts
+                if host.model == model and host.port is not None]
+
+    def merged_fleet_metrics(self) -> str:
+        """Fleet-wide /metrics: every host's (already replica-merged)
+        snapshot merged again — counters/histograms summed across
+        hosts, gauges labeled host="<id>" on top of their replica
+        labels — plus the control plane's own registry."""
+        snapshots = {f"host:{h.id}": h.metrics_text
+                     for h in self.hosts if h.metrics_text}
+        snapshots["control"] = obs.default_registry().render_prometheus()
+        return telemetry.merge_prometheus_snapshots(snapshots,
+                                                    gauge_label="host")
+
+    def fleet_view(self) -> dict:
+        now = time.time()
+        hosts = []
+        fingerprints: Dict[str, set] = {m: set() for m in self.models}
+        for host in self.hosts:
+            hb = host.heartbeat()
+            view = host.view or {}
+            fps = view.get("fingerprints") or []
+            fingerprints[host.model].update(fps)
+            hosts.append({
+                "host": host.id,
+                "model": host.model,
+                "state": host.state,
+                "weight": host.weight,
+                "alive": host.alive,
+                "draining": host.draining,
+                "retired": host.retired,
+                "pid": host.proc.pid if host.proc is not None else None,
+                "port": host.port,
+                "telemetry_port": host.telemetry_port,
+                "restarts": host.restarts,
+                "desired_replicas": host.desired_replicas,
+                "replica_count": view.get("replica_count"),
+                # replicas that have written a "serving" heartbeat —
+                # under SO_REUSEPORT a replica's port exists before its
+                # listener does, so THIS is the readiness signal
+                "replicas_serving": sum(
+                    1 for r in view.get("replicas", [])
+                    if r.get("status") == "serving"),
+                "fingerprints": sorted(fps),
+                "heartbeat_age_s": (
+                    None if not hb else round(max(
+                        now - float(hb.get("wall_time", 0.0)), 0.0), 3)),
+            })
+        return {
+            "role": "fleet-control",
+            "router_port": self.router.port if self.router else None,
+            "models": {m: {
+                "hosts": sum(1 for h in self.hosts if h.model == m),
+                "routable": sum(1 for h in self.hosts
+                                if h.model == m and h.weight > 0),
+                "artifact": self._artifacts.get(m),
+                # >1 fingerprint = a swap window (or a wedged rollout):
+                # observable, and bounded by the canary-first driver
+                "fingerprints": sorted(fingerprints[m]),
+                "mixed_fingerprints": len(fingerprints[m]) > 1,
+            } for m in self.models},
+            "escalated": self._escalated,
+            "swap": self.swap.status(),
+            "hosts": hosts,
+        }
+
+    # ---------------------------------------------------- admin surface
+
+    def request_swap(self, payload: dict) -> Tuple[int, dict]:
+        model = str(payload.get("model") or DEFAULT_MODEL)
+        status = self.swap.request(payload.get("artifact"), model=model,
+                                   rollback_to=payload.get("rollback"))
+        return 202, {"accepted": True, "swap": status}
+
+    def request_scale(self, host_id, n) -> Tuple[int, dict]:
+        host = self._host_by_id(host_id)
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            raise ValueError('body must be {"host": ID, "replicas": N}')
+        cfg = self.config
+        if not (cfg.fleet_scale_min <= n <= cfg.fleet_scale_max):
+            # the configured bounds gate MANUAL overrides too — an
+            # operator typo must not fork a host past its capacity
+            raise ValueError(
+                f"replicas must be in [{cfg.fleet_scale_min}, "
+                f"{cfg.fleet_scale_max}] (--fleet_scale_min/max); "
+                f"got {n}")
+        ok, detail = self._post(host, "/admin/scale", {"replicas": n})
+        if not ok:
+            raise ValueError(f"scale request to host {host.id} "
+                             f"failed: {detail}")
+        host.desired_replicas = n
+        host.cooldown_until = (time.monotonic()
+                               + self.config.fleet_scale_cooldown_s)
+        return 200, {"host": host.id, "desired_replicas": int(n)}
+
+    def drain_host(self, host_id) -> Tuple[int, dict]:
+        """Coordinated host removal: stop routing to it NOW, let its
+        supervisor drain the replicas' in-flight work, retire the
+        process when it exits."""
+        host = self._host_by_id(host_id)
+        if not host.draining:
+            host.draining = True
+            host.state, host.weight = "draining", 0.0
+            host.restart_at = None
+            self._kill(host, signal.SIGTERM)
+            self.flight.event("host_drain", host=host.id)
+            self.log(f"Fleet host {host.id} draining (no new work; "
+                     f"supervisor coordinates the replica drain)")
+        return 202, {"host": host.id, "draining": True}
+
+    def _host_by_id(self, host_id) -> _Host:
+        for host in self.hosts:
+            if host.id == host_id:
+                return host
+        raise KeyError(str(host_id))
+
+    # ------------------------------------------------ swap-driver seams
+
+    def swap_hosts(self, model: str):
+        if model not in self.models:
+            return None
+        return [h for h in self.hosts
+                if h.model == model and h.alive and not h.draining]
+
+    def host_reload(self, host: _Host, artifact: str):
+        return self._post(host, "/admin/reload", {"artifact": artifact})
+
+    def host_fleet(self, host: _Host) -> Optional[dict]:
+        raw = self._fetch(host, "/fleet")
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def rollback_target(self, model: str) -> Optional[str]:
+        return self._artifacts.get(model)
+
+    def set_artifact(self, model: str, artifact: str) -> None:
+        self._artifacts[model] = artifact
+
+    # -------------------------------------------------------------- run
+
+    def _write_heartbeat(self, status: str, **extra) -> None:
+        obs.exporters.write_heartbeat(
+            self.heartbeat_path, status=status, role="fleet-control",
+            router_port=self.router.port if self.router else None,
+            escalated=self._escalated,
+            hosts=[{"host": h.id, "model": h.model, "state": h.state,
+                    "pid": h.proc.pid if h.proc is not None else None,
+                    "port": h.port, "telemetry_port": h.telemetry_port,
+                    "restarts": h.restarts}
+                   for h in self.hosts], **extra)
+
+    def run(self) -> int:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(self.config.fleet_poll_interval_s)
+                if self._stop.is_set():
+                    break
+                self.poll_once()
+        finally:
+            rc = self._shutdown()
+        return rc
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _shutdown(self) -> int:
+        escalated = self._escalated
+        self.log("Fleet shutdown: "
+                 + ("host restart budget exhausted — killing hosts"
+                    if escalated else
+                    "draining the router and every host"))
+        if self.router is not None:
+            self.router.drain()
+        for host in self.hosts:
+            self._kill(host, signal.SIGKILL if escalated
+                       else signal.SIGTERM)
+        budget = self.config.serve_drain_timeout_s + 20.0
+        deadline = time.monotonic() + budget
+        clean = not escalated
+        for host in self.hosts:
+            if host.proc is None or host.retired:
+                continue
+            if host.restart_at is not None:
+                continue  # already dead + reaped, waiting out backoff
+            try:
+                rc = host.proc.wait(
+                    timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                self._kill(host)
+                host.proc.wait()
+                rc = host.proc.returncode
+            if rc != 0:
+                clean = False
+                self.log(f"Fleet host {host.id} exited rc={rc}")
+        if self.router is not None:
+            self.router.close()
+        if self._poll_pool is not None:
+            self._poll_pool.shutdown(wait=False)
+        self._write_heartbeat(
+            "error" if (escalated or not clean) else "done")
+        self.log(f"Fleet exit: "
+                 f"{'clean' if clean and not escalated else 'FAILED'}")
+        return 0 if clean and not escalated else 1
+
+
+# -------------------------------------------------------------- CLI body
+
+
+_FLEET_VALUE_FLAGS = (
+    "--fleet_hosts", "--fleet_port", "--fleet_models",
+    "--fleet_poll_interval", "--fleet_scale_min", "--fleet_scale_max",
+    "--fleet_scale_up_shed_rate", "--fleet_scale_up_p95_ms",
+    "--fleet_scale_up_ticks", "--fleet_scale_down_ticks",
+    "--fleet_scale_cooldown", "--fleet_swap_timeout",
+    "--fleet_max_host_restarts",
+    # run files + ports are per host, owned by the control plane
+    "--heartbeat_file", "--metrics_file", "--trace_export",
+    "--serve_port", "--serve_telemetry_port",
+)
+
+
+def _host_base_command(argv: List[str], strip_artifact: bool
+                       ) -> List[str]:
+    from code2vec_tpu.serving.supervisor import strip_flag
+    argv = list(argv)
+    if argv and argv[0] == "fleet":
+        argv[0] = "serve"
+    for flag in _FLEET_VALUE_FLAGS:
+        argv = strip_flag(argv, flag)
+    if strip_artifact:
+        argv = strip_flag(argv, "--artifact")
+    return [sys.executable, "-m", "code2vec_tpu.cli"] + argv
+
+
+def fleet_main(config, argv: Optional[List[str]] = None,
+               host_command: Optional[List[str]] = None,
+               launcher: Optional[HostLauncher] = None) -> int:
+    """`fleet` CLI subcommand body (cli.main dispatches here before
+    building any model). Each host re-execs this CLI as `serve` with
+    the fleet flags stripped and its own run files/ports —
+    `host_command` overrides the re-exec (the chaos suite points it at
+    a lightweight fake-model host)."""
+    models = parse_fleet_models(getattr(config, "fleet_models", ""))
+    single = not models
+    if single:
+        models = {DEFAULT_MODEL: config.serve_artifact}
+    specs: List[HostSpec] = []
+    for model, artifact in models.items():
+        base = (list(host_command) if host_command is not None
+                else _host_base_command(list(argv or []),
+                                        strip_artifact=not single))
+        cmd = base + ["--serve_port", "0", "--serve_telemetry_port",
+                      "0"]
+        if not single and artifact:
+            cmd = cmd + ["--artifact", artifact]
+        for i in range(config.fleet_hosts):
+            specs.append(HostSpec(f"{model}-{i}", cmd, model=model,
+                                  address=config.serve_host,
+                                  boot_artifact=artifact))
+    control = ControlPlane(config, specs, launcher=launcher,
+                           log=config.log)
+    for model, artifact in models.items():
+        control.set_initial_artifact(model, artifact)
+    router_port = (config.fleet_port if config.fleet_port is not None
+                   else config.serve_port)
+    control.router = FleetRouter(config, control,
+                                 host=config.serve_host,
+                                 port=router_port, log=config.log)
+    installed = threading.current_thread() is threading.main_thread()
+    prev = {}
+    if installed:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig,
+                                      lambda s, f: control.stop())
+        if hasattr(signal, "SIGHUP"):
+            prev[signal.SIGHUP] = signal.signal(
+                signal.SIGHUP,
+                lambda s, f: config.log(
+                    "SIGHUP ignored at the fleet level: drive "
+                    "coordinated swaps via POST /admin/reload on the "
+                    "router (canary-first, rollback on failure)"))
+    config.log(f"Fleet: {len(specs)} host(s) x "
+               f"{max(config.serve_replicas, 1)} replica(s), models "
+               f"{sorted(models)}, router port {control.router.port}")
+    try:
+        return control.run()
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)

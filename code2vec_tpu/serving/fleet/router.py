@@ -1,0 +1,334 @@
+"""Health-gated cross-host router: the fleet's one public address.
+
+Generalizes the supervisor's proxy fallback (serving/supervisor.py)
+from "round-robin over my own replicas" to "weighted routing over a
+fleet of hosts", consuming the health the control plane derives from
+each host's `/fleet` + heartbeat staleness:
+
+- **Weighted away from sick hosts**: a healthy host weighs 1.0; a host
+  with an open breaker or a stale heartbeat is down-weighted (not
+  excluded — a degraded host still serves cache hits and may be the
+  only capacity left); a dead or draining host weighs 0 and receives
+  nothing. Selection is weighted sampling WITHOUT replacement
+  (Efraimidis–Spirakis keys), so retries walk the remaining hosts in
+  weight-biased order.
+- **Deadline-bounded retry**: a connection failure (SIGKILLed host,
+  mid-restart listener) retries the next candidate, but never past the
+  request's remaining `X-Deadline-Ms` budget — a retry dispatched after
+  budget exhaustion can only produce a late 504, so it is answered as
+  an honest 504 instead. The remaining budget also bounds each
+  attempt's socket timeout.
+- **Contract preservation**: the PR-9 503-honesty and PR-12
+  trace-propagation contracts hold end to end — inbound `traceparent`
+  is forwarded, replica trace headers ride back, and every
+  ROUTER-generated terminal status (no host, budget exhausted, all
+  unreachable) carries `X-Trace-Id` + `traceparent` + a `trace_id`
+  body field, with a JITTERED `Retry-After` on 503s.
+- **Multi-model**: hosts are grouped by model (one release artifact per
+  group); the `X-Model` request header (default "default") picks the
+  group. Cache and fingerprint isolation is structural — a request can
+  only ever reach a host mounting its model — and every response still
+  carries the `model_fingerprint` of the exact weights that served it.
+
+Fleet views are answered HERE, never forwarded: `GET /fleet` is the
+control plane's fleet JSON, `GET /metrics` the fleet-wide merge of
+every host's (already replica-merged) snapshot. `POST /admin/reload`
+starts the canary-first coordinated hot-swap (serving/fleet/swap.py),
+`POST /admin/scale {"host": ..., "replicas": N}` overrides one host's
+replica count, `POST /admin/drain {"host": ...}` starts a coordinated
+host drain.
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import random
+import threading
+from typing import Optional
+
+from code2vec_tpu import obs
+from code2vec_tpu.obs.reqtrace import RequestTrace
+from code2vec_tpu.serving.admission import (
+    deadline_from_request, retry_after_seconds,
+)
+
+DEFAULT_MODEL = "default"
+FORWARD_ENDPOINTS = ("/predict", "/embed", "/neighbors")
+
+_C_RETRIES = obs.counter(
+    "fleet_router_retries_total",
+    "forward attempts the fleet router retried on another host after "
+    "a connection failure")
+
+
+def _c_requests(endpoint: str, outcome: str):
+    return obs.counter(
+        "fleet_router_requests_total",
+        "fleet-router requests by endpoint and routing outcome: "
+        "forwarded (a host answered), no_host (no routable host for "
+        "the model), unknown_model (no such model group), expired "
+        "(deadline budget exhausted before/while retrying), "
+        "unreachable (every candidate host refused the connection), "
+        "draining (fleet-wide drain refused intake)",
+        endpoint=endpoint, outcome=outcome)
+
+
+def weighted_order(candidates, rng=random):
+    """Weighted shuffle (Efraimidis–Spirakis): each candidate keyed by
+    random()^(1/weight), descending — higher weight, earlier position,
+    zero cross-candidate coordination. `candidates` is a list of
+    (weight, payload); zero/negative weights are dropped."""
+    keyed = [(rng.random() ** (1.0 / w), payload)
+             for w, payload in candidates if w > 0]
+    keyed.sort(reverse=True, key=lambda kv: kv[0])
+    return [payload for _, payload in keyed]
+
+
+class FleetRouter:
+    """One public HTTP listener over a `control` object exposing:
+    `hosts_for(model) -> Optional[List[(weight, host_id, (addr,
+    port))]]` (None = unknown model), `fleet_view()`,
+    `merged_fleet_metrics()`, `request_swap(payload)`,
+    `request_scale(host_id, n)`, `drain_host(host_id)` — duck-typed so
+    tests drive the router on a stub control plane."""
+
+    def __init__(self, config, control, host: Optional[str] = None,
+                 port: Optional[int] = None, log=None):
+        self.config = config
+        self.control = control
+        self.log = log or config.log
+        self._draining = False
+        router = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, payload, headers=None,
+                       ctype="application/json"):
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload,
+                                        sort_keys=True).encode() + b"\n")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        hz = router.healthz()
+                        self._reply(
+                            503 if hz["status"] == "draining" else 200,
+                            hz)
+                    elif path == "/fleet":
+                        self._reply(200, router.control.fleet_view())
+                    elif path in ("/metrics", "/"):
+                        self._reply(
+                            200,
+                            router.control.merged_fleet_metrics()
+                            .encode(),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+                    else:
+                        self._reply(404, {"error":
+                                          f"no such endpoint: {path}"})
+                except Exception as e:  # noqa: BLE001 — a probe must
+                    # get an HTTP error, never a torn connection
+                    self._reply(500, {"error":
+                                      f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                if path.startswith("/admin/"):
+                    router._admin(self, path)
+                    return
+                if path not in FORWARD_ENDPOINTS:
+                    self._reply(404, {"error":
+                                      f"no such endpoint: {path}"})
+                    return
+                router._forward(self, path)
+
+        class _Listener(http.server.ThreadingHTTPServer):
+            # match the replica listeners: a burst must reach the
+            # hosts' admission gates, not be refused at the kernel
+            request_queue_size = 128
+
+        self._httpd = _Listener(
+            (host if host is not None else config.serve_host,
+             port if port is not None else config.serve_port),
+            Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="fleet-router", daemon=True).start()
+        self.log(f"Fleet router on http://{self._httpd.server_address[0]}"
+                 f":{self.port} (POST /predict /embed /neighbors "
+                 f"routed by X-Model; GET /fleet /metrics /healthz; "
+                 f"POST /admin/reload /admin/scale /admin/drain)")
+
+    # ---------------------------------------------------------- forward
+
+    def _forward(self, handler, path: str) -> None:
+        endpoint = path.lstrip("/")
+        length = int(handler.headers.get("Content-Length", 0))
+        body = handler.rfile.read(length) if length else b""
+        trace = RequestTrace.from_headers(
+            handler.headers.get("traceparent"))
+        trace_headers = {"X-Trace-Id": trace.trace_id,
+                         "traceparent": trace.traceparent()}
+        deadline = deadline_from_request(
+            self.config, handler.headers.get("X-Deadline-Ms"))
+        model = (handler.headers.get("X-Model") or "").strip() \
+            or DEFAULT_MODEL
+        fwd_headers = {"traceparent": trace.traceparent()}
+        for name in ("Content-Type", "X-Deadline-Ms", "X-Model"):
+            if handler.headers.get(name):
+                fwd_headers[name] = handler.headers[name]
+        if self._draining:
+            _c_requests(endpoint, "draining").inc()
+            handler._reply(503, {"error": "fleet is draining",
+                                 "trace_id": trace.trace_id},
+                           dict(trace_headers, **{
+                               "Retry-After":
+                               str(retry_after_seconds(1.0))}))
+            return
+        candidates = self.control.hosts_for(model)
+        if candidates is None:
+            _c_requests(endpoint, "unknown_model").inc()
+            handler._reply(404, {
+                "error": f"no such model: {model!r} (X-Model header; "
+                         f"see GET /fleet for the mounted models)",
+                "trace_id": trace.trace_id}, trace_headers)
+            return
+        ordered = weighted_order([(w, (host_id, addr))
+                                  for w, host_id, addr in candidates])
+        if not ordered:
+            _c_requests(endpoint, "no_host").inc()
+            handler._reply(503, {
+                "error": f"no routable host for model {model!r}",
+                "trace_id": trace.trace_id},
+                dict(trace_headers, **{
+                    "Retry-After": str(retry_after_seconds(1.0))}))
+            return
+        last_err = None
+        for attempt, (host_id, (addr, port)) in enumerate(ordered):
+            remaining = deadline.remaining()
+            if attempt and deadline.bounded and remaining <= 0:
+                # the budget died with the previous attempt: answer
+                # the guaranteed-late 504 honestly, don't dispatch it
+                _c_requests(endpoint, "expired").inc()
+                handler._reply(504, {
+                    "error": "deadline exhausted retrying hosts "
+                             f"({last_err})",
+                    "trace_id": trace.trace_id}, trace_headers)
+                return
+            if attempt:
+                _C_RETRIES.inc()
+            timeout = (min(300.0, max(remaining, 0.05))
+                       if deadline.bounded else 300)
+            try:
+                conn = http.client.HTTPConnection(addr, port,
+                                                  timeout=timeout)
+                try:
+                    # handler.path keeps the query string (`path` was
+                    # stripped for dispatch): ?debug=trace must reach
+                    # the replica
+                    conn.request("POST", handler.path, body=body,
+                                 headers=fwd_headers)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    out_headers = {}
+                    for name in ("Retry-After", "X-Trace-Id",
+                                 "traceparent"):
+                        if resp.getheader(name):
+                            out_headers[name] = resp.getheader(name)
+                    # a replica always stamps these; belt-and-braces
+                    # for any terminal status that somehow lacks them
+                    out_headers.setdefault("X-Trace-Id", trace.trace_id)
+                    out_headers.setdefault("traceparent",
+                                           trace.traceparent())
+                    _c_requests(endpoint, "forwarded").inc()
+                    handler._reply(
+                        resp.status, payload, out_headers,
+                        ctype=resp.getheader("Content-Type",
+                                             "application/json"))
+                    return
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException) as e:
+                # dead / draining / mid-restart host — including one
+                # that died MID-RESPONSE (IncompleteRead/BadStatusLine
+                # are HTTPException, not OSError): the client never
+                # sees a torn response — retry the next candidate
+                last_err = f"{host_id}: {type(e).__name__}: {e}"
+                continue
+        _c_requests(endpoint, "unreachable").inc()
+        handler._reply(503, {
+            "error": f"no host reachable for model {model!r} "
+                     f"({last_err})",
+            "trace_id": trace.trace_id},
+            dict(trace_headers,
+                 **{"Retry-After": str(retry_after_seconds(1.0))}))
+
+    # ------------------------------------------------------------ admin
+
+    def _admin(self, handler, path: str) -> None:
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            raw = handler.rfile.read(length) if length else b"{}"
+            payload = json.loads(
+                raw.decode("utf-8", errors="replace") or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            if path == "/admin/reload":
+                code, out = self.control.request_swap(payload)
+            elif path == "/admin/scale":
+                code, out = self.control.request_scale(
+                    payload.get("host"), payload.get("replicas"))
+            elif path == "/admin/drain":
+                code, out = self.control.drain_host(payload.get("host"))
+            else:
+                code, out = 404, {"error": f"no such endpoint: {path}"}
+        except (ValueError, json.JSONDecodeError) as e:
+            code, out = (409 if "in flight" in str(e) else 400,
+                         {"error": str(e)})
+        except KeyError as e:
+            code, out = 404, {"error": f"no such host: {e}"}
+        except Exception as e:  # noqa: BLE001
+            code, out = 500, {"error": f"{type(e).__name__}: {e}"}
+        handler._reply(code, out)
+
+    # ------------------------------------------------------------- misc
+
+    def healthz(self) -> dict:
+        view = self.control.fleet_view()
+        return {
+            "status": "draining" if self._draining else "routing",
+            "port": self.port,
+            "hosts": len(view.get("hosts", [])),
+            "routable_hosts": sum(
+                1 for h in view.get("hosts", [])
+                if h.get("weight", 0) > 0),
+            "models": sorted(view.get("models", {})),
+        }
+
+    def drain(self) -> None:
+        """Stop intake: every new request is an honest 503 shed while
+        the hosts behind finish their own drains."""
+        self._draining = True
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass  # teardown must never mask the fleet exit path

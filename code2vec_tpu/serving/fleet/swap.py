@@ -1,0 +1,267 @@
+"""Fleet-wide coordinated hot-swap: canary-first, halt on failure,
+roll back rather than leave a mixed fleet.
+
+PR 9's recorded gap: SIGHUP fan-out stops at ONE node — a deploy
+touching N hosts had no coordinator, so "which fingerprint is the
+fleet serving" was unanswerable mid-rollout. This driver closes it:
+
+1. **Canary**: one host (the first live host of the target model
+   group) receives the reload first. Its supervisor fans the swap out
+   to its replicas (serving/supervisor.py reload_all); the driver
+   polls the host's `/fleet` until every replica lands ONE new
+   fingerprint with `swap_state == ready` — that fingerprint becomes
+   the fleet TARGET. A canary that fails (any replica `swap_state ==
+   failed`, or no convergence inside `--fleet_swap_timeout`) halts the
+   rollout with zero non-canary hosts touched.
+2. **Rollout**: remaining hosts swap sequentially; each must land
+   exactly the canary's fingerprint. First failure halts the rollout.
+3. **Rollback**: on a post-canary failure the already-committed hosts
+   (and the failed one) are driven back to the previous artifact —
+   the fleet converges back to ONE fingerprint instead of serving a
+   permanently mixed window. No rollback target (the fleet was started
+   without a known artifact) degrades to halt-and-report.
+
+The mixed-fingerprint window is deliberately OBSERVABLE and BOUNDED:
+`status()` (surfaced in the router's `GET /fleet` under `"swap"`)
+carries the per-host outcomes and the target fingerprint while the
+control plane's fleet view carries every host's live fingerprint set.
+`fleet_swap_total{outcome}` counts committed / failed / rolled_back
+rollouts; every transition is a flight-recorder event.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from code2vec_tpu import obs
+
+
+def _c_swaps(outcome: str):
+    return obs.counter(
+        "fleet_swap_total",
+        "fleet-wide coordinated hot-swap rollouts by outcome: "
+        "committed (every host landed the canary's fingerprint), "
+        "failed (halted with no rollback target or rollback failure), "
+        "rolled_back (a post-canary failure was rolled back to the "
+        "previous artifact fleet-wide)",
+        outcome=outcome)
+
+
+class FleetSwapBusy(ValueError):
+    """A rollout is already in flight — maps to HTTP 409 (the router
+    matches on the message, like SwapManager's reload conflict)."""
+
+    def __init__(self, state: str, target):
+        super().__init__(
+            f"a fleet swap is already in flight (state={state}, "
+            f"target={target}); poll GET /fleet `swap` and retry")
+
+
+class FleetSwapDriver:
+    """Owns the rollout worker thread + the status the router surfaces.
+    `control` is the ControlPlane (duck-typed in tests): provides
+    `swap_hosts(model)` (live hosts of the group, canary first),
+    `host_reload(host, artifact)`, `host_fleet(host)` (fresh `/fleet`
+    JSON or None), `rollback_target(model)` / `set_artifact(model,
+    artifact)`, `flight` and `log`."""
+
+    def __init__(self, control, poll_interval_s: float = 0.25):
+        self.control = control
+        self.poll_interval_s = poll_interval_s
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._status = {"state": "idle", "target": None, "model": None,
+                        "target_fingerprint": None, "error": None,
+                        "hosts": [], "started_at": None,
+                        "completed_at": None}
+
+    def status(self) -> dict:
+        with self._lock:
+            return dict(self._status, hosts=list(self._status["hosts"]))
+
+    def _set(self, **fields) -> None:
+        with self._lock:
+            self._status.update(fields)
+
+    def _host_outcome(self, host_id: str, outcome: str) -> None:
+        with self._lock:
+            self._status["hosts"].append({"host": host_id,
+                                          "outcome": outcome})
+
+    # ------------------------------------------------------------- start
+
+    def request(self, artifact, model: str = "default",
+                rollback_to: Optional[str] = None) -> dict:
+        """Kick off an async rollout; returns the fresh status. Raises
+        ValueError on a bad request, FleetSwapBusy while one runs."""
+        if not artifact:
+            raise ValueError('no artifact: body must be '
+                             '{"artifact": DIR[, "model": NAME]}')
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                raise FleetSwapBusy(self._status["state"],
+                                    self._status["target"])
+            hosts = self.control.swap_hosts(model)
+            if hosts is None:
+                raise ValueError(f"no such model: {model!r}")
+            if not hosts:
+                raise ValueError(
+                    f"no live host in model group {model!r} to swap")
+            rollback = (rollback_to
+                        or self.control.rollback_target(model))
+            self._status.update(
+                state="canary", target=str(artifact), model=model,
+                target_fingerprint=None, error=None, hosts=[],
+                started_at=time.time(), completed_at=None)
+            self._worker = threading.Thread(
+                target=self._run,
+                args=(str(artifact), model, hosts, rollback),
+                name="fleet-swap", daemon=True)
+            self._worker.start()
+        return self.status()
+
+    # ----------------------------------------------------------- rollout
+
+    def _run(self, artifact: str, model: str, hosts: List,
+             rollback: Optional[str]) -> None:
+        control = self.control
+        control.flight.event("fleet_swap_start", target=artifact,
+                             model=model, hosts=len(hosts),
+                             canary=hosts[0].id)
+        target_fp: Optional[str] = None
+        committed: List = []
+        for i, host in enumerate(hosts):
+            ok, result = self._swap_host(host, artifact,
+                                         expect_fp=target_fp)
+            if not ok:
+                self._host_outcome(host.id, f"failed: {result}")
+                control.flight.event("fleet_swap_halt", host=host.id,
+                                     error=result,
+                                     committed=len(committed))
+                if i == 0:
+                    # canary failure: nothing committed, nothing mixed
+                    # — halt-and-report IS the safe terminal state
+                    _c_swaps("failed").inc()
+                    self._set(state="failed", completed_at=time.time(),
+                              error=f"canary {host.id}: {result}")
+                    control.log(f"Fleet swap to {artifact} HALTED at "
+                                f"canary {host.id}: {result}")
+                    return
+                self._rollback(committed + [host], rollback, model,
+                               first_error=f"{host.id}: {result}")
+                return
+            self._host_outcome(host.id, "committed")
+            committed.append(host)
+            if i == 0:
+                target_fp = result
+                self._set(state="rolling", target_fingerprint=result)
+                control.log(f"Fleet swap canary {host.id} committed "
+                            f"fingerprint {result}; rolling out to "
+                            f"{len(hosts) - 1} more host(s)")
+        control.set_artifact(model, artifact)
+        _c_swaps("committed").inc()
+        self._set(state="committed", completed_at=time.time())
+        control.flight.event("fleet_swap_committed", target=artifact,
+                             model=model, fingerprint=target_fp,
+                             hosts=len(hosts))
+        control.log(f"Fleet swap committed: {len(hosts)} host(s) on "
+                    f"fingerprint {target_fp} ({artifact})")
+
+    def _rollback(self, touched: List, rollback: Optional[str],
+                  model: str, first_error: str) -> None:
+        control = self.control
+        if not rollback:
+            _c_swaps("failed").inc()
+            self._set(state="failed", completed_at=time.time(),
+                      error=f"{first_error}; NO rollback target known "
+                            f"— fleet left mixed, operator action "
+                            f"required (see /fleet fingerprints)")
+            control.log(f"Fleet swap FAILED mid-rollout with no "
+                        f"rollback target: {first_error}")
+            return
+        self._set(state="rolling_back")
+        control.flight.event("fleet_swap_rollback", target=rollback,
+                             hosts=len(touched))
+        control.log(f"Fleet swap failed ({first_error}); rolling "
+                    f"{len(touched)} host(s) back to {rollback}")
+        clean = True
+        for host in touched:
+            ok, result = self._swap_host(host, rollback, expect_fp=None)
+            self._host_outcome(
+                host.id, "rolled_back" if ok
+                else f"rollback_failed: {result}")
+            clean = clean and ok
+        if clean:
+            _c_swaps("rolled_back").inc()
+            self._set(state="rolled_back", completed_at=time.time(),
+                      error=first_error)
+            control.log(f"Fleet rollback to {rollback} complete")
+        else:
+            _c_swaps("failed").inc()
+            self._set(state="failed", completed_at=time.time(),
+                      error=f"{first_error}; rollback to {rollback} "
+                            f"also failed on some hosts — see hosts[]")
+            control.log("Fleet rollback FAILED on some hosts")
+
+    # ---------------------------------------------------------- one host
+
+    def _swap_host(self, host, artifact: str,
+                   expect_fp: Optional[str]):
+        """Drive one host's supervisor reload fan-out and poll its
+        /fleet until every replica lands one converged fingerprint with
+        swap_state ready. Returns (True, fingerprint) or (False, why).
+        `expect_fp` (post-canary) additionally pins WHICH fingerprint —
+        a host converging on anything else is a failure (two artifacts
+        claiming one dir, a stale cache on one host)."""
+        control = self.control
+        ok, why = control.host_reload(host, artifact)
+        if not ok:
+            return False, f"reload request failed: {why}"
+        timeout = float(getattr(control.config, "fleet_swap_timeout_s",
+                                120.0))
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            time.sleep(self.poll_interval_s)
+            view = control.host_fleet(host)
+            if view is None:
+                continue  # transiently unreachable; keep polling
+            last = view
+            replicas = [r for r in view.get("replicas", [])
+                        if not r.get("draining")]
+            if not replicas:
+                continue
+            # convergence is keyed on swap_target == THIS artifact: a
+            # replica still showing a PREVIOUS rollout's "ready" (or a
+            # stale "failed" from an old target) can neither satisfy
+            # nor abort this one
+            on_target = [r for r in replicas
+                         if r.get("swap_target") == artifact]
+            if any(r.get("swap_state") == "failed"
+                   for r in on_target):
+                return False, ("a replica rejected the candidate "
+                               "(swap_state=failed)")
+            if len(on_target) != len(replicas):
+                continue  # a replica has not seen the reload yet
+            if {r.get("swap_state") for r in on_target} != {"ready"}:
+                continue  # a replica has not landed its swap yet
+            fps = {r.get("model_fingerprint") for r in on_target}
+            if None in fps or len(fps) != 1:
+                continue
+            fp = fps.pop()
+            if expect_fp is not None and fp != expect_fp:
+                continue  # converged on the WRONG weights; keep
+                # waiting (a slow replica may still flip) until timeout
+            return True, fp
+        return False, (f"no convergence within {timeout:g}s "
+                       f"(last fingerprints="
+                       f"{sorted(f or '?' for f in (self._host_fingerprints(last) or []))})")
+
+    @staticmethod
+    def _host_fingerprints(view) -> Optional[set]:
+        if not view:
+            return None
+        return {r.get("model_fingerprint")
+                for r in view.get("replicas", [])}

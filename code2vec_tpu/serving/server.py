@@ -99,7 +99,7 @@ from code2vec_tpu.obs.flight import default_flight_recorder
 from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.serving.admission import (
     AdmissionController, Deadline, DeadlineExceeded, Shed,
-    deadline_from_request, expired_counter,
+    deadline_from_request, expired_counter, retry_after_seconds,
 )
 from code2vec_tpu.serving.batcher import DynamicBatcher
 from code2vec_tpu.serving.breaker import CircuitBreaker
@@ -161,7 +161,8 @@ class PredictionServer:
     process, and the HTTP layer stays a thin framing shim.
     """
 
-    def __init__(self, model, config=None, log=None):
+    def __init__(self, model, config=None, log=None,
+                 swap_build_model=None):
         self.config = config or model.config
         self.log = log or self.config.log
         # The model reference is (model, fingerprint), swapped
@@ -209,6 +210,8 @@ class PredictionServer:
         self.flight.configure(
             dump_dir=flight_dir,
             capacity=getattr(self.config, "serve_flight_records", 512),
+            max_dumps=getattr(self.config, "serve_flight_max_dumps",
+                              64),
             log=self.log)
         breaker_kw = dict(
             window_s=self.config.serve_breaker_window_s,
@@ -218,7 +221,9 @@ class PredictionServer:
             on_transition=self._on_breaker_transition)
         self.extractor_breaker = CircuitBreaker("extractor", **breaker_kw)
         self.device_breaker = CircuitBreaker("device", **breaker_kw)
-        self.swap = SwapManager(self)
+        # swap_build_model: injection seam mirroring SwapManager's —
+        # the fleet chaos children swap between in-process fake models
+        self.swap = SwapManager(self, build_model=swap_build_model)
         self._httpd: Optional[socketserver.BaseServer] = None
         self._inflight = 0
         self._inflight_cond = threading.Condition()
@@ -328,8 +333,10 @@ class PredictionServer:
             e.count()
             status = 503
             reason = e.reason
-            headers["Retry-After"] = str(max(1, int(round(
-                e.retry_after_s))))
+            # jittered: a synchronized shed (open breaker, drain) must
+            # not teach every client the same retry instant
+            headers["Retry-After"] = str(retry_after_seconds(
+                e.retry_after_s))
             body = json.dumps({"error": str(e), "shed": e.reason,
                                "trace_id": trace.trace_id}
                               ).encode() + b"\n"
@@ -776,7 +783,8 @@ class PredictionServer:
                     _requests_counter(endpoint, "draining").inc()
                     self._error(503, "server is draining",
                                 extra_headers=trace_headers(
-                                    **{"Retry-After": "1"}))
+                                    **{"Retry-After": str(
+                                        retry_after_seconds(1.0))}))
                     return
                 try:
                     try:
@@ -980,6 +988,26 @@ class PredictionServer:
         return clean
 
 
+RELOAD_TARGET_FILENAME = "reload-target.json"
+
+
+def reload_target_for(config) -> Optional[str]:
+    """The artifact dir a SIGHUP should reload, when the supervisor
+    dropped a reload-target file into the run dir (next to this
+    replica's heartbeat file); None otherwise."""
+    if not config.heartbeat_file:
+        return None
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(config.heartbeat_file)),
+        RELOAD_TARGET_FILENAME)
+    try:
+        with open(path) as f:
+            target = json.load(f).get("artifact")
+    except (OSError, ValueError):
+        return None
+    return str(target) if target else None
+
+
 def _heartbeat_fields(server: PredictionServer) -> dict:
     reg = obs.default_registry().collect()
 
@@ -987,11 +1015,16 @@ def _heartbeat_fields(server: PredictionServer) -> dict:
         fam = reg.get(name, {})
         return int(sum(child.value for child in fam.values()))
 
+    swap_status = server.swap.status()
     return {
         "port": server.port,
         "inflight": server._inflight,
         "model_fingerprint": server.model_fingerprint,
-        "swap_state": server.swap.status()["state"],
+        "swap_state": swap_status["state"],
+        # which artifact the swap state refers to: the fleet swap
+        # driver keys its convergence poll on this, so a replica still
+        # showing LAST rollout's "ready" can never satisfy a new one
+        "swap_target": swap_status["target"],
         "breakers": {"extractor": server.extractor_breaker.state,
                      "device": server.device_breaker.state},
         "requests_total": total("serving_requests_total"),
@@ -1001,7 +1034,8 @@ def _heartbeat_fields(server: PredictionServer) -> dict:
 
 
 def serve_main(config, model=None, *, stop: Optional[threading.Event]
-               = None, install_signals: Optional[bool] = None) -> int:
+               = None, install_signals: Optional[bool] = None,
+               swap_build_model=None) -> int:
     """The `serve` CLI subcommand body: build the model, start the
     server, park until SIGTERM/SIGINT (or the injected `stop` event —
     the testable form), drain, exit. Returns the process exit code.
@@ -1017,7 +1051,8 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
     if model is None:
         from code2vec_tpu.model_facade import Code2VecModel
         model = Code2VecModel(config)
-    server = PredictionServer(model, config)
+    server = PredictionServer(model, config,
+                              swap_build_model=swap_build_model)
     if stop is None:
         stop = threading.Event()
     if install_signals is None:
@@ -1030,16 +1065,22 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
         stop.set()
 
     def _on_hup(signum, frame):
-        if config.serve_artifact:
-            config.log("SIGHUP: reloading --artifact "
-                       f"{config.serve_artifact}")
+        # Reload target: a `reload-target.json` next to the heartbeat
+        # file (written by the supervisor's fleet-wide reload fan-out —
+        # under SO_REUSEPORT a POST /admin/reload reaches one
+        # kernel-chosen replica, so the file + SIGHUP is how EVERY
+        # replica learns a NEW artifact dir) wins over the boot-time
+        # --artifact.
+        target = reload_target_for(config) or config.serve_artifact
+        if target:
+            config.log(f"SIGHUP: reloading artifact {target}")
             try:
-                server.swap.request_reload(config.serve_artifact)
+                server.swap.request_reload(target)
             except SwapError as e:
                 config.log(f"SIGHUP reload rejected: {e}")
         else:
-            config.log("SIGHUP ignored: no --artifact to reload "
-                       "(use POST /admin/reload)")
+            config.log("SIGHUP ignored: no --artifact or reload-target "
+                       "file to reload (use POST /admin/reload)")
 
     prev_term = prev_int = prev_hup = None
     if install_signals:

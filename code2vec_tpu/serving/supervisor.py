@@ -64,6 +64,10 @@ import time
 from typing import Dict, List, Optional
 
 from code2vec_tpu import obs
+from code2vec_tpu.obs.reqtrace import RequestTrace
+from code2vec_tpu.serving.admission import (
+    deadline_from_request, retry_after_seconds,
+)
 
 REPLICA_ENV = "C2V_SERVE_REPLICA"
 FORCE_PROXY_ENV = "C2V_SERVE_FORCE_PROXY"
@@ -71,11 +75,32 @@ FORCE_PROXY_ENV = "C2V_SERVE_FORCE_PROXY"
 # supervisor declares a hung STARTUP (model build + jit warmup can
 # legitimately take tens of seconds on a cold replica).
 STARTUP_GRACE_S = 120.0
+# Hard ceiling on /admin/scale: the per-host replica count is bounded
+# by cores/HBM, not ambition — a runaway autoscaler must not fork-bomb
+# the host.
+MAX_REPLICAS = 64
 
 _C_RESTARTS = obs.counter(
     "serving_replica_restarts_total",
     "replica processes restarted by the serving supervisor "
     "(crash or stale heartbeat)")
+
+
+def _c_scale(direction: str):
+    return obs.counter(
+        "serving_replica_scale_total",
+        "supervisor replica-count changes applied via /admin/scale "
+        "(up = spawned, down = drained and retired)",
+        direction=direction)
+
+
+def _c_snapshot_skipped(replica) -> obs.Counter:
+    return obs.counter(
+        "serving_telemetry_snapshots_skipped_total",
+        "per-replica metrics snapshots the merged /metrics scrape "
+        "skipped because the file was torn or unparsable (the scrape "
+        "serves the surviving replicas' truth instead of 500ing)",
+        replica=str(replica))
 
 
 def strip_flag(argv: List[str], flag: str,
@@ -97,6 +122,24 @@ def strip_flag(argv: List[str], flag: str,
     return out
 
 
+def child_env(base_env: Dict[str, str]) -> Dict[str, str]:
+    """Copy of `base_env` with this package's parent dir on
+    PYTHONPATH: the supervisor/fleet re-exec children via
+    `python -m code2vec_tpu.cli`, and a parent launched from OUTSIDE
+    the repo (cwd anywhere, repo importable only via its own
+    sys.path) would otherwise spawn children that cannot import the
+    package at all."""
+    import code2vec_tpu
+    env = dict(base_env)
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(code2vec_tpu.__file__)))
+    pythonpath = env.get("PYTHONPATH", "")
+    if root not in pythonpath.split(os.pathsep):
+        env["PYTHONPATH"] = (root + (os.pathsep + pythonpath
+                                     if pythonpath else ""))
+    return env
+
+
 def _free_port(host: str) -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind((host, 0))
@@ -116,6 +159,15 @@ class _Replica:
         self.restarts = 0
         self.spawned_at = 0.0
         self.restart_at: Optional[float] = None  # backoff gate
+        # scale-down lifecycle: a draining replica finishes in-flight
+        # work (its own SIGTERM drain), then is RETIRED — never
+        # restarted, never counted against the desired replica count
+        self.draining = False
+        self.drain_started = 0.0
+        # reload fan-out deferred until the replica's first heartbeat:
+        # a SIGHUP before serve_main installs its handler would KILL a
+        # still-starting replica (default SIGHUP disposition)
+        self.pending_reload = False
 
     @property
     def alive(self) -> bool:
@@ -163,14 +215,15 @@ class Supervisor:
         if self.reuseport and self.port == 0:
             # replicas must all bind ONE concrete port; resolve now
             self.port = _free_port(config.serve_host)
-        self.replicas = [
-            _Replica(i,
-                     os.path.join(self.run_dir,
-                                  f"replica{i}.heartbeat.json"),
-                     os.path.join(self.run_dir, f"replica{i}.log"),
-                     os.path.join(self.run_dir,
-                                  f"replica{i}.metrics.prom"))
-            for i in range(self.n)]
+        self.replicas = [self._make_replica(i) for i in range(self.n)]
+        # /admin/scale: the monitor loop reconciles the live replica set
+        # toward `_desired` (spawn up, drain down); indices only ever
+        # grow so a retiring replica's run files never collide with a
+        # newly spawned one's
+        self._desired = self.n
+        self._next_index = self.n
+        self._scale_lock = threading.Lock()
+        self._last_reload: Optional[dict] = None
         self._stop = threading.Event()
         self._escalated = False
         self._proxy = None
@@ -182,9 +235,19 @@ class Supervisor:
         # into the run dir (the replicas' own dumps land there too when
         # --heartbeat_file puts their run files in one place).
         self.flight = obs.default_flight_recorder()
-        self.flight.configure(dump_dir=self.run_dir, log=self.log)
+        self.flight.configure(
+            dump_dir=self.run_dir,
+            max_dumps=getattr(config, "serve_flight_max_dumps", 64),
+            log=self.log)
 
     # ------------------------------------------------------------ spawn
+
+    def _make_replica(self, index: int) -> _Replica:
+        return _Replica(
+            index,
+            os.path.join(self.run_dir, f"replica{index}.heartbeat.json"),
+            os.path.join(self.run_dir, f"replica{index}.log"),
+            os.path.join(self.run_dir, f"replica{index}.metrics.prom"))
 
     def _spawn(self, replica: _Replica) -> None:
         try:
@@ -209,7 +272,7 @@ class Supervisor:
             cmd += ["--trace_export",
                     os.path.join(self.run_dir,
                                  f"replica{replica.index}.trace.json")]
-        env = dict(os.environ)
+        env = child_env(os.environ)
         env[REPLICA_ENV] = str(replica.index)
         if self.reuseport:
             cmd += ["--serve_port", str(self.port)]
@@ -235,6 +298,15 @@ class Supervisor:
         replica.pipe_r = r
         replica.spawned_at = time.monotonic()
         replica.restart_at = None
+        # Desired-state reconciliation: a reload-target file means the
+        # fleet's current artifact is NOT the boot artifact this child
+        # just loaded (reload_all / the control plane wrote it), so a
+        # crash-restarted replica must be swapped onto it at its first
+        # heartbeat — otherwise one OOM after a committed rollout
+        # silently mixes fingerprints on this host forever.
+        from code2vec_tpu.serving.server import RELOAD_TARGET_FILENAME
+        replica.pending_reload = os.path.exists(
+            os.path.join(self.run_dir, RELOAD_TARGET_FILENAME))
         self.log(f"Replica {replica.index} spawned "
                  f"(pid {replica.proc.pid}"
                  f"{f', port {replica.port}' if replica.port else ''})")
@@ -248,8 +320,178 @@ class Supervisor:
 
     def _fan_out_sighup(self) -> None:
         self.log("SIGHUP: fanning reload out to all replicas")
-        for replica in self.replicas:
+        for replica in list(self.replicas):
+            if replica.draining:
+                continue
+            if replica.heartbeat() is None:
+                # no heartbeat = serve_main has not installed its
+                # SIGHUP handler yet; the default disposition would
+                # KILL the starting child — defer to first heartbeat
+                replica.pending_reload = True
+                continue
             self._kill(replica, signal.SIGHUP)
+
+    # ------------------------------------------------------------ scale
+
+    def request_scale(self, n) -> dict:
+        """POST /admin/scale body — set the desired replica count; the
+        monitor loop reconciles (spawn up / coordinated-drain down).
+        The fleet control plane drives this off the telemetry signals
+        (serving/fleet/control.py); operators can too."""
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            raise ValueError('body must be {"replicas": N}')
+        if not (1 <= n <= MAX_REPLICAS):
+            raise ValueError(
+                f"replicas must be in [1, {MAX_REPLICAS}] (got {n})")
+        with self._scale_lock:
+            self._desired = n
+        self.log(f"Scale request: desired replicas -> {n}")
+        return {"desired_replicas": n,
+                "current_replicas": len(self.replicas)}
+
+    def _reconcile_scale(self) -> None:
+        with self._scale_lock:
+            desired = self._desired
+        active = [r for r in self.replicas if not r.draining]
+        for _ in range(desired - len(active)):
+            replica = self._make_replica(self._next_index)
+            self._next_index += 1
+            self.replicas.append(replica)
+            self._spawn(replica)
+            _c_scale("up").inc()
+            self.flight.event("replica_scale_up", replica=replica.index)
+        excess = len(active) - desired
+        if excess > 0:
+            # retire the newest first: replica 0's warm cache and
+            # compiled steps are the oldest and most valuable
+            for replica in sorted(active, key=lambda r: r.index,
+                                  reverse=True)[:excess]:
+                replica.draining = True
+                replica.drain_started = time.monotonic()
+                replica.restart_at = None
+                self._kill(replica, signal.SIGTERM)
+                _c_scale("down").inc()
+                self.flight.event("replica_scale_down",
+                                  replica=replica.index)
+                self.log(f"Replica {replica.index} draining "
+                         f"(scale-down)")
+
+    def _retire(self, replica: _Replica) -> None:
+        """A drained (scale-down) replica exited: reap and REMOVE it —
+        its exit is policy, not a failure to restart."""
+        if replica.proc is not None:
+            replica.proc.wait()
+        if replica.pipe_r is not None:
+            try:
+                os.close(replica.pipe_r)
+            except OSError:
+                pass
+            replica.pipe_r = None
+        # its metrics snapshot must leave the merge: a retired
+        # replica's frozen counters would shadow the live fleet
+        if replica.metrics_path:
+            try:
+                os.remove(replica.metrics_path)
+            except OSError:
+                pass
+        self.replicas.remove(replica)
+        self.log(f"Replica {replica.index} retired "
+                 f"(rc={replica.proc.returncode if replica.proc else '?'})")
+
+    # ----------------------------------------------------------- reload
+
+    def reload_all(self, artifact) -> dict:
+        """Fan a hot-swap to `artifact` out to EVERY live replica —
+        the per-host leg of the fleet-wide coordinated swap
+        (serving/fleet/swap.py drives this canary-host-first). Proxy
+        mode POSTs each replica's own /admin/reload; under SO_REUSEPORT
+        one shared port cannot address a specific replica, so the
+        target rides a `reload-target.json` in the run dir + SIGHUP
+        (serve_main's handler reads the file). Swap RESULTS are
+        asynchronous — callers poll /fleet for per-replica swap_state +
+        fingerprint convergence."""
+        if not artifact:
+            raise ValueError('no artifact: body must be '
+                             '{"artifact": DIR}')
+        import http.client
+        artifact = str(artifact)
+        targets = [r for r in list(self.replicas)
+                   if r.alive and not r.draining]
+        results = []
+        # the reload target is written in BOTH modes: a replica still
+        # STARTING (no heartbeat yet — its SIGHUP handler is not
+        # installed, so a signal now would kill it) gets the fan-out
+        # DEFERRED to its first heartbeat, delivered as SIGHUP + this
+        # file by the monitor loop
+        from code2vec_tpu.serving.server import RELOAD_TARGET_FILENAME
+        # _atomic_write's thread-unique tmp matters here: the telemetry
+        # listener AND the proxy both accept /admin/reload on their own
+        # threads of this pid
+        obs.exporters._atomic_write(
+            os.path.join(self.run_dir, RELOAD_TARGET_FILENAME),
+            json.dumps({"artifact": artifact,
+                        "requested_at": time.time()}) + "\n")
+        ready, starting = [], []
+        for replica in targets:
+            (ready if replica.heartbeat() is not None
+             else starting).append(replica)
+        for replica in starting:
+            replica.pending_reload = True
+            results.append({"index": replica.index, "via": "deferred",
+                            "accepted": True})
+        if self.reuseport:
+            for replica in ready:
+                self._kill(replica, signal.SIGHUP)
+                results.append({"index": replica.index, "via": "sighup",
+                                "accepted": True})
+        else:
+            for replica in ready:
+                if replica.port is None:
+                    replica.pending_reload = True
+                    results.append({"index": replica.index,
+                                    "via": "deferred",
+                                    "accepted": True})
+                    continue
+                try:
+                    conn = http.client.HTTPConnection(
+                        self.config.serve_host, replica.port,
+                        timeout=10)
+                    try:
+                        conn.request(
+                            "POST", "/admin/reload",
+                            body=json.dumps({"artifact": artifact}
+                                            ).encode(),
+                            headers={"Content-Type":
+                                     "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        results.append({"index": replica.index,
+                                        "via": "http",
+                                        "accepted": resp.status == 202,
+                                        "status": resp.status})
+                    finally:
+                        conn.close()
+                except (OSError, http.client.HTTPException) as e:
+                    results.append({"index": replica.index,
+                                    "via": "http", "accepted": False,
+                                    "error": f"{type(e).__name__}: "
+                                             f"{e}"})
+        status = {"artifact": artifact, "requested_at": time.time(),
+                  "replicas": results}
+        self._last_reload = status
+        self.flight.event("host_reload_fanout", artifact=artifact,
+                          replicas=len(results))
+        self.log(f"Reload fan-out: artifact {artifact} -> "
+                 f"{len(results)} replica(s)")
+        return status
+
+    def _admin_scale(self, payload: dict):
+        return 200, self.request_scale(payload.get("replicas"))
+
+    def _admin_reload(self, payload: dict):
+        return 202, self.reload_all(payload.get("artifact"))
 
     # ---------------------------------------------------------- monitor
 
@@ -280,6 +522,15 @@ class Supervisor:
                 replica.port = int(port)
                 self.log(f"Replica {replica.index} listening on port "
                          f"{replica.port}")
+        if replica.pending_reload:
+            # deferred reload fan-out: the first heartbeat proves
+            # serve_main's SIGHUP handler is installed (handlers are
+            # set before the server starts publishing), so the signal
+            # now triggers a swap instead of killing a starting child
+            replica.pending_reload = False
+            self._kill(replica, signal.SIGHUP)
+            self.log(f"Replica {replica.index} ready; delivering the "
+                     f"deferred reload fan-out (SIGHUP)")
         age = time.time() - float(hb.get("wall_time", 0))
         if age > self._stale_after():
             self._kill(replica)
@@ -330,14 +581,16 @@ class Supervisor:
             port=self.port,
             telemetry_port=(self._telemetry.port
                             if self._telemetry else None),
+            desired_replicas=self._desired,
             replicas=[{
                 "index": r.index,
                 "pid": r.proc.pid if r.proc is not None else None,
                 "port": r.port,
                 "alive": r.alive,
                 "restarts": r.restarts,
+                "draining": r.draining,
                 "heartbeat_file": r.heartbeat_path,
-            } for r in self.replicas], **extra)
+            } for r in list(self.replicas)], **extra)
 
     # -------------------------------------------------------- telemetry
 
@@ -349,14 +602,33 @@ class Supervisor:
         gap (README "Telemetry")."""
         from code2vec_tpu.serving import telemetry
         snapshots = {}
-        for replica in self.replicas:
+        for replica in list(self.replicas):
             if not replica.metrics_path:
                 continue
             try:
-                with open(replica.metrics_path) as f:
-                    snapshots[str(replica.index)] = f.read()
+                # errors="replace": a corrupt byte must surface as an
+                # unparsable (skip-and-count) snapshot, not a
+                # UnicodeDecodeError 500ing the scrape
+                with open(replica.metrics_path,
+                          errors="replace") as f:
+                    text = f.read()
             except OSError:
                 continue  # not written yet / replica restarting
+            try:
+                families = telemetry.parse_prometheus_text(text)
+            except Exception:  # noqa: BLE001 — a torn snapshot must
+                # not 500 the whole scrape
+                families = None
+            if not families:
+                if text.strip():
+                    # torn / mid-rewrite / foreign garbage:
+                    # skip-and-count this replica, serve the others'
+                    # truth (pinned in tests/test_telemetry.py)
+                    _c_snapshot_skipped(replica.index).inc()
+                continue  # empty file = not written yet, no count
+            # already-parsed families: the merge accepts them as-is,
+            # so validation does not buy a second parse per scrape
+            snapshots[str(replica.index)] = families
         snapshots["supervisor"] = \
             obs.default_registry().render_prometheus()
         return telemetry.merge_prometheus_snapshots(snapshots)
@@ -368,23 +640,33 @@ class Supervisor:
         the supervisor already monitors."""
         from code2vec_tpu.serving import telemetry
         now = time.time()
+        replicas = [dict(
+            telemetry.fleet_replica_view(r.heartbeat(), now),
+            index=r.index,
+            pid=r.proc.pid if r.proc is not None else None,
+            port=r.port,
+            alive=r.alive,
+            restarts=r.restarts,
+            draining=r.draining,
+            in_backoff=r.restart_at is not None,
+        ) for r in list(self.replicas)]
         return {
             "mode": "reuseport" if self.reuseport else "proxy",
             "port": self.port,
             "telemetry_port": (self._telemetry.port
                                if self._telemetry else None),
-            "replica_count": self.n,
+            "replica_count": len(replicas),
+            "desired_replicas": self._desired,
             "escalated": self._escalated,
             "stale_after_s": self._stale_after(),
-            "replicas": [dict(
-                telemetry.fleet_replica_view(r.heartbeat(), now),
-                index=r.index,
-                pid=r.proc.pid if r.proc is not None else None,
-                port=r.port,
-                alive=r.alive,
-                restarts=r.restarts,
-                in_backoff=r.restart_at is not None,
-            ) for r in self.replicas],
+            # the host's fingerprint window: >1 entry = a swap is in
+            # flight (or wedged) on this host — the fleet swap driver
+            # polls this for convergence
+            "fingerprints": sorted({r["model_fingerprint"]
+                                    for r in replicas
+                                    if r["model_fingerprint"]}),
+            "last_reload": self._last_reload,
+            "replicas": replicas,
         }
 
     def _resolve_telemetry_port(self) -> int:
@@ -401,10 +683,16 @@ class Supervisor:
         explicit = getattr(self.config, "serve_telemetry_port",
                            None) is not None
         port = self._resolve_telemetry_port()
+        # the control-plane verbs ride the telemetry listener: one port
+        # per host is both the scrape address and the fleet control
+        # address (serving/fleet/control.py drives these)
+        post_handlers = {"/admin/scale": self._admin_scale,
+                         "/admin/reload": self._admin_reload}
         try:
             self._telemetry = TelemetryServer(
                 self.merged_metrics, self.fleet_view,
-                host=self.config.serve_host, port=port)
+                host=self.config.serve_host, port=port,
+                post_handlers=post_handlers)
         except OSError as e:
             if explicit or port == 0:
                 # an operator-pinned scrape address that cannot bind is
@@ -416,16 +704,20 @@ class Supervisor:
                      f"unavailable ({e}); binding a free port instead")
             self._telemetry = TelemetryServer(
                 self.merged_metrics, self.fleet_view,
-                host=self.config.serve_host, port=0)
+                host=self.config.serve_host, port=0,
+                post_handlers=post_handlers)
         self.log(f"Fleet telemetry on http://{self.config.serve_host}:"
                  f"{self._telemetry.port} (GET /metrics merged across "
-                 f"replicas, GET /fleet)")
+                 f"replicas, GET /fleet, POST /admin/scale, "
+                 f"POST /admin/reload)")
 
     # ------------------------------------------------------------ proxy
 
     def _live_ports(self) -> List[int]:
-        return [r.port for r in self.replicas
-                if r.alive and r.port is not None]
+        # draining (scale-down) replicas stop receiving new work; they
+        # only finish what they already hold
+        return [r.port for r in list(self.replicas)
+                if r.alive and r.port is not None and not r.draining]
 
     def _start_proxy(self) -> None:
         import http.server
@@ -451,6 +743,15 @@ class Supervisor:
                 import http.client
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                # proxy-generated terminal statuses carry trace ids
+                # too: the correlation contract holds even when no
+                # replica ever saw the request
+                trace = RequestTrace.from_headers(
+                    self.headers.get("traceparent"))
+                trace_headers = {"X-Trace-Id": trace.trace_id,
+                                 "traceparent": trace.traceparent()}
+                deadline = deadline_from_request(
+                    sup.config, self.headers.get("X-Deadline-Ms"))
                 fwd_headers = {}
                 for name in ("Content-Type", "X-Deadline-Ms",
                              "traceparent"):
@@ -459,8 +760,11 @@ class Supervisor:
                 ports = sup._live_ports()
                 if not ports:
                     self._reply(503, json.dumps(
-                        {"error": "no live replica"}).encode() + b"\n",
-                        {"Retry-After": "1"})
+                        {"error": "no live replica",
+                         "trace_id": trace.trace_id}).encode() + b"\n",
+                        dict(trace_headers, **{
+                            "Retry-After": str(retry_after_seconds(
+                                1.0))}))
                     return
                 with sup._rr_lock:
                     start = sup._rr_next
@@ -468,9 +772,23 @@ class Supervisor:
                 last_err = None
                 for k in range(len(ports)):
                     port = ports[(start + k) % len(ports)]
+                    remaining = deadline.remaining()
+                    if k and deadline.bounded and remaining <= 0:
+                        # the budget died with the previous attempt: a
+                        # retry dispatched now can only produce a LATE
+                        # 504 — answer it honestly instead
+                        self._reply(504, json.dumps(
+                            {"error": "deadline exhausted retrying "
+                                      f"replicas ({last_err})",
+                             "trace_id": trace.trace_id}
+                        ).encode() + b"\n", trace_headers)
+                        return
+                    timeout = (min(300.0, max(remaining, 0.05))
+                               if deadline.bounded else 300)
                     try:
                         conn = http.client.HTTPConnection(
-                            sup.config.serve_host, port, timeout=300)
+                            sup.config.serve_host, port,
+                            timeout=timeout)
                         try:
                             conn.request(method, self.path, body=body,
                                          headers=fwd_headers)
@@ -498,16 +816,21 @@ class Supervisor:
                             return
                         finally:
                             conn.close()
-                    except OSError as e:
-                        # dead/draining replica: honest retry on the
-                        # next one — the client never sees a torn or
-                        # corrupt response from a killed replica
-                        last_err = e
+                    except (OSError,
+                            http.client.HTTPException) as e:
+                        # dead/draining replica — incl. one killed
+                        # MID-RESPONSE (IncompleteRead is not an
+                        # OSError): honest retry on the next one — the
+                        # client never sees a torn or corrupt response
+                        last_err = f"{type(e).__name__}: {e}"
                         continue
                 self._reply(503, json.dumps(
                     {"error": f"all replicas unreachable "
-                              f"({last_err})"}).encode() + b"\n",
-                    {"Retry-After": "1"})
+                              f"({last_err})",
+                     "trace_id": trace.trace_id}).encode() + b"\n",
+                    dict(trace_headers,
+                         **{"Retry-After": str(
+                             retry_after_seconds(1.0))}))
 
             def do_GET(self):  # noqa: N802
                 # fleet views are answered HERE, not forwarded: a
@@ -542,7 +865,34 @@ class Supervisor:
                 self.wfile.write(body)
 
             def do_POST(self):  # noqa: N802
+                # fleet control verbs are answered by the SUPERVISOR:
+                # a round-robined /admin/reload would reach ONE replica
+                # — the exact gap reload_all exists to fix
+                path = self.path.split("?", 1)[0]
+                if path in ("/admin/scale", "/admin/reload"):
+                    self._admin(path)
+                    return
                 self._forward("POST")
+
+            def _admin(self, path: str) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length) if length else b"{}"
+                    payload = json.loads(
+                        raw.decode("utf-8", errors="replace") or "{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
+                    if path == "/admin/scale":
+                        code, out = sup._admin_scale(payload)
+                    else:
+                        code, out = sup._admin_reload(payload)
+                except (ValueError, json.JSONDecodeError) as e:
+                    code, out = 400, {"error": str(e)}
+                except Exception as e:  # noqa: BLE001
+                    code, out = 500, {"error":
+                                      f"{type(e).__name__}: {e}"}
+                self._reply(code, json.dumps(
+                    out, sort_keys=True).encode() + b"\n")
 
         class _ProxyServer(http.server.ThreadingHTTPServer):
             # match the replica listeners: a burst must not be refused
@@ -607,7 +957,19 @@ class Supervisor:
                 except (OSError, ValueError):
                     pass
                 now = time.monotonic()
-                for replica in self.replicas:
+                self._reconcile_scale()
+                for replica in list(self.replicas):
+                    if replica.draining:
+                        if (replica.proc is None
+                                or replica.proc.poll() is not None):
+                            self._retire(replica)
+                        elif (now - replica.drain_started
+                              > self.config.serve_drain_timeout_s
+                              + 10.0):
+                            # a scale-down drain that outlives the
+                            # replica's own drain budget is wedged
+                            self._kill(replica)
+                        continue
                     if (replica.restart_at is not None
                             and now >= replica.restart_at):
                         self._spawn(replica)
@@ -652,7 +1014,14 @@ class Supervisor:
                 self._kill(replica)
                 replica.proc.wait()
                 rc = replica.proc.returncode
-            if rc != 0:
+            if rc == -signal.SIGTERM and replica.heartbeat() is None:
+                # the drain SIGTERM landed on a replica still STARTING
+                # (no heartbeat yet => no signal handlers, no traffic
+                # served, nothing in flight): the default-disposition
+                # kill is a clean outcome, not a failed drain
+                self.log(f"Replica {replica.index} was still starting "
+                         f"at drain; terminated clean")
+            elif rc != 0:
                 clean = False
                 self.log(f"Replica {replica.index} exited rc={rc}")
             if replica.pipe_r is not None:

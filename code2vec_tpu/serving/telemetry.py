@@ -35,9 +35,10 @@ from __future__ import annotations
 
 import http.server
 import json
+import math
 import re
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 # One exposition-format sample line: name{labels} value
 _SAMPLE_RE = re.compile(
@@ -137,16 +138,21 @@ def parse_prometheus_text(text: str) -> Dict[str, Family]:
     return families
 
 
-def merge_prometheus_snapshots(snapshots: Dict[str, str],
+def merge_prometheus_snapshots(snapshots: Dict[str, object],
                                gauge_label: str = "replica") -> str:
-    """Merge per-replica exposition-text snapshots into ONE exposition
-    text: counter + histogram samples summed across replicas by (sample
-    name, labels); gauge/untyped samples kept per replica with an added
-    `replica="<id>"` label. Returns render-ready text."""
+    """Merge per-replica snapshots into ONE exposition text: counter +
+    histogram samples summed across replicas by (sample name, labels);
+    gauge/untyped samples kept per replica with an added
+    `replica="<id>"` label. Snapshot values may be exposition TEXT or
+    already-parsed families (a caller that validated a snapshot first
+    must not pay a second parse on the scrape path). Returns
+    render-ready text."""
     merged: Dict[str, Family] = {}
     for replica_id in sorted(snapshots):
-        for name, fam in parse_prometheus_text(
-                snapshots[replica_id]).items():
+        snap = snapshots[replica_id]
+        families = (snap if isinstance(snap, dict)
+                    else parse_prometheus_text(snap))
+        for name, fam in families.items():
             out = merged.setdefault(name, Family(name, fam.kind,
                                                  fam.help))
             if out.kind == "untyped" and fam.kind != "untyped":
@@ -200,6 +206,70 @@ def sum_family(text_or_families, name: str,
     return total
 
 
+def histogram_buckets(text_or_families, name: str,
+                      **label_filter) -> Dict[str, float]:
+    """{le: cumulative count} for one histogram family, summed over
+    every label set matching `label_filter` — the raw material for
+    `quantile_from_buckets`. Empty dict when the family is absent."""
+    families = (parse_prometheus_text(text_or_families)
+                if isinstance(text_or_families, str)
+                else text_or_families)
+    fam = families.get(name)
+    if fam is None:
+        return {}
+    out: Dict[str, float] = {}
+    for labels, value in fam.samples.get(name + "_bucket", {}).items():
+        d = dict(labels)
+        if not all(d.get(k) == str(v) for k, v in label_filter.items()):
+            continue
+        le = d.get("le")
+        if le is None:
+            continue
+        out[le] = out.get(le, 0.0) + value
+    return out
+
+
+def quantile_from_buckets(cur: Dict[str, float],
+                          prev: Optional[Dict[str, float]],
+                          q: float) -> Optional[float]:
+    """Quantile estimate (seconds) from cumulative histogram buckets,
+    optionally as a WINDOW: `prev` is an earlier scrape of the same
+    buckets and the quantile is computed over the delta — counters are
+    lifetime-cumulative, and an autoscaler steering off the lifetime
+    p95 would never see a regression fade. Linear interpolation inside
+    the bucket (Prometheus histogram_quantile semantics); a quantile
+    landing in the +Inf bucket returns the largest finite bound (a
+    conservative floor). None when the window holds no samples."""
+    prev = prev or {}
+    deltas = []
+    for le, count in cur.items():
+        bound = math.inf if le == "+Inf" else float(le)
+        deltas.append((bound, max(0.0, count - prev.get(le, 0.0))))
+    if not deltas:
+        return None
+    deltas.sort()
+    total = deltas[-1][1]  # the +Inf (or widest) cumulative count
+    if total <= 0:
+        return None
+    rank = q * total
+    lower = 0.0
+    for bound, cum in deltas:
+        if cum >= rank:
+            if math.isinf(bound):
+                finite = [b for b, _ in deltas if not math.isinf(b)]
+                return finite[-1] if finite else None
+            prev_cum = 0.0
+            for b2, c2 in deltas:
+                if b2 >= bound:
+                    break
+                lower, prev_cum = b2, c2
+            span = cum - prev_cum
+            if span <= 0:
+                return bound
+            return lower + (bound - lower) * (rank - prev_cum) / span
+    return None
+
+
 def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
     """The per-replica slice of GET /fleet, derived from one replica
     heartbeat (serving/server.py _heartbeat_fields). None-tolerant: a
@@ -210,7 +280,8 @@ def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
                 "model_fingerprint": None, "breakers": None,
                 "requests_total": None, "requests_shed_total": None,
                 "requests_expired_total": None,
-                "shed_rate": None, "swap_state": None, "inflight": None}
+                "shed_rate": None, "swap_state": None,
+                "swap_target": None, "inflight": None}
     total = heartbeat.get("requests_total")
     shed = heartbeat.get("requests_shed_total")
     shed_rate = None
@@ -230,6 +301,7 @@ def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
             "requests_expired_total"),
         "shed_rate": shed_rate,
         "swap_state": heartbeat.get("swap_state"),
+        "swap_target": heartbeat.get("swap_target"),
         "inflight": heartbeat.get("inflight"),
     }
 
@@ -238,11 +310,20 @@ class TelemetryServer:
     """The supervisor's telemetry listener: GET /metrics (merged
     exposition text), GET /fleet (JSON). Callback-driven so the
     supervisor owns the data and this stays a framing shim, exactly
-    like PredictionServer's HTTP layer."""
+    like PredictionServer's HTTP layer.
+
+    `post_handlers` maps a path to a callable taking the request's JSON
+    body (a dict) and returning `(http_status, payload_dict)` — the
+    control-plane verbs (`/admin/scale`, `/admin/reload`) ride the same
+    listener, so one port per host is both the scrape address and the
+    fleet control address. A handler raising ValueError maps to 400."""
 
     def __init__(self, merged_metrics_fn, fleet_fn,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 post_handlers: Optional[Dict[str, Callable[
+                     [dict], Tuple[int, dict]]]] = None):
         telem = self
+        self.post_handlers = dict(post_handlers or {})
 
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -276,6 +357,34 @@ class TelemetryServer:
                         ).encode() + b"\n")
                 except Exception as e:  # noqa: BLE001 — a scraper must
                     # get an HTTP error, never a torn connection
+                    self._respond(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode() + b"\n")
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                handler = telem.post_handlers.get(path)
+                if handler is None:
+                    self._respond(404, json.dumps(
+                        {"error": f"no such endpoint: {path}"}
+                    ).encode() + b"\n")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length) if length else b"{}"
+                    payload = json.loads(
+                        raw.decode("utf-8", errors="replace") or "{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
+                    code, body = handler(payload)
+                    self._respond(code, json.dumps(
+                        body, sort_keys=True).encode() + b"\n")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._respond(400, json.dumps(
+                        {"error": str(e)}).encode() + b"\n")
+                except Exception as e:  # noqa: BLE001 — the control
+                    # plane must get an HTTP error, never a torn
+                    # connection it would misread as a dead host
                     self._respond(500, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}
                     ).encode() + b"\n")

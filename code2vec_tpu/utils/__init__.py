@@ -1,1 +1,16 @@
-from code2vec_tpu.utils.prefetch import DevicePrefetcher  # noqa: F401
+# Lazy re-export (PEP 562): prefetch.py pulls training/step.py and with
+# it jax + flax — seconds of import and hundreds of MB. Serving-side
+# consumers of this package (admission/extractor code importing
+# utils.faults) must not pay that: a supervisor-restarted fake-model
+# replica's convergence time is dominated by exactly this import.
+# Everything in-repo imports DevicePrefetcher from its own module;
+# this keeps `from code2vec_tpu.utils import DevicePrefetcher` working
+# for external callers without the eager cost.
+
+
+def __getattr__(name):
+    if name == "DevicePrefetcher":
+        from code2vec_tpu.utils.prefetch import DevicePrefetcher
+        return DevicePrefetcher
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -53,6 +53,8 @@ WORKDIR = "/tmp/serving_bench"
 OUT_PATH = os.path.join(REPO, "experiments", "results", "serving.json")
 RESILIENCE_OUT_PATH = os.path.join(
     REPO, "experiments", "results", "serving_resilience.json")
+FLEET_OUT_PATH = os.path.join(
+    REPO, "experiments", "results", "serving_fleet.json")
 
 N_CLASSES = 24          # distinct request bodies in the corpus
 REQUESTS_PER_CLIENT = 24
@@ -766,6 +768,174 @@ def tracing_main() -> None:
     log(f"Wrote {TRACING_OUT_PATH}")
 
 
+def fleet_main() -> None:
+    """`python experiments/serving_bench.py fleet`: the PR-13 fleet
+    drill against REAL CLI hosts — 2 single-replica `serve` supervisors
+    (each a full model build from a checkpoint) behind the control
+    plane + health-gated router; one WHOLE host (supervisor + replica)
+    is SIGKILLed under closed-loop load. Records the availability dip,
+    host recovery time (dominated by the replica's model rebuild),
+    zero malformed responses, and router convergence. Writes
+    experiments/results/serving_fleet.json."""
+    import signal as signal_mod
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving.fleet.control import (
+        ControlPlane, HostSpec,
+    )
+    from code2vec_tpu.serving.fleet.router import FleetRouter
+    from experiments.javagen import NOUNS, generate_class
+
+    def log(msg: str) -> None:
+        print(msg, flush=True)
+
+    log("Building model + corpus for the fleet drill ...")
+    model = build_model()
+    prefix = os.path.join(WORKDIR, "corpus")
+    save_base = os.path.join(WORKDIR, "fleet-bench-model")
+    model.save(save_base)
+    rng = random.Random(17)
+    bodies = [generate_class(rng, NOUNS, f"Fleet{i}", "com.bench", 1)
+              for i in range(8)]
+    fleet_dir = os.path.join(WORKDIR, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    host_cmd = [
+        sys.executable, "-m", "code2vec_tpu.cli", "serve",
+        "--data", prefix, "--load", save_base,
+        "--serve_batch_size", str(SERVE_BATCH),
+        "--serve_buckets", BUCKETS, "--serve_max_delay_ms", "5",
+        "--serve_cache_entries", "0", "--extractor_pool_size", "2",
+        "--serve_heartbeat_interval", "1", "-v", "0",
+        "--serve_port", "0", "--serve_telemetry_port", "0"]
+    config = Config(
+        serve=True, fleet=True, serve_host="127.0.0.1",
+        fleet_hosts=2, fleet_poll_interval_s=0.5,
+        fleet_max_host_restarts=5, serve_drain_timeout_s=15.0,
+        # scaling off: the drill measures failover, not the autoscaler
+        fleet_scale_down_ticks=10_000_000, fleet_scale_up_shed_rate=1.0,
+        heartbeat_file=os.path.join(fleet_dir, "fleet.heartbeat.json"),
+        verbose_mode=0)
+    control = ControlPlane(
+        config, [HostSpec("bench-0", host_cmd),
+                 HostSpec("bench-1", host_cmd)], log=lambda m: None)
+    control.router = FleetRouter(config, control, host="127.0.0.1",
+                                 port=0, log=lambda m: None)
+    rc_holder = {}
+    thread = threading.Thread(
+        target=lambda: rc_holder.update(rc=control.run()), daemon=True)
+    thread.start()
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        view = control.fleet_view()
+        if all(h["weight"] > 0 and (h.get("replicas_serving") or 0) >= 1
+               for h in view["hosts"]):
+            break
+        time.sleep(0.5)
+    else:
+        raise RuntimeError(f"fleet never came up: {view}")
+    port = control.router.port
+    log(f"  2 hosts up behind router :{port}; warming both hosts ...")
+    for _ in range(4):  # weighted-random routing: cover both hosts
+        for b in bodies:
+            status, _ = _post_status(port, b)
+            assert status == 200, status
+
+    events = []
+    lock = threading.Lock()
+    stop_load = threading.Event()
+    t_start = time.perf_counter()
+
+    def client(ci):
+        i = ci
+        while not stop_load.is_set():
+            t0 = time.perf_counter()
+            malformed = False
+            try:
+                status, payload = _post_status(port,
+                                               bodies[i % len(bodies)])
+                try:
+                    parsed = json.loads(payload)
+                    malformed = not (("methods" in parsed)
+                                     if status == 200
+                                     else ("error" in parsed))
+                except ValueError:
+                    malformed = True
+            except Exception:  # noqa: BLE001
+                status = -1
+            with lock:
+                events.append((t0 - t_start, status,
+                               time.perf_counter() - t0, malformed))
+            i += 1
+
+    clients = [threading.Thread(target=client, args=(ci,))
+               for ci in range(4)]
+    for t in clients:
+        t.start()
+    time.sleep(3.0)
+    victim = control.hosts[0]
+    victim_pid = victim.proc.pid
+    hb = victim.heartbeat()
+    replica_pids = [r["pid"] for r in hb["replicas"] if r["pid"]]
+    t_kill = time.perf_counter() - t_start
+    os.kill(victim_pid, signal_mod.SIGKILL)
+    for pid in replica_pids:
+        try:
+            os.kill(pid, signal_mod.SIGKILL)
+        except OSError:
+            pass
+    log(f"  SIGKILL host bench-0 (supervisor {victim_pid} + "
+        f"{len(replica_pids)} replica(s)) at t={t_kill:.1f}s")
+    recovery_s = None
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        view = control.fleet_view()
+        h0 = view["hosts"][0]
+        if (h0["pid"] not in (None, victim_pid) and h0["weight"] > 0
+                and (h0.get("replicas_serving") or 0) >= 1):
+            recovery_s = time.perf_counter() - t_start - t_kill
+            break
+        time.sleep(0.5)
+    if recovery_s is None:
+        raise RuntimeError(f"host never recovered: {control.fleet_view()}")
+    time.sleep(5.0)  # post-recovery traffic through both hosts
+    stop_load.set()
+    for t in clients:
+        t.join(timeout=120)
+    control.stop()
+    thread.join(timeout=120)
+
+    failures = [(t, s) for t, s, _, _ in events if s != 200]
+    fail_in_dip = [t for t, _ in failures if t >= t_kill]
+    dip_window_s = ((max(fail_in_dip) - min(fail_in_dip))
+                    if fail_in_dip else 0.0)
+    ok_post = sorted(lat for t, s, lat, _ in events
+                     if s == 200 and t >= t_kill)
+    result = {
+        "bench": "serving_fleet",
+        "hosts": 2,
+        "replicas_per_host": 1,
+        "requests": len(events),
+        "kill_at_s": round(t_kill, 2),
+        "host_recovery_s": round(recovery_s, 2),
+        "failed_requests_total": len(failures),
+        "failed_requests_after_kill": len(fail_in_dip),
+        "availability_dip_window_s": round(dip_window_s, 2),
+        "malformed_responses": sum(1 for _, _, _, m in events if m),
+        "ok_p50_ms_after_kill": round(_pct(ok_post, 0.50) * 1e3, 1),
+        "fleet_exit_rc": rc_holder.get("rc"),
+    }
+    assert result["malformed_responses"] == 0, "corrupt responses"
+    os.makedirs(os.path.dirname(FLEET_OUT_PATH), exist_ok=True)
+    with open(FLEET_OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    log(f"  recovery {result['host_recovery_s']}s (incl. model "
+        f"rebuild), {len(fail_in_dip)} failed request(s) in a "
+        f"{result['availability_dip_window_s']}s dip, 0 malformed; "
+        f"fleet rc={result['fleet_exit_rc']}")
+    log(f"Wrote {FLEET_OUT_PATH}")
+
+
 def main() -> None:
     def log(msg: str) -> None:
         print(msg, flush=True)
@@ -818,5 +988,7 @@ if __name__ == "__main__":
         loadgen_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "tracing":
         tracing_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        fleet_main()
     else:
         main()

@@ -37,6 +37,11 @@ SERVING_BUDGET=600
 # /neighbors + hot-swap embedding-space drills (tiny in-process models
 # + the scripted fake extractor).
 RETRIEVAL_BUDGET=600
+# Cross-host fleet: the host-SIGKILL-under-load convergence drill, the
+# canary swap commit/rollback drill and the multi-model/scale e2e —
+# each fleet is 2 host supervisors x fake-model replicas, so the
+# budget covers hangs, not work.
+FLEET_BUDGET=600
 
 rc=0
 
@@ -61,6 +66,7 @@ run_suite "$MULTI_HOST_BUDGET" tests/test_multihost_chaos.py \
 run_suite "$ELASTIC_BUDGET" tests/test_elastic_resume.py "$@"
 run_suite "$SERVING_BUDGET" tests/test_serving_chaos.py "$@"
 run_suite "$RETRIEVAL_BUDGET" tests/test_retrieval.py "$@"
+run_suite "$FLEET_BUDGET" tests/test_fleet.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

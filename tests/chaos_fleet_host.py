@@ -1,0 +1,57 @@
+"""Fleet "host" child for the fleet chaos suite (tests/test_fleet.py).
+
+One fleet host = one REAL serving Supervisor (the full heartbeat /
+monitor / restart / telemetry / scale / reload-fan-out machinery)
+whose replicas are the lightweight fake-model children
+(tests/chaos_serving_child.py) — so a multi-"host" fleet starts in a
+couple of seconds and the control-plane / router / coordinated-swap
+protocol under test is the production one.
+
+Usage (the fleet ControlPlane appends `--heartbeat_file PATH`; the
+test builds the rest of the command):
+
+    python tests/chaos_fleet_host.py HOST_CONFIG_JSON \
+        REPLICA_OVERRIDES_JSON [--heartbeat_file PATH] \
+        [--serve_port N] [--serve_telemetry_port N]
+"""
+
+import json
+import os
+import sys
+
+# No jax in a supervisor parent: keep host startup at subprocess speed.
+os.environ.setdefault("C2V_HOST_WORKER", "1")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    overrides = json.loads(open(argv[0]).read())
+    replica_overrides_path = argv[1]
+    if "--heartbeat_file" in argv:
+        overrides["heartbeat_file"] = argv[argv.index(
+            "--heartbeat_file") + 1]
+    if "--serve_port" in argv:
+        overrides["serve_port"] = int(
+            argv[argv.index("--serve_port") + 1])
+    if "--serve_telemetry_port" in argv:
+        overrides["serve_telemetry_port"] = int(
+            argv[argv.index("--serve_telemetry_port") + 1])
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving.supervisor import supervisor_main
+
+    config = Config(serve=True, verbose_mode=0, **overrides)
+    child_command = [
+        sys.executable, os.path.join(HERE, "chaos_serving_child.py"),
+        replica_overrides_path]
+    return supervisor_main(config, child_command=child_command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,9 +46,10 @@ class FakeModel:
     """The minimal surface PredictionServer needs: deterministic
     predictions derived from the extractor lines, instant."""
 
-    def __init__(self, config, fingerprint):
+    def __init__(self, config, fingerprint, topk=3):
         self.config = config
         self._fp = fingerprint
+        self.topk = topk
         self.context_buckets = (4, 8, config.max_contexts)
         self._predict_steps = {}
 
@@ -73,7 +74,7 @@ class FakeModel:
         out = []
         for line in lines:
             parts = line.split()
-            out.append(_FakeResult(parts[0], parts[1:], topk=3))
+            out.append(_FakeResult(parts[0], parts[1:], topk=self.topk))
         return out
 
     def smoke_schema(self):
@@ -96,14 +97,35 @@ def main() -> int:
             "--metrics_file") + 1]
     if "--serve_port" in argv:
         overrides["serve_port"] = int(argv[argv.index("--serve_port") + 1])
+    # fleet-drill extensions (non-Config keys): a deterministic
+    # fingerprint (cross-host swap convergence is asserted on it), a
+    # fake swap builder ("fake_swap": fingerprint = "fp-" + the target
+    # dir's basename), and target basenames whose swap candidate must
+    # FAIL validation on THIS replica ("swap_fail_targets" — the
+    # rollback drills break one host's rollout this way).
+    fingerprint = overrides.pop(
+        "fingerprint", f"fake-replica-model-pid{os.getpid()}")
+    fake_swap = overrides.pop("fake_swap", False)
+    swap_fail_targets = set(overrides.pop("swap_fail_targets", ()))
 
     from code2vec_tpu.config import Config
     from code2vec_tpu.serving.server import serve_main
 
     config = Config(serve=True, verbose_mode=0, **overrides)
-    model = FakeModel(
-        config, fingerprint=f"fake-replica-model-pid{os.getpid()}")
-    return serve_main(config, model=model)
+    model = FakeModel(config, fingerprint=fingerprint)
+
+    build_model = None
+    if fake_swap:
+        def build_model(artifact_dir):
+            name = os.path.basename(str(artifact_dir).rstrip("/"))
+            new = FakeModel(config, fingerprint=f"fp-{name}")
+            if name in swap_fail_targets:
+                # schema mismatch: SwapManager validation rejects it
+                new.topk = 5
+            return new
+
+    return serve_main(config, model=model,
+                      swap_build_model=build_model)
 
 
 if __name__ == "__main__":

@@ -132,6 +132,66 @@ def test_fleet_replica_view_staleness_and_shed_rate():
     assert empty["status"] is None and empty["shed_rate"] is None
 
 
+def test_merge_survives_torn_and_garbage_snapshots():
+    """A torn or mid-rewrite snapshot must never crash the merge: bad
+    lines are skipped per line, full-garbage text merges to nothing."""
+    good = _registry_text(requests=3)
+    torn = good[: len(good) // 2]  # truncated mid-line
+    merged = telemetry.merge_prometheus_snapshots(
+        {"0": good, "1": torn, "2": "\x00\xff not prometheus {{{",
+         "3": ""})
+    fams = telemetry.parse_prometheus_text(merged)
+    # the intact replica's counters survive; the torn one contributes
+    # only its complete lines; garbage contributes nothing
+    assert telemetry.sum_family(fams, "serving_requests_total") >= 3.0
+
+
+def test_supervisor_scrape_skips_and_counts_bad_replica_snapshot(
+        tmp_path):
+    """Satellite pin: a replica metrics file caught torn/garbled must
+    be SKIPPED AND COUNTED — the supervisor /metrics scrape stays 200
+    on the surviving replicas' truth, never a 500."""
+    from code2vec_tpu import obs
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving.supervisor import Supervisor
+    from code2vec_tpu.serving.telemetry import TelemetryServer
+
+    config = Config(
+        serve=True, serve_host="127.0.0.1", serve_port=0,
+        serve_replicas=2, verbose_mode=0,
+        heartbeat_file=str(tmp_path / "supervisor.heartbeat.json"))
+    sup = Supervisor(config, child_command=["true"])  # never spawned
+    # replica 0: binary garbage (a torn rewrite / disk corruption);
+    # replica 1: a valid snapshot
+    with open(sup.replicas[0].metrics_path, "wb") as f:
+        f.write(b"\x00\xffgarbage{{{ 7\n===")
+    with open(sup.replicas[1].metrics_path, "w") as f:
+        f.write(_registry_text(requests=5))
+
+    def skipped():
+        return sum(
+            child.value for labels, child in obs.default_registry()
+            .collect().get("serving_telemetry_snapshots_skipped_total",
+                           {}).items())
+
+    before = skipped()
+    merged = sup.merged_metrics()
+    assert telemetry.sum_family(
+        merged, "serving_requests_total") >= 5.0
+    assert skipped() == before + 1
+    # and over HTTP: 200, never a 500, repeat scrapes keep counting
+    telem = TelemetryServer(sup.merged_metrics, sup.fleet_view,
+                            host="127.0.0.1", port=0)
+    try:
+        status, body = _get("127.0.0.1", telem.port, "/metrics")
+        assert status == 200
+        assert telemetry.sum_family(
+            body.decode(), "serving_requests_total") >= 5.0
+        assert skipped() == before + 2
+    finally:
+        telem.close()
+
+
 # --------------------------------------------------- supervisor e2e
 
 
