@@ -204,7 +204,9 @@ def arguments_parser() -> ArgumentParser:
                         default=None, metavar="MS",
                         help="scale a host up when its window "
                              "total-phase p95 exceeds this many ms "
-                             "(default 0 = disabled)")
+                             "(default 500 = 10x the measured healthy "
+                             "p95, serving_bench.py p95 mode; 0 "
+                             "disables the trigger)")
     parser.add_argument("--fleet_scale_up_ticks", type=int,
                         default=None, metavar="N",
                         help="consecutive over-threshold ticks before "
@@ -246,6 +248,43 @@ def arguments_parser() -> ArgumentParser:
                              "symmetric int8 (the artifact stays "
                              "self-contained, just 4x the bytes; the "
                              "control arm of BENCH_QUANT.md)")
+    parser.add_argument("--release_scheme",
+                        choices=["int8", "fp8_e4m3", "fp8_e5m2", "int4",
+                                 "float32"],
+                        default=None,
+                        help="quantization scheme of the exported "
+                             "tables (default int8; fp8 keeps 1 "
+                             "byte/weight with a relative error "
+                             "profile, int4 packs two weights per byte "
+                             "for another ~2x — per-scheme accuracy "
+                             "deltas in BENCH_QUANT.md)")
+    parser.add_argument("--serve_mips_nprobe", type=int, default=None,
+                        metavar="N",
+                        help="approximate-MIPS prediction head: search "
+                             "only the N nearest coarse-quantizer "
+                             "lists of the target-name table at "
+                             "serve/predict time instead of streaming "
+                             "all ~246K rows (default 0 = exact "
+                             "blockwise top-k; BENCH_QUANT.md records "
+                             "the agreement-vs-speedup sweep and the "
+                             "tuned value)")
+    parser.add_argument("--serve_mips_nlist", type=int, default=None,
+                        metavar="N",
+                        help="coarse-quantizer size of the MIPS head "
+                             "(default 0 = sqrt(vocab) auto)")
+    parser.add_argument("--overlap_allreduce",
+                        dest="overlap_grad_allreduce",
+                        action="store_true", default=None,
+                        help="bucketed async gradient all-reduce: "
+                             "split the train step into backward + "
+                             "per-bucket all-reduce+Adam dispatches so "
+                             "communication overlaps the optimizer "
+                             "apply (dense GSPMD data-parallel only; "
+                             "BENCH_ROOFLINE.md 'Roofline levers')")
+    parser.add_argument("--overlap_bucket_mb", type=float, default=None,
+                        metavar="MB",
+                        help="target gradient-bucket size for "
+                             "--overlap_allreduce (default 32)")
     parser.add_argument("--no_aot", action="store_true",
                         help="skip the jax.export AOT lowerings in the "
                              "exported artifact (consumers then always "
@@ -528,6 +567,11 @@ def config_from_args(argv=None) -> Config:
                                       "fleet_max_host_restarts",
                                       "serve_artifact",
                                       "export_artifact_path",
+                                      "release_scheme",
+                                      "serve_mips_nprobe",
+                                      "serve_mips_nlist",
+                                      "overlap_grad_allreduce",
+                                      "overlap_bucket_mb",
                                       "topk_block_size",
                                       "embed_out", "embed_dtype",
                                       "embed_shard_rows",

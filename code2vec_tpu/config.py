@@ -339,8 +339,15 @@ class Config:
     # poll tick: shed rate above this fraction...
     fleet_scale_up_shed_rate: float = 0.05
     # ...or total-phase p95 above this many milliseconds (0 disables
-    # the p95 trigger; shed rate alone then drives scale-up).
-    fleet_scale_up_p95_ms: float = 0.0
+    # the p95 trigger; shed rate alone then drives scale-up). Default
+    # MEASURED, not guessed (the PR-13 "defaults off pending a
+    # threshold" follow-on): `serving_bench.py p95` records the healthy
+    # 4-client cache-off total-phase p95 (~48 ms on the dev harness)
+    # and ships 10x rounded up — unambiguous sustained distress the
+    # shed-rate trigger cannot see (slow-but-not-yet-shedding), still
+    # a quarter of the default 2000 ms deadline so scale-up fires
+    # before requests expire (experiments/results/serving_p95.json).
+    fleet_scale_up_p95_ms: float = 500.0
     # Hysteresis: consecutive over-threshold ticks required to scale
     # up, consecutive zero-request ticks required to scale down, and a
     # cooldown after every action so a noisy signal cannot flap the
@@ -381,6 +388,34 @@ class Config:
     # the exported artifact (ops/quant.py). False exports fp32 tables
     # (same layout, 4x the bytes) — the control arm of BENCH_QUANT.md.
     release_quantize: bool = True
+    # Quantization scheme of the exported tables (release/artifact.py):
+    # int8 (1 byte/weight, the default), fp8_e4m3 / fp8_e5m2 (1
+    # byte/weight with a relative error profile), int4 (two weights per
+    # byte — another ~2x below int8), or float32 (= --no_quantize).
+    # Per-scheme accuracy deltas vs same-run fp32 in BENCH_QUANT.md.
+    release_scheme: str = "int8"
+    # Approximate-MIPS prediction head (retrieval/mips.py): when > 0,
+    # serve/predict top-k over the ~246K-name classifier searches only
+    # the rows of the `serve_mips_nprobe` nearest coarse-quantizer
+    # lists instead of streaming the whole table (blockwise exact path
+    # stays the default at 0, and remains the accuracy-eval path
+    # regardless). Top-1 agreement vs exact is measured per nprobe in
+    # BENCH_QUANT.md; the tuned value documented there keeps agreement
+    # >= 0.99.
+    serve_mips_nprobe: int = 0
+    # Coarse-quantizer size of the MIPS head; 0 = sqrt(real vocab) auto.
+    serve_mips_nlist: int = 0
+    # Overlap the gradient all-reduce with the optimizer apply
+    # (parallel/overlap.py): the train step splits into backward (no
+    # cross-host reduce) + per-bucket all-reduce+Adam jits dispatched
+    # back to back, so bucket i's apply overlaps bucket i+1's reduce
+    # and the host never blocks on one monolithic step chain. Dense
+    # GSPMD data-parallel only (tp = cp = 1); measured at 2 hosts in
+    # BENCH_ROOFLINE.md "Roofline levers".
+    overlap_grad_allreduce: bool = False
+    # Target bytes per gradient bucket, in MB (leaves bigger than one
+    # bucket get their own).
+    overlap_bucket_mb: float = 32.0
     # Also AOT-export (jax.export) the bucketed serve functions into
     # the artifact, one per (serve_batch_size, context bucket) shape,
     # so a serving replica cold-starts from deserialized lowerings
@@ -742,6 +777,45 @@ class Config:
             raise ValueError(
                 "topk_block_size must be >= 0 (0 forces the full-logits "
                 "top-k path).")
+        if self.release_scheme not in ("int8", "fp8_e4m3", "fp8_e5m2",
+                                       "int4", "float32"):
+            raise ValueError(
+                "release_scheme must be one of int8, fp8_e4m3, "
+                "fp8_e5m2, int4, float32.")
+        if self.serve_mips_nprobe < 0:
+            raise ValueError(
+                "serve_mips_nprobe must be >= 0 (0 = exact blockwise "
+                "top-k, the default).")
+        if self.serve_mips_nlist < 0:
+            raise ValueError(
+                "serve_mips_nlist must be >= 0 (0 = sqrt(vocab) auto).")
+        if self.serve_mips_nprobe > 0:
+            if not (self.serve or self.predict):
+                raise ValueError(
+                    "serve_mips_nprobe applies to serve/--predict (the "
+                    "prediction head); eval/embed always use the exact "
+                    "blockwise path, so the knob would be a silent "
+                    "no-op here.")
+            if self.is_testing:
+                raise ValueError(
+                    "--serve_mips_nprobe cannot be combined with "
+                    "--test: accuracy evaluation always scores the "
+                    "exact blockwise head. Measure MIPS agreement and "
+                    "speedup with experiments/quant_bench.py "
+                    "(BENCH_QUANT.md) instead.")
+        if self.overlap_bucket_mb <= 0:
+            raise ValueError("overlap_bucket_mb must be > 0.")
+        if self.overlap_grad_allreduce and self.use_sparse_embedding_update:
+            raise ValueError(
+                "overlap_grad_allreduce is incompatible with "
+                "--sparse_embedding_update: the sparse path already "
+                "exchanges (ids, rows) lists instead of table-shaped "
+                "gradients.")
+        if self.overlap_grad_allreduce and (self.tp > 1 or self.cp > 1):
+            raise ValueError(
+                "overlap_grad_allreduce supports data-parallel meshes "
+                "only (tp = cp = 1): the split backward runs the plain "
+                "module forward per data shard.")
         if self.export_artifact_path and not self.is_loading:
             raise ValueError(
                 "export (--artifact_out) requires --load: the artifact "

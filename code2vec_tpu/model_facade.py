@@ -37,7 +37,9 @@ from code2vec_tpu.training.loop import Trainer
 from code2vec_tpu.training.state import (
     TrainState, create_train_state, dropout_rng, make_optimizer, num_params,
 )
-from code2vec_tpu.training.step import TrainStepBuilder, device_put_batch
+from code2vec_tpu.training.step import (
+    EvalOutputs, TrainStepBuilder, device_put_batch,
+)
 from code2vec_tpu.utils.faults import fault_point
 from code2vec_tpu.vocab import Code2VecVocabs, VocabType
 
@@ -841,9 +843,59 @@ class Code2VecModel(BucketedPredictMixin):
     # ---------------------------------------------------------- predict
 
     def _make_predict_step(self, batch_rows: int, m: int):
+        mips = self._get_mips_topk()
+        if mips is not None:
+            # Approximate-MIPS prediction head (--serve_mips_nprobe,
+            # retrieval/mips.py): encode exactly, then search nprobe
+            # coarse lists of the target table instead of streaming all
+            # of it. Predict/serve only — the accuracy-eval path
+            # (_get_eval_step) always keeps the exact head.
+            module = self.module
+
+            def step(params, src, pth, tgt, mask, labels, valid):
+                code_vectors, attention = module.apply(
+                    {"params": params}, src, pth, tgt, mask,
+                    deterministic=True, method=Code2VecModule.encode)
+                values, indices = mips(code_vectors.astype(jnp.float32))
+                return EvalOutputs(values, indices, code_vectors,
+                                   attention, jnp.zeros((), jnp.float32))
+
+            return jax.jit(step)
         # a FRESH jitted eval step per shape (BucketedPredictMixin): each
         # entry compiles exactly once for its one padded shape
         return self.builder.make_eval_step(self.state)
+
+    def _get_mips_topk(self):
+        """The facade's lazily-built MIPS head closure, or None when the
+        knob is off or the mesh shards the table (the head gathers from
+        an unsharded device copy; sharded serving keeps the exact
+        head, logged once)."""
+        nprobe = int(getattr(self.config, "serve_mips_nprobe", 0) or 0)
+        if nprobe <= 0:
+            return None
+        if self.mesh is not None:
+            if not getattr(self, "_mips_mesh_warned", False):
+                self._mips_mesh_warned = True
+                self.log("serve_mips_nprobe ignored: the MIPS head "
+                         "needs an unsharded target table (mesh is "
+                         "active); serving with the exact blockwise "
+                         "head")
+            return None
+        cached = getattr(self, "_mips_topk", None)
+        if cached is None:
+            from code2vec_tpu.retrieval.mips import MipsHead
+            head = MipsHead.build(
+                np.asarray(jax.device_get(
+                    self.state.params["target_embedding"])), None,
+                real_vocab=self.dims.real_target_vocab_size,
+                nlist=int(getattr(self.config, "serve_mips_nlist", 0)
+                          or 0),
+                nprobe=nprobe, seed=self.config.seed, log=self.log)
+            self.mips_head = head
+            k = min(self.config.top_k_words_considered_during_prediction,
+                    self.dims.real_target_vocab_size)
+            cached = self._mips_topk = head.topk_fn(k, nprobe)
+        return cached
 
     def _call_predict_step(self, step, arrays):
         return step(self.state.params, *arrays)

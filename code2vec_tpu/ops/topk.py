@@ -22,10 +22,13 @@ below k may pick different -inf-valued indices (the init sentinel is
 value -inf, index 0); callers clamp k to the real vocab size, so this
 never happens in practice. Pinned in tests/test_quant.py.
 
-The table blocks may be int8 with per-row symmetric scales
-(ops/quant.py): the dequant is fused after the block matmul (the int8
-accumulation happens in the compute dtype, scales applied to the f32
-block logits), so the table moves through HBM at one byte per weight.
+The table blocks may be quantized with per-row symmetric scales
+(ops/quant.py): int8 or fp8 blocks cast straight into the compute
+dtype, int4-packed blocks (`int4_dim`) are nibble-unpacked AFTER the
+block slice, and in every case the dequant is fused after the block
+matmul (accumulation in the compute dtype, scales applied to the f32
+block logits) — the table moves through HBM at one byte (int8/fp8) or
+half a byte (int4) per weight.
 """
 
 from __future__ import annotations
@@ -102,6 +105,8 @@ def blockwise_matmul_top_k(
     scales: Optional[jax.Array] = None,   # (V, 1) f32 per-row dequant
     valid_rows: Optional[int] = None,     # ids >= this are padding (-inf)
     compute_dtype: jnp.dtype = jnp.float32,
+    int4_dim: Optional[int] = None,       # table is int4-packed uint8
+    #                                       (V, ceil(int4_dim/2))
 ) -> BlockTopKOutputs:
     """Streaming `top_k(code_vectors @ target_table.T, k)` + logsumexp.
 
@@ -136,6 +141,11 @@ def blockwise_matmul_top_k(
         vals, idx, run_max, run_sum = carry
         start = jnp.minimum(i * block, v - block)
         tbl = jax.lax.dynamic_slice_in_dim(target_table, start, block, axis=0)
+        if int4_dim is not None:
+            # packed bytes through HBM; nibbles unpacked on the
+            # block-sized slice only (ops/quant.py)
+            from code2vec_tpu.ops.quant import unpack_int4
+            tbl = unpack_int4(tbl, int4_dim)
         ids = start + jnp.arange(block, dtype=jnp.int32)
         logits = jnp.einsum("bd,vd->bv", cv, tbl.astype(compute_dtype),
                             preferred_element_type=jnp.float32)
@@ -172,7 +182,8 @@ def blockwise_matmul_top_k(
 def gathered_label_logits(code_vectors: jax.Array, target_table: jax.Array,
                           labels: jax.Array, *,
                           scales: Optional[jax.Array] = None,
-                          compute_dtype: jnp.dtype = jnp.float32) -> jax.Array:
+                          compute_dtype: jnp.dtype = jnp.float32,
+                          int4_dim: Optional[int] = None) -> jax.Array:
     """(B,) logit of each row's own label: a B-row gather + dot instead
     of a column of the full logit matrix. Same per-element contraction
     as the blockwise/full matmul, so CE = lse - label_logit matches the
@@ -180,6 +191,9 @@ def gathered_label_logits(code_vectors: jax.Array, target_table: jax.Array,
     NaN/Inf label logit is substituted with -1e30 exactly as the full
     path's safe_logits would have at that column."""
     rows = jnp.take(target_table, labels, axis=0)          # (B, D)
+    if int4_dim is not None:
+        from code2vec_tpu.ops.quant import unpack_int4
+        rows = unpack_int4(rows, int4_dim)
     logits = jnp.einsum("bd,bd->b", code_vectors.astype(compute_dtype),
                         rows.astype(compute_dtype),
                         preferred_element_type=jnp.float32)

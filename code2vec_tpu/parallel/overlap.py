@@ -1,0 +1,268 @@
+"""Bucketed async gradient all-reduce: overlap communication with the
+optimizer apply.
+
+The unbucketed GSPMD train step is ONE XLA program: backward, the
+data-parallel gradient all-reduce and the full Adam sweep run as a
+single dispatch, and the all-reduce of the LAST gradient serializes
+ahead of the ENTIRE optimizer apply. BENCH_ROOFLINE.md shows the apply
+already runs at this part's practical HBM bandwidth — the remaining
+lever is keeping the interconnect busy while it runs.
+
+This module splits the step into 1 + K dispatches:
+
+1. **backward** — per-shard forward/backward under `shard_map` with NO
+   gradient reduce: each device keeps its local partial gradients
+   (declared replicated with the replication check off — the standard
+   "unreduced array" spelling). Only the scalar loss is psummed (exact
+   global loss, one element).
+2. **K bucket steps** — the gradient leaves are partitioned into
+   size-bounded buckets ordered by approximate backward-completion
+   order (classifier first — its gradient exists first in the backward
+   pass). Each bucket is its own jitted dispatch: psum the bucket's
+   partial gradients over the data axis, then apply the optimizer to
+   exactly that parameter subtree (donated, so params/moments update in
+   place). The K dispatches are enqueued back to back; on device,
+   bucket i's all-reduce overlaps bucket i-1's (bandwidth-bound) Adam
+   apply, and the host never sits behind one monolithic step chain.
+
+Semantics: the per-bucket optimizer is the SAME optax transformation
+`state.make_optimizer` built (applied to a subtree — Adam is
+elementwise, and every bucket's count advances identically), and the
+psum of per-shard partials is the same sum the in-program all-reduce
+computes. Loss/params parity with the unbucketed step is pinned in
+tests/test_overlap.py (bit-equal single-device; documented float
+tolerance across the reduction-order change on a mesh). Dropout under
+a mesh folds in the data-axis index (the manual-kernel path's
+discipline) — same distribution, different draw than the unbucketed
+GSPMD step's single global mask.
+
+Scope: dense optimizer, GSPMD, tp = cp = 1 (config.verify enforces;
+the sparse path already exchanges rows instead of tables, and the
+manual-TP path owns its own collectives). Works with mesh=None too
+(pure pipelining of apply dispatches — the measurable win is on 2+
+hosts, experiments/overlap_bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from code2vec_tpu import obs
+from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.parallel.mesh import AXIS_DATA
+
+# Approximate backward-completion order of the param leaves: the
+# classifier matmul is the LAST forward op, so its gradient is the
+# first one backward finishes; the input-side gathers come last.
+# Unknown leaves (future params) sort after these, alphabetically.
+_BACKWARD_ORDER = ("target_embedding", "attention", "transform",
+                   "path_embedding", "token_embedding")
+
+
+def plan_buckets(params, bucket_bytes: int) -> List[List[str]]:
+    """Partition param-leaf names into contiguous buckets of at most
+    `bucket_bytes` (a leaf larger than the budget gets its own
+    bucket), in backward-completion order."""
+    names = sorted(params, key=lambda n: (
+        _BACKWARD_ORDER.index(n) if n in _BACKWARD_ORDER
+        else len(_BACKWARD_ORDER), n))
+    buckets: List[List[str]] = []
+    current: List[str] = []
+    current_bytes = 0
+    for name in names:
+        nbytes = int(np.prod(params[name].shape)) * 4  # grads are f32
+        if current and current_bytes + nbytes > bucket_bytes:
+            buckets.append(current)
+            current, current_bytes = [], 0
+        current.append(name)
+        current_bytes += nbytes
+        if current_bytes >= bucket_bytes:
+            buckets.append(current)
+            current, current_bytes = [], 0
+    if current:
+        buckets.append(current)
+    return buckets
+
+
+def _adam_core(opt_state):
+    """The ScaleByAdamState slice of a dense optax state, or None when
+    the structure is not the one `state.make_optimizer` builds (the
+    builder then refuses loudly rather than mis-slicing)."""
+    if not isinstance(opt_state, (tuple, list)) or not opt_state:
+        return None
+    core = opt_state[0]
+    if not (hasattr(core, "mu") and hasattr(core, "nu")
+            and hasattr(core, "count") and isinstance(core.mu, dict)):
+        return None
+    return core
+
+
+def build_overlap_train_step(builder, example_state) -> Callable:
+    """(state, *batch_arrays, rng) -> (state, loss) host composite of
+    1 backward + K bucket dispatches. `builder` is the
+    TrainStepBuilder; `example_state` fixes tree structure/shapes."""
+    config = builder.config
+    module = builder.module
+    optimizer = builder.optimizer
+    mesh = builder.mesh
+    params = example_state.params
+    core = _adam_core(example_state.opt_state)
+    if core is None or set(core.mu) != set(params):
+        raise ValueError(
+            "overlap_grad_allreduce needs the dense optax Adam state "
+            "state.make_optimizer builds (ScaleByAdamState over the "
+            "param dict); got "
+            f"{type(example_state.opt_state).__name__}.")
+    opt_rest_len = len(example_state.opt_state) - 1
+
+    bucket_bytes = int(float(config.overlap_bucket_mb) * (1 << 20))
+    buckets = plan_buckets(params, bucket_bytes)
+    param_specs = mesh_lib.param_specs(params)
+
+    # ------------------------------------------------------- backward
+
+    def local_loss_fn(p, src, pth, tgt, mask, labels, valid, dropout_rng,
+                      global_batch: int):
+        logits, _, _ = module.apply(
+            {"params": p}, src, pth, tgt, mask,
+            deterministic=False, rngs={"dropout": dropout_rng})
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        ce = ce * valid.astype(jnp.float32)
+        # local sum / GLOBAL batch: per-shard partial grads then SUM to
+        # exactly the unbucketed step's sum-CE / batch_size loss
+        return jnp.sum(ce) / global_batch
+
+    if mesh is None:
+        def backward_fn(p, src, pth, tgt, mask, labels, valid, rng, step):
+            dropout_rng = jax.random.fold_in(rng, step)
+            loss, grads = jax.value_and_grad(local_loss_fn)(
+                p, src, pth, tgt, mask, labels, valid, dropout_rng,
+                labels.shape[0])
+            return grads, loss
+
+        backward = jax.jit(backward_fn)
+    else:
+        batch_specs = tuple(
+            mesh_lib.batch_specs()[name] for name in (
+                "source_token_indices", "path_indices",
+                "target_token_indices", "context_valid_mask",
+                "target_index", "example_valid"))
+        dp = dict(zip(mesh.axis_names,
+                      mesh.devices.shape))[AXIS_DATA]
+
+        def per_shard_backward(p, src, pth, tgt, mask, labels, valid,
+                               rng, step):
+            # distinct dropout per data shard (the manual path's
+            # discipline); tp = cp = 1 so no other axes draw
+            dropout_rng = jax.random.fold_in(
+                jax.random.fold_in(rng, step),
+                jax.lax.axis_index(AXIS_DATA))
+            local, grads = jax.value_and_grad(local_loss_fn)(
+                p, src, pth, tgt, mask, labels, valid, dropout_rng,
+                labels.shape[0] * dp)
+            # grads stay UNREDUCED (each shard's partial); only the
+            # scalar loss is summed here
+            loss = jax.lax.psum(local, AXIS_DATA)
+            return grads, loss
+
+        from code2vec_tpu.training.step import _shard_map
+        sharded = _shard_map(
+            per_shard_backward, mesh=mesh,
+            in_specs=(param_specs,) + batch_specs + (P(), P()),
+            out_specs=(param_specs, P()),
+            check_vma=False)
+        backward = jax.jit(sharded)
+
+    # --------------------------------------------------- bucket steps
+
+    def make_bucket_fn(names: Sequence[str]):
+        specs = {k: param_specs[k] for k in names}
+        reducer = None
+        if mesh is not None:
+            def reduce(gs):
+                out = {}
+                for k, g in gs.items():
+                    axes = mesh_lib.replicated_axes_for_spec(specs[k])
+                    out[k] = jax.lax.psum(g, axes) if axes else g
+                return out
+
+            from code2vec_tpu.training.step import _shard_map
+            reducer = _shard_map(reduce, mesh=mesh, in_specs=(specs,),
+                                 out_specs=specs, check_vma=False)
+
+        def bucket_step(p_sub, mu_sub, nu_sub, count, rest, g_sub):
+            # `count` and `rest` are NOT donated: every bucket reads
+            # the same shared count buffer (each computes the identical
+            # incremented value), where mu/nu/param/grad leaves belong
+            # to exactly one bucket and alias in place.
+            if reducer is not None:
+                g_sub = reducer(g_sub)
+            opt_sub = (adam_type(count=count, mu=mu_sub, nu=nu_sub),
+                       ) + tuple(rest)
+            updates, new_opt = optimizer.update(g_sub, opt_sub, p_sub)
+            return optax.apply_updates(p_sub, updates), new_opt
+
+        # params/mu/nu donate (updated in place); grads are NOT listed:
+        # there is no same-shaped output left for them once the params
+        # aliased, and XLA's unusable-donation warning would fire every
+        # compile.
+        return jax.jit(bucket_step, donate_argnums=(0, 1, 2))
+
+    adam_type = type(core)
+    bucket_fns = [make_bucket_fn(names) for names in buckets]
+
+    h_bucket = obs.histogram(
+        "train_overlap_bucket_dispatch_seconds",
+        "host-side dispatch of one bucketed all-reduce+apply step")
+
+    def train_step(state, src, pth, tgt, mask, labels, valid, rng):
+        import time as _time
+        grads, loss = backward(state.params, src, pth, tgt, mask,
+                               labels, valid, rng, state.step)
+        adam = state.opt_state[0]
+        rest = tuple(state.opt_state[1:])
+        new_params = {}
+        new_mu = {}
+        new_nu = {}
+        new_count = None
+        new_rest = rest
+        for fn, names in zip(bucket_fns, buckets):
+            t0 = _time.perf_counter()
+            p_sub = {k: state.params[k] for k in names}
+            p_out, opt_out = fn(p_sub,
+                                {k: adam.mu[k] for k in names},
+                                {k: adam.nu[k] for k in names},
+                                adam.count, rest,
+                                {k: grads[k] for k in names})
+            new_params.update(p_out)
+            new_mu.update(opt_out[0].mu)
+            new_nu.update(opt_out[0].nu)
+            new_count = opt_out[0].count  # identical across buckets
+            new_rest = tuple(opt_out[1:])
+            h_bucket.observe(_time.perf_counter() - t0)
+        opt_state = (adam_type(count=new_count, mu=new_mu, nu=new_nu),
+                     ) + new_rest
+        if opt_rest_len != len(new_rest):  # structural invariant
+            raise AssertionError("bucket optimizer changed state arity")
+        from code2vec_tpu.training.state import TrainState
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=opt_state), loss
+
+    n_leaves = len(params)
+    train_step.overlap_buckets = len(buckets)
+    train_step.overlap_description = (
+        f"{len(buckets)} gradient bucket(s) over {n_leaves} leaves "
+        f"(<= {config.overlap_bucket_mb:g} MB each, backward-completion "
+        f"order {[list(b) for b in buckets]}), "
+        f"{'data-parallel psum per bucket' if mesh is not None else 'single-device (apply pipelining only)'}")
+    obs.gauge("train_overlap_buckets",
+              "gradient buckets of the overlapped train step "
+              "(0/absent = unbucketed single-program step)"
+              ).set(len(buckets))
+    return train_step

@@ -7,7 +7,9 @@ replica needs — no checkpoint, no optimizer state, no training config:
                             fingerprint (see _build_meta)
     dictionaries.bin        the three vocabularies (reference sidecar
                             format, vocab.py)
-    token_embedding.npy     int8 (V, D) — or f32 for --no_quantize
+    token_embedding.npy     int8 (V, D) / uint8 fp8 bit patterns (V, D)
+                            / uint8 int4-packed (V, ceil(D/2)) — or f32
+                            for --no_quantize (scheme in the meta)
     token_embedding.scale.npy   f32 (V, 1) per-row symmetric scales
     path_embedding[.scale].npy
     target_embedding[.scale].npy
@@ -16,10 +18,14 @@ replica needs — no checkpoint, no optimizer state, no training config:
     aot/serve_r<rows>_m<m>.jaxexport   serialized jax.export lowerings,
                             one per (serve_batch_size, context bucket)
 
-Quantization is per-row symmetric int8 (ops/quant.py): at the flagship
-shape the three tables drop ~3.9x (1 byte/weight + 4 bytes/row), which
-is both the artifact's disk/RSS footprint and — because the hot ops are
-bandwidth-bound (BENCH_ROOFLINE.md) — the serve step's HBM traffic.
+Quantization is per-row symmetric (ops/quant.py), scheme selectable at
+export (`--release_scheme int8|fp8_e4m3|fp8_e5m2|int4`): int8 drops the
+three tables ~3.9x at the flagship shape (1 byte/weight + 4 bytes/row),
+fp8 keeps the byte count with a relative error profile, int4 packs two
+weights per byte for another ~2x — which is both the artifact's
+disk/RSS footprint and, because the hot ops are bandwidth-bound
+(BENCH_ROOFLINE.md), the serve step's HBM traffic. Quality deltas per
+scheme are measured same-run vs fp32 in BENCH_QUANT.md.
 
 Every load validates `kind`/`format`/table dtypes against the declared
 scheme and raises ArtifactError naming the offending field; pointing
@@ -44,10 +50,43 @@ AOT_DIR = "aot"
 ARTIFACT_FORMAT = 1
 ARTIFACT_KIND = "code2vec_release_artifact"
 SCHEME_INT8 = "int8_rowwise_symmetric"
+SCHEME_FP8_E4M3 = "fp8_e4m3_rowwise"
+SCHEME_FP8_E5M2 = "fp8_e5m2_rowwise"
+SCHEME_INT4 = "int4_rowwise_packed"
 SCHEME_FP32 = "float32"
+# Every scheme the loader/runtime understand; the quantized ones carry
+# per-row f32 scales. fp8/int4 payloads are stored as uint8 npy files
+# (fp8 = bit patterns — numpy's mmap path cannot represent ml_dtypes;
+# int4 = two nibbles per byte), decoded by the runtime (ops/quant.py).
+QUANTIZED_SCHEMES = (SCHEME_INT8, SCHEME_FP8_E4M3, SCHEME_FP8_E5M2,
+                     SCHEME_INT4)
+ALL_SCHEMES = QUANTIZED_SCHEMES + (SCHEME_FP32,)
+# --release_scheme CLI vocabulary -> on-disk scheme name.
+SCHEME_BY_KNOB = {
+    "int8": SCHEME_INT8,
+    "fp8_e4m3": SCHEME_FP8_E4M3,
+    "fp8_e5m2": SCHEME_FP8_E5M2,
+    "int4": SCHEME_INT4,
+    "float32": SCHEME_FP32,
+}
 
 _TABLES = ("token_embedding", "path_embedding", "target_embedding")
 _DENSE = ("transform", "attention")
+
+
+def _quantize_table(table: "np.ndarray", scheme: str):
+    """(payload, scales-or-None) for one table under `scheme`."""
+    from code2vec_tpu.ops import quant
+    if scheme == SCHEME_INT8:
+        return quant.quantize_rows(table)
+    if scheme == SCHEME_FP8_E4M3:
+        return quant.quantize_rows_fp8(table, "e4m3")
+    if scheme == SCHEME_FP8_E5M2:
+        return quant.quantize_rows_fp8(table, "e5m2")
+    if scheme == SCHEME_INT4:
+        return quant.quantize_rows_int4(table)
+    assert scheme == SCHEME_FP32, scheme
+    return table, None
 
 
 class ArtifactError(ValueError):
@@ -114,20 +153,29 @@ def _content_fingerprint(payloads: Dict[str, np.ndarray], meta: dict) -> str:
 
 
 def export_artifact(model, out_dir: str, *, quantize: Optional[bool] = None,
-                    aot: Optional[bool] = None, log=None) -> dict:
+                    aot: Optional[bool] = None,
+                    scheme: Optional[str] = None, log=None) -> dict:
     """Write a release artifact from a live facade model. Returns the
-    meta dict (with the content fingerprint filled in)."""
+    meta dict (with the content fingerprint filled in). `scheme` is an
+    on-disk scheme name (ALL_SCHEMES); unset, it follows
+    config.release_scheme with `quantize`/--no_quantize forcing fp32."""
     import jax
-
-    from code2vec_tpu.ops.quant import quantize_rows
 
     config = model.config
     log = log or config.log
     quantize = config.release_quantize if quantize is None else quantize
     aot = config.release_aot if aot is None else aot
+    if scheme is None:
+        knob = getattr(config, "release_scheme", "int8")
+        if knob not in SCHEME_BY_KNOB:
+            raise ValueError(f"release_scheme must be one of "
+                             f"{sorted(SCHEME_BY_KNOB)}, got {knob!r}")
+        scheme = SCHEME_BY_KNOB[knob] if quantize else SCHEME_FP32
+    if scheme not in ALL_SCHEMES:
+        raise ValueError(f"unknown artifact scheme {scheme!r} "
+                         f"(one of {ALL_SCHEMES})")
     os.makedirs(out_dir, exist_ok=True)
 
-    scheme = SCHEME_INT8 if quantize else SCHEME_FP32
     params = {k: np.asarray(jax.device_get(v))
               for k, v in model.state.params.items()}
     fp32_bytes = sum(params[t].nbytes for t in _TABLES)
@@ -136,22 +184,20 @@ def export_artifact(model, out_dir: str, *, quantize: Optional[bool] = None,
     for name in _TABLES:
         table = params[name].astype(np.float32)
         scale_path = os.path.join(out_dir, f"{name}.scale.npy")
-        if quantize:
-            q, scales = quantize_rows(table)
-            np.save(os.path.join(out_dir, f"{name}.npy"), q)
+        q, scales = _quantize_table(table, scheme)
+        np.save(os.path.join(out_dir, f"{name}.npy"), q)
+        written += q.nbytes
+        payloads[name] = q
+        if scales is not None:
             np.save(scale_path, scales)
-            written += q.nbytes + scales.nbytes
-            payloads[name] = q
+            written += scales.nbytes
             payloads[f"{name}.scale"] = scales
-        else:
-            np.save(os.path.join(out_dir, f"{name}.npy"), table)
-            written += table.nbytes
-            payloads[name] = table
-            # A prior int8 export into the same dir leaves scale files
-            # behind; the loader reads whatever scale files exist, so
-            # stale ones must go with the tables they described.
-            if os.path.exists(scale_path):
-                os.remove(scale_path)
+        elif os.path.exists(scale_path):
+            # A prior quantized export into the same dir leaves scale
+            # files behind; the loader reads whatever scale files
+            # exist, so stale ones must go with the tables they
+            # described.
+            os.remove(scale_path)
     for name in _DENSE:
         arr = params[name].astype(np.float32)
         np.save(os.path.join(out_dir, f"{name}.npy"), arr)
@@ -216,22 +262,39 @@ def export_artifact(model, out_dir: str, *, quantize: Optional[bool] = None,
 def _expected_dtype(scheme: str, name: str) -> np.dtype:
     if name.endswith(".scale") or name in _DENSE:
         return np.dtype(np.float32)
-    return np.dtype(np.int8 if scheme == SCHEME_INT8 else np.float32)
+    if scheme == SCHEME_INT8:
+        return np.dtype(np.int8)
+    if scheme in (SCHEME_FP8_E4M3, SCHEME_FP8_E5M2, SCHEME_INT4):
+        # fp8 bit patterns / packed nibbles both travel as uint8 bytes
+        return np.dtype(np.uint8)
+    return np.dtype(np.float32)
 
 
-def _expected_shape(dims: dict, name: str) -> tuple:
+def table_dim(dims: dict, name: str) -> int:
+    """Unpacked (model-side) column count of one embedding table."""
+    d_tok, d_path = int(dims["token_dim"]), int(dims["path_dim"])
+    return {"token_embedding": d_tok, "path_embedding": d_path,
+            "target_embedding": d_path + 2 * d_tok}[name]
+
+
+def _expected_shape(dims: dict, name: str,
+                    scheme: str = SCHEME_FP32) -> tuple:
     """Declared shape of each payload per meta["dims"]. Shape drift must
     fail at load: a truncated table would otherwise serve silently-wrong
-    rows (jnp.take clamps out-of-bounds ids under jit)."""
+    rows (jnp.take clamps out-of-bounds ids under jit). int4-packed
+    tables store two columns per byte."""
     d_tok, d_path = int(dims["token_dim"]), int(dims["path_dim"])
     code_dim = d_path + 2 * d_tok
-    return {
+    shape = {
         "token_embedding": (int(dims["token_vocab_size"]), d_tok),
         "path_embedding": (int(dims["path_vocab_size"]), d_path),
         "target_embedding": (int(dims["target_vocab_size"]), code_dim),
         "transform": (code_dim, code_dim),
         "attention": (code_dim, 1),
     }[name]
+    if scheme == SCHEME_INT4 and name in _TABLES:
+        return (shape[0], (shape[1] + 1) // 2)
+    return shape
 
 
 def load_artifact(path: str,
@@ -262,15 +325,17 @@ def load_artifact(path: str,
             "format", f"artifact format {meta.get('format')} is newer "
             f"than this build understands (<= {ARTIFACT_FORMAT})")
     scheme = (meta.get("quantization") or {}).get("scheme")
-    if scheme not in (SCHEME_INT8, SCHEME_FP32):
-        raise ArtifactError("quantization.scheme",
-                            f"unknown scheme {scheme!r}")
+    if scheme not in ALL_SCHEMES:
+        raise ArtifactError(
+            "quantization.scheme",
+            f"unknown scheme {scheme!r} (this build understands "
+            f"{list(ALL_SCHEMES)})")
     if expect_scheme is not None and scheme != expect_scheme:
         raise ArtifactError(
             "quantization.scheme",
             f"artifact is quantized as {scheme!r} but the caller "
             f"requires {expect_scheme!r}; re-export with "
-            f"{'--no_quantize' if expect_scheme == SCHEME_FP32 else 'quantization on'} "
+            f"{'--no_quantize' if expect_scheme == SCHEME_FP32 else 'the matching --release_scheme'} "
             f"or use a consumer that dequantizes")
     if "fingerprint" not in meta:
         raise ArtifactError("fingerprint", "missing (torn export?)")
@@ -305,14 +370,14 @@ def load_artifact(path: str,
                 f"{name}.dtype",
                 f"expected {want} under quantization.scheme={scheme}, "
                 f"file holds {arr.dtype}")
-        want_shape = _expected_shape(meta.get("dims") or {}, name)
+        want_shape = _expected_shape(meta.get("dims") or {}, name, scheme)
         if tuple(arr.shape) != want_shape:
             raise ArtifactError(
                 f"{name}.shape",
                 f"expected {want_shape} per meta dims, file holds "
                 f"{tuple(arr.shape)}")
         tables[name] = arr
-        if scheme == SCHEME_INT8 and name in _TABLES:
+        if scheme in QUANTIZED_SCHEMES and name in _TABLES:
             sp = os.path.join(base, f"{name}.scale.npy")
             if not os.path.isfile(sp):
                 raise ArtifactError(f"{name}.scale", "scale file missing")

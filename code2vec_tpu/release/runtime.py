@@ -31,6 +31,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from code2vec_tpu import obs
 from code2vec_tpu.model_facade import BucketedPredictMixin
@@ -40,7 +41,8 @@ from code2vec_tpu.ops.topk import (
     blockwise_matmul_top_k, gathered_label_logits,
 )
 from code2vec_tpu.release.artifact import (
-    SCHEME_INT8, ReleaseArtifact, load_artifact,
+    QUANTIZED_SCHEMES, SCHEME_FP8_E4M3, SCHEME_FP8_E5M2, SCHEME_INT4,
+    SCHEME_INT8, ReleaseArtifact, load_artifact, table_dim,
 )
 from code2vec_tpu.training.step import EvalOutputs
 from code2vec_tpu.vocab import Code2VecVocabs
@@ -68,17 +70,25 @@ def _aot_counter(outcome: str):
         outcome=outcome)
 
 
-def make_release_step(meta: dict):
+def make_release_step(meta: dict, mips_topk=None):
     """Pure serve/eval function over artifact params:
     (params, src, pth, tgt, mask, labels, valid) ->
     (topk_values, topk_indices, code_vectors, attention, loss_sum).
+
+    `mips_topk` (a retrieval/mips.py `MipsHead.topk_fn` closure)
+    replaces the exact blockwise classifier head with the
+    approximate-MIPS candidate search — serve/predict only, never the
+    accuracy-eval path (config.verify rejects the combination); its
+    steps report loss_sum = 0 (no logsumexp exists over a candidate
+    subset, and no serving consumer reads it).
 
     Returns a plain tuple (not EvalOutputs) so jax.export can serialize
     the output pytree without namedtuple registration; callers wrap.
     """
     dims = meta["dims"]
     scheme = meta["quantization"]["scheme"]
-    quantized = scheme == SCHEME_INT8
+    quantized = scheme in QUANTIZED_SCHEMES
+    int4 = scheme == SCHEME_INT4
     compute_dtype = jnp.dtype(meta["compute_dtype"])
     k = min(int(meta["topk"]), int(dims["real_target_vocab_size"]))
     raw_block = meta.get("topk_block_size")
@@ -95,12 +105,20 @@ def make_release_step(meta: dict):
     def scale(params, name):
         return params[f"{name}_scale"] if quantized else None
 
+    def int4_dim(name):
+        # int4 tables travel packed; their consumers need the unpacked
+        # column count (ops/quant.py unpack_int4)
+        return table_dim(dims, name) if int4 else None
+
     def step(params, src, pth, tgt, mask, labels, valid):
         tok, tok_s = params["token_embedding"], scale(params, "token_embedding")
-        src_rows = table_gather(tok, tok_s, src)
-        tgt_rows = table_gather(tok, tok_s, tgt)
+        src_rows = table_gather(tok, tok_s, src,
+                                int4_dim=int4_dim("token_embedding"))
+        tgt_rows = table_gather(tok, tok_s, tgt,
+                                int4_dim=int4_dim("token_embedding"))
         pth_rows = table_gather(params["path_embedding"],
-                                scale(params, "path_embedding"), pth)
+                                scale(params, "path_embedding"), pth,
+                                int4_dim=int4_dim("path_embedding"))
         # concat/cast/tanh-transform/attention exactly as
         # models/code2vec.py transform_gathered + encode (deterministic).
         # Hand-mirrored rather than routed through module.apply (the
@@ -115,13 +133,19 @@ def make_release_step(meta: dict):
         code_vectors, attention = masked_single_query_attention(
             transformed, params["attention"][:, 0], mask)
         code_vectors = code_vectors.astype(jnp.float32)
+        if mips_topk is not None:
+            values, indices = mips_topk(code_vectors)
+            return (values, indices, code_vectors, attention,
+                    jnp.zeros((), jnp.float32))
         target_s = scale(params, "target_embedding")
         out = blockwise_matmul_top_k(
             code_vectors, params["target_embedding"], k, block,
-            scales=target_s, valid_rows=real_v, compute_dtype=compute_dtype)
+            scales=target_s, valid_rows=real_v, compute_dtype=compute_dtype,
+            int4_dim=int4_dim("target_embedding"))
         label_logit = gathered_label_logits(
             code_vectors, params["target_embedding"], labels,
-            scales=target_s, compute_dtype=compute_dtype)
+            scales=target_s, compute_dtype=compute_dtype,
+            int4_dim=int4_dim("target_embedding"))
         loss_rows = valid & (labels > oov_floor)
         ce = (out.lse - label_logit) * loss_rows.astype(jnp.float32)
         return (out.values, out.indices.astype(jnp.int32), code_vectors,
@@ -130,10 +154,23 @@ def make_release_step(meta: dict):
     return step
 
 
+def _table_device_dtype(scheme: str):
+    """Device dtype of the table params per scheme. fp8 payloads are
+    bitcast from their on-disk uint8 patterns back to the fp8 dtype at
+    load, so the step's astype decodes them; int4 stays packed uint8."""
+    return {
+        SCHEME_INT8: jnp.int8,
+        SCHEME_FP8_E4M3: jnp.float8_e4m3fn,
+        SCHEME_FP8_E5M2: jnp.float8_e5m2,
+        SCHEME_INT4: jnp.uint8,
+    }.get(scheme, jnp.float32)
+
+
 def param_specs(meta: dict) -> Dict[str, jax.ShapeDtypeStruct]:
     """ShapeDtypeStructs of the artifact param tree (AOT export specs)."""
     dims = meta["dims"]
-    quantized = meta["quantization"]["scheme"] == SCHEME_INT8
+    scheme = meta["quantization"]["scheme"]
+    quantized = scheme in QUANTIZED_SCHEMES
     d_tok, d_path = int(dims["token_dim"]), int(dims["path_dim"])
     code_dim = d_path + 2 * d_tok
     shapes = {
@@ -141,7 +178,10 @@ def param_specs(meta: dict) -> Dict[str, jax.ShapeDtypeStruct]:
         "path_embedding": (int(dims["path_vocab_size"]), d_path),
         "target_embedding": (int(dims["target_vocab_size"]), code_dim),
     }
-    table_dtype = jnp.int8 if quantized else jnp.float32
+    if scheme == SCHEME_INT4:
+        shapes = {name: (v, (d + 1) // 2)
+                  for name, (v, d) in shapes.items()}
+    table_dtype = _table_device_dtype(scheme)
     specs = {name: jax.ShapeDtypeStruct(shape, table_dtype)
              for name, shape in shapes.items()}
     if quantized:
@@ -262,12 +302,70 @@ class ReleaseModel(BucketedPredictMixin):
         self.vocabs = Code2VecVocabs.load(
             self.artifact.dictionaries_path,
             separate_oov_and_pad=config.separate_oov_and_pad)
-        # Device-resident artifact params: int8 tables + f32 scales (one
-        # transfer each; the mmap'd host copies are dropped after this).
+        # Device-resident artifact params: quantized tables + f32 scales
+        # (one transfer each; the mmap'd host copies are dropped after
+        # this). fp8 payloads travel on disk as uint8 bit patterns
+        # (numpy's npy mmap cannot represent ml_dtypes) and are viewed
+        # back to their fp8 dtype here, so the step's astype decodes
+        # them; int4 tables stay packed (unpacked per gathered row).
+        import ml_dtypes
+        fp8_np = {SCHEME_FP8_E4M3: ml_dtypes.float8_e4m3fn,
+                  SCHEME_FP8_E5M2: ml_dtypes.float8_e5m2}.get(
+            self.artifact.scheme)
+        mips_nprobe = int(getattr(config, "serve_mips_nprobe", 0) or 0)
         self.params = {}
         for name, arr in self.artifact.tables.items():
+            if mips_nprobe > 0 and name.startswith("target_embedding"):
+                # the MIPS head (below) holds the list-reordered copy;
+                # transferring the original-order table too would
+                # double the dominant table's device footprint
+                continue
+            if fp8_np is not None and not name.endswith(".scale") \
+                    and arr.dtype == np.uint8:
+                arr = np.asarray(arr).view(fp8_np)
             self.params[name.replace(".scale", "_scale")] = jnp.asarray(arr)
         self._step_fn = make_release_step(meta)
+        # Approximate-MIPS prediction head (--serve_mips_nprobe > 0):
+        # built once from the artifact's (quantized) target table; the
+        # predict steps then search nprobe coarse lists instead of
+        # streaming the whole classifier. AOT lowerings bake the exact
+        # head, so MIPS steps always jit (logged below); the exact
+        # `_step_fn` remains the fallback/eval program.
+        self.mips_head = None
+        self._mips_step = None
+        if mips_nprobe > 0:
+            from code2vec_tpu.retrieval.mips import MipsHead
+            dims = meta["dims"]
+            int4_dim = (int(dims["path_dim"]) + 2 * int(dims["token_dim"])
+                        if self.artifact.scheme == SCHEME_INT4 else None)
+            # Build from the HOST-side artifact tables (fp8 viewed to
+            # its ml_dtypes type, like the device-param load above) —
+            # the head holds the list-reordered quantized rows on
+            # device; the original-order target table was skipped in
+            # the device-param loop above (the MIPS step never reads
+            # it) so the dominant table is device-resident exactly
+            # once.
+            tgt = np.asarray(self.artifact.tables["target_embedding"])
+            if fp8_np is not None and tgt.dtype == np.uint8:
+                tgt = tgt.view(fp8_np)
+            tgt_scale = self.artifact.tables.get("target_embedding.scale")
+            self.mips_head = MipsHead.build(
+                tgt,
+                None if tgt_scale is None else np.asarray(tgt_scale),
+                real_vocab=int(dims["real_target_vocab_size"]),
+                nlist=int(getattr(config, "serve_mips_nlist", 0) or 0),
+                nprobe=mips_nprobe, int4_dim=int4_dim,
+                seed=int(getattr(config, "seed", 0)), log=self.log)
+            k = min(int(meta["topk"]),
+                    int(dims["real_target_vocab_size"]))
+            self._mips_step = make_release_step(
+                meta, mips_topk=self.mips_head.topk_fn(k, mips_nprobe))
+            self.log(f"Approximate-MIPS head active: nprobe "
+                     f"{self.mips_head.nprobe}/{self.mips_head.nlist} "
+                     f"lists per prediction (AOT store bypassed — the "
+                     f"lowerings bake the exact head; the "
+                     f"original-order target table is not device-"
+                     f"resident, only the head's reordered copy)")
         self._predict_steps: Dict[Tuple[int, int], object] = {}
         self.aot_loads = {"aot": 0, "jit_fallback": 0, "jit_error": 0}
         self.log(
@@ -296,6 +394,8 @@ class ReleaseModel(BucketedPredictMixin):
     # ------------------------------------------------- predict plumbing
 
     def _make_predict_step(self, batch_rows: int, m: int):
+        if self._mips_step is not None:
+            return jax.jit(self._mips_step)
         aot = self.meta.get("aot") or {}
         path = self.artifact.aot_path(batch_rows, m)
         if path is not None and _backend_matches(

@@ -1,0 +1,192 @@
+"""Approximate-MIPS prediction head: the retrieval stack's IVF coarse
+quantizer pointed at the ~246K-name target classifier table.
+
+The serve-time prediction head is `top_k(code_vector @ table.T)` — a
+maximum-inner-product search over the target vocabulary. The PR-8
+blockwise head (ops/topk.py) already avoids materializing the (B, V)
+logit row, but still STREAMS every table row through the matmul per
+batch. This module reuses the PR-10 IVF machinery (retrieval/index.py:
+jitted Lloyd k-means, list-contiguous reordering, padded-list gathers)
+to search k ≪ V candidates instead:
+
+- **Build** (once, at model load): k-means over the real-vocab rows
+  (plain L2 Lloyd — the standard IVF coarse quantizer; probing ranks
+  lists by centroid INNER PRODUCT, the MIPS analogue of the cosine
+  probe the /neighbors index uses), rows reordered list-contiguously
+  IN THEIR QUANTIZED FORM (int8/fp8 bytes or int4-packed nibbles move
+  through HBM, scales reordered alongside — the byte-count lever and
+  the candidate-count lever compose).
+- **Search**: one (B, nlist) centroid matmul -> top-`nprobe` lists ->
+  gather + fused-dequant the candidate rows -> exact scores over the
+  candidates -> top-k, mapped back to global vocab ids.
+
+Approximation contract: scores of returned candidates are EXACT (same
+contraction as the blockwise head); only the candidate set is
+approximate. `--serve_mips_nprobe 0` (the default) keeps the exact
+blockwise head; accuracy evaluation always uses the exact head. Top-1
+agreement vs exact per nprobe is measured by experiments/quant_bench.py
+(BENCH_QUANT.md), with the tuned value documented as the smallest
+nprobe keeping agreement >= 0.99. nprobe = nlist searches every row and
+pins equality with the exact head in tests/test_quant.py.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from code2vec_tpu import obs
+
+
+class MipsHead:
+    """Built coarse quantizer + list-contiguous quantized rows on
+    device. Thread-safe for concurrent searches (read-only after build;
+    jit caches are internally locked by jax)."""
+
+    def __init__(self, centroids, rows, scales, list_pad, global_ids,
+                 *, int4_dim: Optional[int], real_vocab: int,
+                 nprobe: int, build_seconds: float):
+        import jax.numpy as jnp
+        self._centroids = jnp.asarray(centroids)
+        self._rows = jnp.asarray(rows)
+        self._scales = None if scales is None else jnp.asarray(scales)
+        self._list_pad = jnp.asarray(list_pad)
+        self._global_ids = jnp.asarray(global_ids)
+        self._int4_dim = int4_dim
+        self.real_vocab = int(real_vocab)
+        self.nlist = int(centroids.shape[0])
+        self.nprobe = max(1, min(int(nprobe), self.nlist))
+        self.build_seconds = build_seconds
+        obs.gauge("serving_mips_nlist",
+                  "coarse-quantizer size of the approximate-MIPS "
+                  "prediction head (0 = head not built)"
+                  ).set(self.nlist)
+
+    @classmethod
+    def build(cls, table, scales, *, real_vocab: int, nlist: int = 0,
+              nprobe: int = 8, int4_dim: Optional[int] = None,
+              kmeans_iters: int = 6, seed: int = 0, log=None
+              ) -> "MipsHead":
+        """Train the coarse quantizer over the REAL vocab rows of a
+        (possibly quantized) target table and reorder the quantized
+        payload list-contiguously. `table`/`scales` follow the
+        ops/quant.py conventions (scales None = f32 table; int4_dim set
+        = packed uint8 rows). Padded classifier rows (>= real_vocab)
+        are excluded up front — they can never be predicted."""
+        from code2vec_tpu.ops import quant
+        from code2vec_tpu.retrieval.index import assign_lists, train_kmeans
+
+        t0 = time.perf_counter()
+        table_np = np.asarray(table)[:real_vocab]
+        scales_np = None if scales is None else \
+            np.asarray(scales)[:real_vocab]
+        if scales_np is None:
+            x = np.asarray(table_np, np.float32)
+        elif int4_dim is not None:
+            x = quant.dequantize_rows_int4(table_np, scales_np, int4_dim)
+        elif table_np.dtype == np.int8:
+            x = quant.dequantize_rows(table_np, scales_np)
+        else:
+            # fp8 payload already viewed to its ml_dtypes type by the
+            # caller (release/runtime.py device params)
+            x = table_np.astype(np.float32) * scales_np
+        n = x.shape[0]
+        if nlist <= 0:
+            nlist = max(1, int(math.isqrt(n)))
+        nlist = min(int(nlist), n)
+        centroids = train_kmeans(x, nlist, iters=kmeans_iters, seed=seed)
+        nlist = centroids.shape[0]
+        assign = assign_lists(x, centroids)
+        # stable sort: ties in the scored matmul resolve identically
+        # run to run (same discipline as index-build)
+        order = np.argsort(assign, kind="stable")
+        counts = np.bincount(assign, minlength=nlist)
+        offsets = np.zeros(nlist + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        maxlen = max(int(counts.max()), 1)
+        pad = np.full((nlist, maxlen), -1, dtype=np.int32)
+        for i in range(nlist):
+            lo, hi = int(offsets[i]), int(offsets[i + 1])
+            pad[i, :hi - lo] = np.arange(lo, hi, dtype=np.int32)
+        head = cls(centroids, table_np[order],
+                   None if scales_np is None else scales_np[order],
+                   pad, order.astype(np.int32),
+                   int4_dim=int4_dim, real_vocab=n, nprobe=nprobe,
+                   build_seconds=round(time.perf_counter() - t0, 3))
+        if log:
+            log(f"MIPS head built over {n} target rows: nlist {nlist}, "
+                f"default nprobe {head.nprobe}, max list {maxlen}, "
+                f"{head.build_seconds}s")
+        return head
+
+    # ----------------------------------------------------------- search
+
+    def topk_fn(self, k: int, nprobe: Optional[int] = None):
+        """Pure (code_vectors (B, D) f32) -> (values (B, k), indices
+        (B, k) i32 global vocab ids) over the head's closure arrays —
+        jit-safe, composed into the serve step by release/runtime.py
+        and model_facade. Rows short of k candidates pad with -inf/0
+        (never happens at production nprobe; k is clamped by callers)."""
+        import jax
+        import jax.numpy as jnp
+        from code2vec_tpu.ops.quant import unpack_int4
+
+        nprobe = self.nprobe if nprobe is None else \
+            max(1, min(int(nprobe), self.nlist))
+        k = max(1, min(int(k), self.real_vocab))
+        centroids = self._centroids
+        rows, scales = self._rows, self._scales
+        list_pad, global_ids = self._list_pad, self._global_ids
+        int4_dim = self._int4_dim
+
+        def topk(code_vectors):
+            cv = code_vectors.astype(jnp.float32)
+            # (B, nlist) inner-product probe picks the searched lists
+            cscores = cv @ centroids.T
+            _, probe = jax.lax.top_k(cscores, nprobe)
+            cand = list_pad[probe].reshape(cv.shape[0], -1)
+            live = cand >= 0
+            safe = jnp.maximum(cand, 0)
+            gathered = jnp.take(rows, safe, axis=0)       # (B, P, D')
+            if int4_dim is not None:
+                gathered = unpack_int4(gathered, int4_dim)
+            scores = jnp.einsum("bd,bpd->bp", cv,
+                                gathered.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+            if scales is not None:
+                scores = scores * jnp.take(scales[:, 0], safe, axis=0)
+            scores = jnp.where(live, scores, -jnp.inf)
+            kk = min(k, scores.shape[1])
+            vals, pos = jax.lax.top_k(scores, kk)
+            idx = jnp.take_along_axis(cand, pos, axis=1)
+            # candidate positions -> global target-vocab ids; dead
+            # slots get the blockwise head's sentinel (value -inf,
+            # index 0)
+            idx = jnp.where(idx >= 0,
+                            jnp.take(global_ids, jnp.maximum(idx, 0)), 0)
+            if kk < k:
+                vals = jnp.pad(vals, ((0, 0), (0, k - kk)),
+                               constant_values=-jnp.inf)
+                idx = jnp.pad(idx, ((0, 0), (0, k - kk)))
+            return vals, idx.astype(jnp.int32)
+
+        return topk
+
+    def search(self, code_vectors: np.ndarray, k: int,
+               nprobe: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Host convenience wrapper (bench/tests): jitted `topk_fn`
+        cached per (k, nprobe)."""
+        import jax
+        key = (int(k), self.nprobe if nprobe is None else int(nprobe))
+        cache = getattr(self, "_search_fns", None)
+        if cache is None:
+            cache = self._search_fns = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = jax.jit(self.topk_fn(k, nprobe))
+        vals, idx = fn(np.asarray(code_vectors, np.float32))
+        return np.asarray(vals), np.asarray(idx)

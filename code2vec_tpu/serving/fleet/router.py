@@ -41,7 +41,6 @@ host drain.
 
 from __future__ import annotations
 
-import http.client
 import http.server
 import json
 import random
@@ -52,6 +51,9 @@ from code2vec_tpu import obs
 from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.serving.admission import (
     deadline_from_request, retry_after_seconds,
+)
+from code2vec_tpu.serving.forwarding import (
+    forward_with_retry, handle_admin_post,
 )
 
 DEFAULT_MODEL = "default"
@@ -219,93 +221,43 @@ class FleetRouter:
                 dict(trace_headers, **{
                     "Retry-After": str(retry_after_seconds(1.0))}))
             return
-        last_err = None
-        for attempt, (host_id, (addr, port)) in enumerate(ordered):
-            remaining = deadline.remaining()
-            if attempt and deadline.bounded and remaining <= 0:
-                # the budget died with the previous attempt: answer
-                # the guaranteed-late 504 honestly, don't dispatch it
-                _c_requests(endpoint, "expired").inc()
-                handler._reply(504, {
-                    "error": "deadline exhausted retrying hosts "
-                             f"({last_err})",
-                    "trace_id": trace.trace_id}, trace_headers)
-                return
-            if attempt:
-                _C_RETRIES.inc()
-            timeout = (min(300.0, max(remaining, 0.05))
-                       if deadline.bounded else 300)
-            try:
-                conn = http.client.HTTPConnection(addr, port,
-                                                  timeout=timeout)
-                try:
-                    # handler.path keeps the query string (`path` was
-                    # stripped for dispatch): ?debug=trace must reach
-                    # the replica
-                    conn.request("POST", handler.path, body=body,
-                                 headers=fwd_headers)
-                    resp = conn.getresponse()
-                    payload = resp.read()
-                    out_headers = {}
-                    for name in ("Retry-After", "X-Trace-Id",
-                                 "traceparent"):
-                        if resp.getheader(name):
-                            out_headers[name] = resp.getheader(name)
-                    # a replica always stamps these; belt-and-braces
-                    # for any terminal status that somehow lacks them
-                    out_headers.setdefault("X-Trace-Id", trace.trace_id)
-                    out_headers.setdefault("traceparent",
-                                           trace.traceparent())
-                    _c_requests(endpoint, "forwarded").inc()
-                    handler._reply(
-                        resp.status, payload, out_headers,
-                        ctype=resp.getheader("Content-Type",
-                                             "application/json"))
-                    return
-                finally:
-                    conn.close()
-            except (OSError, http.client.HTTPException) as e:
-                # dead / draining / mid-restart host — including one
-                # that died MID-RESPONSE (IncompleteRead/BadStatusLine
-                # are HTTPException, not OSError): the client never
-                # sees a torn response — retry the next candidate
-                last_err = f"{host_id}: {type(e).__name__}: {e}"
-                continue
-        _c_requests(endpoint, "unreachable").inc()
-        handler._reply(503, {
-            "error": f"no host reachable for model {model!r} "
-                     f"({last_err})",
-            "trace_id": trace.trace_id},
-            dict(trace_headers,
-                 **{"Retry-After": str(retry_after_seconds(1.0))}))
+        # One forward/retry loop for the whole serving tier
+        # (serving/forwarding.py; the supervisor proxy is the
+        # single-host degenerate case of this call). handler.path keeps
+        # the query string (`path` was stripped for dispatch):
+        # ?debug=trace must reach the replica.
+        forward_with_retry(
+            method="POST", path=handler.path, body=body,
+            fwd_headers=fwd_headers,
+            targets=[(host_id, addr, port)
+                     for host_id, (addr, port) in ordered],
+            deadline=deadline, trace=trace,
+            reply=lambda code, payload, headers, ctype:
+                handler._reply(code, payload, headers, ctype=ctype),
+            what="hosts",
+            unreachable_error=f"no host reachable for model {model!r}",
+            retry_after=str(retry_after_seconds(1.0)),
+            retry_counter=_C_RETRIES,
+            on_outcome=lambda outcome:
+                _c_requests(endpoint, outcome).inc())
 
     # ------------------------------------------------------------ admin
 
     def _admin(self, handler, path: str) -> None:
-        try:
-            length = int(handler.headers.get("Content-Length", 0))
-            raw = handler.rfile.read(length) if length else b"{}"
-            payload = json.loads(
-                raw.decode("utf-8", errors="replace") or "{}")
-            if not isinstance(payload, dict):
-                raise ValueError("body must be a JSON object")
+        def dispatch(payload: dict):
             if path == "/admin/reload":
-                code, out = self.control.request_swap(payload)
-            elif path == "/admin/scale":
-                code, out = self.control.request_scale(
+                return self.control.request_swap(payload)
+            if path == "/admin/scale":
+                return self.control.request_scale(
                     payload.get("host"), payload.get("replicas"))
-            elif path == "/admin/drain":
-                code, out = self.control.drain_host(payload.get("host"))
-            else:
-                code, out = 404, {"error": f"no such endpoint: {path}"}
-        except (ValueError, json.JSONDecodeError) as e:
-            code, out = (409 if "in flight" in str(e) else 400,
-                         {"error": str(e)})
-        except KeyError as e:
-            code, out = 404, {"error": f"no such host: {e}"}
-        except Exception as e:  # noqa: BLE001
-            code, out = 500, {"error": f"{type(e).__name__}: {e}"}
-        handler._reply(code, out)
+            if path == "/admin/drain":
+                return self.control.drain_host(payload.get("host"))
+            return 404, {"error": f"no such endpoint: {path}"}
+
+        handle_admin_post(
+            handler, dispatch,
+            lambda code, out: handler._reply(code, out),
+            conflict_409=True, keyerror_is_missing_host=True)
 
     # ------------------------------------------------------------- misc
 

@@ -1,0 +1,158 @@
+"""Shared HTTP forwarding + admin-dispatch core for the serving tier.
+
+The fleet router (serving/fleet/router.py) and the supervisor's proxy
+fallback (serving/supervisor.py) grew near-copies of the same
+forward-with-retry loop — walk an ordered candidate list, bound every
+attempt's socket timeout by the request's remaining X-Deadline-Ms
+budget, answer a guaranteed-late retry as an honest 504 instead of
+dispatching it, retry connection failures INCLUDING a backend that died
+mid-response (IncompleteRead/BadStatusLine are HTTPException, not
+OSError), and relay trace headers on every terminal status — plus three
+copies of the admin-POST body parse/dispatch/error-mapping. This module
+is the single implementation; the supervisor proxy is the single-host
+degenerate case of the router's loop (PR-13 recorded follow-on).
+
+Metric registrations stay at the call sites (scripts/check_metrics_doc
+walks literal registrations): callers pass counter OBJECTS in.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Callable, List, Optional, Sequence, Tuple
+
+# Socket-timeout ceiling for an unbounded-deadline forward (the
+# pre-refactor literal in both loops).
+_UNBOUNDED_TIMEOUT_S = 300.0
+
+# Response headers relayed from a backend to the client: the retry hint
+# and the PR-12 trace-correlation contract.
+_RELAY_HEADERS = ("Retry-After", "X-Trace-Id", "traceparent")
+
+
+def forward_with_retry(
+    *,
+    method: str,
+    path: str,
+    body: bytes,
+    fwd_headers: dict,
+    targets: Sequence[Tuple[str, str, int]],   # (label, address, port)
+    deadline,                                   # admission.Deadline
+    trace,                                      # reqtrace.RequestTrace
+    reply: Callable[[int, bytes, dict, str], None],
+    what: str,                                  # "hosts" / "replicas"
+    unreachable_error: str,
+    retry_after: Optional[str] = None,
+    retry_counter=None,
+    on_outcome: Optional[Callable[[str], None]] = None,
+) -> None:
+    """Forward one request along `targets`, retrying connection
+    failures within the deadline budget; answers the client through
+    `reply(status, payload_bytes, headers, content_type)` exactly once.
+
+    Outcomes reported through `on_outcome`: "forwarded" (a backend
+    answered — any status), "expired" (budget died retrying),
+    "unreachable" (every candidate refused/tore the connection).
+    Every locally-generated terminal status carries the trace headers
+    + a trace_id body field; the unreachable 503 adds `retry_after`
+    when given."""
+    trace_headers = {"X-Trace-Id": trace.trace_id,
+                     "traceparent": trace.traceparent()}
+
+    def json_reply(code: int, error: str, extra: Optional[dict] = None):
+        payload = json.dumps(
+            {"error": error, "trace_id": trace.trace_id},
+            sort_keys=True).encode() + b"\n"
+        reply(code, payload, dict(trace_headers, **(extra or {})),
+              "application/json")
+
+    last_err = None
+    for attempt, (label, addr, port) in enumerate(targets):
+        remaining = deadline.remaining()
+        if attempt and deadline.bounded and remaining <= 0:
+            # the budget died with the previous attempt: a retry
+            # dispatched now can only produce a LATE 504 — answer it
+            # honestly instead
+            if on_outcome:
+                on_outcome("expired")
+            json_reply(504, f"deadline exhausted retrying {what} "
+                            f"({last_err})")
+            return
+        if attempt and retry_counter is not None:
+            retry_counter.inc()
+        timeout = (min(_UNBOUNDED_TIMEOUT_S, max(remaining, 0.05))
+                   if deadline.bounded else _UNBOUNDED_TIMEOUT_S)
+        try:
+            conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+            try:
+                conn.request(method, path, body=body, headers=fwd_headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                out_headers = {}
+                for name in _RELAY_HEADERS:
+                    if resp.getheader(name):
+                        out_headers[name] = resp.getheader(name)
+                # a backend always stamps these; belt-and-braces for
+                # any terminal status that somehow lacks them
+                out_headers.setdefault("X-Trace-Id", trace.trace_id)
+                out_headers.setdefault("traceparent", trace.traceparent())
+                if on_outcome:
+                    on_outcome("forwarded")
+                reply(resp.status, payload, out_headers,
+                      resp.getheader("Content-Type", "application/json"))
+                return
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            # dead / draining / mid-restart backend — including one
+            # that died MID-RESPONSE (IncompleteRead/BadStatusLine are
+            # HTTPException, not OSError): the client never sees a
+            # torn response — retry the next candidate
+            last_err = f"{label}: {type(e).__name__}: {e}"
+            continue
+    if on_outcome:
+        on_outcome("unreachable")
+    json_reply(503, f"{unreachable_error} ({last_err})",
+               {"Retry-After": retry_after} if retry_after else None)
+
+
+def read_json_object(handler) -> dict:
+    """Read + parse an HTTP request body as a JSON object (empty body =
+    {}); raises ValueError on anything that is not a dict."""
+    length = int(handler.headers.get("Content-Length", 0))
+    raw = handler.rfile.read(length) if length else b"{}"
+    payload = json.loads(raw.decode("utf-8", errors="replace") or "{}")
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    return payload
+
+
+def handle_admin_post(
+    handler,
+    dispatch: Callable[[dict], Tuple[int, dict]],
+    reply: Callable[[int, dict], None],
+    *,
+    conflict_409: bool = False,
+    keyerror_is_missing_host: bool = False,
+) -> None:
+    """The admin-POST skeleton shared by the fleet router, the
+    supervisor proxy and the TelemetryServer: parse the JSON body,
+    dispatch, map errors (ValueError -> 400; with `conflict_409`, an
+    "in flight" ValueError -> 409; with `keyerror_is_missing_host`, a
+    KeyError -> 404 naming the host; anything else -> 500 as an HTTP
+    error — the control plane must never see a torn connection it
+    would misread as a dead backend)."""
+    try:
+        code, out = dispatch(read_json_object(handler))
+    except (ValueError, json.JSONDecodeError) as e:
+        code = (409 if conflict_409 and "in flight" in str(e) else 400)
+        out = {"error": str(e)}
+    except KeyError as e:
+        if keyerror_is_missing_host:
+            code, out = 404, {"error": f"no such host: {e}"}
+        else:
+            code, out = 500, {"error": f"KeyError: {e}"}
+    except Exception as e:  # noqa: BLE001
+        code, out = 500, {"error": f"{type(e).__name__}: {e}"}
+    reply(code, out)

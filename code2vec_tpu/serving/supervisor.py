@@ -68,6 +68,9 @@ from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.serving.admission import (
     deadline_from_request, retry_after_seconds,
 )
+from code2vec_tpu.serving.forwarding import (
+    forward_with_retry, handle_admin_post,
+)
 
 REPLICA_ENV = "C2V_SERVE_REPLICA"
 FORCE_PROXY_ENV = "C2V_SERVE_FORCE_PROXY"
@@ -75,6 +78,11 @@ FORCE_PROXY_ENV = "C2V_SERVE_FORCE_PROXY"
 # supervisor declares a hung STARTUP (model build + jit warmup can
 # legitimately take tens of seconds on a cold replica).
 STARTUP_GRACE_S = 120.0
+# Cache-warmth window for scale-down victim selection: the monitor
+# loop re-baselines every replica's cache-hit counter at this cadence,
+# so "warmth" means hits over the last window (up to 2x this), not
+# lifetime.
+_WARMTH_WINDOW_S = 60.0
 # Hard ceiling on /admin/scale: the per-host replica count is bounded
 # by cores/HBM, not ambition — a runaway autoscaler must not fork-bomb
 # the host.
@@ -168,6 +176,13 @@ class _Replica:
         # a SIGHUP before serve_main installs its handler would KILL a
         # still-starting replica (default SIGHUP disposition)
         self.pending_reload = False
+        # cache-warmth window baseline: serving_cache_hits_total at the
+        # last warmth sample (monitor loop, ~every _WARMTH_WINDOW_S).
+        # Scale-down ranks replicas by hits SINCE this baseline — the
+        # lifetime counter measures uptime, not current hit rate, and
+        # would protect a long-lived replica whose cache stopped
+        # absorbing traffic an hour ago.
+        self.warmth_prev = 0.0
 
     @property
     def alive(self) -> bool:
@@ -364,10 +379,7 @@ class Supervisor:
             self.flight.event("replica_scale_up", replica=replica.index)
         excess = len(active) - desired
         if excess > 0:
-            # retire the newest first: replica 0's warm cache and
-            # compiled steps are the oldest and most valuable
-            for replica in sorted(active, key=lambda r: r.index,
-                                  reverse=True)[:excess]:
+            for replica in self._scale_down_victims(active, excess):
                 replica.draining = True
                 replica.drain_started = time.monotonic()
                 replica.restart_at = None
@@ -377,6 +389,54 @@ class Supervisor:
                                   replica=replica.index)
                 self.log(f"Replica {replica.index} draining "
                          f"(scale-down)")
+
+    @staticmethod
+    def _read_cache_hits(replica: _Replica) -> float:
+        """Lifetime serving_cache_hits_total from the replica's
+        telemetry snapshot; 0 for a missing/unreadable one (a replica
+        still starting has absorbed nothing)."""
+        from code2vec_tpu.serving import telemetry
+        if not (replica.metrics_path
+                and os.path.isfile(replica.metrics_path)):
+            return 0.0
+        try:
+            with open(replica.metrics_path,
+                      encoding="utf-8", errors="replace") as f:
+                return telemetry.sum_family(
+                    f.read(), "serving_cache_hits_total")
+        except (OSError, ValueError):
+            return 0.0
+
+    def _sample_warmth_baselines(self) -> None:
+        """Roll the cache-warmth window: every live replica's current
+        lifetime hit count becomes the next window's baseline (monitor
+        loop, ~every _WARMTH_WINDOW_S)."""
+        for replica in list(self.replicas):
+            replica.warmth_prev = self._read_cache_hits(replica)
+
+    def _scale_down_victims(self, active: List[_Replica],
+                            excess: int) -> List[_Replica]:
+        """Cache-warmth-aware scale-down selection (PR-13 follow-on):
+        retire the replicas whose prediction caches absorbed the
+        FEWEST hits over the current warmth window (hits since the
+        last ~_WARMTH_WINDOW_S baseline — lifetime counters measure
+        uptime, not warmth, and the repo's own autoscaler discipline
+        is windowed deltas for exactly that reason). A replica without
+        a readable snapshot counts 0; a restarted replica's
+        counter-reset clamps to 0 (its fresh cache IS cold). Ties (a
+        cold host where every window is 0) fall back to newest-first,
+        the previous policy: replica 0's compiled steps are the
+        oldest."""
+        hits = {replica: max(0.0, self._read_cache_hits(replica)
+                             - replica.warmth_prev)
+                for replica in active}
+        victims = sorted(active,
+                         key=lambda r: (hits[r], -r.index))[:excess]
+        for v in victims:
+            self.log(f"Scale-down victim: replica {v.index} "
+                     f"(window cache hits {hits[v]:.0f} — fewest "
+                     f"among {len(active)} active)")
+        return victims
 
     def _retire(self, replica: _Replica) -> None:
         """A drained (scale-down) replica exited: reap and REMOVE it —
@@ -730,9 +790,10 @@ class Supervisor:
             def log_message(self, *args):
                 pass
 
-            def _reply(self, code, body, headers=None):
+            def _reply(self, code, body, headers=None,
+                       ctype="application/json"):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
@@ -740,7 +801,6 @@ class Supervisor:
                 self.wfile.write(body)
 
             def _forward(self, method: str) -> None:
-                import http.client
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 # proxy-generated terminal statuses carry trace ids
@@ -769,68 +829,22 @@ class Supervisor:
                 with sup._rr_lock:
                     start = sup._rr_next
                     sup._rr_next += 1
-                last_err = None
-                for k in range(len(ports)):
-                    port = ports[(start + k) % len(ports)]
-                    remaining = deadline.remaining()
-                    if k and deadline.bounded and remaining <= 0:
-                        # the budget died with the previous attempt: a
-                        # retry dispatched now can only produce a LATE
-                        # 504 — answer it honestly instead
-                        self._reply(504, json.dumps(
-                            {"error": "deadline exhausted retrying "
-                                      f"replicas ({last_err})",
-                             "trace_id": trace.trace_id}
-                        ).encode() + b"\n", trace_headers)
-                        return
-                    timeout = (min(300.0, max(remaining, 0.05))
-                               if deadline.bounded else 300)
-                    try:
-                        conn = http.client.HTTPConnection(
-                            sup.config.serve_host, port,
-                            timeout=timeout)
-                        try:
-                            conn.request(method, self.path, body=body,
-                                         headers=fwd_headers)
-                            resp = conn.getresponse()
-                            payload = resp.read()
-                            headers = {}
-                            # trace headers ride back through the
-                            # proxy: the id must reach the client on
-                            # EVERY terminal status or proxy mode
-                            # breaks the correlation contract
-                            for name in ("Retry-After", "X-Trace-Id",
-                                         "traceparent"):
-                                if resp.getheader(name):
-                                    headers[name] = resp.getheader(name)
-                            ctype = resp.getheader(
-                                "Content-Type", "application/json")
-                            self.send_response(resp.status)
-                            self.send_header("Content-Type", ctype)
-                            self.send_header("Content-Length",
-                                             str(len(payload)))
-                            for hk, hv in headers.items():
-                                self.send_header(hk, hv)
-                            self.end_headers()
-                            self.wfile.write(payload)
-                            return
-                        finally:
-                            conn.close()
-                    except (OSError,
-                            http.client.HTTPException) as e:
-                        # dead/draining replica — incl. one killed
-                        # MID-RESPONSE (IncompleteRead is not an
-                        # OSError): honest retry on the next one — the
-                        # client never sees a torn or corrupt response
-                        last_err = f"{type(e).__name__}: {e}"
-                        continue
-                self._reply(503, json.dumps(
-                    {"error": f"all replicas unreachable "
-                              f"({last_err})",
-                     "trace_id": trace.trace_id}).encode() + b"\n",
-                    dict(trace_headers,
-                         **{"Retry-After": str(
-                             retry_after_seconds(1.0))}))
+                # Round-robin order, then the SAME deadline-bounded
+                # forward/retry loop the fleet router runs
+                # (serving/forwarding.py): this proxy is its
+                # single-host degenerate case.
+                ordered = [ports[(start + k) % len(ports)]
+                           for k in range(len(ports))]
+                forward_with_retry(
+                    method=method, path=self.path, body=body,
+                    fwd_headers=fwd_headers,
+                    targets=[(f"replica:{port}", sup.config.serve_host,
+                              port) for port in ordered],
+                    deadline=deadline, trace=trace,
+                    reply=self._reply,
+                    what="replicas",
+                    unreachable_error="all replicas unreachable",
+                    retry_after=str(retry_after_seconds(1.0)))
 
             def do_GET(self):  # noqa: N802
                 # fleet views are answered HERE, not forwarded: a
@@ -875,24 +889,12 @@ class Supervisor:
                 self._forward("POST")
 
             def _admin(self, path: str) -> None:
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(length) if length else b"{}"
-                    payload = json.loads(
-                        raw.decode("utf-8", errors="replace") or "{}")
-                    if not isinstance(payload, dict):
-                        raise ValueError("body must be a JSON object")
-                    if path == "/admin/scale":
-                        code, out = sup._admin_scale(payload)
-                    else:
-                        code, out = sup._admin_reload(payload)
-                except (ValueError, json.JSONDecodeError) as e:
-                    code, out = 400, {"error": str(e)}
-                except Exception as e:  # noqa: BLE001
-                    code, out = 500, {"error":
-                                      f"{type(e).__name__}: {e}"}
-                self._reply(code, json.dumps(
-                    out, sort_keys=True).encode() + b"\n")
+                handle_admin_post(
+                    self,
+                    (sup._admin_scale if path == "/admin/scale"
+                     else sup._admin_reload),
+                    lambda code, out: self._reply(code, json.dumps(
+                        out, sort_keys=True).encode() + b"\n"))
 
         class _ProxyServer(http.server.ThreadingHTTPServer):
             # match the replica listeners: a burst must not be refused
@@ -945,6 +947,7 @@ class Supervisor:
             self._spawn(replica)
         self._write_heartbeat("supervising")
         last_hb = time.monotonic()
+        last_warmth = time.monotonic()
         try:
             while not self._stop.is_set():
                 # liveness pipes double as the wakeup: a dying replica
@@ -980,6 +983,9 @@ class Supervisor:
                             self._escalated = True
                             self._stop.set()
                             break
+                if now - last_warmth >= _WARMTH_WINDOW_S:
+                    self._sample_warmth_baselines()
+                    last_warmth = now
                 if now - last_hb >= 1.0:
                     self._write_heartbeat("supervising")
                     last_hb = now

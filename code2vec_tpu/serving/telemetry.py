@@ -369,25 +369,17 @@ class TelemetryServer:
                         {"error": f"no such endpoint: {path}"}
                     ).encode() + b"\n")
                     return
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(length) if length else b"{}"
-                    payload = json.loads(
-                        raw.decode("utf-8", errors="replace") or "{}")
-                    if not isinstance(payload, dict):
-                        raise ValueError("body must be a JSON object")
-                    code, body = handler(payload)
-                    self._respond(code, json.dumps(
-                        body, sort_keys=True).encode() + b"\n")
-                except (ValueError, json.JSONDecodeError) as e:
-                    self._respond(400, json.dumps(
-                        {"error": str(e)}).encode() + b"\n")
-                except Exception as e:  # noqa: BLE001 — the control
-                    # plane must get an HTTP error, never a torn
-                    # connection it would misread as a dead host
-                    self._respond(500, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}
-                    ).encode() + b"\n")
+                # shared admin-POST skeleton (serving/forwarding.py):
+                # parse/dispatch/error-map — the control plane must get
+                # an HTTP error, never a torn connection it would
+                # misread as a dead host
+                from code2vec_tpu.serving.forwarding import (
+                    handle_admin_post,
+                )
+                handle_admin_post(
+                    self, handler,
+                    lambda code, body: self._respond(code, json.dumps(
+                        body, sort_keys=True).encode() + b"\n"))
 
         self.merged_metrics_fn = merged_metrics_fn
         self.fleet_fn = fleet_fn

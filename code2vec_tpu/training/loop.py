@@ -220,6 +220,15 @@ class Trainer:
         g_epoch = reg.gauge("train_epoch", "current epoch number")
         g_rss = reg.gauge("process_rss_bytes", "current resident set size")
 
+        # Overlapped train step (parallel/overlap.py): the composite
+        # announces its bucket plan once — the operator reading the log
+        # knows whether the dispatch histogram covers one program or
+        # 1 + K (and the bench A/B can assert which arm it measured).
+        overlap_desc = getattr(self.train_step, "overlap_description",
+                               None)
+        if overlap_desc:
+            log(f"Overlapped train step active: {overlap_desc}")
+
         batch_num = 0              # batches this run
         trace_active = False       # profiler trace in flight
         epoch = self.initial_epoch

@@ -122,6 +122,16 @@ class TrainStepBuilder:
                 f"but config.use_sparse_embedding_update="
                 f"{self.config.use_sparse_embedding_update}; pass the same "
                 f"config to create_train_state and TrainStepBuilder.")
+        if getattr(self.config, "overlap_grad_allreduce", False) \
+                and not sparse and not self.manual:
+            # Bucketed async all-reduce overlap (parallel/overlap.py):
+            # backward + K per-bucket reduce+apply dispatches instead
+            # of one monolithic program. config.verify keeps this to
+            # the dense GSPMD data-parallel case (tp = cp = 1).
+            from code2vec_tpu.parallel.overlap import (
+                build_overlap_train_step,
+            )
+            return build_overlap_train_step(self, example_state)
         if self.manual:
             if sparse:
                 return self._make_manual_sparse_train_step(example_state)

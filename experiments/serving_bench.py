@@ -655,6 +655,66 @@ TRACING_OUT_PATH = os.path.join(
     REPO, "experiments", "results", "serving_tracing.json")
 
 
+def p95_main() -> None:
+    """Measure the healthy-load total-phase p95 — the exact signal the
+    fleet autoscaler's `--fleet_scale_up_p95_ms` trigger reads
+    (serving/fleet/control.py computes histogram_quantile over
+    serving_request_seconds{phase=total} windows) — and derive the
+    shipped default: 10x the healthy p95, rounded up to 100 ms.
+
+    Rationale for 10x: the p95 trigger exists to catch the degradation
+    mode the shed-rate trigger CANNOT see — a host that got an order of
+    magnitude slower without (yet) shedding (queueing behind a sick
+    extractor, a noisy neighbor, swap pressure). Healthy p95 swings
+    ~±30% run to run on this harness and model/hardware mixes vary
+    several-fold across deployments, so a small multiple would flap
+    exactly the hosts that are fine; 10x healthy is unambiguous
+    distress while still a quarter of the 2000 ms default deadline —
+    the autoscaler reacts BEFORE requests start expiring. Recorded in
+    experiments/results/serving_p95.json and the README knob table.
+    """
+    import math
+
+    def log(msg: str) -> None:
+        print(msg, flush=True)
+
+    from code2vec_tpu import obs
+    from code2vec_tpu.serving import telemetry
+
+    log("Building model + corpus for the p95 probe ...")
+    model = build_model()
+    sources = make_corpus()
+    scenario = run_scenario(model, sources, n_clients=4,
+                            cache_entries=0, log=log)
+    text = obs.default_registry().render_prometheus()
+    buckets = telemetry.histogram_buckets(
+        text, "serving_request_seconds", phase="total")
+    p95_s = telemetry.quantile_from_buckets(buckets, None, 0.95)
+    assert p95_s is not None, "no total-phase samples recorded"
+    default_ms = math.ceil(p95_s * 1000.0 * 10 / 100.0) * 100.0
+    result = {
+        "bench": "fleet_scale_up_p95_default",
+        "harness": "run_scenario(4 clients, cache off) — healthy "
+                   "uncontended load, server-side "
+                   "serving_request_seconds{phase=total} histogram "
+                   "(the autoscaler's own signal)",
+        "scenario": {k: v for k, v in scenario.items()
+                     if not k.startswith("_")},
+        "healthy_total_p95_ms": round(p95_s * 1000.0, 1),
+        "rule": "default = healthy p95 x 10, rounded up to 100 ms",
+        "derived_default_ms": default_ms,
+    }
+    out = os.path.join(REPO, "experiments", "results",
+                       "serving_p95.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    log(f"healthy total-phase p95 {result['healthy_total_p95_ms']} ms "
+        f"-> derived --fleet_scale_up_p95_ms default "
+        f"{default_ms:g} ms; wrote {out}")
+
+
 def tracing_main() -> None:
     """PR-2-discipline tracing-overhead A/B: the cache-OFF serving
     path (every request pays the full traced pipeline) with
@@ -990,5 +1050,7 @@ if __name__ == "__main__":
         tracing_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
         fleet_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "p95":
+        p95_main()
     else:
         main()

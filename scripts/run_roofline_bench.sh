@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Run the roofline-PR benches with a hard timeout and crash
+# diagnostics, matching scripts/run_chaos.sh conventions:
+#
+#   1. the 2-host bucketed all-reduce overlap A/B
+#      (experiments/overlap_bench.py -> experiments/results/overlap.json
+#       + the BENCH_ROOFLINE.md overlap section);
+#   2. the `roofline` pytest marker (overlap parity, bucket-planner
+#      laws, fp8/int4 round-trip bounds, MIPS-head agreement pins).
+#
+# The overlap bench drives a real 2-process jax.distributed pair —
+# a collectives bug tends to surface as a HANG (one host waiting on a
+# dead peer's all-reduce), so the run is wall-clock bounded and, on
+# failure, any metrics snapshots left under the run dir are dumped.
+#
+# Usage: scripts/run_roofline_bench.sh [extra args passed to the bench]
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+RUN_DIR="$(mktemp -d "${TMPDIR:-/tmp}/c2v-roofline.XXXXXX")"
+LOG="$RUN_DIR/bench.log"
+export C2V_CHAOS_DIAG_DIR="$RUN_DIR"
+
+# Wall-clock backstops: the 2-host A/B finishes in ~2 min on a dev CPU
+# (two arms x compile + 20 steps each, per process); the marker suite
+# in ~1 min. The timeouts catch a gloo hang, not a slow run.
+BENCH_BUDGET=900
+TEST_BUDGET=600
+rc=0
+
+echo "=== overlap A/B bench (budget ${BENCH_BUDGET}s) ==="
+timeout -k 20 "$BENCH_BUDGET" \
+    env JAX_PLATFORMS=cpu python experiments/overlap_bench.py "$@" \
+    2>&1 | tee "$LOG"
+bench_rc=${PIPESTATUS[0]}
+if [ "$bench_rc" -eq 124 ] || [ "$bench_rc" -eq 137 ]; then
+    echo "BENCH TIMED OUT (rc=$bench_rc): likely a collective hang" \
+        | tee -a "$LOG"
+fi
+[ "$bench_rc" -ne 0 ] && rc=$bench_rc
+
+echo "=== roofline marker suite (budget ${TEST_BUDGET}s) ==="
+timeout -k 20 "$TEST_BUDGET" \
+    env JAX_PLATFORMS=cpu python -m pytest -q -m roofline \
+    -p no:cacheprovider -p no:xdist -p no:randomly tests/ \
+    2>&1 | tee -a "$LOG"
+test_rc=${PIPESTATUS[0]}
+[ "$test_rc" -ne 0 ] && rc=$test_rc
+
+if [ "$rc" -ne 0 ]; then
+    echo "=== roofline run FAILED (rc=$rc): dumping diagnostics ==="
+    find "$RUN_DIR" -maxdepth 4 -type f \
+        \( -name '*heartbeat*.json' -o -name 'hb*.json' \
+           -o -name '*.prom' -o -name '*metrics*' \) 2>/dev/null \
+        | while read -r f; do
+        echo "--- $f ---"
+        cat "$f"
+        echo
+    done
+    echo "full log: $LOG"
+else
+    rm -rf "$RUN_DIR"
+fi
+exit "$rc"

@@ -1037,3 +1037,138 @@ def test_fleet_multi_model_groups_and_host_scale_e2e(
     control.stop()
     thread.join(timeout=60)
     assert rc_holder["rc"] == 0
+
+
+# --------------------- shared forwarding core (serving/forwarding.py)
+
+
+class _FakeDeadline:
+    def __init__(self, remaining_values, bounded=True):
+        self._vals = list(remaining_values)
+        self.bounded = bounded
+
+    def remaining(self):
+        return self._vals.pop(0) if self._vals else 0.0
+
+
+class _FakeTrace:
+    trace_id = "f" * 32
+
+    def traceparent(self):
+        return f"00-{self.trace_id}-{'b' * 16}-01"
+
+
+def _run_forward(targets, deadline=None, **kw):
+    from code2vec_tpu.serving.forwarding import forward_with_retry
+    replies = []
+    outcomes = []
+    forward_with_retry(
+        method="POST", path="/predict", body=b"x",
+        fwd_headers={}, targets=targets,
+        deadline=deadline or _FakeDeadline([10.0] * 8),
+        trace=_FakeTrace(),
+        reply=lambda *a: replies.append(a),
+        what="replicas", unreachable_error="all replicas unreachable",
+        on_outcome=outcomes.append, **kw)
+    assert len(replies) == 1, "reply must be called exactly once"
+    return replies[0], outcomes
+
+
+def test_forwarding_relays_backend_and_stamps_trace():
+    srv = _stub_backend("fp-fwd")
+    port = srv.server_address[1]
+    try:
+        (code, payload, headers, ctype), outcomes = _run_forward(
+            [("b", "127.0.0.1", port)])
+        assert code == 200 and outcomes == ["forwarded"]
+        assert headers["X-Trace-Id"]  # stamped even when backend lacks it
+        assert json.loads(payload)["model_fingerprint"] == "fp-fwd"
+    finally:
+        srv.shutdown()
+
+
+def test_forwarding_retries_dead_then_succeeds_and_counts():
+    srv = _stub_backend("fp-retry")
+    port = srv.server_address[1]
+    dead = _free_port()
+
+    class _Ctr:
+        n = 0
+
+        def inc(self):
+            self.n += 1
+
+    ctr = _Ctr()
+    try:
+        (code, _, _, _), outcomes = _run_forward(
+            [("dead", "127.0.0.1", dead), ("live", "127.0.0.1", port)],
+            retry_counter=ctr)
+        assert code == 200 and outcomes == ["forwarded"]
+        assert ctr.n == 1
+    finally:
+        srv.shutdown()
+
+
+def test_forwarding_expired_budget_is_honest_504():
+    dead = _free_port()
+    (code, payload, headers, _), outcomes = _run_forward(
+        [("d1", "127.0.0.1", dead), ("d2", "127.0.0.1", dead)],
+        deadline=_FakeDeadline([0.5, 0.0]))
+    assert code == 504 and outcomes == ["expired"]
+    body = json.loads(payload)
+    assert "deadline exhausted retrying replicas" in body["error"]
+    assert body["trace_id"] == _FakeTrace.trace_id
+    assert headers["X-Trace-Id"] == _FakeTrace.trace_id
+
+
+def test_forwarding_all_unreachable_503_with_retry_after():
+    dead = _free_port()
+    (code, payload, headers, _), outcomes = _run_forward(
+        [("d1", "127.0.0.1", dead)], retry_after="1.2")
+    assert code == 503 and outcomes == ["unreachable"]
+    assert "all replicas unreachable" in json.loads(payload)["error"]
+    assert headers["Retry-After"] == "1.2"
+    assert headers["traceparent"].startswith("00-" + _FakeTrace.trace_id)
+
+
+def test_handle_admin_post_error_mapping():
+    from code2vec_tpu.serving.forwarding import handle_admin_post
+
+    class _Handler:
+        headers = {"Content-Length": "2"}
+
+        class rfile:
+            @staticmethod
+            def read(n):
+                return b"{}"
+
+    out = []
+
+    def run(dispatch, **kw):
+        out.clear()
+        handle_admin_post(_Handler(), dispatch,
+                          lambda code, body: out.append((code, body)),
+                          **kw)
+        return out[0]
+
+    assert run(lambda p: (200, {"ok": True})) == (200, {"ok": True})
+    code, body = run(lambda p: (_ for _ in ()).throw(
+        ValueError("bad knob")))
+    assert code == 400 and "bad knob" in body["error"]
+    # "in flight" ValueError -> 409 only when the caller opts in
+    code, _ = run(lambda p: (_ for _ in ()).throw(
+        ValueError("a swap is already in flight")), conflict_409=True)
+    assert code == 409
+    code, _ = run(lambda p: (_ for _ in ()).throw(
+        ValueError("a swap is already in flight")))
+    assert code == 400
+    # KeyError -> 404 naming the host only when the caller opts in
+    code, body = run(lambda p: (_ for _ in ()).throw(KeyError("h7")),
+                     keyerror_is_missing_host=True)
+    assert code == 404 and "h7" in body["error"]
+    code, _ = run(lambda p: (_ for _ in ()).throw(KeyError("h7")))
+    assert code == 500
+    # anything else -> 500 as an HTTP error, never a torn connection
+    code, body = run(lambda p: (_ for _ in ()).throw(
+        RuntimeError("boom")))
+    assert code == 500 and "RuntimeError" in body["error"]

@@ -1,0 +1,208 @@
+"""Bucketed async all-reduce overlap suite (parallel/overlap.py).
+
+Pins the roofline PR's correctness contract: the overlapped composite
+(backward + K bucket reduce+apply dispatches) computes the SAME step as
+the unbucketed single-program GSPMD step — loss bit-equal, params
+within a documented float tolerance (the program split changes XLA's
+fusion/reduction order for the token table's two-gather gradient; the
+mesh path additionally reorders the cross-shard sum) — plus the bucket
+planner's size/order laws and the config guard rails.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+
+pytestmark = pytest.mark.roofline
+
+# Documented parity tolerance (see module docstring): everything
+# observed is <= 2e-9 absolute on the tiny model; the bound leaves room
+# for platform-dependent fusion without letting a real bug through.
+PARITY_RTOL = 2e-6
+PARITY_ATOL = 1e-7
+
+
+def _build(overlap, mesh=None, *, dropout_keep=1.0, bucket_mb=0.003,
+           nu_dtype="bfloat16"):
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+    from code2vec_tpu.training.step import TrainStepBuilder
+    config = Config(train_data_path_prefix="<t>", train_batch_size=8,
+                    max_contexts=6, compute_dtype="float32",
+                    dropout_keep_rate=dropout_keep,
+                    dp=(2 if mesh is not None else 1),
+                    adam_nu_dtype=nu_dtype,
+                    overlap_grad_allreduce=overlap,
+                    overlap_bucket_mb=bucket_mb)
+    dims = ModelDims(token_vocab_size=50, path_vocab_size=40,
+                     target_vocab_size=30, token_dim=8, path_dim=8)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=dropout_keep)
+    opt = make_optimizer(config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(0),
+                               mesh=mesh, config=config)
+    step = TrainStepBuilder(module, opt, config,
+                            mesh=mesh).make_train_step(state)
+    return step, state
+
+
+def _batch(mesh=None):
+    rng = np.random.default_rng(3)
+    b, m = 8, 6
+    arrays = (rng.integers(2, 50, (b, m)).astype(np.int32),
+              rng.integers(2, 40, (b, m)).astype(np.int32),
+              rng.integers(2, 50, (b, m)).astype(np.int32),
+              np.ones((b, m), np.float32),
+              rng.integers(2, 30, (b,)).astype(np.int32),
+              np.ones((b,), bool))
+    if mesh is None:
+        return tuple(jnp.asarray(a) for a in arrays)
+    import collections
+
+    from code2vec_tpu.training.step import device_put_batch
+    Batch = collections.namedtuple("Batch", [
+        "source_token_indices", "path_indices", "target_token_indices",
+        "context_valid_mask", "target_index", "example_valid"])
+    return device_put_batch(Batch(*arrays), mesh)
+
+
+def _run_parity(mesh, steps=3):
+    step_ref, s_ref = _build(False, mesh)
+    step_ov, s_ov = _build(True, mesh)
+    assert step_ov.overlap_buckets >= 2, step_ov.overlap_description
+    arrays = _batch(mesh)
+    key = jax.random.PRNGKey(7)
+    for i in range(steps):
+        s_ref, l_ref = step_ref(s_ref, *arrays, key)
+        s_ov, l_ov = step_ov(s_ov, *arrays, key)
+        assert float(l_ref) == float(l_ov), \
+            f"step {i}: loss {float(l_ref)} != {float(l_ov)}"
+    for k in s_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(s_ov.params[k]), np.asarray(s_ref.params[k]),
+            rtol=PARITY_RTOL, atol=PARITY_ATOL, err_msg=k)
+    # optimizer state advanced identically: shared count, all moment
+    # leaves present and matching within the same tolerance
+    assert int(np.asarray(s_ov.opt_state[0].count)) == steps
+    for k in s_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(s_ov.opt_state[0].mu[k], dtype=np.float32),
+            np.asarray(s_ref.opt_state[0].mu[k], dtype=np.float32),
+            rtol=1e-3, atol=1e-6, err_msg=f"mu/{k}")  # bf16 storage
+    return s_ref, s_ov
+
+
+def test_overlap_parity_single_device():
+    """mesh=None: pure apply pipelining — loss bit-equal to the
+    unbucketed step, params within the documented tolerance."""
+    _run_parity(None)
+
+
+def test_overlap_parity_dp2_mesh():
+    """dp=2 mesh: the per-shard backward + per-bucket psum computes the
+    same step as the in-program all-reduce."""
+    from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+    mesh = make_mesh(MeshPlan(dp=2))
+    _run_parity(mesh)
+
+
+def test_overlap_parity_f32_adam_state():
+    """The bucket slicing also handles the plain optax.adam state
+    (nu_dtype float32 skips the custom transform)."""
+    step_ref, s_ref = _build(False, nu_dtype="float32")
+    step_ov, s_ov = _build(True, nu_dtype="float32")
+    arrays = _batch()
+    key = jax.random.PRNGKey(5)
+    s_ref, l_ref = step_ref(s_ref, *arrays, key)
+    s_ov, l_ov = step_ov(s_ov, *arrays, key)
+    assert float(l_ref) == float(l_ov)
+    for k in s_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(s_ov.params[k]), np.asarray(s_ref.params[k]),
+            rtol=PARITY_RTOL, atol=PARITY_ATOL, err_msg=k)
+
+
+def test_overlap_with_dropout_trains():
+    """Dropout draws differ from the unbucketed step by design (the
+    mesh path folds the data-axis index); the overlapped step must
+    still train — finite losses, params move, moments update."""
+    step_ov, state = _build(True, dropout_keep=0.75)
+    arrays = _batch()
+    key = jax.random.PRNGKey(9)
+    before = np.asarray(state.params["transform"]).copy()
+    for _ in range(2):
+        state, loss = step_ov(state, *arrays, key)
+        assert np.isfinite(float(loss))
+    assert not np.array_equal(before, np.asarray(state.params["transform"]))
+
+
+def test_plan_buckets_order_and_bounds():
+    from code2vec_tpu.parallel.overlap import plan_buckets
+
+    class L:  # noqa: N801 — shape-only stand-in
+        def __init__(self, *shape):
+            self.shape = shape
+
+    params = {"token_embedding": L(100, 8), "path_embedding": L(50, 8),
+              "target_embedding": L(30, 24), "transform": L(24, 24),
+              "attention": L(24, 1)}
+    buckets = plan_buckets(params, bucket_bytes=3000)
+    flat = [n for b in buckets for n in b]
+    # backward-completion order: classifier side first, gathers last
+    assert flat == ["target_embedding", "attention", "transform",
+                    "path_embedding", "token_embedding"]
+    # every bucket respects the byte bound unless a single leaf exceeds
+    # it alone
+    for b in buckets:
+        nbytes = sum(int(np.prod(params[n].shape)) * 4 for n in b)
+        assert nbytes <= 3000 or len(b) == 1
+    # one-bucket degenerate case with a huge budget
+    assert plan_buckets(params, bucket_bytes=1 << 30) == [flat]
+    # a leaf larger than the budget still lands (its own bucket)
+    tiny = plan_buckets(params, bucket_bytes=1)
+    assert [n for b in tiny for n in b] == flat
+    assert all(len(b) == 1 for b in tiny)
+
+
+def test_overlap_step_exposes_plan():
+    step, _ = _build(True)
+    assert step.overlap_buckets >= 2
+    assert "gradient bucket" in step.overlap_description
+
+
+def test_config_rejects_overlap_with_sparse_or_tp():
+    base = dict(train_data_path_prefix="<t>", overlap_grad_allreduce=True)
+    with pytest.raises(ValueError, match="sparse"):
+        Config(**base, use_sparse_embedding_update=True).verify()
+    with pytest.raises(ValueError, match="data-parallel"):
+        Config(**base, tp=2, max_contexts=200).verify()
+    with pytest.raises(ValueError, match="data-parallel"):
+        Config(**base, cp=2, max_contexts=200).verify()
+    with pytest.raises(ValueError, match="overlap_bucket_mb"):
+        Config(train_data_path_prefix="<t>",
+               overlap_bucket_mb=0).verify()
+    # the supported combo passes
+    Config(**base, dp=2).verify()
+
+
+def test_overlap_refuses_foreign_opt_state():
+    """A non-Adam optax state must be refused loudly, not mis-sliced."""
+    from code2vec_tpu.parallel.overlap import build_overlap_train_step
+
+    class FakeBuilder:
+        config = Config(train_data_path_prefix="<t>",
+                        overlap_grad_allreduce=True)
+        module = optimizer = None
+        mesh = None
+
+    class FakeState:
+        params = {"transform": np.zeros((2, 2), np.float32)}
+        opt_state = (object(),)
+
+    with pytest.raises(ValueError, match="ScaleByAdamState"):
+        build_overlap_train_step(FakeBuilder(), FakeState())

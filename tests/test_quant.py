@@ -751,3 +751,387 @@ def test_serving_cache_key_includes_model_fingerprint(exported):
     assert cache_key("class A {\n  int get() {\n    return 1; } }",
                      endpoint="predict", topk=10,
                      model=model.model_fingerprint()) == k_ckpt
+
+
+# ------------------------------- sub-byte / fp8 schemes (roofline PR)
+
+
+roofline = pytest.mark.roofline
+
+
+@roofline
+@pytest.mark.parametrize("fmt,mbits,sub_half", [
+    ("e4m3", 3, 2.0 ** -9),
+    ("e5m2", 2, 2.0 ** -16),
+])
+def test_fp8_round_trip_error_bound(fmt, mbits, sub_half):
+    """fp8 rounding is RELATIVE: err <= |w| * 2^-(mantissa+1) for
+    normals, <= scale * half-subnormal-step near zero. All-zero rows
+    reproduce exactly."""
+    from code2vec_tpu.ops.quant import (
+        dequantize_rows_fp8, quantize_rows_fp8,
+    )
+    rng = np.random.default_rng(5)
+    t = (rng.standard_normal((200, 33))
+         * rng.gamma(1.5, 2, (200, 1))).astype(np.float32)
+    t[7] = 0
+    q, s = quantize_rows_fp8(t, fmt)
+    assert q.dtype == np.uint8 and q.shape == t.shape
+    assert s.shape == (200, 1) and float(s[7, 0]) == 0.0
+    r = dequantize_rows_fp8(q, s, fmt)
+    err = np.abs(r - t)
+    bound = np.maximum(np.abs(t) * 2.0 ** -(mbits + 1), s * sub_half)
+    assert (err <= bound + 1e-12).all()
+    assert (r[7] == 0).all()
+
+
+@roofline
+def test_fp8_rejects_unknown_format():
+    from code2vec_tpu.ops.quant import quantize_rows_fp8
+    with pytest.raises(ValueError, match="fp8 format"):
+        quantize_rows_fp8(np.zeros((2, 2), np.float32), "e3m4")
+
+
+@roofline
+@pytest.mark.parametrize("d", [16, 33])   # even and odd widths
+def test_int4_round_trip_error_bound_and_packing(d):
+    """int4 worst-case round-trip error is s_r/2 (s_r = absmax/7); the
+    payload is two nibbles per byte with odd widths padded by an
+    encoded zero."""
+    from code2vec_tpu.ops.quant import (
+        dequantize_rows_int4, quantize_rows_int4, unpack_int4_host,
+    )
+    rng = np.random.default_rng(6)
+    t = (rng.standard_normal((100, d))
+         * rng.gamma(2, 1, (100, 1))).astype(np.float32)
+    t[4] = 0
+    q, s = quantize_rows_int4(t)
+    assert q.dtype == np.uint8 and q.shape == (100, (d + 1) // 2)
+    r = dequantize_rows_int4(q, s, d)
+    assert (np.abs(r - t) <= s / 2 + 1e-9).all()
+    assert (r[4] == 0).all()
+    # nibble values stay in the signed [-7, 7] code book
+    u = unpack_int4_host(q, d)
+    assert u.min() >= -7 and u.max() <= 7
+    # at production table widths the packed payload+scales are >= 1.8x
+    # smaller than int8's (narrow test rows amortize the per-row scale
+    # worse): 128-wide rows -> (128+4)/(64+4) = 1.94x
+    assert (128 + 4) / ((128 + 1) // 2 + 4) >= 1.8
+
+
+@roofline
+def test_int4_device_gather_and_blockwise_match_dequantized():
+    """The packed-gather + in-kernel unpack and the int4 blockwise
+    top-k both equal the same ops over the host-dequantized table."""
+    from code2vec_tpu.ops.quant import (
+        dequant_gather_int4, dequantize_rows_int4, quantize_rows_int4,
+    )
+    from code2vec_tpu.ops.topk import (
+        blockwise_matmul_top_k, gathered_label_logits,
+    )
+    rng = np.random.default_rng(7)
+    v, d = 300, 24
+    t = rng.standard_normal((v, d)).astype(np.float32)
+    q, s = quantize_rows_int4(t)
+    deq = dequantize_rows_int4(q, s, d)
+    ids = jnp.asarray(rng.integers(0, v, (5, 4)))
+    g = dequant_gather_int4(jnp.asarray(q), jnp.asarray(s), ids, d)
+    np.testing.assert_allclose(np.asarray(g),
+                               deq[np.asarray(ids)], rtol=1e-6)
+    cv = jnp.asarray(rng.standard_normal((6, d)), jnp.float32)
+    full = jnp.einsum("bd,vd->bv", cv, jnp.asarray(deq),
+                      preferred_element_type=jnp.float32)
+    fv, fi = jax.lax.top_k(full, 7)
+    out = jax.jit(lambda c, tb, sc: blockwise_matmul_top_k(
+        c, tb, 7, 64, scales=sc, int4_dim=d))(
+        cv, jnp.asarray(q), jnp.asarray(s))
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(out.indices))
+    np.testing.assert_allclose(np.asarray(fv), np.asarray(out.values),
+                               rtol=1e-6)
+    labels = jnp.asarray(rng.integers(0, v, (6,)), jnp.int32)
+    ll = gathered_label_logits(cv, jnp.asarray(q), labels,
+                               scales=jnp.asarray(s), int4_dim=d)
+    ref = np.einsum("bd,bd->b", np.asarray(cv),
+                    deq[np.asarray(labels)])
+    np.testing.assert_allclose(np.asarray(ll), ref, rtol=1e-5)
+
+
+@roofline
+@pytest.mark.parametrize("knob,scheme,dtype", [
+    ("fp8_e4m3", "fp8_e4m3_rowwise", np.uint8),
+    ("fp8_e5m2", "fp8_e5m2_rowwise", np.uint8),
+    ("int4", "int4_rowwise_packed", np.uint8),
+])
+def test_scheme_artifact_round_trip(exported, tmp_path, knob, scheme,
+                                    dtype):
+    """Every sub-int8 scheme exports, validates on load, and its
+    ReleaseModel step matches the fp32 release step over the
+    host-dequantized tables (the fused dequant is where the bytes are
+    saved, not where the math changes)."""
+    from code2vec_tpu.ops import quant
+    from code2vec_tpu.release.artifact import (
+        export_artifact, load_artifact,
+    )
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, _, _ = exported
+    art_dir = str(tmp_path / f"art_{knob}")
+    meta = export_artifact(model, art_dir, scheme=scheme, aot=False,
+                           log=lambda m: None)
+    assert meta["quantization"]["scheme"] == scheme
+    art = load_artifact(art_dir)
+    for name in ("token_embedding", "path_embedding",
+                 "target_embedding"):
+        assert art.tables[name].dtype == dtype
+        assert art.tables[f"{name}.scale"].dtype == np.float32
+    if knob == "int4":
+        d = model.dims.token_dim
+        assert art.tables["token_embedding"].shape[1] == (d + 1) // 2
+        # >= 1.8x smaller than the int8 flavor of the same tables
+        tb = meta["table_bytes"]
+        int8_bytes = sum(
+            np.asarray(jax.device_get(
+                model.state.params[n])).size
+            + 4 * model.state.params[n].shape[0]
+            for n in ("token_embedding", "path_embedding",
+                      "target_embedding"))
+        assert int8_bytes / tb["artifact"] >= 1.8
+    cfg = dataclasses.replace(model.config, train_data_path_prefix=None,
+                              model_load_path=None,
+                              serve_artifact=art_dir)
+    rm = ReleaseModel(cfg, log=lambda m: None)
+    arrays = _rand_batch_arrays(model, b=4)
+    out = rm.eval_step(None, *arrays)
+    assert np.isfinite(np.asarray(out.topk_values)).all()
+    assert np.isfinite(float(out.loss_sum))
+    # fp32 reference over explicitly dequantized tables: same math,
+    # different byte layout
+    fp32_dir = str(tmp_path / f"art_{knob}_fp32ref")
+    export_artifact(model, fp32_dir, scheme="float32", aot=False,
+                    log=lambda m: None)
+    for name in ("token_embedding", "path_embedding",
+                 "target_embedding"):
+        q = np.load(os.path.join(art_dir, f"{name}.npy"))
+        s = np.load(os.path.join(art_dir, f"{name}.scale.npy"))
+        if knob == "int4":
+            d = {"token_embedding": model.dims.token_dim,
+                 "path_embedding": model.dims.path_dim,
+                 "target_embedding": model.dims.code_dim
+                 if hasattr(model.dims, "code_dim")
+                 else model.dims.path_dim + 2 * model.dims.token_dim}[name]
+            deq = quant.dequantize_rows_int4(q, s, d)
+        else:
+            fmt = "e4m3" if "e4m3" in knob else "e5m2"
+            deq = quant.dequantize_rows_fp8(q, s, fmt)
+        np.save(os.path.join(fp32_dir, f"{name}.npy"),
+                deq.astype(np.float32))
+    cfg_ref = dataclasses.replace(cfg, serve_artifact=fp32_dir)
+    rm_ref = ReleaseModel(cfg_ref, log=lambda m: None)
+    ref = rm_ref.eval_step(None, *arrays)
+    np.testing.assert_array_equal(np.asarray(out.topk_indices),
+                                  np.asarray(ref.topk_indices))
+    np.testing.assert_allclose(np.asarray(out.topk_values),
+                               np.asarray(ref.topk_values), rtol=1e-4,
+                               atol=1e-5)
+
+
+@roofline
+def test_scheme_rejection_matrix(exported, tmp_path):
+    """The loader's named-field validation across the new schemes: a
+    tampered dtype, a truncated int4 payload, a missing scale file, an
+    unknown scheme and an expect_scheme mismatch all fail naming the
+    offending field."""
+    import shutil
+
+    from code2vec_tpu.release.artifact import (
+        ArtifactError, export_artifact, load_artifact,
+    )
+    model, _, _ = exported
+    base = str(tmp_path / "int4")
+    export_artifact(model, base, scheme="int4_rowwise_packed", aot=False,
+                    log=lambda m: None)
+
+    def corrupt(name, fn):
+        broken = str(tmp_path / f"broken_{np.random.randint(1 << 30)}")
+        shutil.copytree(base, broken)
+        fn(broken)
+        return broken
+
+    # int4 meta with an f32 payload -> dtype named
+    b = corrupt("dtype", lambda d: np.save(
+        os.path.join(d, "token_embedding.npy"),
+        np.zeros_like(np.load(os.path.join(d, "token_embedding.npy")),
+                      dtype=np.float32)))
+    with pytest.raises(ArtifactError, match="token_embedding.dtype"):
+        load_artifact(b)
+    # truncated packed payload -> shape named (packed width checked)
+    b = corrupt("shape", lambda d: np.save(
+        os.path.join(d, "path_embedding.npy"),
+        np.load(os.path.join(d, "path_embedding.npy"))[:, :-1]))
+    with pytest.raises(ArtifactError, match="path_embedding.shape"):
+        load_artifact(b)
+    # missing scale -> scale named
+    b = corrupt("scale", lambda d: os.remove(
+        os.path.join(d, "target_embedding.scale.npy")))
+    with pytest.raises(ArtifactError, match="target_embedding.scale"):
+        load_artifact(b)
+    # unknown scheme -> quantization.scheme named
+
+    def bad_scheme(d):
+        with open(os.path.join(d, "release_meta.json")) as f:
+            meta = json.load(f)
+        meta["quantization"]["scheme"] = "int2_hypothetical"
+        with open(os.path.join(d, "release_meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    b = corrupt("scheme", bad_scheme)
+    with pytest.raises(ArtifactError, match="quantization.scheme"):
+        load_artifact(b)
+    # expect_scheme mismatch (an int8-only consumer handed int4)
+    with pytest.raises(ArtifactError, match="quantization.scheme"):
+        load_artifact(base, expect_scheme="int8_rowwise_symmetric")
+
+
+@roofline
+def test_release_scheme_knob_drives_export(exported, tmp_path):
+    """config.release_scheme picks the scheme; --no_quantize still
+    forces fp32 regardless of the knob."""
+    from code2vec_tpu.release.artifact import export_artifact
+    model, _, _ = exported
+    cfg = dataclasses.replace(model.config, release_scheme="int4")
+    old_cfg = model.config
+    model.config = cfg
+    try:
+        meta = export_artifact(model, str(tmp_path / "a"), aot=False,
+                               log=lambda m: None)
+        assert meta["quantization"]["scheme"] == "int4_rowwise_packed"
+        meta = export_artifact(model, str(tmp_path / "b"), aot=False,
+                               quantize=False, log=lambda m: None)
+        assert meta["quantization"]["scheme"] == "float32"
+    finally:
+        model.config = old_cfg
+
+
+@roofline
+def test_config_release_scheme_validation():
+    with pytest.raises(ValueError, match="release_scheme"):
+        Config(train_data_path_prefix="<t>",
+               release_scheme="int2").verify()
+
+
+# -------------------------------------- approximate-MIPS head pins
+
+
+@roofline
+@pytest.mark.parametrize("scheme", ["f32", "int8", "int4"])
+def test_mips_full_probe_matches_blockwise_exact(scheme):
+    """nprobe = nlist searches every row: the MIPS head must return the
+    exact blockwise head's top-k (indices and values) for every table
+    flavor."""
+    from code2vec_tpu.ops import quant
+    from code2vec_tpu.ops.topk import blockwise_matmul_top_k
+    from code2vec_tpu.retrieval.mips import MipsHead
+    rng = np.random.default_rng(11)
+    v, d, b, k, real = 500, 24, 6, 7, 470
+    t = rng.standard_normal((v, d)).astype(np.float32)
+    cv = rng.standard_normal((b, d)).astype(np.float32)
+    if scheme == "f32":
+        head = MipsHead.build(t, None, real_vocab=real, nlist=16, seed=0)
+        ref = blockwise_matmul_top_k(jnp.asarray(cv), jnp.asarray(t), k,
+                                     128, valid_rows=real)
+    elif scheme == "int8":
+        q, s = quant.quantize_rows(t)
+        head = MipsHead.build(q, s, real_vocab=real, nlist=16, seed=0)
+        ref = blockwise_matmul_top_k(jnp.asarray(cv), jnp.asarray(q), k,
+                                     128, scales=jnp.asarray(s),
+                                     valid_rows=real)
+    else:
+        q, s = quant.quantize_rows_int4(t)
+        head = MipsHead.build(q, s, real_vocab=real, int4_dim=d,
+                              nlist=16, seed=0)
+        ref = blockwise_matmul_top_k(jnp.asarray(cv), jnp.asarray(q), k,
+                                     128, scales=jnp.asarray(s),
+                                     valid_rows=real, int4_dim=d)
+    vals, idx = head.search(cv, k, nprobe=head.nlist)
+    np.testing.assert_array_equal(idx, np.asarray(ref.indices))
+    np.testing.assert_allclose(vals, np.asarray(ref.values), rtol=1e-5)
+
+
+@roofline
+def test_mips_agreement_on_clustered_table():
+    """On clustered data (what trained name embeddings look like,
+    BENCH_RETRIEVAL.md) a small nprobe already recovers the exact
+    top-1: agreement >= 0.95 at nprobe 4 of 20."""
+    from code2vec_tpu.ops.topk import blockwise_matmul_top_k
+    from code2vec_tpu.retrieval.mips import MipsHead
+    rng = np.random.default_rng(12)
+    centers = rng.standard_normal((20, 16)).astype(np.float32) * 4
+    t = np.repeat(centers, 40, axis=0) + \
+        rng.standard_normal((800, 16)).astype(np.float32) * 0.3
+    queries = centers[rng.integers(0, 20, 50)] + \
+        rng.standard_normal((50, 16)).astype(np.float32) * 0.3
+    head = MipsHead.build(t, None, real_vocab=800, nlist=20, seed=0)
+    _, approx = head.search(queries, 1, nprobe=4)
+    exact = blockwise_matmul_top_k(jnp.asarray(queries), jnp.asarray(t),
+                                   1, 256)
+    agreement = float((approx[:, 0]
+                       == np.asarray(exact.indices)[:, 0]).mean())
+    assert agreement >= 0.95, agreement
+
+
+@roofline
+def test_release_model_mips_matches_exact_at_full_probe(exported,
+                                                        tmp_path):
+    """serve_mips_nprobe = nlist through the real ReleaseModel predict
+    surface returns the exact model's predictions."""
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, _ = exported
+    lines = ["name|x1 tok1,p1,tok1 tok2,p2,tok2" + " " * 14,
+             "name|x2 tok3,p3,tok3" + " " * 15]
+    cfg = dataclasses.replace(model.config, train_data_path_prefix=None,
+                              model_load_path=None,
+                              serve_artifact=art_dir)
+    exact = ReleaseModel(cfg, log=lambda m: None).predict(lines)
+    cfg_mips = dataclasses.replace(cfg, serve_mips_nprobe=10_000,
+                                   serve_mips_nlist=8)
+    rm = ReleaseModel(cfg_mips, log=lambda m: None)
+    assert rm.mips_head is not None
+    # the dominant table is device-resident exactly once: the head
+    # holds the reordered copy, the original-order table is never
+    # transferred
+    assert "target_embedding" not in rm.params
+    assert "target_embedding_scale" not in rm.params
+    approx = rm.predict(lines)
+    for e, a in zip(exact, approx):
+        assert e.topk_predicted_words == a.topk_predicted_words
+        np.testing.assert_allclose(a.topk_predicted_words_scores,
+                                   e.topk_predicted_words_scores,
+                                   rtol=1e-4)
+
+
+@roofline
+def test_facade_mips_predict_matches_exact_at_full_probe(tmp_path):
+    """The facade predict path honors serve_mips_nprobe too (serve
+    --load without an artifact): full probe == exact facade predict."""
+    (tmp_path / "exact").mkdir()
+    (tmp_path / "mips").mkdir()
+    model = _tiny_model(tmp_path / "exact")
+    lines = ["name|x1 tok1,p1,tok1 tok2,p2,tok2" + " " * 14]
+    exact = model.predict(lines)
+    mips_model = _tiny_model(tmp_path / "mips", predict=True,
+                             serve_mips_nprobe=10_000,
+                             serve_mips_nlist=8)
+    approx = mips_model.predict(lines)
+    assert mips_model.mips_head is not None
+    assert exact[0].topk_predicted_words == approx[0].topk_predicted_words
+
+
+@roofline
+def test_config_rejects_mips_misuse():
+    with pytest.raises(ValueError, match="serve_mips_nprobe"):
+        Config(train_data_path_prefix="<t>",
+               serve_mips_nprobe=4).verify()     # neither serve nor predict
+    with pytest.raises(ValueError, match="exact blockwise head"):
+        Config(train_data_path_prefix="<t>", serve=True,
+               test_data_path="x.c2v", serve_mips_nprobe=4).verify()
+    Config(train_data_path_prefix="<t>", serve=True,
+           serve_mips_nprobe=4).verify()

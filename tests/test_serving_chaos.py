@@ -1179,3 +1179,55 @@ def test_deadline_default_must_not_exceed_max():
         "--serve_deadline_ms", "5000", "--serve_deadline_max_ms", "1000"])
     with pytest.raises(ValueError, match="serve_deadline_max_ms"):
         config.verify()
+
+
+def test_scale_down_prefers_coldest_cache_replica(tmp_path):
+    """Cache-warmth-aware scale-down (PR-13 follow-on, roofline PR):
+    the victim is the replica with the fewest serving_cache_hits_total
+    over the CURRENT warmth window (hits since the last baseline
+    sample — lifetime counters measure uptime, not warmth); missing/
+    unreadable snapshots count 0; counter resets clamp to 0; all-equal
+    windows fall back to newest-first."""
+    from code2vec_tpu.serving.supervisor import Supervisor
+
+    config = _supervisor_config(tmp_path, serve_replicas=3)
+    sup = Supervisor(config, child_command=["true"])
+
+    def write_metrics(replica, hits):
+        with open(replica.metrics_path, "w") as f:
+            f.write("# TYPE serving_cache_hits_total counter\n"
+                    f"serving_cache_hits_total {hits}\n")
+
+    r0, r1, r2 = sup.replicas
+    write_metrics(r0, 50)
+    write_metrics(r1, 3)
+    write_metrics(r2, 90)
+    assert sup._scale_down_victims(sup.replicas, 1) == [r1]
+    # two victims: the two coldest caches, coldest first
+    assert sup._scale_down_victims(sup.replicas, 2) == [r1, r0]
+    # WINDOWED, not lifetime: baseline the counters, then give the
+    # lifetime-richest replica (r2) the QUIETEST window — it must be
+    # the victim despite its big historical count
+    sup._sample_warmth_baselines()
+    write_metrics(r0, 80)    # +30 this window
+    write_metrics(r1, 60)    # +57
+    write_metrics(r2, 91)    # +1  <- coldest window, biggest lifetime
+    assert sup._scale_down_victims(sup.replicas, 1) == [r2]
+    # a restarted replica's counter reset clamps to 0 (fresh cache IS
+    # cold), never a negative that would wrap the ordering
+    write_metrics(r2, 2)
+    assert sup._scale_down_victims(sup.replicas, 1) == [r2]
+    sup._sample_warmth_baselines()
+    # replica without a snapshot (still starting) = coldest of all
+    os.remove(r2.metrics_path)
+    assert sup._scale_down_victims(sup.replicas, 1) == [r2]
+    # unreadable garbage parses to 0 samples -> counts 0 hits
+    with open(r2.metrics_path, "wb") as f:
+        f.write(b"\x00\xff garbage")
+    assert sup._scale_down_victims(sup.replicas, 1) == [r2]
+    # all-equal warmth: newest-first (the pre-roofline policy)
+    for r in sup.replicas:
+        r.warmth_prev = 0.0
+        write_metrics(r, 7)
+    assert sup._scale_down_victims(sup.replicas, 1) == [r2]
+    assert sup._scale_down_victims(sup.replicas, 2) == [r2, r1]
