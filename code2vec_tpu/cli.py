@@ -359,6 +359,94 @@ def arguments_parser() -> ArgumentParser:
                              "mismatch: refuse the swap (default) or "
                              "commit it and detach the index "
                              "(/neighbors then answers 503)")
+    # -- continuous-training pipeline (README "Continuous training") --
+    parser.add_argument("--pipeline_dir", metavar="DIR",
+                        help="`pipeline` subcommand state root: the "
+                             "journaled pipeline manifest, per-stage "
+                             "work dirs and the candidate artifacts "
+                             "live here; a rerun of a killed pipeline "
+                             "resumes from the last committed stage")
+    parser.add_argument("--pipeline_raw", metavar="FILE",
+                        help="new raw extractor output to ingest as a "
+                             "delta shard against the frozen incumbent "
+                             "vocab (OOV rate exported through obs)")
+    parser.add_argument("--pipeline_incumbent", metavar="DIR",
+                        help="the incumbent RELEASE ARTIFACT the fleet "
+                             "serves today — shadow-eval's baseline "
+                             "and the rollback identity")
+    parser.add_argument("--pipeline_traffic", metavar="FILE",
+                        help="recorded live-traffic sample to replay "
+                             "through incumbent and candidate at "
+                             "shadow-eval (what --serve_traffic_sample "
+                             "records on serving replicas); empty = "
+                             "gate on the accuracy harness alone")
+    parser.add_argument("--pipeline_shadow_samples", type=int,
+                        default=None, metavar="N",
+                        help="max traffic lines replayed at shadow-eval "
+                             "(deterministically sampled; default 256)")
+    parser.add_argument("--pipeline_finetune_epochs", type=int,
+                        default=None, metavar="N",
+                        help="epochs the fine-tune stage trains on the "
+                             "delta shard, resumed from the latest "
+                             "committed checkpoint (default 1)")
+    parser.add_argument("--pipeline_gate_top1_drop", type=float,
+                        default=None, metavar="DELTA",
+                        help="largest tolerated top-1 accuracy drop of "
+                             "the candidate vs the incumbent before "
+                             "the gate refuses promotion (default "
+                             "0.01)")
+    parser.add_argument("--pipeline_gate_topk_drop", type=float,
+                        default=None, metavar="DELTA",
+                        help="largest tolerated top-k accuracy drop "
+                             "(default 0.01)")
+    parser.add_argument("--pipeline_gate_f1_drop", type=float,
+                        default=None, metavar="DELTA",
+                        help="largest tolerated subtoken-F1 drop "
+                             "(default 0.01)")
+    parser.add_argument("--pipeline_gate_min_agreement", type=float,
+                        default=None, metavar="RATIO",
+                        help="smallest tolerated top-k agreement over "
+                             "the replayed traffic slice (default "
+                             "0.98; only checked when traffic was "
+                             "replayed)")
+    parser.add_argument("--pipeline_fleet", default=None,
+                        metavar="HOST:PORT",
+                        help="fleet router admin address the promote "
+                             "stage drives the canary-first "
+                             "coordinated swap through; empty = stop "
+                             "after shadow-eval with a gated candidate "
+                             "artifact on disk")
+    parser.add_argument("--pipeline_model", default=None,
+                        metavar="NAME",
+                        help="fleet model group to promote into "
+                             "(default 'default')")
+    parser.add_argument("--pipeline_promote_timeout",
+                        dest="pipeline_promote_timeout_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="budget for one fleet rollout to reach a "
+                             "terminal state before the stage fails "
+                             "(default 600)")
+    parser.add_argument("--pipeline_refresh_retrieval",
+                        action="store_true", default=None,
+                        help="after promotion, re-embed the delta "
+                             "shard with the candidate, build a fresh "
+                             "ANN index behind its fingerprint and "
+                             "remount it fleet-wide (refuse/detach "
+                             "policy guards every replica transition)")
+    parser.add_argument("--serve_traffic_sample",
+                        dest="serve_traffic_sample_file", metavar="FILE",
+                        help="record every Nth request's extracted "
+                             "lines into this bounded ring file — the "
+                             "shadow-eval replay corpus (README "
+                             "'Continuous training'; off by default)")
+    parser.add_argument("--serve_traffic_sample_every", type=int,
+                        default=None, metavar="N",
+                        help="sample every Nth cache-miss request into "
+                             "the traffic ring (default 10)")
+    parser.add_argument("--serve_traffic_sample_cap", type=int,
+                        default=None, metavar="N",
+                        help="lines the traffic sample ring retains "
+                             "(default 4096)")
     parser.add_argument("--topk_block", dest="topk_block_size", type=int,
                         default=None, metavar="ROWS",
                         help="target-table rows per block of the "
@@ -504,7 +592,7 @@ def config_from_args(argv=None) -> Config:
     # `index-build` and `export-embeddings` are the retrieval-stack
     # jobs (README "Retrieval").
     subcommands = ("serve", "fleet", "export", "embed", "index-build",
-                   "export-embeddings")
+                   "export-embeddings", "pipeline")
     subcommand = argv[0] if argv and argv[0] in subcommands else None
     if subcommand:
         argv = argv[1:]
@@ -526,6 +614,11 @@ def config_from_args(argv=None) -> Config:
     if subcommand == "export-embeddings" and not args.embeddings_out:
         raise SystemExit("the `export-embeddings` subcommand requires "
                          "--embeddings_out DIR (plus --load MODEL)")
+    if subcommand == "pipeline" and not args.pipeline_dir:
+        raise SystemExit(
+            "the `pipeline` subcommand requires --pipeline_dir DIR "
+            "(plus --load CKPT, --pipeline_raw FILE, "
+            "--pipeline_incumbent DIR and --test CORPUS)")
     knobs = {knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
                                       "on_nonfinite_loss",
                                       "extractor_timeout_s",
@@ -582,12 +675,29 @@ def config_from_args(argv=None) -> Config:
                                       "index_metric",
                                       "retrieval_index",
                                       "retrieval_topk",
-                                      "retrieval_swap_policy")
+                                      "retrieval_swap_policy",
+                                      "pipeline_dir", "pipeline_raw",
+                                      "pipeline_incumbent",
+                                      "pipeline_traffic",
+                                      "pipeline_shadow_samples",
+                                      "pipeline_finetune_epochs",
+                                      "pipeline_gate_top1_drop",
+                                      "pipeline_gate_topk_drop",
+                                      "pipeline_gate_f1_drop",
+                                      "pipeline_gate_min_agreement",
+                                      "pipeline_fleet",
+                                      "pipeline_model",
+                                      "pipeline_promote_timeout_s",
+                                      "pipeline_refresh_retrieval",
+                                      "serve_traffic_sample_file",
+                                      "serve_traffic_sample_every",
+                                      "serve_traffic_sample_cap")
              if (value := getattr(args, knob)) is not None}
     config = Config(
         predict=args.predict,
         serve=args.serve or serve_subcommand,
         fleet=subcommand == "fleet",
+        pipeline=subcommand == "pipeline",
         model_save_path=args.save_path,
         model_load_path=args.load_path,
         train_data_path_prefix=args.data_path,
@@ -643,6 +753,15 @@ def main(argv=None) -> None:
         argv = sys.argv[1:]
     config = config_from_args(argv)
     config.verify()
+
+    # Continuous-training pipeline: the supervisor PARENT never builds
+    # a model either — each stage re-execs this CLI (train/export/
+    # embed/index-build) or drives the fleet router over HTTP, and the
+    # journaled manifest makes a killed run resumable
+    # (pipeline/supervisor.py, README "Continuous training").
+    if config.pipeline:
+        from code2vec_tpu.pipeline.supervisor import pipeline_main
+        sys.exit(pipeline_main(config, argv=list(argv)))
 
     # Cross-host fleet: the control-plane PARENT never builds a model;
     # it launches one `serve` supervisor per host behind the

@@ -474,6 +474,65 @@ class Config:
     # neighbors are NEVER computed across embedding spaces.
     retrieval_swap_policy: str = "refuse"
 
+    # -- continuous-training pipeline (code2vec_tpu/pipeline; README
+    # "Continuous training"; no reference equivalent — the reference's
+    # model is one-shot) --
+    # Run the crash-safe pipeline supervisor (`pipeline` subcommand):
+    # ingest delta -> fine-tune -> export -> shadow-eval -> canary
+    # promote -> retrieval refresh, journaled per stage.
+    pipeline: bool = False
+    # Pipeline state root: journaled manifest, per-stage work dirs,
+    # candidate checkpoint/artifact. One dir = one run; a killed run
+    # rerun with the SAME inputs resumes from the last committed stage.
+    pipeline_dir: Optional[str] = None
+    # New raw extractor output to ingest as a delta shard against the
+    # FROZEN incumbent vocab (OOV rate exported through obs — the
+    # "vocabulary aging out" signal).
+    pipeline_raw: Optional[str] = None
+    # The incumbent release artifact the fleet serves today: the
+    # shadow-eval baseline and the implicit rollback identity.
+    pipeline_incumbent: Optional[str] = None
+    # Recorded live-traffic sample (what serving replicas write at
+    # --serve_traffic_sample) replayed through incumbent AND candidate
+    # at shadow-eval. None = gate on the accuracy harness alone.
+    pipeline_traffic: Optional[str] = None
+    # Max traffic lines replayed (deterministically sampled by seed,
+    # so a rerun of a killed shadow-eval replays the same slice).
+    pipeline_shadow_samples: int = 256
+    # Epochs the fine-tune stage trains on the delta shard, resumed
+    # from the latest committed checkpoint via the elastic-restore
+    # path (any host count / mesh shape the child runs on).
+    pipeline_finetune_epochs: int = 1
+    # Quality-gate regression bars: largest tolerated drop (candidate
+    # minus incumbent) per metric, and the smallest tolerated top-k
+    # agreement over the replayed traffic. Any tripped bar REFUSES
+    # promotion (terminal; incumbent keeps serving).
+    pipeline_gate_top1_drop: float = 0.01
+    pipeline_gate_topk_drop: float = 0.01
+    pipeline_gate_f1_drop: float = 0.01
+    pipeline_gate_min_agreement: float = 0.98
+    # Fleet router admin address (host:port) the promote stage drives
+    # the canary-first coordinated swap through. Empty = the pipeline
+    # stops after shadow-eval with a gated candidate on disk.
+    pipeline_fleet: str = ""
+    # Fleet model group to promote into (the router's X-Model key).
+    pipeline_model: str = "default"
+    # Budget for one fleet rollout (promote or index remount) to reach
+    # a terminal state before the stage fails.
+    pipeline_promote_timeout_s: float = 600.0
+    # After promotion: re-embed the delta shard with the candidate,
+    # build a fresh ANN index behind its fingerprint, and remount it
+    # fleet-wide through the reload fan-out (each replica mounts the
+    # index atomically with its model flip; the refuse/detach policy
+    # guards every transition).
+    pipeline_refresh_retrieval: bool = False
+    # -- live-traffic sampling (serving/traffic.py) --
+    # Record every Nth cache-miss request's EXTRACTED lines into this
+    # bounded ring file — the shadow-eval replay corpus. None = off.
+    serve_traffic_sample_file: Optional[str] = None
+    serve_traffic_sample_every: int = 10
+    serve_traffic_sample_cap: int = 4096
+
     # Knob names the user set EXPLICITLY on the command line (filled by
     # cli.config_from_args). Lets a consumer distinguish "holds the
     # dataclass default because nobody asked" from "the operator typed
@@ -777,6 +836,78 @@ class Config:
             raise ValueError(
                 "topk_block_size must be >= 0 (0 forces the full-logits "
                 "top-k path).")
+        if self.pipeline:
+            if not self.pipeline_dir:
+                raise ValueError(
+                    "pipeline requires --pipeline_dir DIR (the "
+                    "journaled state root a killed run resumes from).")
+            if self.serve or self.predict or self.is_training:
+                raise ValueError(
+                    "the `pipeline` subcommand is a standalone "
+                    "supervisor: it re-execs train/export/embed "
+                    "children itself and cannot be combined with "
+                    "--serve/--predict/--data.")
+            if (self.export_artifact_path or self.embed_out
+                    or self.index_out or self.embeddings_out
+                    or self.fleet):
+                raise ValueError(
+                    "pipeline cannot be combined with the one-shot "
+                    "export/embed/index-build/export-embeddings jobs "
+                    "or `fleet`: it drives those itself as stages.")
+            if not self.is_loading:
+                raise ValueError(
+                    "pipeline requires --load CKPT: the incumbent "
+                    "checkpoint is the fine-tune starting point and "
+                    "the frozen-vocab source.")
+            if not self.pipeline_raw:
+                raise ValueError(
+                    "pipeline requires --pipeline_raw FILE (the new "
+                    "raw extractor output to ingest as a delta "
+                    "shard).")
+            if not self.pipeline_incumbent:
+                raise ValueError(
+                    "pipeline requires --pipeline_incumbent DIR (the "
+                    "release artifact the fleet serves today — "
+                    "shadow-eval's baseline).")
+            if not self.is_testing:
+                raise ValueError(
+                    "pipeline requires --test FILE: the accuracy "
+                    "harness shadow-eval scores both models on.")
+            if self.serve_artifact:
+                raise ValueError(
+                    "pipeline takes the incumbent artifact via "
+                    "--pipeline_incumbent, not --artifact (which "
+                    "conflicts with the --load'ed checkpoint).")
+        if self.pipeline_shadow_samples < 0:
+            raise ValueError(
+                "pipeline_shadow_samples must be >= 0 (0 = gate on "
+                "the accuracy harness alone).")
+        if self.pipeline_finetune_epochs < 1:
+            raise ValueError("pipeline_finetune_epochs must be >= 1.")
+        for bar in ("pipeline_gate_top1_drop", "pipeline_gate_topk_drop",
+                    "pipeline_gate_f1_drop"):
+            if getattr(self, bar) < 0:
+                raise ValueError(f"{bar} must be >= 0 (the largest "
+                                 f"tolerated drop).")
+        if not (0 <= self.pipeline_gate_min_agreement <= 1):
+            raise ValueError(
+                "pipeline_gate_min_agreement must be in [0, 1].")
+        if self.pipeline_promote_timeout_s <= 0:
+            raise ValueError(
+                "pipeline_promote_timeout must be > 0 (a rollout poll "
+                "that never times out wedges the pipeline on a dead "
+                "fleet).")
+        if self.serve_traffic_sample_file and not self.serve:
+            raise ValueError(
+                "--serve_traffic_sample applies to the serve "
+                "subcommand (it records the serving extract path).")
+        if self.serve_traffic_sample_every < 1:
+            raise ValueError(
+                "serve_traffic_sample_every must be >= 1 (1 = sample "
+                "every request).")
+        if self.serve_traffic_sample_cap < 1:
+            raise ValueError(
+                "serve_traffic_sample_cap must be >= 1.")
         if self.release_scheme not in ("int8", "fp8_e4m3", "fp8_e5m2",
                                        "int4", "float32"):
             raise ValueError(

@@ -346,7 +346,8 @@ class PathContextReader:
                  batch_size: Optional[int] = None,
                  num_epochs: Optional[int] = None,
                  yield_epoch_markers: bool = False,
-                 start_epoch: int = 0):
+                 start_epoch: int = 0,
+                 skip_rows: int = 0):
         self.vocabs = vocabs
         self.config = config
         self.estimator_action = estimator_action
@@ -371,6 +372,15 @@ class PathContextReader:
         # RNG is keyed per absolute epoch, so the resumed pass orders
         # its lines exactly as an uninterrupted run would have.
         self.start_epoch = start_epoch
+        # Resume data cursor (training only): drop this host's share of
+        # the first epoch's already-consumed POST-FILTER rows from the
+        # epoch-keyed shuffled order — the text-reader counterpart of
+        # PackedDataset.iter_batches(skip_rows=...), obeying the same
+        # cursor laws (the resumed stream is exactly the uninterrupted
+        # stream minus its first skip_rows rows; later epochs are
+        # untouched). The facade rounds the cursor down to a global
+        # batch multiple before it gets here.
+        self.skip_rows = skip_rows
 
     # ------------------------------------------------------------------
 
@@ -392,10 +402,13 @@ class PathContextReader:
             else:
                 epochs = self.config.num_train_epochs
             line_iter = self._shuffled_lines(epochs)
-        else:
-            line_iter = _iter_file_lines(self.data_path, self.shard_index,
-                                         self.num_shards,
-                                         self.config.csv_buffer_size)
+            yield from self._batched(
+                line_iter, batch_size,
+                skip_rows=self.skip_rows // max(self.num_shards, 1))
+            return
+        line_iter = _iter_file_lines(self.data_path, self.shard_index,
+                                     self.num_shards,
+                                     self.config.csv_buffer_size)
         yield from self._batched(line_iter, batch_size)
 
     # ------------------------------------------------------------------
@@ -476,9 +489,17 @@ class PathContextReader:
             while inflight:
                 yield inflight.popleft().result()
 
-    def _batched(self, line_iter: Iterator, batch_size: int) -> Iterator[RowBatch]:
+    def _batched(self, line_iter: Iterator, batch_size: int,
+                 skip_rows: int = 0) -> Iterator[RowBatch]:
         pending: List[RowBatch] = []
         pending_rows = 0
+        # Cursor resume: discard the first `skip_rows` POST-FILTER rows
+        # of the stream — they are the rows the interrupted epoch
+        # already consumed, in exactly this (epoch-keyed, deterministic)
+        # order. Applies to the FIRST streamed epoch only; the boundary
+        # marker clears any leftover skip (a stale over-long cursor
+        # must not eat into the next epoch's rows).
+        remaining_skip = max(int(skip_rows), 0)
 
         def pop_batches() -> Iterator[RowBatch]:
             nonlocal pending, pending_rows
@@ -496,10 +517,19 @@ class PathContextReader:
 
         for item in self._parsed_chunks(line_iter):
             if isinstance(item, EpochEnd):
+                remaining_skip = 0
                 yield from pop_batches()
                 if self.yield_epoch_markers:
                     yield item
                 continue
+            if remaining_skip:
+                n = item.target_index.shape[0]
+                if n <= remaining_skip:
+                    remaining_skip -= n
+                    continue
+                item = _select_rows(item,
+                                    np.arange(remaining_skip, n))
+                remaining_skip = 0
             if item.target_index.shape[0]:
                 pending.append(item)
                 pending_rows += item.target_index.shape[0]

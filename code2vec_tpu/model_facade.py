@@ -527,12 +527,15 @@ class Code2VecModel(BucketedPredictMixin):
             self._steps_per_epoch = local_steps
             return batches
         self._require_single_process("training from raw .c2v text")
-        if self._resume_cursor and self._resume_cursor.get(
-                "global_row_ordinal"):
-            self.log("Saved data cursor ignored: the streaming text "
-                     "reader cannot seek mid-epoch; re-running the "
-                     "interrupted epoch from its start (pack the dataset "
-                     "for cursor resume)")
+        # The text reader honors the resume cursor too (PR-6 residue
+        # closed): the epoch-keyed shuffled order is deterministic, so
+        # skipping the first `skip_rows` post-filter rows of the
+        # resumed epoch reproduces exactly the packed reader's cursor
+        # laws — no row skipped, none double-read.
+        skip_rows = self._cursor_skip_rows()
+        self._applied_skip_rows = skip_rows
+        self._applied_skip_epoch = (self.initial_epoch if skip_rows
+                                    else None)
         shard_index, num_shards = distributed.host_shard()
         return PathContextReader(self.vocabs, config, EstimatorAction.Train,
                                  shard_index=shard_index,
@@ -540,7 +543,8 @@ class Code2VecModel(BucketedPredictMixin):
                                  batch_size=batch_size,
                                  num_epochs=epochs_to_run,
                                  yield_epoch_markers=True,
-                                 start_epoch=self.initial_epoch)
+                                 start_epoch=self.initial_epoch,
+                                 skip_rows=skip_rows)
 
     def _cursor_skip_rows(self) -> int:
         """Remap the restored artifact's data cursor (global rows the

@@ -1,0 +1,16 @@
+"""Continuous-training pipeline: delta-ingest -> fine-tune -> export ->
+shadow-eval -> canary promote -> retrieval refresh, as one supervised,
+crash-safe loop (the `pipeline` CLI subcommand; README "Continuous
+training").
+
+Every ingredient exists elsewhere as an island — elastic resume (PR 6),
+release export (PR 8), validated hot-swap with rollback (PR 9),
+fingerprint-pinned retrieval (PR 10), the coordinated fleet swap
+(PR 13). This package closes them into one stage machine
+(pipeline/supervisor.py) whose state lives in a journaled manifest
+(pipeline/manifest.py, tmp+rename like the checkpoint protocol): a
+SIGKILL at any stage boundary resumes idempotently from the last
+committed stage, and a candidate that regresses the quality gate
+(pipeline/shadow_eval.py) or fails its fleet rollout is REFUSED with
+the incumbent left serving everywhere.
+"""

@@ -1,0 +1,146 @@
+"""Journaled pipeline manifest: the stage machine's durable state.
+
+One JSON file (`pipeline_manifest.json` in the pipeline run dir)
+rewritten atomically (tmp+rename, the checkpoint commit discipline —
+obs.exporters._atomic_write) at every state transition, so a reader
+never observes a torn manifest and a SIGKILL between transitions loses
+at most the uncommitted stage's work:
+
+- `stages`: {name: {status, outputs, completed_at, duration_s}} — a
+  stage is re-run on resume iff it has no record here. Stage OUTPUTS
+  (packed delta shards, checkpoints, release artifacts, index dirs)
+  are themselves committed atomically by their writers, so re-running
+  an uncommitted stage is idempotent.
+- `journal`: append-only event list (stage start/commit, terminal
+  transitions) — the flight-recorder-style trail of what the
+  supervisor was doing when it died.
+- `terminal`: the run's final verdict (committed | gate_refused |
+  promote_failed), set exactly once. A rerun of a terminal manifest
+  re-reports the verdict instead of re-driving stages — reruns
+  CONVERGE to the same terminal manifest.
+
+The manifest records a `params_fingerprint` of the run's defining
+inputs (delta file, incumbent, gate bars, ...): resuming a pipeline
+dir with DIFFERENT inputs is refused loudly (PipelineStateError) —
+half of run A's stages followed by half of run B's would be a silently
+corrupt candidate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from code2vec_tpu.obs import exporters
+
+MANIFEST_NAME = "pipeline_manifest.json"
+SCHEMA_VERSION = 1
+
+# journal ring bound: a long retry loop must not grow the manifest
+# without bound (the newest entries are the ones a postmortem needs)
+_JOURNAL_CAP = 256
+
+
+class PipelineStateError(ValueError):
+    """A pipeline dir whose manifest cannot be resumed by this run
+    (schema from the future, or different run inputs)."""
+
+
+class PipelineManifest:
+    def __init__(self, path: str, data: Dict):
+        self.path = path
+        self.data = data
+
+    # ------------------------------------------------------------- load
+
+    @classmethod
+    def load_or_create(cls, pipeline_dir: str, params_fingerprint: str,
+                       stage_names: List[str],
+                       log=None) -> "PipelineManifest":
+        path = os.path.join(os.path.abspath(pipeline_dir), MANIFEST_NAME)
+        if os.path.isfile(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except ValueError as e:
+                raise PipelineStateError(
+                    f"{path} is unreadable ({e}); the manifest is "
+                    f"written atomically, so this is not a crash "
+                    f"artifact — move it aside or use a fresh "
+                    f"--pipeline_dir")
+            if int(data.get("schema_version", -1)) != SCHEMA_VERSION:
+                raise PipelineStateError(
+                    f"{path} has schema_version "
+                    f"{data.get('schema_version')!r}; this build "
+                    f"understands {SCHEMA_VERSION}")
+            if data.get("params_fingerprint") != params_fingerprint:
+                raise PipelineStateError(
+                    f"{path} records a run with different inputs "
+                    f"(params fingerprint "
+                    f"{data.get('params_fingerprint')!r} != "
+                    f"{params_fingerprint!r}). Resuming would mix two "
+                    f"runs' stages into one candidate; finish/inspect "
+                    f"the old run or use a fresh --pipeline_dir")
+            if log is not None:
+                done = [n for n in stage_names
+                        if data.get("stages", {}).get(n)]
+                log(f"Pipeline manifest resumed from {path}: "
+                    f"{len(done)}/{len(stage_names)} stage(s) already "
+                    f"committed ({', '.join(done) or 'none'})")
+            return cls(path, data)
+        data = {
+            "schema_version": SCHEMA_VERSION,
+            "params_fingerprint": params_fingerprint,
+            "stage_names": list(stage_names),
+            "created_at": time.time(),
+            "stages": {},
+            "journal": [],
+            "terminal": None,
+        }
+        manifest = cls(path, data)
+        manifest._write()
+        return manifest
+
+    # ------------------------------------------------------------ state
+
+    def stage(self, name: str) -> Optional[Dict]:
+        return self.data["stages"].get(name)
+
+    @property
+    def terminal(self) -> Optional[Dict]:
+        return self.data.get("terminal")
+
+    def journal(self, event: str, **detail) -> None:
+        rec = {"t": time.time(), "event": event}
+        rec.update(detail)
+        self.data["journal"].append(rec)
+        self.data["journal"] = self.data["journal"][-_JOURNAL_CAP:]
+        self._write()
+
+    def commit_stage(self, name: str, outputs: Dict,
+                     duration_s: Optional[float] = None,
+                     status: str = "committed") -> None:
+        self.data["stages"][name] = {
+            "status": status,
+            "outputs": outputs,
+            "completed_at": time.time(),
+            "duration_s": (None if duration_s is None
+                           else round(duration_s, 3)),
+        }
+        # one atomic write commits record + journal entry together
+        self.journal("stage_commit", stage=name, status=status)
+
+    def set_terminal(self, outcome: str, detail: Dict) -> None:
+        self.data["terminal"] = {"outcome": outcome,
+                                 "completed_at": time.time(),
+                                 "detail": detail}
+        self.journal("terminal", outcome=outcome)
+
+    # ------------------------------------------------------------ write
+
+    def _write(self) -> None:
+        exporters._atomic_write(
+            self.path,
+            json.dumps(self.data, indent=1, sort_keys=True) + "\n")

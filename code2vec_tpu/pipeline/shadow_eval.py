@@ -1,0 +1,223 @@
+"""Shadow evaluation: score a candidate model side-by-side with the
+incumbent and decide — with numbers — whether it may be promoted.
+
+Two signal sources, both replayed through BOTH models so every number
+is a paired comparison, never a cross-run anecdote:
+
+- **Accuracy harness**: the `--test` corpus through each model's
+  standard `evaluate()` (the PR-8 release-runtime eval path — the
+  exact head, the exact metrics the README reports). The gate compares
+  top-1/top-k accuracy and subtoken F1 deltas against configurable
+  regression bars.
+- **Recorded live traffic**: a sampled slice of extractor lines the
+  serving stack recorded (`--serve_traffic_sample`,
+  serving/traffic.py) replayed through each model's bucketed predict
+  path; the gate compares top-k AGREEMENT (mean overlap of the two
+  top-k lists) and top-1 agreement — distribution-shift insurance the
+  frozen harness cannot give.
+
+The verdict is fail-closed: a candidate whose metrics are non-finite
+(a NaN-poisoned fine-tune) is refused regardless of the bars, and any
+single tripped bar refuses promotion. Every gate number is exported as
+a `pipeline_gate_*` gauge and the verdict counted in
+`pipeline_gate_total{verdict}` so the refusal is diagnosable from a
+scrape alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, Iterable, List, Optional
+
+from code2vec_tpu import obs
+
+
+@dataclasses.dataclass(frozen=True)
+class GateBars:
+    """Regression bars, phrased as the largest tolerated DROP (candidate
+    minus incumbent; a negative delta is a regression) and the smallest
+    tolerated traffic agreement."""
+    max_top1_drop: float = 0.01
+    max_topk_drop: float = 0.01
+    max_f1_drop: float = 0.01
+    min_topk_agreement: float = 0.98
+
+    @classmethod
+    def from_config(cls, config) -> "GateBars":
+        return cls(
+            max_top1_drop=float(getattr(config,
+                                        "pipeline_gate_top1_drop", 0.01)),
+            max_topk_drop=float(getattr(config,
+                                        "pipeline_gate_topk_drop", 0.01)),
+            max_f1_drop=float(getattr(config,
+                                      "pipeline_gate_f1_drop", 0.01)),
+            min_topk_agreement=float(getattr(
+                config, "pipeline_gate_min_agreement", 0.98)))
+
+
+def _eval_numbers(results) -> Dict[str, float]:
+    """(top1, topk, f1, loss) from a ModelEvaluationResults-like object
+    (duck-typed: the comparator unit tests script these)."""
+    topk_acc = results.topk_acc
+    return {
+        "top1": float(topk_acc[0]),
+        "topk": float(topk_acc[-1]),
+        "f1": float(results.subtoken_f1),
+        "loss": (None if getattr(results, "loss", None) is None
+                 else float(results.loss)),
+    }
+
+
+def sample_traffic(lines: Iterable[str], limit: int,
+                   seed: int = 0) -> List[str]:
+    """A deterministic sample of up to `limit` recorded traffic lines
+    (seeded — reruns of a killed shadow-eval stage replay the SAME
+    slice). `limit <= 0` disables the replay entirely (the documented
+    `--pipeline_shadow_samples 0` semantics: gate on the accuracy
+    harness alone)."""
+    if limit <= 0:
+        return []
+    pool = [ln for ln in (l.strip("\n") for l in lines) if ln.strip()]
+    if len(pool) <= limit:
+        return pool
+    return random.Random(seed).sample(pool, limit)
+
+
+def topk_agreement(incumbent, candidate, lines: List[str],
+                   batch_size: int = 64) -> Dict[str, Optional[float]]:
+    """Replay extractor lines through both models' predict paths and
+    measure how much the answer would change for live callers:
+    `topk_agreement` = mean overlap fraction of the two top-k word
+    lists, `top1_agreement` = fraction of lines whose #1 word is
+    unchanged. Returns None values when there is nothing to replay."""
+    if not lines:
+        return {"samples": 0, "topk_agreement": None,
+                "top1_agreement": None}
+    inc = incumbent.predict(list(lines), batch_size=batch_size)
+    cand = candidate.predict(list(lines), batch_size=batch_size)
+    overlap_sum = 0.0
+    top1_hits = 0
+    for a, b in zip(inc, cand):
+        wa = list(a.topk_predicted_words)
+        wb = list(b.topk_predicted_words)
+        k = max(len(wa), len(wb), 1)
+        overlap_sum += len(set(wa) & set(wb)) / k
+        if wa and wb and wa[0] == wb[0]:
+            top1_hits += 1
+    n = len(lines)
+    return {"samples": n,
+            "topk_agreement": overlap_sum / n,
+            "top1_agreement": top1_hits / n}
+
+
+def gate_verdict(incumbent_eval, candidate_eval,
+                 agreement: Optional[Dict] = None,
+                 bars: Optional[GateBars] = None) -> Dict:
+    """The promotion decision. Returns {passed, reasons, numbers};
+    `numbers` carries every delta/agreement the verdict was made on
+    (they also go into the heartbeat and the flight-recorder incident
+    when the gate refuses). Fail-closed on non-finite candidate
+    metrics."""
+    bars = bars or GateBars()
+    inc = _eval_numbers(incumbent_eval)
+    cand = _eval_numbers(candidate_eval)
+    numbers: Dict = {
+        "incumbent_top1": inc["top1"], "candidate_top1": cand["top1"],
+        "incumbent_topk": inc["topk"], "candidate_topk": cand["topk"],
+        "incumbent_f1": inc["f1"], "candidate_f1": cand["f1"],
+        "top1_delta": cand["top1"] - inc["top1"],
+        "topk_delta": cand["topk"] - inc["topk"],
+        "f1_delta": cand["f1"] - inc["f1"],
+        "topk_agreement": (None if not agreement
+                           else agreement.get("topk_agreement")),
+        "top1_agreement": (None if not agreement
+                           else agreement.get("top1_agreement")),
+        "traffic_samples": (0 if not agreement
+                            else int(agreement.get("samples") or 0)),
+    }
+    reasons: List[str] = []
+    cand_scalars = [cand["top1"], cand["topk"], cand["f1"]]
+    if cand["loss"] is not None:
+        cand_scalars.append(cand["loss"])
+    if agreement and agreement.get("topk_agreement") is not None:
+        cand_scalars.append(agreement["topk_agreement"])
+    if not all(math.isfinite(v) for v in cand_scalars):
+        reasons.append(
+            "candidate metrics are non-finite (NaN-poisoned "
+            "fine-tune); refusing regardless of the bars")
+    else:
+        for key, bar in (("top1", bars.max_top1_drop),
+                         ("topk", bars.max_topk_drop),
+                         ("f1", bars.max_f1_drop)):
+            delta = numbers[f"{key}_delta"]
+            if delta < -bar:
+                reasons.append(
+                    f"{key} regressed {delta:+.4f} (bar: -{bar:g}); "
+                    f"incumbent {inc[key]:.4f} vs candidate "
+                    f"{cand[key]:.4f}")
+        agr = numbers["topk_agreement"]
+        if agr is not None and agr < bars.min_topk_agreement:
+            reasons.append(
+                f"top-k traffic agreement {agr:.4f} below "
+                f"{bars.min_topk_agreement:g} over "
+                f"{numbers['traffic_samples']} replayed sample(s)")
+    passed = not reasons
+    obs.gauge("pipeline_gate_top1_delta",
+              "shadow-eval candidate-minus-incumbent top-1 accuracy "
+              "delta of the latest gate decision").set(
+        numbers["top1_delta"] if math.isfinite(numbers["top1_delta"])
+        else -1.0)
+    obs.gauge("pipeline_gate_topk_delta",
+              "shadow-eval candidate-minus-incumbent top-k accuracy "
+              "delta of the latest gate decision").set(
+        numbers["topk_delta"] if math.isfinite(numbers["topk_delta"])
+        else -1.0)
+    obs.gauge("pipeline_gate_f1_delta",
+              "shadow-eval candidate-minus-incumbent subtoken-F1 "
+              "delta of the latest gate decision").set(
+        numbers["f1_delta"] if math.isfinite(numbers["f1_delta"])
+        else -1.0)
+    if numbers["topk_agreement"] is not None:
+        obs.gauge("pipeline_gate_topk_agreement",
+                  "shadow-eval incumbent/candidate top-k agreement "
+                  "over replayed live-traffic samples (latest gate "
+                  "decision)").set(
+            numbers["topk_agreement"]
+            if math.isfinite(numbers["topk_agreement"]) else 0.0)
+    obs.counter("pipeline_gate_total",
+                "shadow-eval gate decisions by verdict",
+                verdict="pass" if passed else "fail").inc()
+    return {"passed": passed, "reasons": reasons, "numbers": numbers}
+
+
+def shadow_compare(config, incumbent_artifact: str,
+                   candidate_artifact: str,
+                   traffic_lines: List[str],
+                   bars: Optional[GateBars] = None,
+                   build_model=None, log=None) -> Dict:
+    """The shadow-eval stage body on REAL release artifacts: build both
+    sides (PR-8 runtime; `build_model` is the test seam), run the
+    accuracy harness through each, replay the traffic slice, and return
+    the gate verdict. The incumbent is never mutated — both models are
+    read-only artifact consumers."""
+    log = log or config.log
+    if build_model is None:
+        def build_model(artifact_dir):
+            from code2vec_tpu.release.runtime import ReleaseModel
+            cfg = dataclasses.replace(config, serve_artifact=artifact_dir,
+                                      serve=False, predict=False,
+                                      pipeline=False)
+            return ReleaseModel(cfg, log=log)
+    incumbent = build_model(incumbent_artifact)
+    candidate = build_model(candidate_artifact)
+    log(f"Shadow eval: scoring incumbent {incumbent_artifact} vs "
+        f"candidate {candidate_artifact} on {config.test_data_path} "
+        f"+ {len(traffic_lines)} replayed traffic line(s)")
+    incumbent_eval = incumbent.evaluate()
+    candidate_eval = candidate.evaluate()
+    agreement = topk_agreement(incumbent, candidate, traffic_lines)
+    return gate_verdict(incumbent_eval, candidate_eval,
+                        agreement=agreement,
+                        bars=bars or GateBars.from_config(config))

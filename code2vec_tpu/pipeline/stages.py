@@ -1,0 +1,528 @@
+"""Stage bodies of the continuous-training pipeline.
+
+Each stage is a function `fn(ctx) -> outputs dict` over a
+PipelineContext; the supervisor owns ordering, manifest commits and
+fault points. Stage work is IDEMPOTENT under re-run: outputs are
+committed atomically by their writers (pack_raw's tmp+rename, the
+checkpoint commit protocol, the export-dir rename below, the embed
+job's per-shard resume), so a stage killed before its manifest commit
+can simply run again.
+
+Heavy lifting runs in CHILD processes re-execing this repo's own CLI
+(`train`/`export`/`embed`/`index-build`) — the same crash-isolation
+philosophy as the serving supervisor: the pipeline parent holds no
+model, so a fine-tune OOM kills one stage attempt, not the loop's
+state.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu import obs
+from code2vec_tpu.utils.faults import fault_point
+
+CHECKPOINT_MANIFEST = "code2vec_manifest.json"
+CHECKPOINT_META = "code2vec_meta.json"
+
+
+class StageFailed(RuntimeError):
+    """A stage attempt failed (crash, bad input, subprocess rc != 0).
+    NOT terminal: the manifest keeps no record, so a rerun retries the
+    stage from its last committed predecessor."""
+
+    def __init__(self, stage: str, detail: str):
+        super().__init__(f"pipeline stage {stage!r} failed: {detail}")
+        self.stage = stage
+        self.detail = detail
+
+
+class StageSkipped(Exception):
+    """A stage that does not apply to this run (no fleet to promote
+    into, retrieval refresh not requested); committed to the manifest
+    with status "skipped" so reruns don't re-decide."""
+
+
+class GateRefused(StageFailed):
+    """The shadow-eval quality gate refused the candidate — a TERMINAL
+    verdict (the incumbent keeps serving; re-running cannot change the
+    numbers)."""
+
+    def __init__(self, stage: str, detail: str, numbers: Dict):
+        super().__init__(stage, detail)
+        self.numbers = numbers
+
+
+class PromoteFailed(StageFailed):
+    """The fleet rollout failed or rolled back — TERMINAL for this run
+    (the fleet swap driver already restored the incumbent everywhere;
+    the candidate needs investigation, not a blind retry)."""
+
+    def __init__(self, stage: str, detail: str, outcome: str,
+                 numbers: Optional[Dict] = None):
+        super().__init__(stage, detail)
+        self.outcome = outcome
+        self.numbers = numbers or {}
+
+
+def _c_promotions(outcome: str):
+    return obs.counter(
+        "pipeline_promotions_total",
+        "pipeline-driven fleet promotions by outcome (committed, "
+        "failed, rolled_back, timeout)", outcome=outcome)
+
+
+class PipelineContext:
+    """What every stage sees: config, the manifest (for committed
+    predecessors' outputs), per-stage work dirs under the pipeline run
+    dir, and a CLI-subprocess runner."""
+
+    def __init__(self, config, manifest, run_dir: str, log):
+        self.config = config
+        self.manifest = manifest
+        self.run_dir = run_dir
+        self.log = log
+
+    def dir(self, name: str) -> str:
+        path = os.path.join(self.run_dir, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def outputs(self, stage: str) -> Dict:
+        rec = self.manifest.stage(stage)
+        if rec is None:
+            raise StageFailed(
+                stage, f"stage ordering bug: {stage!r} has no committed "
+                       f"record yet its outputs were requested")
+        return rec.get("outputs") or {}
+
+    def run_cli(self, argv: List[str], stage: str, name: str) -> None:
+        """Run `python -m code2vec_tpu.cli <argv>` to completion,
+        logging to `<stage dir>/<name>.log`; nonzero rc = StageFailed
+        with the log path named (the child's heartbeat file, when one
+        was passed, says where it stopped)."""
+        from code2vec_tpu.serving.supervisor import child_env
+        log_path = os.path.join(self.dir(stage), f"{name}.log")
+        cmd = [sys.executable, "-m", "code2vec_tpu.cli"] + list(argv)
+        self.log(f"Pipeline stage {stage}: running {name} subprocess "
+                 f"({' '.join(argv[:6])}...; log: {log_path})")
+        with open(log_path, "ab") as logf:
+            rc = subprocess.call(cmd, stdout=logf, stderr=logf,
+                                 env=child_env(os.environ))
+        if rc != 0:
+            raise StageFailed(
+                stage, f"{name} subprocess exited rc={rc}; see "
+                       f"{log_path}")
+
+
+# ------------------------------------------------------------ helpers
+
+
+def newest_committed_checkpoint(load_path: str
+                                ) -> Tuple[Optional[str], int]:
+    """(dir, epoch) of the newest committed checkpoint a `--load` path
+    resolves to — a concrete artifact dir, or the newest `_iter*` under
+    a save base. LIGHT probe only (manifest present + meta readable);
+    the consuming subprocess's resolve path does full integrity
+    verification and backward fallback."""
+    base = os.path.abspath(load_path)
+    candidates = ([base] if os.path.isfile(
+        os.path.join(base, CHECKPOINT_MANIFEST))
+        else [p for p in glob_mod.glob(base + "_iter*")
+              if os.path.isfile(os.path.join(p, CHECKPOINT_MANIFEST))])
+    best: Optional[str] = None
+    best_key = (-1, -1.0)
+    for path in candidates:
+        try:
+            with open(os.path.join(path, CHECKPOINT_META)) as f:
+                epoch = int(json.load(f).get("epoch", 0))
+            mtime = os.path.getmtime(
+                os.path.join(path, CHECKPOINT_MANIFEST))
+        except (OSError, ValueError):
+            continue
+        if (epoch, mtime) > best_key:
+            best, best_key = path, (epoch, mtime)
+    return best, max(best_key[0], 0)
+
+
+def _frozen_vocabs(config, incumbent_dir: str):
+    from code2vec_tpu.vocab import Code2VecVocabs
+    path = os.path.join(incumbent_dir, "dictionaries.bin")
+    if not os.path.isfile(path):
+        raise StageFailed(
+            "ingest", f"incumbent checkpoint {incumbent_dir} has no "
+                      f"dictionaries.bin to freeze the vocab from")
+    return Code2VecVocabs.load(
+        path, separate_oov_and_pad=config.separate_oov_and_pad)
+
+
+def measure_delta_oov(raw_path: str, ds, vocabs) -> Dict[str, float]:
+    """OOV profile of an ingested delta (`ds`: its PackedDataset)
+    against the frozen vocab: the 'is the vocabulary aging out' signal
+    of the continuous loop. target rate = packed rows whose label fell
+    to OOV (untrainable); context rate = raw token/path fields missing
+    from the frozen dicts — measured on the TEXT because in the joined
+    PAD/OOV scheme an OOV slot packs to the PAD index and the ints
+    cannot distinguish them (one extra serial pass over the raw file;
+    delta shards are small next to the base corpus)."""
+    t_oov = vocabs.target_vocab.oov_index
+    rows = oov_rows = 0
+    for start in range(0, ds.num_rows_total, 1 << 18):
+        labels = ds._rec[start:start + (1 << 18), 0]
+        rows += labels.shape[0]
+        oov_rows += int((labels == t_oov).sum())
+    token_w2i = vocabs.token_vocab.word_to_index
+    path_w2i = vocabs.path_vocab.word_to_index
+    slots = oov_slots = 0
+    with open(raw_path, "r", errors="surrogateescape",
+              buffering=16 * 1024 * 1024) as f:
+        for line in f:
+            for ctx in line.split()[1:]:
+                pieces = ctx.split(",")
+                a = pieces[0] if pieces else ""
+                b = pieces[1] if len(pieces) > 1 else ""
+                c = pieces[2] if len(pieces) > 2 else ""
+                for val, table in ((a, token_w2i), (c, token_w2i)):
+                    if val:
+                        slots += 1
+                        oov_slots += val not in table
+                if b:
+                    slots += 1
+                    oov_slots += b not in path_w2i
+    return {"rows": rows,
+            "target_oov_rate": oov_rows / max(rows, 1),
+            "context_oov_rate": oov_slots / max(slots, 1)}
+
+
+def _fleet_base(config) -> str:
+    addr = str(config.pipeline_fleet).strip().rstrip("/")
+    if not addr.startswith("http://") and not addr.startswith("https://"):
+        addr = "http://" + addr
+    return addr
+
+
+def _http_json(stage: str, method: str, url: str,
+               payload: Optional[Dict] = None,
+               timeout: float = 15.0) -> Tuple[int, Dict]:
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            raw = r.read()
+            status = r.status
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        status = e.code
+    except (OSError, ValueError) as e:
+        raise StageFailed(stage, f"fleet unreachable at {url}: {e}")
+    try:
+        body = json.loads(raw.decode("utf-8", errors="replace") or "{}")
+    except ValueError:
+        body = {"raw": raw.decode("utf-8", errors="replace")[:200]}
+    return status, body
+
+
+def drive_fleet_swap(ctx, stage: str, artifact: str,
+                     retrieval_index: Optional[str] = None) -> Dict:
+    """Request a canary-first coordinated rollout through the fleet
+    router and poll `GET /fleet` until THIS rollout (keyed on its
+    target) reaches a terminal state. Returns the terminal swap status
+    dict; the caller maps failed/rolled_back to its own verdict."""
+    config = ctx.config
+    base = _fleet_base(config)
+    model = config.pipeline_model
+    payload: Dict = {"artifact": artifact, "model": model}
+    if retrieval_index:
+        payload["retrieval_index"] = retrieval_index
+    status, body = _http_json(stage, "POST", base + "/admin/reload",
+                              payload)
+    if status not in (200, 202):
+        raise StageFailed(
+            stage, f"fleet reload request refused: HTTP {status} "
+                   f"{json.dumps(body)[:300]}")
+    deadline = time.monotonic() + config.pipeline_promote_timeout_s
+    last: Dict = {}
+    while time.monotonic() < deadline:
+        time.sleep(0.25)
+        status, view = _http_json(stage, "GET", base + "/fleet")
+        if status != 200:
+            continue
+        swap = view.get("swap") or {}
+        if swap.get("target") != artifact:
+            continue  # an older rollout's status; ours not started yet
+        last = {"swap": swap, "models": view.get("models", {})}
+        if swap.get("state") in ("committed", "failed", "rolled_back"):
+            return last
+    _c_promotions("timeout").inc()
+    raise StageFailed(
+        stage, f"fleet rollout did not reach a terminal state within "
+               f"{config.pipeline_promote_timeout_s:g}s "
+               f"(last: {json.dumps(last)[:300]}); inspect GET /fleet")
+
+
+# -------------------------------------------------------------- stages
+
+
+def run_ingest(ctx: PipelineContext) -> Dict:
+    """Pack new raw extractor output as a delta shard against the
+    FROZEN incumbent vocab (no re-histogram, no sampling tiers — OOV
+    is the designed fate of genuinely new words, and its rate is the
+    exported aging signal)."""
+    config = ctx.config
+    raw = config.pipeline_raw
+    if not raw or not os.path.isfile(raw):
+        raise StageFailed("ingest",
+                          f"--pipeline_raw {raw!r} is not a file")
+    incumbent_ckpt, _epoch = newest_committed_checkpoint(
+        config.model_load_path)
+    if incumbent_ckpt is None:
+        raise StageFailed(
+            "ingest", f"no committed checkpoint under --load "
+                      f"{config.model_load_path}")
+    vocabs = _frozen_vocabs(config, incumbent_ckpt)
+    delta_prefix = os.path.join(ctx.dir("delta"), "delta")
+    packed = delta_prefix + ".train.c2vb"
+    from code2vec_tpu.data.packed import PackedDataset, pack_raw
+    from code2vec_tpu.data.reader import EstimatorAction
+    rows = pack_raw(raw, packed, vocabs, None, None,
+                    config.max_contexts, seed=config.seed,
+                    num_workers=config.preprocess_workers, log=ctx.log)
+    ds = PackedDataset(packed, vocabs)
+    oov = measure_delta_oov(raw, ds, vocabs)
+    obs.counter("pipeline_ingest_rows_total",
+                "delta rows packed by pipeline ingest").inc(rows)
+    for kind in ("target", "context"):
+        obs.gauge("pipeline_ingest_oov_rate",
+                  "OOV rate of the latest ingested delta shard against "
+                  "the frozen vocab (kind=target: rows whose label is "
+                  "OOV; kind=context: non-pad context slots that fell "
+                  "to OOV)", kind=kind).set(oov[f"{kind}_oov_rate"])
+    # post-filter trainable rows bound the fine-tune batch size
+    train_rows = ds.steps_per_epoch(1, EstimatorAction.Train)
+    if train_rows == 0:
+        raise StageFailed(
+            "ingest", f"delta shard has no trainable rows "
+                      f"({rows} packed, all filtered: OOV target / no "
+                      f"valid context)")
+    ctx.log(f"Pipeline ingest: {rows} rows ({train_rows} trainable) "
+            f"packed at {packed}; target OOV "
+            f"{oov['target_oov_rate']:.4f}, context OOV "
+            f"{oov['context_oov_rate']:.4f}")
+    return {"delta_prefix": delta_prefix, "packed": packed,
+            "rows": rows, "train_rows": train_rows,
+            "incumbent_ckpt": incumbent_ckpt,
+            "target_oov_rate": oov["target_oov_rate"],
+            "context_oov_rate": oov["context_oov_rate"]}
+
+
+def run_finetune(ctx: PipelineContext) -> Dict:
+    """Fine-tune from the latest committed checkpoint on the delta
+    shard, in a child CLI process (elastic-restore path: `--load`
+    resolves to the newest VALID artifact and restores on whatever
+    host count/mesh the child runs). A rerun after a mid-train kill
+    resumes from the candidate's own newest committed checkpoint."""
+    config = ctx.config
+    ingest = ctx.outputs("ingest")
+    save_base = os.path.join(ctx.dir("candidate"), "ckpt")
+    # resume-aware source: a prior (killed) fine-tune attempt's own
+    # committed checkpoint beats restarting from the incumbent
+    prior, _ = newest_committed_checkpoint(save_base)
+    load_from = save_base if prior is not None else \
+        config.model_load_path
+    _, incumbent_epoch = newest_committed_checkpoint(
+        config.model_load_path)
+    total_epochs = incumbent_epoch + config.pipeline_finetune_epochs
+    batch = max(1, min(config.train_batch_size, ingest["train_rows"]))
+    argv = ["--data", ingest["delta_prefix"],
+            "--load", load_from,
+            "--save", save_base,
+            "--epochs", str(total_epochs),
+            "--batch_size", str(batch),
+            "--seed", str(config.seed),
+            "--heartbeat_file",
+            os.path.join(ctx.dir("finetune"), "train.heartbeat.json"),
+            "--metrics_file",
+            os.path.join(ctx.dir("finetune"), "train.metrics.prom")]
+    ctx.run_cli(argv, "finetune", "train")
+    candidate, cand_epoch = newest_committed_checkpoint(save_base)
+    if candidate is None:
+        raise StageFailed(
+            "finetune", f"train subprocess exited 0 but no committed "
+                        f"checkpoint exists under {save_base}")
+    return {"save_base": save_base, "candidate_ckpt": candidate,
+            "epoch": cand_epoch, "batch_size": batch,
+            "loaded_from": load_from}
+
+
+def run_export(ctx: PipelineContext) -> Dict:
+    """Export the candidate as a PR-8 release artifact (scheme from
+    config), committed by directory rename so a kill mid-export leaves
+    only a disposable `.tmp` dir."""
+    config = ctx.config
+    finetune = ctx.outputs("finetune")
+    out = os.path.join(ctx.dir("candidate"), "artifact")
+    tmp = out + ".tmp"
+    # idempotent re-run: clear any casualty of a previous attempt
+    shutil.rmtree(tmp, ignore_errors=True)
+    shutil.rmtree(out, ignore_errors=True)
+    argv = ["export", "--load", finetune["save_base"],
+            "--artifact_out", tmp,
+            "--release_scheme", config.release_scheme]
+    if not config.release_quantize:
+        argv.append("--no_quantize")
+    if not config.release_aot:
+        argv.append("--no_aot")
+    ctx.run_cli(argv, "export", "export")
+    meta_path = os.path.join(tmp, "release_meta.json")
+    try:
+        with open(meta_path) as f:
+            fingerprint = json.load(f)["fingerprint"]
+    except (OSError, ValueError, KeyError) as e:
+        raise StageFailed("export",
+                          f"exported artifact has no readable "
+                          f"fingerprint ({meta_path}: {e})")
+    os.rename(tmp, out)
+    return {"artifact": out, "fingerprint": fingerprint,
+            "scheme": config.release_scheme}
+
+
+def run_shadow_eval(ctx: PipelineContext) -> Dict:
+    """Candidate vs incumbent through the accuracy harness plus a
+    replayed traffic slice; a tripped bar is a TERMINAL refusal."""
+    fault_point("shadow_eval")
+    config = ctx.config
+    from code2vec_tpu.pipeline.shadow_eval import (
+        GateBars, sample_traffic, shadow_compare,
+    )
+    export = ctx.outputs("export")
+    lines: List[str] = []
+    if config.pipeline_traffic:
+        if not os.path.isfile(config.pipeline_traffic):
+            ctx.log(f"Pipeline shadow eval: no traffic sample at "
+                    f"{config.pipeline_traffic}; gating on the "
+                    f"accuracy harness alone")
+        else:
+            with open(config.pipeline_traffic) as f:
+                lines = sample_traffic(f, config.pipeline_shadow_samples,
+                                       seed=config.seed)
+    verdict = shadow_compare(config, config.pipeline_incumbent,
+                             export["artifact"], lines,
+                             bars=GateBars.from_config(config),
+                             log=ctx.log)
+    if not verdict["passed"]:
+        raise GateRefused("shadow_eval",
+                          "; ".join(verdict["reasons"]),
+                          numbers=verdict["numbers"])
+    ctx.log(f"Pipeline gate PASSED: "
+            f"top1 {verdict['numbers']['top1_delta']:+.4f}, "
+            f"f1 {verdict['numbers']['f1_delta']:+.4f}, agreement "
+            f"{verdict['numbers']['topk_agreement']}")
+    return dict(verdict["numbers"], gate="passed")
+
+
+def run_promote(ctx: PipelineContext) -> Dict:
+    """Canary-first fleet rollout of the gated candidate (the PR-13
+    swap driver, through the router's admin surface). failed or
+    rolled_back is TERMINAL — the driver already left/restored the
+    incumbent on every host."""
+    config = ctx.config
+    export = ctx.outputs("export")
+    if not config.pipeline_fleet:
+        raise StageSkipped(
+            f"no --pipeline_fleet router address; gated candidate is "
+            f"ready at {export['artifact']}")
+    fault_point("promote")
+    result = drive_fleet_swap(ctx, "promote", export["artifact"])
+    swap = result["swap"]
+    outcome = swap.get("state")
+    _c_promotions(outcome).inc()
+    if outcome != "committed":
+        raise PromoteFailed(
+            "promote",
+            f"fleet rollout {outcome}: {swap.get('error')} — the "
+            f"incumbent is serving everywhere (driver "
+            f"{'rolled the fleet back' if outcome == 'rolled_back' else 'halted at the canary'})",
+            outcome=outcome,
+            numbers={"swap_error": swap.get("error"),
+                     "hosts": swap.get("hosts")})
+    model_view = result.get("models", {}).get(config.pipeline_model, {})
+    ctx.log(f"Pipeline promote committed: fleet on fingerprint "
+            f"{swap.get('target_fingerprint')} "
+            f"(mixed={model_view.get('mixed_fingerprints')})")
+    return {"outcome": "committed",
+            "fingerprint": swap.get("target_fingerprint"),
+            "hosts": swap.get("hosts")}
+
+
+def run_retrieval_refresh(ctx: PipelineContext) -> Dict:
+    """Re-embed the delta shard with the promoted candidate, build a
+    fresh ANN index carrying its fingerprint, and remount it across
+    the fleet through the reload fan-out (the refuse/detach policy
+    guards the transition on every replica; the model swap at promote
+    detached any stale index under `detach`)."""
+    config = ctx.config
+    if not config.pipeline_refresh_retrieval:
+        raise StageSkipped("--pipeline_refresh_retrieval not set")
+    ingest = ctx.outputs("ingest")
+    export = ctx.outputs("export")
+    retr = ctx.dir("retrieval")
+    store = os.path.join(retr, "store")
+    index_out = os.path.join(retr, "index")
+    corpus = ingest["delta_prefix"] + ".train.c2v"
+    # embed resumes per committed shard across re-runs (PR-10)
+    ctx.run_cli(["embed", "--artifact", export["artifact"],
+                 "--test", corpus, "--embed_out", store,
+                 "--embed_dtype", config.embed_dtype,
+                 "--embed_shard_rows", str(config.embed_shard_rows)],
+                "retrieval_refresh", "embed")
+    tmp = index_out + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    shutil.rmtree(index_out, ignore_errors=True)
+    ctx.run_cli(["index-build", "--vectors", store,
+                 "--index_out", tmp,
+                 "--nlist", str(config.index_nlist),
+                 "--nprobe", str(config.index_nprobe),
+                 "--index_metric", config.index_metric],
+                "retrieval_refresh", "index-build")
+    os.rename(tmp, index_out)
+    outputs = {"store": store, "index": index_out,
+               "fingerprint": export["fingerprint"]}
+    if not config.pipeline_fleet:
+        outputs["remount"] = "skipped (no fleet)"
+        return outputs
+    result = drive_fleet_swap(ctx, "retrieval_refresh",
+                              export["artifact"],
+                              retrieval_index=index_out)
+    state = result["swap"].get("state")
+    if state != "committed":
+        raise StageFailed(
+            "retrieval_refresh",
+            f"index remount rollout {state}: "
+            f"{result['swap'].get('error')} — prediction traffic is "
+            f"unaffected; /neighbors stays on the detached/previous "
+            f"index until remounted")
+    outputs["remount"] = "committed"
+    ctx.log(f"Pipeline retrieval refresh: index {index_out} remounted "
+            f"fleet-wide behind fingerprint {export['fingerprint']}")
+    return outputs
+
+
+DEFAULT_STAGES = (
+    ("ingest", run_ingest),
+    ("finetune", run_finetune),
+    ("export", run_export),
+    ("shadow_eval", run_shadow_eval),
+    ("promote", run_promote),
+    ("retrieval_refresh", run_retrieval_refresh),
+)

@@ -1,0 +1,267 @@
+"""The pipeline stage machine: drive ingest -> fine-tune -> export ->
+shadow-eval -> promote -> retrieval refresh with crash-safe,
+journaled progress (the `pipeline` CLI subcommand body).
+
+Robustness contract (README "Continuous training"):
+
+- Every stage's outputs commit atomically and its completion is
+  recorded in the journaled manifest (pipeline/manifest.py) — a
+  SIGKILL at ANY stage boundary resumes idempotently from the last
+  committed stage, and committed work is never repeated.
+- The fault point `pipeline_stage` (utils/faults.py) is crossed TWICE
+  per stage — at stage start, and again with the stage's work done but
+  its manifest commit pending — so the chaos suite can kill the
+  supervisor at every boundary of the machine; `shadow_eval` and
+  `promote` fire inside their stages.
+- A refused quality gate or a failed/rolled-back fleet rollout is
+  TERMINAL: the incumbent keeps serving everywhere, the verdict (with
+  its numbers) lands in the manifest, the heartbeat and a
+  flight-recorder incident, and the supervisor exits nonzero. Reruns
+  of a terminal manifest re-report the verdict — every rerun converges
+  to the same terminal manifest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu import obs
+from code2vec_tpu.pipeline.manifest import (
+    PipelineManifest, PipelineStateError,
+)
+from code2vec_tpu.pipeline.stages import (
+    DEFAULT_STAGES, GateRefused, PipelineContext, PromoteFailed,
+    StageFailed, StageSkipped,
+)
+from code2vec_tpu.utils.faults import fault_point
+
+
+def _h_stage(stage: str):
+    return obs.histogram(
+        "pipeline_stage_seconds",
+        "wall time of one pipeline stage attempt that reached its "
+        "manifest commit", stage=stage)
+
+
+def _c_stage(stage: str, outcome: str):
+    return obs.counter(
+        "pipeline_stages_total",
+        "pipeline stage attempts by outcome (committed, skipped, "
+        "refused, failed)", stage=stage, outcome=outcome)
+
+
+def _c_runs(outcome: str):
+    return obs.counter(
+        "pipeline_runs_total",
+        "pipeline runs reaching a terminal verdict (committed, "
+        "gate_refused, promote_failed) or failing a stage attempt "
+        "(error)", outcome=outcome)
+
+
+class PipelineSupervisor:
+    """One pipeline run over one state dir. `stages` is the injection
+    seam: [(name, fn(ctx))] — production uses stages.DEFAULT_STAGES,
+    the chaos suite scripts cheap stage bodies around the REAL
+    manifest/fault/terminal machinery."""
+
+    def __init__(self, config, stages: Optional[List[Tuple]] = None,
+                 log=None, params_fingerprint: Optional[str] = None):
+        self.config = config
+        self.log = log or config.log
+        if not config.pipeline_dir:
+            raise PipelineStateError(
+                "pipeline requires --pipeline_dir DIR (the journaled "
+                "state root)")
+        self.run_dir = os.path.abspath(config.pipeline_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.heartbeat_path = config.heartbeat_file or os.path.join(
+            self.run_dir, "pipeline.heartbeat.json")
+        self.stages = list(stages if stages is not None
+                           else DEFAULT_STAGES)
+        self.flight = obs.default_flight_recorder()
+        self.flight.configure(
+            dump_dir=self.run_dir,
+            max_dumps=getattr(config, "serve_flight_max_dumps", 64),
+            log=self.log)
+        self.manifest = PipelineManifest.load_or_create(
+            self.run_dir,
+            params_fingerprint or self._params_fingerprint(),
+            [name for name, _fn in self.stages], log=self.log)
+        self.ctx = PipelineContext(config, self.manifest, self.run_dir,
+                                   self.log)
+
+    # ------------------------------------------------------- identity
+
+    def _params_fingerprint(self) -> str:
+        """Identity of the run REQUEST: resuming this dir with
+        different inputs/bars is refused (manifest.py). The raw delta
+        file participates by path+size so a silently swapped input
+        cannot graft onto a half-finished run."""
+        config = self.config
+        raw = config.pipeline_raw
+        raw_size = None
+        if raw and os.path.isfile(raw):
+            raw_size = os.path.getsize(raw)
+        ident = {
+            "raw": os.path.abspath(raw) if raw else None,
+            "raw_size": raw_size,
+            "load": (os.path.abspath(config.model_load_path)
+                     if config.model_load_path else None),
+            "incumbent": (os.path.abspath(config.pipeline_incumbent)
+                          if config.pipeline_incumbent else None),
+            "test": config.test_data_path or None,
+            "traffic": (os.path.abspath(config.pipeline_traffic)
+                        if config.pipeline_traffic else None),
+            "finetune_epochs": config.pipeline_finetune_epochs,
+            "bars": [config.pipeline_gate_top1_drop,
+                     config.pipeline_gate_topk_drop,
+                     config.pipeline_gate_f1_drop,
+                     config.pipeline_gate_min_agreement],
+            "scheme": config.release_scheme,
+            "fleet": config.pipeline_fleet or None,
+            "model": config.pipeline_model,
+            "refresh": bool(config.pipeline_refresh_retrieval),
+            "seed": config.seed,
+        }
+        return hashlib.sha256(
+            json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
+
+    # ------------------------------------------------------ heartbeat
+
+    def _heartbeat(self, status: str, **extra) -> None:
+        obs.exporters.write_heartbeat(
+            self.heartbeat_path, status=status, role="pipeline",
+            pipeline_dir=self.run_dir,
+            stages_committed=[n for n, _ in self.stages
+                              if self.manifest.stage(n)], **extra)
+
+    # ------------------------------------------------------------ run
+
+    def run(self) -> int:
+        terminal = self.manifest.terminal
+        if terminal is not None:
+            outcome = terminal["outcome"]
+            self.log(f"Pipeline manifest is already terminal "
+                     f"({outcome}); re-reporting. "
+                     f"{json.dumps(terminal['detail'])[:400]}")
+            self._heartbeat("done" if outcome == "committed"
+                            else outcome, terminal=terminal)
+            return 0 if outcome == "committed" else 1
+        for name, fn in self.stages:
+            rec = self.manifest.stage(name)
+            if rec is not None:
+                self.log(f"Pipeline stage {name}: already "
+                         f"{rec['status']} "
+                         f"(at {rec.get('completed_at')}); skipping")
+                continue
+            self._heartbeat("running", stage=name)
+            fault_point("pipeline_stage")  # boundary: stage start
+            self.manifest.journal("stage_start", stage=name)
+            self.log(f"Pipeline stage {name}: starting")
+            t0 = time.monotonic()
+            try:
+                outputs = fn(self.ctx)
+                status = "committed"
+            except StageSkipped as e:
+                outputs = {"reason": str(e)}
+                status = "skipped"
+                self.log(f"Pipeline stage {name}: skipped ({e})")
+            except GateRefused as e:
+                return self._terminal_failure(
+                    "gate_refused", name, str(e), e.numbers,
+                    incident="pipeline_gate_refused")
+            except PromoteFailed as e:
+                return self._terminal_failure(
+                    "promote_failed", name, str(e),
+                    dict(e.numbers, rollout_outcome=e.outcome),
+                    incident="pipeline_promote_failed")
+            except StageFailed as e:
+                return self._stage_failure(name, str(e))
+            except Exception as e:  # noqa: BLE001 — a stage body
+                # raising OUTSIDE the StageFailed family (a corrupt
+                # artifact's ValueError, a disk-full OSError) is still
+                # a failed ATTEMPT: record it everywhere the runbook
+                # looks instead of dying with a bare traceback and a
+                # forever-"running" heartbeat. Not terminal — the
+                # manifest keeps no record, a rerun retries.
+                return self._stage_failure(
+                    name, f"{type(e).__name__}: {e}")
+            duration = time.monotonic() - t0
+            # boundary: work done, manifest commit pending — a kill
+            # here re-runs the stage (its writers are idempotent),
+            # never skips it
+            fault_point("pipeline_stage")
+            self.manifest.commit_stage(name, outputs,
+                                       duration_s=duration,
+                                       status=status)
+            _h_stage(name).observe(duration)
+            _c_stage(name, status).inc()
+            self.log(f"Pipeline stage {name}: {status} in "
+                     f"{duration:.1f}s")
+        detail = self._run_summary()
+        self.manifest.set_terminal("committed", detail)
+        _c_runs("committed").inc()
+        self._heartbeat("done", terminal=self.manifest.terminal)
+        self.log(f"Pipeline run COMMITTED: "
+                 f"{json.dumps(detail)[:400]}")
+        return 0
+
+    def _run_summary(self) -> Dict:
+        detail: Dict = {}
+        export = self.manifest.stage("export")
+        if export and export.get("outputs"):
+            detail["artifact"] = export["outputs"].get("artifact")
+            detail["fingerprint"] = export["outputs"].get("fingerprint")
+        promote = self.manifest.stage("promote")
+        if promote:
+            detail["promote"] = promote["status"]
+        return detail
+
+    def _stage_failure(self, name: str, error: str) -> int:
+        """A failed stage ATTEMPT (not a verdict): counted, heartbeat
+        status=error, immediate flight dump, rc 1 — and the manifest
+        untouched, so a rerun resumes exactly here."""
+        _c_stage(name, "failed").inc()
+        _c_runs("error").inc()
+        self.flight.incident("pipeline_stage_failed", immediate=True,
+                             stage=name, error=error)
+        self._heartbeat("error", stage=name, error=error)
+        self.log(f"Pipeline stage {name} FAILED (rerun resumes here): "
+                 f"{error}")
+        return 1
+
+    def _terminal_failure(self, outcome: str, stage: str, error: str,
+                          numbers: Dict, incident: str) -> int:
+        """A verdict rerunning cannot change: record it everywhere the
+        runbook says to look — manifest (terminal), heartbeat (with the
+        gate's numbers), flight recorder (immediate dump), metrics —
+        and exit nonzero with the incumbent serving everywhere."""
+        _c_stage(stage, "refused").inc()
+        _c_runs(outcome).inc()
+        safe_numbers = {k: v for k, v in (numbers or {}).items()
+                        if isinstance(v, (int, float, str, bool,
+                                          type(None)))}
+        self.manifest.set_terminal(
+            outcome, {"stage": stage, "error": error, **safe_numbers})
+        self.flight.incident(incident, immediate=True, stage=stage,
+                             error=error, **safe_numbers)
+        self._heartbeat(outcome, stage=stage, error=error,
+                        gate=safe_numbers)
+        self.log(f"Pipeline {outcome.upper()} at stage {stage}: "
+                 f"{error}")
+        return 1
+
+
+def pipeline_main(config, argv=None) -> int:
+    """`pipeline` CLI subcommand body (cli.main dispatches here before
+    any model/jax state is built — stages own their heavy children)."""
+    try:
+        supervisor = PipelineSupervisor(config)
+    except PipelineStateError as e:
+        config.log(f"Pipeline refused to start: {e}")
+        return 1
+    return supervisor.run()

@@ -642,8 +642,10 @@ class ControlPlane:
 
     def request_swap(self, payload: dict) -> Tuple[int, dict]:
         model = str(payload.get("model") or DEFAULT_MODEL)
-        status = self.swap.request(payload.get("artifact"), model=model,
-                                   rollback_to=payload.get("rollback"))
+        status = self.swap.request(
+            payload.get("artifact"), model=model,
+            rollback_to=payload.get("rollback"),
+            retrieval_index=payload.get("retrieval_index"))
         return 202, {"accepted": True, "swap": status}
 
     def request_scale(self, host_id, n) -> Tuple[int, dict]:
@@ -698,8 +700,12 @@ class ControlPlane:
         return [h for h in self.hosts
                 if h.model == model and h.alive and not h.draining]
 
-    def host_reload(self, host: _Host, artifact: str):
-        return self._post(host, "/admin/reload", {"artifact": artifact})
+    def host_reload(self, host: _Host, artifact: str,
+                    retrieval_index: Optional[str] = None):
+        payload = {"artifact": artifact}
+        if retrieval_index:
+            payload["retrieval_index"] = str(retrieval_index)
+        return self._post(host, "/admin/reload", payload)
 
     def host_fleet(self, host: _Host) -> Optional[dict]:
         raw = self._fetch(host, "/fleet")

@@ -93,9 +93,13 @@ class FleetSwapDriver:
     # ------------------------------------------------------------- start
 
     def request(self, artifact, model: str = "default",
-                rollback_to: Optional[str] = None) -> dict:
+                rollback_to: Optional[str] = None,
+                retrieval_index: Optional[str] = None) -> dict:
         """Kick off an async rollout; returns the fresh status. Raises
-        ValueError on a bad request, FleetSwapBusy while one runs."""
+        ValueError on a bad request, FleetSwapBusy while one runs.
+        `retrieval_index` rides the reload to every replica, which
+        mounts it atomically with its model flip (the pipeline's
+        retrieval-refresh rollout; rollbacks never carry one)."""
         if not artifact:
             raise ValueError('no artifact: body must be '
                              '{"artifact": DIR[, "model": NAME]}')
@@ -117,7 +121,8 @@ class FleetSwapDriver:
                 started_at=time.time(), completed_at=None)
             self._worker = threading.Thread(
                 target=self._run,
-                args=(str(artifact), model, hosts, rollback),
+                args=(str(artifact), model, hosts, rollback,
+                      retrieval_index),
                 name="fleet-swap", daemon=True)
             self._worker.start()
         return self.status()
@@ -125,16 +130,19 @@ class FleetSwapDriver:
     # ----------------------------------------------------------- rollout
 
     def _run(self, artifact: str, model: str, hosts: List,
-             rollback: Optional[str]) -> None:
+             rollback: Optional[str],
+             retrieval_index: Optional[str] = None) -> None:
         control = self.control
         control.flight.event("fleet_swap_start", target=artifact,
                              model=model, hosts=len(hosts),
+                             retrieval_index=retrieval_index,
                              canary=hosts[0].id)
         target_fp: Optional[str] = None
         committed: List = []
         for i, host in enumerate(hosts):
             ok, result = self._swap_host(host, artifact,
-                                         expect_fp=target_fp)
+                                         expect_fp=target_fp,
+                                         retrieval_index=retrieval_index)
             if not ok:
                 self._host_outcome(host.id, f"failed: {result}")
                 control.flight.event("fleet_swap_halt", host=host.id,
@@ -208,7 +216,8 @@ class FleetSwapDriver:
     # ---------------------------------------------------------- one host
 
     def _swap_host(self, host, artifact: str,
-                   expect_fp: Optional[str]):
+                   expect_fp: Optional[str],
+                   retrieval_index: Optional[str] = None):
         """Drive one host's supervisor reload fan-out and poll its
         /fleet until every replica lands one converged fingerprint with
         swap_state ready. Returns (True, fingerprint) or (False, why).
@@ -216,7 +225,8 @@ class FleetSwapDriver:
         a host converging on anything else is a failure (two artifacts
         claiming one dir, a stale cache on one host)."""
         control = self.control
-        ok, why = control.host_reload(host, artifact)
+        ok, why = control.host_reload(host, artifact,
+                                      retrieval_index=retrieval_index)
         if not ok:
             return False, f"reload request failed: {why}"
         timeout = float(getattr(control.config, "fleet_swap_timeout_s",
@@ -233,12 +243,16 @@ class FleetSwapDriver:
                         if not r.get("draining")]
             if not replicas:
                 continue
-            # convergence is keyed on swap_target == THIS artifact: a
-            # replica still showing a PREVIOUS rollout's "ready" (or a
-            # stale "failed" from an old target) can neither satisfy
-            # nor abort this one
+            # convergence is keyed on (swap_target, swap_retrieval_
+            # index) == THIS rollout's: a replica still showing a
+            # PREVIOUS rollout's "ready" (or a stale "failed" from an
+            # old target) can neither satisfy nor abort this one —
+            # including a retrieval-refresh rollout re-targeting the
+            # SAME artifact the promote rollout just landed
             on_target = [r for r in replicas
-                         if r.get("swap_target") == artifact]
+                         if r.get("swap_target") == artifact
+                         and r.get("swap_retrieval_index")
+                         == retrieval_index]
             if any(r.get("swap_state") == "failed"
                    for r in on_target):
                 return False, ("a replica rejected the candidate "

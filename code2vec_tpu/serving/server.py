@@ -184,6 +184,13 @@ class PredictionServer:
             buckets=model.context_buckets)
         self.cache = PredictionCache(self.config.serve_cache_entries)
         self.topk = self.config.top_k_words_considered_during_prediction
+        # Live-traffic sample for the continuous-training pipeline's
+        # shadow eval (serving/traffic.py): every Nth cache-miss
+        # request's EXTRACTED lines into a bounded ring file that the
+        # pipeline replays through incumbent and candidate
+        # (--serve_traffic_sample; None = off).
+        from code2vec_tpu.serving.traffic import sampler_for
+        self.traffic = sampler_for(self.config, log=self.log)
         # Retrieval mount (serve --retrieval_index DIR): /neighbors
         # serves ANN code search from this index. Mounting validates the
         # index artifact AND that its recorded embedding fingerprint is
@@ -245,11 +252,14 @@ class PredictionServer:
         every cache key and stamped on every response. Swappable."""
         return self._model_ref[1]
 
-    def swap_model(self, new_model) -> str:
+    def swap_model(self, new_model, retrieval_handle=None) -> str:
         """Atomically replace the serving model (called by the
         SwapManager AFTER validation). In-flight batches finish on the
         model reference they already read; the next dispatched batch —
-        and the next cache key — uses the new one."""
+        and the next cache key — uses the new one. `retrieval_handle`
+        (an already-mounted, fingerprint-checked RetrievalHandle)
+        remounts /neighbors atomically WITH the flip — the pipeline's
+        retrieval-refresh stage delivers a rebuilt index this way."""
         fp = new_model.model_fingerprint()
         with self._model_lock:
             self._model_ref = (new_model, fp)
@@ -257,6 +267,11 @@ class PredictionServer:
             # model's bucket grid (and fresh device-time samples — p95s
             # keyed to the old grid would misprice every refusal)
             self.batcher.rebucket(new_model.context_buckets)
+            if retrieval_handle is not None:
+                self.retrieval = retrieval_handle
+                self.log(f"Retrieval index remounted atomically with "
+                         f"the model swap (fingerprint "
+                         f"{retrieval_handle.fingerprint})")
             # Embedding-space backstop, atomic with the flip: a mounted
             # index whose vectors came from different weights must never
             # answer /neighbors again (the SwapManager's `refuse` policy
@@ -438,6 +453,8 @@ class PredictionServer:
         try:
             lines, hash_to_string = self._extract(code, deadline, phases,
                                                   trace=trace)
+            if self.traffic is not None:
+                self.traffic.record(lines)
             future = self.batcher.submit(lines, phases=phases,
                                          deadline=deadline, trace=trace)
             try:
@@ -846,7 +863,9 @@ class PredictionServer:
                         raise _HTTPError(
                             400, 'body must be {"artifact": DIR}')
                     target = payload.get("artifact")
-                    status = server.swap.request_reload(target)
+                    status = server.swap.request_reload(
+                        target,
+                        retrieval_index=payload.get("retrieval_index"))
                 except json.JSONDecodeError as e:
                     self._error(400, f"bad JSON body: {e}")
                 except SwapError as e:
@@ -976,6 +995,8 @@ class PredictionServer:
                     break
                 self._inflight_cond.wait(timeout=remaining)
         self.batcher.drain(timeout=max(deadline - time.monotonic(), 1.0))
+        if self.traffic is not None:
+            self.traffic.flush()
         self.pool.close()
         if self._httpd is not None:
             try:
@@ -991,10 +1012,12 @@ class PredictionServer:
 RELOAD_TARGET_FILENAME = "reload-target.json"
 
 
-def reload_target_for(config) -> Optional[str]:
-    """The artifact dir a SIGHUP should reload, when the supervisor
-    dropped a reload-target file into the run dir (next to this
-    replica's heartbeat file); None otherwise."""
+def reload_target_info(config) -> Optional[dict]:
+    """The reload-target payload a SIGHUP should act on, when the
+    supervisor dropped a reload-target file into the run dir (next to
+    this replica's heartbeat file): {"artifact": DIR} plus an optional
+    "retrieval_index" DIR to remount atomically with the swap (the
+    pipeline's retrieval-refresh stage). None otherwise."""
     if not config.heartbeat_file:
         return None
     path = os.path.join(
@@ -1002,10 +1025,15 @@ def reload_target_for(config) -> Optional[str]:
         RELOAD_TARGET_FILENAME)
     try:
         with open(path) as f:
-            target = json.load(f).get("artifact")
+            payload = json.load(f)
     except (OSError, ValueError):
         return None
-    return str(target) if target else None
+    if not isinstance(payload, dict) or not payload.get("artifact"):
+        return None
+    return {"artifact": str(payload["artifact"]),
+            "retrieval_index": (str(payload["retrieval_index"])
+                                if payload.get("retrieval_index")
+                                else None)}
 
 
 def _heartbeat_fields(server: PredictionServer) -> dict:
@@ -1025,6 +1053,11 @@ def _heartbeat_fields(server: PredictionServer) -> dict:
         # driver keys its convergence poll on this, so a replica still
         # showing LAST rollout's "ready" can never satisfy a new one
         "swap_target": swap_status["target"],
+        # ...and which index rode along (None for a plain model swap):
+        # a retrieval-refresh rollout re-targets the SAME artifact, so
+        # the driver needs this to tell the new rollout's "ready" from
+        # the promote rollout's
+        "swap_retrieval_index": swap_status.get("retrieval_index"),
         "breakers": {"extractor": server.extractor_breaker.state,
                      "device": server.device_breaker.state},
         "requests_total": total("serving_requests_total"),
@@ -1071,11 +1104,15 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
         # kernel-chosen replica, so the file + SIGHUP is how EVERY
         # replica learns a NEW artifact dir) wins over the boot-time
         # --artifact.
-        target = reload_target_for(config) or config.serve_artifact
+        info = reload_target_info(config)
+        target = (info["artifact"] if info else None) \
+            or config.serve_artifact
         if target:
             config.log(f"SIGHUP: reloading artifact {target}")
             try:
-                server.swap.request_reload(target)
+                server.swap.request_reload(
+                    target,
+                    retrieval_index=(info or {}).get("retrieval_index"))
             except SwapError as e:
                 config.log(f"SIGHUP reload rejected: {e}")
         else:

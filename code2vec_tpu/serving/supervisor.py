@@ -215,9 +215,15 @@ class Supervisor:
             # to exit wins)
             stripped = strip_flag(stripped, "--metrics_file")
             stripped = strip_flag(stripped, "--trace_export")
+            # ...and --serve_traffic_sample: every replica rewriting
+            # ONE ring file would silently reduce the shadow-eval
+            # corpus to whichever replica flushed last
+            stripped = strip_flag(stripped, "--serve_traffic_sample")
             self.child_command = ([sys.executable, "-m",
                                    "code2vec_tpu.cli"] + stripped)
         self.trace_export = bool(getattr(config, "trace_export", None))
+        self.traffic_sample = getattr(config,
+                                      "serve_traffic_sample_file", None)
         base = (os.path.dirname(os.path.abspath(config.heartbeat_file))
                 if config.heartbeat_file else None)
         self.run_dir = base or tempfile.mkdtemp(prefix="c2v-serve-sup-")
@@ -287,6 +293,16 @@ class Supervisor:
             cmd += ["--trace_export",
                     os.path.join(self.run_dir,
                                  f"replica{replica.index}.trace.json")]
+        if self.traffic_sample:
+            # per-replica (and, under a fleet, per-host) traffic
+            # sample ring (README "Continuous training"): point the
+            # pipeline's --pipeline_traffic at any one of them (or
+            # concatenate)
+            host = os.environ.get("C2V_FLEET_HOST")
+            suffix = (f".{host}" if host else "") + \
+                f".replica{replica.index}"
+            cmd += ["--serve_traffic_sample",
+                    self.traffic_sample + suffix]
         env = child_env(os.environ)
         env[REPLICA_ENV] = str(replica.index)
         if self.reuseport:
@@ -462,7 +478,7 @@ class Supervisor:
 
     # ----------------------------------------------------------- reload
 
-    def reload_all(self, artifact) -> dict:
+    def reload_all(self, artifact, retrieval_index=None) -> dict:
         """Fan a hot-swap to `artifact` out to EVERY live replica —
         the per-host leg of the fleet-wide coordinated swap
         (serving/fleet/swap.py drives this canary-host-first). Proxy
@@ -489,10 +505,13 @@ class Supervisor:
         # _atomic_write's thread-unique tmp matters here: the telemetry
         # listener AND the proxy both accept /admin/reload on their own
         # threads of this pid
+        target_payload = {"artifact": artifact,
+                          "requested_at": time.time()}
+        if retrieval_index:
+            target_payload["retrieval_index"] = str(retrieval_index)
         obs.exporters._atomic_write(
             os.path.join(self.run_dir, RELOAD_TARGET_FILENAME),
-            json.dumps({"artifact": artifact,
-                        "requested_at": time.time()}) + "\n")
+            json.dumps(target_payload) + "\n")
         ready, starting = [], []
         for replica in targets:
             (ready if replica.heartbeat() is not None
@@ -519,10 +538,13 @@ class Supervisor:
                         self.config.serve_host, replica.port,
                         timeout=10)
                     try:
+                        body = {"artifact": artifact}
+                        if retrieval_index:
+                            body["retrieval_index"] = str(
+                                retrieval_index)
                         conn.request(
                             "POST", "/admin/reload",
-                            body=json.dumps({"artifact": artifact}
-                                            ).encode(),
+                            body=json.dumps(body).encode(),
                             headers={"Content-Type":
                                      "application/json"})
                         resp = conn.getresponse()
@@ -551,7 +573,9 @@ class Supervisor:
         return 200, self.request_scale(payload.get("replicas"))
 
     def _admin_reload(self, payload: dict):
-        return 202, self.reload_all(payload.get("artifact"))
+        return 202, self.reload_all(
+            payload.get("artifact"),
+            retrieval_index=payload.get("retrieval_index"))
 
     # ---------------------------------------------------------- monitor
 

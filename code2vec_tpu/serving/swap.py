@@ -62,17 +62,21 @@ class SwapManager:
     is loading/validating is rejected (409) rather than queued —
     deploy tooling should poll `model.swap_status` and re-issue."""
 
-    def __init__(self, server, build_model: Optional[Callable] = None):
+    def __init__(self, server, build_model: Optional[Callable] = None,
+                 mount_index: Optional[Callable] = None):
         self.server = server
         self.config = server.config
         self.log = server.log
-        # Injection seam: tests swap between in-process models; the
-        # default builds a ReleaseModel from an artifact dir with the
-        # PR-8 load-time validation.
+        # Injection seams: tests swap between in-process models (and
+        # mount scripted index handles); the defaults build a
+        # ReleaseModel from an artifact dir with the PR-8 load-time
+        # validation and mount a fingerprint-checked RetrievalHandle.
         self._build_model = build_model or self._build_release_model
+        self._mount_index = mount_index or self._mount_retrieval_index
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
-        self._status = {"state": "idle", "target": None, "error": None,
+        self._status = {"state": "idle", "target": None,
+                        "retrieval_index": None, "error": None,
                         "completed_at": None, "swapped_fingerprint": None}
 
     # ------------------------------------------------------------ state
@@ -87,9 +91,15 @@ class SwapManager:
 
     # -------------------------------------------------------------- API
 
-    def request_reload(self, artifact_dir: Optional[str]) -> dict:
+    def request_reload(self, artifact_dir: Optional[str],
+                       retrieval_index: Optional[str] = None) -> dict:
         """Kick off an async reload; returns the (new) status. Raises
-        SwapError when no target is given or a swap is in flight."""
+        SwapError when no target is given or a swap is in flight.
+        `retrieval_index` additionally mounts a rebuilt /neighbors
+        index ATOMICALLY with the model flip (the index is
+        fingerprint-checked against the NEW model before anything
+        swaps; a mismatch fails the whole swap, old model + old index
+        untouched)."""
         if not artifact_dir:
             raise SwapError(
                 "no artifact to reload: POST /admin/reload with "
@@ -103,9 +113,11 @@ class SwapManager:
                     f"target={self._status['target']}); poll "
                     f"/healthz model.swap_status and retry")
             self._status.update(state="loading", target=artifact_dir,
+                                retrieval_index=retrieval_index,
                                 error=None, completed_at=None)
             self._worker = threading.Thread(
-                target=self._reload_worker, args=(artifact_dir,),
+                target=self._reload_worker,
+                args=(artifact_dir, retrieval_index),
                 name="serving-swap", daemon=True)
             self._worker.start()
         return self.status()
@@ -122,17 +134,25 @@ class SwapManager:
                                      serve_artifact=artifact_dir)
         return ReleaseModel(config, log=self.log)
 
-    def _reload_worker(self, artifact_dir: str) -> None:
+    def _reload_worker(self, artifact_dir: str,
+                       retrieval_index: Optional[str] = None) -> None:
         from code2vec_tpu.obs.flight import default_flight_recorder
         flight = default_flight_recorder()
         old_model = self.server.model
         flight.event("swap_start", target=artifact_dir,
+                     retrieval_index=retrieval_index,
                      old_fingerprint=self.server.model_fingerprint)
         try:
             fault_point("swap_validate")
             new_model = self._build_model(artifact_dir)
             self._set(state="validating")
-            self._validate(old_model, new_model)
+            self._validate(old_model, new_model,
+                           mounting_index=retrieval_index is not None)
+            # the riding index mounts (and fingerprint-checks against
+            # the NEW model) BEFORE anything swaps: a bad index fails
+            # the whole reload with old model + old index untouched
+            handle = (self._mount_index(retrieval_index, new_model)
+                      if retrieval_index else None)
         except BaseException as e:  # noqa: BLE001 — ANY load/validate
             # failure must leave the old model serving and be visible.
             _swap_counter("failed").inc()
@@ -145,7 +165,7 @@ class SwapManager:
                      f"({type(e).__name__}: {e}); old model "
                      f"{self.server.model_fingerprint} keeps serving")
             return
-        fp = self.server.swap_model(new_model)
+        fp = self.server.swap_model(new_model, retrieval_handle=handle)
         _swap_counter("success").inc()
         self._set(state="ready", completed_at=time.time(),
                   swapped_fingerprint=fp)
@@ -154,7 +174,15 @@ class SwapManager:
         self.log(f"Model swapped live to {artifact_dir} "
                  f"(fingerprint {fp})")
 
-    def _validate(self, old_model, new_model) -> None:
+    def _mount_retrieval_index(self, path: str, new_model):
+        from code2vec_tpu.retrieval.api import RetrievalHandle
+        return RetrievalHandle.mount(
+            path, new_model.model_fingerprint(),
+            default_topk=getattr(self.config, "retrieval_topk", 10),
+            log=self.log)
+
+    def _validate(self, old_model, new_model,
+                  mounting_index: bool = False) -> None:
         """Golden-prediction smoke batch: the new model must produce the
         same OUTPUT SCHEMA the running one does — same top-k width (a
         narrower k would silently truncate every client's list), same
@@ -173,7 +201,11 @@ class SwapManager:
                     f"{new[field]} vs running model's {old[field]} — "
                     f"clients depend on the running schema; re-export "
                     f"the artifact to match or deploy as a new service")
-        self._validate_retrieval(new_model)
+        if not mounting_index:
+            # a reload that CARRIES a new index replaces the mounted
+            # one — the stale-index policy below only governs swaps
+            # that would leave the old index behind
+            self._validate_retrieval(new_model)
 
     def _validate_retrieval(self, new_model) -> None:
         """Embedding-space gate for a mounted retrieval index: a swap to

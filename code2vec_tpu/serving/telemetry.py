@@ -281,7 +281,8 @@ def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
                 "requests_total": None, "requests_shed_total": None,
                 "requests_expired_total": None,
                 "shed_rate": None, "swap_state": None,
-                "swap_target": None, "inflight": None}
+                "swap_target": None, "swap_retrieval_index": None,
+                "inflight": None}
     total = heartbeat.get("requests_total")
     shed = heartbeat.get("requests_shed_total")
     shed_rate = None
@@ -302,6 +303,7 @@ def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
         "shed_rate": shed_rate,
         "swap_state": heartbeat.get("swap_state"),
         "swap_target": heartbeat.get("swap_target"),
+        "swap_retrieval_index": heartbeat.get("swap_retrieval_index"),
         "inflight": heartbeat.get("inflight"),
     }
 

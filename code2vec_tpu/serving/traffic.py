@@ -1,0 +1,105 @@
+"""Live-traffic sampling for shadow evaluation (README "Continuous
+training").
+
+The pipeline's shadow-eval stage replays a slice of what production
+actually asked the model, so a candidate that matches the frozen
+accuracy harness but diverges on real traffic is still caught. This
+sampler records the EXTRACTED predict lines (the post-extractor
+`name ctx,ctx,ctx ...` rows) — the exact input both sides of the
+shadow replay consume — on every Nth cache-miss request, into a
+bounded ring that is atomically rewritten on a small cadence
+(`--serve_traffic_sample`, `--serve_traffic_sample_every`,
+`--serve_traffic_sample_cap`).
+
+Deliberately OFF the hot path: a sampled request pays one deque
+extend; the file rewrite happens once per `_FLUSH_EVERY` sampled
+requests and at drain. Raw source never lands on disk — only the
+extractor's tokenized context lines (method names + path contexts),
+the same data the .c2v corpus format already carries.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional
+
+from code2vec_tpu import obs
+from code2vec_tpu.obs import exporters
+
+_C_SAMPLED = obs.counter(
+    "serving_traffic_sampled_total",
+    "extractor lines recorded into the live-traffic sample ring for "
+    "shadow evaluation")
+
+_FLUSH_EVERY = 32
+
+
+class TrafficSampler:
+    """Thread-safe bounded sample of predict-path extractor lines."""
+
+    def __init__(self, path: str, every: int = 10, cap: int = 4096,
+                 log=None):
+        self.path = path
+        self.every = max(1, int(every))
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, int(cap)))
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._sampled_since_flush = 0
+        self._log = log or (lambda msg: None)
+
+    def record(self, lines: List[str]) -> None:
+        """Offer one request's extracted lines; every Nth request is
+        kept. Never raises into the request path."""
+        try:
+            with self._lock:
+                self._requests += 1
+                if self._requests % self.every:
+                    return
+                clean = [ln.strip() for ln in lines if ln.strip()]
+                if not clean:
+                    return
+                self._ring.extend(clean)
+                _C_SAMPLED.inc(len(clean))
+                self._sampled_since_flush += 1
+                flush = self._sampled_since_flush >= _FLUSH_EVERY
+                if flush:
+                    self._sampled_since_flush = 0
+                    snapshot = list(self._ring)
+            if flush:
+                self._write(snapshot)
+        except Exception as e:  # noqa: BLE001 — sampling must never
+            # fail a serving request
+            self._log(f"Traffic sampler record failed ({e})")
+
+    def flush(self) -> None:
+        with self._lock:
+            snapshot = list(self._ring)
+            self._sampled_since_flush = 0
+        if snapshot:
+            self._write(snapshot)
+
+    def _write(self, snapshot: List[str]) -> None:
+        try:
+            exporters._atomic_write(self.path,
+                                    "\n".join(snapshot) + "\n")
+        except OSError as e:
+            self._log(f"Traffic sampler write failed ({e})")
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"path": self.path, "every": self.every,
+                    "entries": len(self._ring),
+                    "requests_seen": self._requests}
+
+
+def sampler_for(config, log=None) -> Optional[TrafficSampler]:
+    path = getattr(config, "serve_traffic_sample_file", None)
+    if not path:
+        return None
+    return TrafficSampler(
+        path,
+        every=getattr(config, "serve_traffic_sample_every", 10),
+        cap=getattr(config, "serve_traffic_sample_cap", 4096),
+        log=log)

@@ -70,6 +70,27 @@ serving/swap.py, serving/server.py; tests/test_serving_chaos.py):
                        hung-replica detection fires); `exit` kills the
                        whole replica (the supervisor's crash-restart
                        path).
+
+Fault points in the continuous-training pipeline
+(pipeline/supervisor.py, pipeline/stages.py;
+tests/test_pipeline.py):
+
+- `pipeline_stage`   — crossed TWICE per stage of the pipeline stage
+                       machine: at stage start (hit 2k-1 for stage k)
+                       and again with the stage's work done but its
+                       manifest commit still pending (hit 2k). Arming
+                       `pipeline_stage@N=exit` therefore kills the
+                       supervisor at EVERY boundary of the machine;
+                       the rerun must resume from the last committed
+                       stage and never repeat committed work.
+- `shadow_eval`      — top of the shadow-eval stage, before either
+                       model is built. A kill here must leave the
+                       candidate un-judged (stage uncommitted, rerun
+                       re-evaluates) and the incumbent serving.
+- `promote`          — immediately before the canary-first fleet
+                       rollout request is issued. A kill here must
+                       leave the fleet untouched on the incumbent
+                       (the rollout was never requested).
 """
 
 from __future__ import annotations

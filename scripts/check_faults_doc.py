@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Static fault-point documentation check (tier-1 via
+tests/test_faults_doc.py) — check_metrics_doc.py's sibling for the
+chaos registry.
+
+Every `fault_point("name")` call site under `code2vec_tpu/` must be
+documented in the registry docstring of `utils/faults.py` (the
+`- \\`name\\`` bullets), and every documented name must still be
+crossed somewhere in the code — a new fault point cannot ship
+undocumented (the chaos suite arms points BY NAME from that registry),
+and the registry cannot keep names the code dropped (an armed typo'd/
+stale point silently injects nothing, invalidating the drill).
+
+Call sites are extracted by AST walk: any call whose callee is named
+`fault_point` (bare or attribute) with a literal first argument — the
+repo convention. A non-literal first argument is an ERROR: a
+dynamically-named fault point cannot be statically checked or armed
+from the registry.
+
+Usage: python scripts/check_faults_doc.py  (exit 0 = consistent)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "code2vec_tpu")
+REGISTRY = os.path.join(PACKAGE_DIR, "utils", "faults.py")
+
+# the registry module itself defines fault_point; its docstring is the
+# documentation side, so its code is not a call-site source
+_IGNORED_FILES = {os.path.join("utils", "faults.py")}
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# a registry entry is a bullet whose FIRST token is a backticked name
+# (prose mentions elsewhere in the docstring — spec grammar, examples —
+# are not declarations)
+_DOC_NAME_RE = re.compile(r"^- `([a-z][a-z0-9_]*)`", re.MULTILINE)
+
+
+def crossed_fault_points() -> Dict[str, List[str]]:
+    """{fault-point name: [files crossing it]} from an AST walk of the
+    package. Raises SystemExit on a dynamic (non-literal) name."""
+    names: Dict[str, List[str]] = {}
+    errors: List[str] = []
+    for root, _dirs, files in os.walk(PACKAGE_DIR):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, PACKAGE_DIR)
+            if rel in _IGNORED_FILES:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                func = node.func
+                callee = (func.id if isinstance(func, ast.Name)
+                          else func.attr if isinstance(func,
+                                                       ast.Attribute)
+                          else None)
+                if callee != "fault_point":
+                    continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and _NAME_RE.match(arg.value)):
+                    names.setdefault(arg.value, []).append(rel)
+                    continue
+                errors.append(
+                    f"{rel}:{node.lineno}: non-literal fault-point "
+                    f"name in fault_point(...) — the chaos suite arms "
+                    f"points by name from the utils/faults.py "
+                    f"registry, so the name must be a string literal")
+    if errors:
+        raise SystemExit("\n".join(errors))
+    return names
+
+
+def documented_fault_points() -> Set[str]:
+    """Backticked bullet names in the utils/faults.py registry
+    docstring."""
+    with open(REGISTRY) as f:
+        tree = ast.parse(f.read(), filename=REGISTRY)
+    doc = ast.get_docstring(tree)
+    if not doc:
+        raise SystemExit(f"{REGISTRY} has no module docstring — the "
+                         f"fault-point registry lives there")
+    return set(_DOC_NAME_RE.findall(doc))
+
+
+def check() -> List[str]:
+    """Returns a list of problems (empty = consistent)."""
+    crossed = crossed_fault_points()
+    documented = documented_fault_points()
+    problems: List[str] = []
+    for name in sorted(set(crossed) - documented):
+        problems.append(
+            f"UNDOCUMENTED: fault point {name} (crossed in "
+            f"{', '.join(sorted(set(crossed[name])))}) is missing from "
+            f"the utils/faults.py registry docstring")
+    for name in sorted(documented - set(crossed)):
+        problems.append(
+            f"STALE DOC: fault point {name} appears in the "
+            f"utils/faults.py registry docstring but no fault_point() "
+            f"call site crosses it under code2vec_tpu/")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} fault-point documentation "
+              f"problem(s). Update the registry docstring in "
+              f"code2vec_tpu/utils/faults.py.")
+        return 1
+    print(f"OK: {len(crossed_fault_points())} crossed fault points "
+          f"all documented, no stale registry entries.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

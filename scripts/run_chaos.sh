@@ -42,6 +42,11 @@ RETRIEVAL_BUDGET=600
 # each fleet is 2 host supervisors x fake-model replicas, so the
 # budget covers hangs, not work.
 FLEET_BUDGET=600
+# Continuous-training pipeline: the SIGKILL-at-every-stage-boundary
+# matrix on the real supervisor (scripted stage bodies — milliseconds
+# per attempt) plus the end-to-end promotion/refusal/rollback drill on
+# a real 2-host fake-model fleet under client load.
+PIPELINE_BUDGET=600
 
 rc=0
 
@@ -67,6 +72,7 @@ run_suite "$ELASTIC_BUDGET" tests/test_elastic_resume.py "$@"
 run_suite "$SERVING_BUDGET" tests/test_serving_chaos.py "$@"
 run_suite "$RETRIEVAL_BUDGET" tests/test_retrieval.py "$@"
 run_suite "$FLEET_BUDGET" tests/test_fleet.py "$@"
+run_suite "$PIPELINE_BUDGET" tests/test_pipeline.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

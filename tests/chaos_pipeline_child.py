@@ -1,0 +1,66 @@
+"""Pipeline-supervisor child for the pipeline chaos suite
+(tests/test_pipeline.py).
+
+Runs the REAL PipelineSupervisor (manifest, journal, fault points,
+terminal verdicts) over cheap scripted stage bodies, so the
+SIGKILL-at-every-boundary drill runs in milliseconds per attempt: the
+parent arms `C2V_FAULTS=pipeline_stage@N=exit` in the environment,
+this process dies with the distinctive fault exit code mid-machine,
+and the rerun must resume from the last committed stage.
+
+Each stage body appends one `<stage>` line to `LEDGER` (append-mode —
+survives the kill) and writes a deterministic `out-<stage>.txt` into
+the run dir, so the parent can prove (a) committed stages never re-ran
+and (b) every kill matrix converges to the same terminal manifest.
+
+Usage: python tests/chaos_pipeline_child.py PIPELINE_DIR LEDGER
+"""
+
+import os
+import sys
+
+os.environ.setdefault("C2V_HOST_WORKER", "1")  # no jax in the drill
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main() -> int:
+    pipeline_dir, ledger = sys.argv[1], sys.argv[2]
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.pipeline.supervisor import PipelineSupervisor
+    from code2vec_tpu.utils.faults import fault_point
+
+    def stage(name, extra_fault=None):
+        def body(ctx):
+            if extra_fault:
+                fault_point(extra_fault)
+            with open(ledger, "a") as f:
+                f.write(name + "\n")
+            out = os.path.join(ctx.run_dir, f"out-{name}.txt")
+            tmp = out + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{name}: deterministic output\n")
+            os.replace(tmp, out)
+            return {"stage": name, "out": out}
+        return (name, body)
+
+    stages = [
+        stage("ingest"),
+        stage("finetune"),
+        stage("export"),
+        stage("shadow_eval", extra_fault="shadow_eval"),
+        stage("promote", extra_fault="promote"),
+        stage("retrieval_refresh"),
+    ]
+    config = Config(pipeline=True, pipeline_dir=pipeline_dir,
+                    verbose_mode=0)
+    supervisor = PipelineSupervisor(config, stages=stages,
+                                    log=lambda m: None)
+    return supervisor.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Tier-1 gate for scripts/check_faults_doc.py: every fault point
+crossed under code2vec_tpu/ must appear in the utils/faults.py registry
+docstring and vice versa — a new chaos hook cannot ship undocumented,
+and the registry cannot keep names the code dropped (an armed stale
+point silently injects nothing, invalidating the drill that armed
+it)."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO_ROOT, "scripts", "check_faults_doc.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_faults_doc",
+                                                  CHECKER)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_every_crossed_fault_point_is_documented_and_vice_versa():
+    checker = _load_checker()
+    problems = checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_extracts_a_plausible_call_site_set():
+    """The AST walk must actually see the hooks: spot-check names from
+    different layers (checkpointing, serving, resume, pipeline) so a
+    silently-broken walk cannot turn the doc check vacuous."""
+    checker = _load_checker()
+    names = set(checker.crossed_fault_points())
+    assert len(names) >= 10
+    for expected in ("save", "checkpoint_commit", "swap_validate",
+                     "cursor_remap", "replica_heartbeat",
+                     "pipeline_stage", "shadow_eval", "promote"):
+        assert expected in names, f"{expected} missing from the walk"
+
+
+def test_checker_flags_undocumented_and_stale(tmp_path, monkeypatch):
+    """The check fails in BOTH directions: a crossed-but-undocumented
+    point and a documented-but-never-crossed point each produce a
+    problem."""
+    checker = _load_checker()
+    crossed = sorted(checker.crossed_fault_points())
+    assert "save" in crossed
+    rows = "\n".join(f"- `{n}` — x" for n in crossed if n != "save")
+    registry = tmp_path / "faults.py"
+    registry.write_text(
+        '"""Registry.\n\n'
+        f"{rows}\n- `made_up_point` — x\n"
+        '"""\n')
+    monkeypatch.setattr(checker, "REGISTRY", str(registry))
+    problems = checker.check()
+    assert any("UNDOCUMENTED: fault point save" in p for p in problems)
+    assert any("STALE DOC: fault point made_up_point" in p
+               for p in problems)

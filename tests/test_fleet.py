@@ -482,8 +482,9 @@ class _SwapControl:
     def swap_hosts(self, model):
         return list(self.hosts) if model == "default" else None
 
-    def host_reload(self, host, artifact):
+    def host_reload(self, host, artifact, retrieval_index=None):
         host.apply_reload(artifact)
+        host.retrieval_index = retrieval_index
         return True, ""
 
     def host_fleet(self, host):

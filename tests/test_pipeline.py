@@ -1,0 +1,1157 @@
+"""Continuous-training pipeline suite (code2vec_tpu/pipeline/):
+journaled manifest resume, the SIGKILL-at-every-stage-boundary chaos
+matrix, the shadow-eval quality gate (verdict matrix + exported
+metrics), the promote/retrieval-refresh fleet drivers, the
+retrieval-index remount plumbing, delta ingest against a frozen vocab,
+and the live-traffic sampler.
+
+Fast tests run in tier-1 on scripted stages/stubs; the subprocess kill
+matrix and the end-to-end fleet promotion drill (real Supervisor
+subprocesses running fake-model replicas) are marked `slow` and run
+via scripts/run_chaos.sh with their own budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import obs
+from code2vec_tpu.config import Config
+from code2vec_tpu.pipeline.manifest import (
+    PipelineManifest, PipelineStateError,
+)
+from code2vec_tpu.pipeline.shadow_eval import (
+    GateBars, gate_verdict, sample_traffic, topk_agreement,
+)
+from code2vec_tpu.pipeline.stages import (
+    GateRefused, PipelineContext, PromoteFailed, StageFailed,
+    StageSkipped, run_ingest, run_promote,
+)
+from code2vec_tpu.pipeline.supervisor import PipelineSupervisor
+from code2vec_tpu.utils.faults import FAULT_EXIT_CODE, FaultInjected
+from code2vec_tpu.utils import faults
+
+from test_serving import FAKE_EXTRACTOR, _counter_value
+
+pytestmark = pytest.mark.pipeline
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PIPELINE_CHILD = os.path.join(HERE, "chaos_pipeline_child.py")
+FLEET_HOST = os.path.join(HERE, "chaos_fleet_host.py")
+
+
+def _gauge_value(name, **labels):
+    fams = obs.default_registry().collect()
+    key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    child = fams.get(name, {}).get(key)
+    return child.value if child is not None else None
+
+
+# ------------------------------------------------------------ manifest
+
+
+def test_manifest_create_commit_and_resume(tmp_path):
+    m = PipelineManifest.load_or_create(str(tmp_path), "fp1",
+                                        ["a", "b"])
+    assert m.stage("a") is None and m.terminal is None
+    m.commit_stage("a", {"x": 1}, duration_s=0.5)
+    # a fresh load sees the committed stage (the resume path)
+    m2 = PipelineManifest.load_or_create(str(tmp_path), "fp1",
+                                         ["a", "b"])
+    assert m2.stage("a")["outputs"] == {"x": 1}
+    assert m2.stage("a")["status"] == "committed"
+    assert m2.stage("b") is None
+    m2.set_terminal("committed", {"ok": True})
+    m3 = PipelineManifest.load_or_create(str(tmp_path), "fp1",
+                                         ["a", "b"])
+    assert m3.terminal["outcome"] == "committed"
+    # the journal recorded the transitions, newest last
+    events = [e["event"] for e in m3.data["journal"]]
+    assert events[-1] == "terminal"
+
+
+def test_manifest_refuses_different_run_inputs(tmp_path):
+    PipelineManifest.load_or_create(str(tmp_path), "fp1", ["a"])
+    with pytest.raises(PipelineStateError, match="different inputs"):
+        PipelineManifest.load_or_create(str(tmp_path), "fp2", ["a"])
+
+
+def test_manifest_refuses_future_schema(tmp_path):
+    m = PipelineManifest.load_or_create(str(tmp_path), "fp1", ["a"])
+    m.data["schema_version"] = 99
+    m._write()
+    with pytest.raises(PipelineStateError, match="schema_version"):
+        PipelineManifest.load_or_create(str(tmp_path), "fp1", ["a"])
+
+
+# ------------------------------------------- shadow-eval comparator
+
+
+class _Res:
+    """Scripted ModelEvaluationResults stand-in."""
+
+    def __init__(self, top1, topk, f1, loss=1.0):
+        self.topk_acc = np.array([top1, topk])
+        self.subtoken_f1 = f1
+        self.subtoken_precision = f1
+        self.subtoken_recall = f1
+        self.loss = loss
+
+
+def test_gate_passes_better_and_equal_candidates():
+    inc = _Res(0.40, 0.60, 0.50)
+    for cand in (_Res(0.45, 0.65, 0.55),   # better
+                 _Res(0.40, 0.60, 0.50),   # equal
+                 _Res(0.395, 0.595, 0.495)):  # within the 0.01 bar
+        v = gate_verdict(inc, cand, bars=GateBars())
+        assert v["passed"], v["reasons"]
+    assert _gauge_value("pipeline_gate_top1_delta") is not None
+
+
+def test_gate_refuses_worse_than_bar_with_named_metric():
+    inc = _Res(0.40, 0.60, 0.50)
+    v = gate_verdict(inc, _Res(0.40, 0.60, 0.44), bars=GateBars())
+    assert not v["passed"]
+    assert any("f1 regressed" in r for r in v["reasons"])
+    assert v["numbers"]["f1_delta"] == pytest.approx(-0.06)
+    # the refusal is visible from a scrape alone
+    assert _gauge_value("pipeline_gate_f1_delta") == \
+        pytest.approx(-0.06)
+    assert _counter_value("pipeline_gate_total", verdict="fail") >= 1
+
+
+def test_gate_refuses_nan_poisoned_candidate_fail_closed():
+    inc = _Res(0.40, 0.60, 0.50)
+    v = gate_verdict(inc, _Res(float("nan"), 0.60, 0.50),
+                     bars=GateBars())
+    assert not v["passed"]
+    assert any("non-finite" in r for r in v["reasons"])
+    # NaN loss alone also refuses (the metrics can look fine while the
+    # model is diverging)
+    v = gate_verdict(inc, _Res(0.41, 0.61, 0.51, loss=float("nan")),
+                     bars=GateBars())
+    assert not v["passed"]
+
+
+def test_gate_agreement_bar_only_when_traffic_was_replayed():
+    inc = _Res(0.40, 0.60, 0.50)
+    cand = _Res(0.40, 0.60, 0.50)
+    low = {"samples": 50, "topk_agreement": 0.5,
+           "top1_agreement": 0.5}
+    v = gate_verdict(inc, cand, agreement=low, bars=GateBars())
+    assert not v["passed"]
+    assert any("agreement" in r for r in v["reasons"])
+    assert _gauge_value("pipeline_gate_topk_agreement") == \
+        pytest.approx(0.5)
+    # no traffic -> the agreement bar cannot trip
+    v = gate_verdict(inc, cand, agreement={"samples": 0,
+                                           "topk_agreement": None,
+                                           "top1_agreement": None},
+                     bars=GateBars())
+    assert v["passed"]
+
+
+class _PredModel:
+    def __init__(self, words_per_line):
+        self._words = words_per_line
+
+    def predict(self, lines, batch_size=None, with_code_vectors=False):
+        class _R:
+            def __init__(self, words):
+                self.topk_predicted_words = words
+        return [_R(self._words[i % len(self._words)])
+                for i in range(len(lines))]
+
+
+def test_topk_agreement_scripted_models():
+    lines = ["m1 a,P,b", "m2 c,P,d"]
+    same = _PredModel([["x", "y", "z"]])
+    assert topk_agreement(same, same, lines)["topk_agreement"] == 1.0
+    disjoint = _PredModel([["p", "q", "r"]])
+    out = topk_agreement(same, disjoint, lines)
+    assert out["topk_agreement"] == 0.0
+    assert out["top1_agreement"] == 0.0
+    half = _PredModel([["x", "y", "w"]])
+    out = topk_agreement(same, half, lines)
+    assert out["topk_agreement"] == pytest.approx(2 / 3)
+    assert out["top1_agreement"] == 1.0
+    assert topk_agreement(same, same, [])["topk_agreement"] is None
+
+
+def test_sample_traffic_deterministic_and_bounded():
+    lines = [f"m{i} a,P,b" for i in range(100)] + ["", "  "]
+    a = sample_traffic(lines, 10, seed=7)
+    b = sample_traffic(lines, 10, seed=7)
+    assert a == b and len(a) == 10
+    assert sample_traffic(lines, 1000, seed=7) == \
+        [ln for ln in lines if ln.strip()]
+    # 0 disables the replay (gate on the accuracy harness alone)
+    assert sample_traffic(lines, 0, seed=7) == []
+
+
+# --------------------------------------------------- supervisor core
+
+
+def _scripted_stages(ledger, overrides=None):
+    overrides = overrides or {}
+
+    def make(name):
+        def body(ctx):
+            if name in overrides:
+                return overrides[name](ctx)
+            ledger.append(name)
+            return {"stage": name}
+        return (name, body)
+
+    return [make(n) for n in ("ingest", "finetune", "export",
+                              "shadow_eval", "promote",
+                              "retrieval_refresh")]
+
+
+def _cfg(tmp_path, sub="pipe", **kw):
+    return Config(pipeline=True,
+                  pipeline_dir=str(tmp_path / sub),
+                  verbose_mode=0, **kw)
+
+
+def test_supervisor_runs_all_stages_once_and_is_idempotent(tmp_path):
+    ledger = []
+    config = _cfg(tmp_path)
+    sup = PipelineSupervisor(config, stages=_scripted_stages(ledger),
+                             log=lambda m: None)
+    assert sup.run() == 0
+    assert ledger == ["ingest", "finetune", "export", "shadow_eval",
+                      "promote", "retrieval_refresh"]
+    assert sup.manifest.terminal["outcome"] == "committed"
+    # rerun of a committed manifest re-reports without re-driving
+    sup2 = PipelineSupervisor(config,
+                              stages=_scripted_stages(ledger),
+                              log=lambda m: None)
+    assert sup2.run() == 0
+    assert len(ledger) == 6
+
+
+def test_supervisor_resumes_from_last_committed_at_every_boundary(
+        tmp_path):
+    """THE resume law, in process: arm `pipeline_stage@N=raise` for
+    every N (two boundary crossings per stage), crash there, rerun
+    with faults disarmed — the rerun completes, committed stages never
+    re-ran, and every kill matrix converges to the same terminal
+    manifest."""
+    names = ["ingest", "finetune", "export", "shadow_eval", "promote",
+             "retrieval_refresh"]
+    # baseline outputs to converge to
+    base_ledger = []
+    base_cfg = _cfg(tmp_path, "baseline")
+    PipelineSupervisor(base_cfg, stages=_scripted_stages(base_ledger),
+                       log=lambda m: None).run()
+    baseline = json.loads(open(os.path.join(
+        base_cfg.pipeline_dir, "pipeline_manifest.json")).read())
+    try:
+        for n in range(1, 2 * len(names) + 1):
+            ledger = []
+            config = _cfg(tmp_path, f"kill{n}")
+            faults.reset(f"pipeline_stage@{n}=raise")
+            sup = PipelineSupervisor(
+                config, stages=_scripted_stages(ledger),
+                log=lambda m: None)
+            with pytest.raises(FaultInjected):
+                sup.run()
+            committed_at_kill = [s for s in names
+                                 if sup.manifest.stage(s)]
+            # hit 2k-1 = stage k's start, hit 2k = its commit window:
+            # exactly floor((n-1)/2) stages were committed
+            assert len(committed_at_kill) == (n - 1) // 2
+            faults.reset(None)
+            ledger_at_kill = list(ledger)
+            sup2 = PipelineSupervisor(
+                config, stages=_scripted_stages(ledger),
+                log=lambda m: None)
+            assert sup2.run() == 0
+            # committed stages never re-ran
+            for s in committed_at_kill:
+                assert ledger.count(s) == 1
+            # a stage killed AFTER its work but BEFORE its commit ran
+            # again (idempotent), everything else exactly once
+            for s in names:
+                assert 1 <= ledger.count(s) <= 2
+                if s not in ledger_at_kill:
+                    assert ledger.count(s) == 1
+            # convergence: same terminal manifest as the baseline
+            final = sup2.manifest.data
+            assert final["terminal"]["outcome"] == "committed"
+            assert {k: v["outputs"] for k, v in
+                    final["stages"].items()} == \
+                   {k: v["outputs"] for k, v in
+                    baseline["stages"].items()}
+    finally:
+        faults.reset(None)
+
+
+def test_gate_refusal_is_terminal_with_numbers_everywhere(tmp_path):
+    numbers = {"f1_delta": -0.2, "top1_delta": -0.1,
+               "topk_agreement": 0.4}
+    ledger = []
+
+    def refuse(ctx):
+        raise GateRefused("shadow_eval", "f1 regressed", numbers)
+
+    config = _cfg(tmp_path)
+    stages = _scripted_stages(ledger, {"shadow_eval": refuse})
+    sup = PipelineSupervisor(config, stages=stages, log=lambda m: None)
+    assert sup.run() == 1
+    # terminal verdict in the manifest, numbers included
+    term = sup.manifest.terminal
+    assert term["outcome"] == "gate_refused"
+    assert term["detail"]["f1_delta"] == -0.2
+    # the incumbent was never touched: promote never ran
+    assert "promote" not in ledger
+    assert sup.manifest.stage("promote") is None
+    # gate numbers in the heartbeat (the runbook's first stop)
+    hb = json.loads(open(sup.heartbeat_path).read())
+    assert hb["status"] == "gate_refused"
+    assert hb["gate"]["f1_delta"] == -0.2
+    # a flight dump was written (immediate incident)
+    assert any(f.startswith("flight-") for f in
+               os.listdir(config.pipeline_dir))
+    # rerun converges to the same refusal without re-driving stages
+    before = len(ledger)
+    sup2 = PipelineSupervisor(config, stages=stages,
+                              log=lambda m: None)
+    assert sup2.run() == 1
+    assert len(ledger) == before
+    assert _counter_value("pipeline_runs_total",
+                          outcome="gate_refused") >= 1
+
+
+def test_promote_failure_is_terminal_rollback_recorded(tmp_path):
+    def fail(ctx):
+        raise PromoteFailed("promote", "fleet rollout rolled_back",
+                            outcome="rolled_back",
+                            numbers={"swap_error": "host default-1"})
+
+    config = _cfg(tmp_path)
+    sup = PipelineSupervisor(
+        config, stages=_scripted_stages([], {"promote": fail}),
+        log=lambda m: None)
+    assert sup.run() == 1
+    term = sup.manifest.terminal
+    assert term["outcome"] == "promote_failed"
+    assert term["detail"]["rollout_outcome"] == "rolled_back"
+
+
+def test_stage_failure_is_retryable_not_terminal(tmp_path):
+    attempts = []
+
+    def flaky(ctx):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise StageFailed("finetune", "transient: child OOM")
+        return {"ok": True}
+
+    config = _cfg(tmp_path)
+    stages = _scripted_stages([], {"finetune": flaky})
+    sup = PipelineSupervisor(config, stages=stages, log=lambda m: None)
+    assert sup.run() == 1
+    assert sup.manifest.terminal is None  # NOT terminal
+    assert sup.manifest.stage("finetune") is None
+    sup2 = PipelineSupervisor(config, stages=stages,
+                              log=lambda m: None)
+    assert sup2.run() == 0
+    assert len(attempts) == 2
+    assert sup2.manifest.stage("finetune")["outputs"] == {"ok": True}
+
+
+def test_unexpected_exception_is_a_recorded_stage_failure(tmp_path):
+    """A stage body raising OUTSIDE the StageFailed family (corrupt
+    artifact ValueError, disk-full OSError) must not leave a dead
+    supervisor behind a forever-'running' heartbeat: it is a failed,
+    retryable attempt recorded in heartbeat + metrics + flight."""
+    def boom(ctx):
+        raise ValueError("release_meta.json: tampered")
+
+    config = _cfg(tmp_path)
+    stages = _scripted_stages([], {"export": boom})
+    sup = PipelineSupervisor(config, stages=stages, log=lambda m: None)
+    assert sup.run() == 1
+    assert sup.manifest.terminal is None       # retryable, not a verdict
+    assert sup.manifest.stage("export") is None
+    hb = json.loads(open(sup.heartbeat_path).read())
+    assert hb["status"] == "error"
+    assert "ValueError" in hb["error"]
+    assert _counter_value("pipeline_stages_total", stage="export",
+                          outcome="failed") >= 1
+
+
+def test_skipped_stage_committed_as_skipped(tmp_path):
+    def skip(ctx):
+        raise StageSkipped("no fleet configured")
+
+    config = _cfg(tmp_path)
+    sup = PipelineSupervisor(
+        config, stages=_scripted_stages([], {"promote": skip}),
+        log=lambda m: None)
+    assert sup.run() == 0
+    rec = sup.manifest.stage("promote")
+    assert rec["status"] == "skipped"
+    assert "no fleet" in rec["outputs"]["reason"]
+
+
+def test_supervisor_refuses_resumed_dir_with_different_inputs(
+        tmp_path):
+    config = _cfg(tmp_path)
+    PipelineSupervisor(config, stages=_scripted_stages([]),
+                       log=lambda m: None)
+    changed = _cfg(tmp_path, pipeline_finetune_epochs=7)
+    with pytest.raises(PipelineStateError, match="different inputs"):
+        PipelineSupervisor(changed, stages=_scripted_stages([]),
+                           log=lambda m: None)
+
+
+# --------------------------------------------- promote stage (stub fleet)
+
+
+class _ScriptedRouter:
+    """Stub fleet router: POST /admin/reload records the payload and
+    arms a scripted swap-state sequence; GET /fleet steps through it."""
+
+    def __init__(self, states, error=None):
+        import http.server
+        self.reloads = []
+        self.states = list(states)
+        self.error = error
+        self._seq = []
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                outer.reloads.append((self.path, payload))
+                outer._seq = list(outer.states)
+                self._reply(202, {"accepted": True})
+
+            def do_GET(self):
+                if not outer.reloads:
+                    self._reply(200, {"swap": {"state": "idle",
+                                               "target": None}})
+                    return
+                state = (outer._seq.pop(0) if len(outer._seq) > 1
+                         else outer._seq[0])
+                artifact = outer.reloads[-1][1]["artifact"]
+                fp = "fp-" + os.path.basename(artifact)
+                self._reply(200, {
+                    "swap": {"state": state, "target": artifact,
+                             "target_fingerprint": fp,
+                             "error": (outer.error if state in
+                                       ("failed", "rolled_back")
+                                       else None)},
+                    "models": {"default": {
+                        "fingerprints": [fp],
+                        "mixed_fingerprints": False}},
+                })
+
+        self.httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _promote_ctx(tmp_path, port, artifact):
+    config = _cfg(tmp_path, pipeline_fleet=f"127.0.0.1:{port}",
+                  pipeline_promote_timeout_s=15.0)
+    manifest = PipelineManifest.load_or_create(
+        config.pipeline_dir, "fp", ["export", "promote"])
+    manifest.commit_stage("export", {"artifact": artifact,
+                                     "fingerprint":
+                                     "fp-" + os.path.basename(artifact)})
+    return PipelineContext(config, manifest, config.pipeline_dir,
+                           lambda m: None)
+
+
+def test_promote_stage_commits_through_scripted_fleet(tmp_path):
+    router = _ScriptedRouter(["canary", "rolling", "committed"])
+    try:
+        ctx = _promote_ctx(tmp_path, router.port, "/artifacts/v2")
+        out = run_promote(ctx)
+        assert out["outcome"] == "committed"
+        assert out["fingerprint"] == "fp-v2"
+        assert router.reloads[0][1] == {"artifact": "/artifacts/v2",
+                                        "model": "default"}
+        assert _counter_value("pipeline_promotions_total",
+                              outcome="committed") >= 1
+    finally:
+        router.close()
+
+
+def test_promote_stage_rolled_back_is_promote_failed(tmp_path):
+    router = _ScriptedRouter(["canary", "rolling", "rolled_back"],
+                             error="default-1: replica rejected")
+    try:
+        ctx = _promote_ctx(tmp_path, router.port, "/artifacts/v3")
+        with pytest.raises(PromoteFailed) as e:
+            run_promote(ctx)
+        assert e.value.outcome == "rolled_back"
+        assert "incumbent is serving everywhere" in str(e.value)
+        assert _counter_value("pipeline_promotions_total",
+                              outcome="rolled_back") >= 1
+    finally:
+        router.close()
+
+
+def test_promote_stage_skips_without_fleet(tmp_path):
+    ctx = _promote_ctx(tmp_path, 1, "/artifacts/v2")
+    ctx.config.pipeline_fleet = ""
+    with pytest.raises(StageSkipped, match="pipeline_fleet"):
+        run_promote(ctx)
+
+
+def test_refresh_reload_carries_retrieval_index(tmp_path):
+    from code2vec_tpu.pipeline.stages import drive_fleet_swap
+    router = _ScriptedRouter(["canary", "committed"])
+    try:
+        ctx = _promote_ctx(tmp_path, router.port, "/artifacts/v2")
+        result = drive_fleet_swap(ctx, "retrieval_refresh",
+                                  "/artifacts/v2",
+                                  retrieval_index="/idx/new")
+        assert result["swap"]["state"] == "committed"
+        assert router.reloads[0][1]["retrieval_index"] == "/idx/new"
+    finally:
+        router.close()
+
+
+# ------------------------------------ retrieval-index remount plumbing
+
+
+class _SwapStubModel:
+    def __init__(self, fp, topk=3):
+        self._fp = fp
+        self.topk = topk
+        self.context_buckets = (4, 8)
+
+    def model_fingerprint(self):
+        return self._fp
+
+    def smoke_schema(self):
+        return {"topk": self.topk, "code_vector_size": 8,
+                "scores_finite": True}
+
+
+class _SwapStubServer:
+    def __init__(self):
+        self.config = Config(verbose_mode=0)
+        self.log = lambda m: None
+        self.model = _SwapStubModel("fp-old")
+        self.model_fingerprint = "fp-old"
+        self.retrieval = None
+        self.swapped = []
+
+    def swap_model(self, new_model, retrieval_handle=None):
+        self.swapped.append((new_model, retrieval_handle))
+        return new_model.model_fingerprint()
+
+
+def _wait_swap(manager, timeout=10.0):
+    deadline = time.time() + timeout
+    while manager.status()["state"] in ("loading", "validating"):
+        if time.time() > deadline:
+            raise AssertionError(f"swap wedged: {manager.status()}")
+        time.sleep(0.01)
+    return manager.status()
+
+
+def test_swap_manager_mounts_index_atomically_with_flip():
+    from code2vec_tpu.serving.swap import SwapManager
+
+    server = _SwapStubServer()
+    mounted = []
+
+    class _Handle:
+        fingerprint = "fp-new"
+        attached = True
+
+    def mount(path, new_model):
+        mounted.append((path, new_model.model_fingerprint()))
+        return _Handle()
+
+    manager = SwapManager(server,
+                          build_model=lambda d: _SwapStubModel("fp-new"),
+                          mount_index=mount)
+    manager.request_reload("/artifacts/v2", retrieval_index="/idx/new")
+    status = _wait_swap(manager)
+    assert status["state"] == "ready"
+    assert status["retrieval_index"] == "/idx/new"
+    # the index was fingerprint-checked against the NEW model and
+    # handed to swap_model for the atomic flip
+    assert mounted == [("/idx/new", "fp-new")]
+    [(model, handle)] = server.swapped
+    assert model.model_fingerprint() == "fp-new"
+    assert handle.fingerprint == "fp-new"
+
+
+def test_swap_manager_mount_failure_fails_whole_swap():
+    from code2vec_tpu.serving.swap import SwapManager
+
+    server = _SwapStubServer()
+
+    def mount(path, new_model):
+        raise ValueError("index fingerprint mismatch: fp-stale")
+
+    manager = SwapManager(server,
+                          build_model=lambda d: _SwapStubModel("fp-new"),
+                          mount_index=mount)
+    manager.request_reload("/artifacts/v2", retrieval_index="/idx/bad")
+    status = _wait_swap(manager)
+    assert status["state"] == "failed"
+    assert "mismatch" in status["error"]
+    # old model + old index untouched: nothing swapped
+    assert server.swapped == []
+
+
+def test_plain_swap_without_index_keeps_stale_index_policy():
+    """A reload WITHOUT a riding index still runs the PR-10 refuse
+    policy against a mounted mismatching index."""
+    from code2vec_tpu.serving.swap import SwapManager
+
+    server = _SwapStubServer()
+
+    class _Mounted:
+        fingerprint = "fp-old"
+        attached = True
+
+    server.retrieval = _Mounted()
+    server.config.retrieval_swap_policy = "refuse"
+    manager = SwapManager(server,
+                          build_model=lambda d: _SwapStubModel("fp-new"))
+    manager.request_reload("/artifacts/v2")
+    status = _wait_swap(manager)
+    assert status["state"] == "failed"
+    assert "stale embedding space" in status["error"]
+    assert server.swapped == []
+
+
+def test_reload_target_info_roundtrip(tmp_path):
+    from code2vec_tpu.serving.server import (
+        RELOAD_TARGET_FILENAME, reload_target_info,
+    )
+    hb = tmp_path / "hb.json"
+    config = Config(verbose_mode=0, heartbeat_file=str(hb))
+    assert reload_target_info(config) is None
+    target = tmp_path / RELOAD_TARGET_FILENAME
+    target.write_text(json.dumps({"artifact": "/a/v2",
+                                  "retrieval_index": "/idx/n"}))
+    info = reload_target_info(config)
+    assert info == {"artifact": "/a/v2", "retrieval_index": "/idx/n"}
+    target.write_text(json.dumps({"artifact": "/a/v2"}))
+    assert reload_target_info(config)["retrieval_index"] is None
+
+
+def test_fleet_swap_driver_keys_on_retrieval_index(tmp_path):
+    """A replica still showing the PROMOTE rollout's ready state for
+    the SAME artifact (swap_retrieval_index None) must not satisfy a
+    retrieval-refresh rollout carrying an index — the driver waits for
+    the post-reload state."""
+    from code2vec_tpu.serving.fleet.swap import FleetSwapDriver
+
+    class _Host:
+        id = "h0"
+
+        def __init__(self):
+            # stale state from the committed promote rollout
+            self.swap_target = "/artifacts/v2"
+            self.swap_state = "ready"
+            self.swap_retrieval_index = None
+            self.fingerprint = "fp-v2"
+            self.reload_applied = False
+
+    host = _Host()
+
+    class _Control:
+        class config:
+            fleet_swap_timeout_s = 10.0
+
+        flight = obs.default_flight_recorder()
+        log = staticmethod(lambda m: None)
+
+        def swap_hosts(self, model):
+            return [host]
+
+        def host_reload(self, h, artifact, retrieval_index=None):
+            # apply DELAYED: the window where the stale promote state
+            # is all the driver can see
+            def later():
+                time.sleep(0.4)
+                h.reload_applied = True
+                h.swap_retrieval_index = retrieval_index
+            threading.Thread(target=later, daemon=True).start()
+            return True, ""
+
+        def host_fleet(self, h):
+            return {"replicas": [{
+                "model_fingerprint": h.fingerprint,
+                "swap_state": h.swap_state,
+                "swap_target": h.swap_target,
+                "swap_retrieval_index": h.swap_retrieval_index,
+                "draining": False}]}
+
+        def rollback_target(self, model):
+            return "/artifacts/v1"
+
+        def set_artifact(self, model, artifact):
+            pass
+
+    driver = FleetSwapDriver(_Control(), poll_interval_s=0.05)
+    driver.request("/artifacts/v2", retrieval_index="/idx/new")
+    deadline = time.time() + 10
+    while driver.status()["state"] in ("canary", "rolling"):
+        assert time.time() < deadline, driver.status()
+        time.sleep(0.02)
+    assert driver.status()["state"] == "committed"
+    # convergence waited for the reload to actually land
+    assert host.reload_applied
+
+
+# --------------------------------------------------- ingest (real pack)
+
+
+def test_ingest_packs_delta_against_frozen_vocab_with_oov(
+        tmp_path, tiny_vocabs):
+    ckpt = tmp_path / "ckpt_iter3"
+    ckpt.mkdir()
+    (ckpt / "code2vec_manifest.json").write_text("{}")
+    (ckpt / "code2vec_meta.json").write_text(
+        json.dumps({"epoch": 3}))
+    tiny_vocabs.save(str(ckpt / "dictionaries.bin"))
+    raw = tmp_path / "delta.raw.txt"
+    raw.write_text("get|name foo,P1,bar baz,P2,qux\n"
+                   "brandnewtarget foo,P1,bar\n"          # OOV target
+                   "run nope,P9,bar\n")                   # OOV context
+    config = Config(verbose_mode=0, max_contexts=4,
+                    pipeline_raw=str(raw),
+                    model_load_path=str(tmp_path / "ckpt"))
+    ctx = PipelineContext(config, None, str(tmp_path / "run"),
+                          lambda m: None)
+    os.makedirs(ctx.run_dir, exist_ok=True)
+    out = run_ingest(ctx)
+    assert out["rows"] == 3
+    assert out["train_rows"] == 2  # OOV target row is untrainable
+    assert out["incumbent_ckpt"] == str(ckpt)
+    assert out["target_oov_rate"] == pytest.approx(1 / 3)
+    assert 0 < out["context_oov_rate"] < 1
+    assert os.path.isfile(out["packed"])
+    assert os.path.isfile(out["packed"] + ".targets")
+    assert _gauge_value("pipeline_ingest_oov_rate",
+                        kind="target") == pytest.approx(1 / 3)
+    # re-run is idempotent (atomic pack overwrite)
+    out2 = run_ingest(ctx)
+    assert out2["rows"] == 3
+
+
+def test_ingest_refuses_untrainable_delta(tmp_path, tiny_vocabs):
+    ckpt = tmp_path / "ckpt_iter1"
+    ckpt.mkdir()
+    (ckpt / "code2vec_manifest.json").write_text("{}")
+    (ckpt / "code2vec_meta.json").write_text(json.dumps({"epoch": 1}))
+    tiny_vocabs.save(str(ckpt / "dictionaries.bin"))
+    raw = tmp_path / "delta.raw.txt"
+    raw.write_text("unknown1 foo,P1,bar\nunknown2 bar,P2,foo\n")
+    config = Config(verbose_mode=0, max_contexts=4,
+                    pipeline_raw=str(raw),
+                    model_load_path=str(tmp_path / "ckpt"))
+    ctx = PipelineContext(config, None, str(tmp_path / "run"),
+                          lambda m: None)
+    with pytest.raises(StageFailed, match="no trainable rows"):
+        run_ingest(ctx)
+
+
+# ------------------------------------------------------ traffic sampler
+
+
+def test_traffic_sampler_every_nth_bounded_and_atomic(tmp_path):
+    from code2vec_tpu.serving.traffic import TrafficSampler
+    path = str(tmp_path / "traffic.txt")
+    sampler = TrafficSampler(path, every=2, cap=8)
+    for i in range(10):
+        sampler.record([f"m{i} a,P,b"])
+    sampler.flush()
+    lines = open(path).read().splitlines()
+    # requests 2,4,6,8,10 sampled (every 2nd)
+    assert lines == [f"m{i} a,P,b" for i in (1, 3, 5, 7, 9)]
+    # the cap bounds the ring: oldest evicted
+    for i in range(10, 40):
+        sampler.record([f"m{i} a,P,b"])
+    sampler.flush()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 8
+    assert lines[-1] == "m39 a,P,b"
+    assert sampler.status()["entries"] == 8
+    assert _counter_value("serving_traffic_sampled_total") >= 13
+
+
+# ----------------------------------------------------------- CLI/config
+
+
+def test_pipeline_cli_parse_and_verify(tmp_path):
+    from code2vec_tpu.cli import config_from_args
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    raw = tmp_path / "raw.txt"
+    raw.write_text("x a,P,b\n")
+    incumbent = tmp_path / "incumbent"
+    incumbent.mkdir()
+    config = config_from_args([
+        "pipeline", "--pipeline_dir", str(tmp_path / "run"),
+        "--load", str(ckpt), "--pipeline_raw", str(raw),
+        "--pipeline_incumbent", str(incumbent),
+        "--test", str(tmp_path / "val.c2v"),
+        "--pipeline_fleet", "127.0.0.1:8800",
+        "--pipeline_gate_f1_drop", "0.02"])
+    assert config.pipeline
+    assert config.pipeline_gate_f1_drop == 0.02
+    config.verify()
+    # the subcommand demands its state dir
+    with pytest.raises(SystemExit, match="pipeline_dir"):
+        config_from_args(["pipeline", "--load", str(ckpt)])
+
+
+@pytest.mark.parametrize("mutate, match", [
+    (dict(pipeline_dir=None), "pipeline_dir"),
+    (dict(model_load_path=None), "Must train or load"),
+    (dict(pipeline_raw=None), "pipeline_raw"),
+    (dict(pipeline_incumbent=None), "pipeline_incumbent"),
+    (dict(test_data_path=""), "requires --test"),
+    (dict(serve=True), "standalone"),
+    (dict(train_data_path_prefix="/x"), "standalone"),
+    (dict(export_artifact_path="/x"), "one-shot"),
+    (dict(pipeline_finetune_epochs=0), "finetune_epochs"),
+    (dict(pipeline_gate_min_agreement=2.0), "min_agreement"),
+    (dict(pipeline_promote_timeout_s=0), "promote_timeout"),
+])
+def test_pipeline_verify_rejection_matrix(tmp_path, mutate, match):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    kwargs = dict(pipeline=True,
+                  pipeline_dir=str(tmp_path / "run"),
+                  model_load_path=str(ckpt),
+                  pipeline_raw=str(tmp_path / "raw.txt"),
+                  pipeline_incumbent=str(tmp_path / "inc"),
+                  test_data_path=str(tmp_path / "val.c2v"),
+                  verbose_mode=0)
+    kwargs.update(mutate)
+    with pytest.raises(ValueError, match=match):
+        Config(**kwargs).verify()
+
+
+def test_traffic_sample_knob_verify():
+    with pytest.raises(ValueError, match="serve subcommand"):
+        Config(verbose_mode=0, model_load_path="./m",
+               serve_traffic_sample_file="/x").verify()
+    with pytest.raises(ValueError, match="sample_every"):
+        Config(verbose_mode=0, model_load_path="./m", serve=True,
+               serve_traffic_sample_every=0).verify()
+
+
+# -------------------------------------------- chaos drills (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_pipeline_sigkill_at_every_boundary_subprocess(tmp_path):
+    """ROADMAP acceptance: SIGKILL (os._exit via the armed fault — no
+    handlers, no cleanup) the REAL pipeline supervisor process at every
+    stage boundary; the rerun resumes from the last committed stage and
+    converges to the same terminal manifest, with no committed stage's
+    work repeated."""
+    def run_child(run_dir, ledger, faults_spec=None):
+        env = dict(os.environ)
+        env.pop("C2V_FAULTS", None)
+        if faults_spec:
+            env["C2V_FAULTS"] = faults_spec
+        return subprocess.run(
+            [sys.executable, PIPELINE_CHILD, run_dir, ledger],
+            env=env, capture_output=True, timeout=120)
+
+    names = ["ingest", "finetune", "export", "shadow_eval", "promote",
+             "retrieval_refresh"]
+    # baseline manifest to converge to
+    base_dir = str(tmp_path / "baseline")
+    base_ledger = str(tmp_path / "baseline.ledger")
+    assert run_child(base_dir, base_ledger).returncode == 0
+    baseline = json.loads(open(
+        os.path.join(base_dir, "pipeline_manifest.json")).read())
+
+    def norm_outputs(manifest, run_dir):
+        # stage outputs carry absolute paths under the run dir; two
+        # runs converge when they agree modulo that root
+        return {k: {kk: (vv.replace(run_dir, "<run>")
+                         if isinstance(vv, str) else vv)
+                    for kk, vv in v["outputs"].items()}
+                for k, v in manifest["stages"].items()}
+
+    base_outputs = norm_outputs(baseline, base_dir)
+
+    for n in range(1, 2 * len(names) + 1):
+        run_dir = str(tmp_path / f"kill{n}")
+        ledger = str(tmp_path / f"kill{n}.ledger")
+        killed = run_child(run_dir, ledger,
+                           faults_spec=f"pipeline_stage@{n}=exit")
+        assert killed.returncode == FAULT_EXIT_CODE, (
+            n, killed.returncode, killed.stderr[-500:])
+        manifest = json.loads(open(
+            os.path.join(run_dir, "pipeline_manifest.json")).read())
+        committed_at_kill = set(manifest["stages"])
+        assert len(committed_at_kill) == (n - 1) // 2
+        # rerun, faults disarmed: completes and converges
+        rerun = run_child(run_dir, ledger)
+        assert rerun.returncode == 0, rerun.stderr[-500:]
+        final = json.loads(open(
+            os.path.join(run_dir, "pipeline_manifest.json")).read())
+        assert final["terminal"]["outcome"] == "committed"
+        assert norm_outputs(final, run_dir) == base_outputs
+        counts = {s: 0 for s in names}
+        for line in open(ledger).read().splitlines():
+            counts[line] += 1
+        for s in names:
+            # committed-before-kill stages ran exactly once; the stage
+            # killed in its commit window ran at most twice
+            assert counts[s] == (1 if s in committed_at_kill
+                                 else counts[s])
+            assert 1 <= counts[s] <= 2
+        # every stage's deterministic output exists exactly once
+        for s in names:
+            out = os.path.join(run_dir, f"out-{s}.txt")
+            assert open(out).read() == f"{s}: deterministic output\n"
+
+
+# ------------------------------------------ fleet promotion drill (slow)
+
+
+@pytest.fixture()
+def fake_extractor(tmp_path, monkeypatch):
+    path = tmp_path / "fake-c2v-extract"
+    path.write_text(FAKE_EXTRACTOR)
+    path.chmod(0o755)
+    monkeypatch.setenv("C2V_NATIVE_EXTRACTOR", str(path))
+    monkeypatch.delenv("C2V_FAKE_NO_SERVER", raising=False)
+    return str(path)
+
+
+@pytest.fixture()
+def run_fleet(tmp_path, fake_extractor):
+    from code2vec_tpu.serving.fleet.control import ControlPlane
+    from code2vec_tpu.serving.fleet.router import FleetRouter
+
+    running = []
+
+    def start(config, host_specs, artifacts=None):
+        control = ControlPlane(config, host_specs, log=lambda m: None)
+        for model, artifact in (artifacts or {}).items():
+            control.set_initial_artifact(model, artifact)
+        control.router = FleetRouter(config, control, host="127.0.0.1",
+                                     port=0, log=lambda m: None)
+        rc_holder = {}
+        thread = threading.Thread(
+            target=lambda: rc_holder.update(rc=control.run()),
+            daemon=True)
+        thread.start()
+        running.append((control, thread))
+        return control, thread, rc_holder
+
+    yield start
+    for control, thread in running:
+        control.stop()
+        thread.join(timeout=60)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_pipeline_promotion_drill_on_real_fleet(tmp_path, run_fleet):
+    """ROADMAP acceptance, end to end on real subprocesses: (1) a good
+    candidate flows through the pipeline and the canary-first rollout
+    lands its fingerprint on every replica of every host under client
+    load with zero malformed/mixed responses; (2) a quality-regressed
+    candidate is REFUSED at shadow-eval with the fleet untouched;
+    (3) a candidate that fails mid-fleet-swap rolls the whole fleet
+    back — terminal promote_failed, fleet back on the prior
+    fingerprint."""
+    from test_fleet import (
+        _all_routable, _fleet_config, _host_overrides, _post,
+        _replica_overrides, _wait_fleet, _write_json,
+    )
+    from code2vec_tpu.serving.fleet.control import HostSpec
+    from code2vec_tpu.pipeline.stages import (
+        run_retrieval_refresh,
+    )
+
+    ok_replicas = _write_json(
+        tmp_path, "replica-ok.json",
+        _replica_overrides(fingerprint="fp-v1", fake_swap=True))
+    failing_replicas = _write_json(
+        tmp_path, "replica-fail-v3.json",
+        _replica_overrides(fingerprint="fp-v1", fake_swap=True,
+                           swap_fail_targets=["v3"]))
+    host_json = _write_json(tmp_path, "host.json", _host_overrides())
+    config = _fleet_config(tmp_path)
+    control, thread, rc_holder = run_fleet(
+        config,
+        [HostSpec("default-0",
+                  [sys.executable, FLEET_HOST, host_json, ok_replicas]),
+         HostSpec("default-1",
+                  [sys.executable, FLEET_HOST, host_json,
+                   failing_replicas])],
+        artifacts={"default": "/artifacts/v1"})
+    _wait_fleet(control, _all_routable(2), what="2 routable hosts")
+    port = control.router.port
+
+    # -- background client load for the swap windows
+    malformed, statuses = [], []
+    lock = threading.Lock()
+    stop_load = threading.Event()
+    allowed_fps = {"fp-v1", "fp-v2", "fp-v3"}
+
+    def load(ci):
+        i = 0
+        while not stop_load.is_set():
+            try:
+                status, body, headers = _post(
+                    port, "/predict",
+                    f"class P{ci}x{i} {{ int m{ci}x{i}() "
+                    f"{{ return 1; }} }}", timeout=30)
+            except Exception:
+                i += 1
+                continue  # torn TCP = client retry, not corruption
+            try:
+                payload = json.loads(body)
+                if status == 200:
+                    ok = (payload.get("model_fingerprint")
+                          in allowed_fps and "methods" in payload)
+                else:
+                    ok = (status in (503, 504)
+                          and payload.get("trace_id"))
+                if not ok:
+                    raise ValueError(f"dishonest: {status} {payload}")
+            except ValueError as e:
+                with lock:
+                    malformed.append((status, body[:200], str(e)))
+            with lock:
+                statuses.append(status)
+            i += 1
+
+    threads = [threading.Thread(target=load, args=(ci,))
+               for ci in range(3)]
+    for t in threads:
+        t.start()
+
+    def pipeline_for(sub, artifact_name, shadow_fn):
+        cfg = Config(pipeline=True,
+                     pipeline_dir=str(tmp_path / sub),
+                     pipeline_fleet=f"127.0.0.1:{port}",
+                     pipeline_promote_timeout_s=120.0,
+                     verbose_mode=0)
+        artifact = os.path.join(str(tmp_path), "artifacts",
+                                artifact_name)
+        stages = [
+            ("ingest", lambda ctx: {"delta_prefix": "unused"}),
+            ("finetune", lambda ctx: {"save_base": "unused"}),
+            ("export", lambda ctx: {"artifact": artifact,
+                                    "fingerprint":
+                                        f"fp-{artifact_name}"}),
+            ("shadow_eval", shadow_fn),
+            ("promote", run_promote),
+            ("retrieval_refresh", run_retrieval_refresh),
+        ]
+        return PipelineSupervisor(cfg, stages=stages,
+                                  log=lambda m: None)
+
+    def shadow_pass(ctx):
+        v = gate_verdict(_Res(0.40, 0.60, 0.50),
+                         _Res(0.42, 0.62, 0.52), bars=GateBars())
+        assert v["passed"]
+        return dict(v["numbers"], gate="passed")
+
+    def shadow_fail(ctx):
+        v = gate_verdict(_Res(0.40, 0.60, 0.50),
+                         _Res(0.30, 0.50, 0.40), bars=GateBars())
+        assert not v["passed"]
+        raise GateRefused("shadow_eval", "; ".join(v["reasons"]),
+                          v["numbers"])
+
+    try:
+        # ---- (1) good candidate: ingest -> promote, fleet-wide fp-v2
+        sup = pipeline_for("pipe-good", "v2", shadow_pass)
+        assert sup.run() == 0
+        assert sup.manifest.terminal["outcome"] == "committed"
+        assert sup.manifest.stage("promote")["outputs"]["outcome"] == \
+            "committed"
+        # retrieval refresh not requested -> recorded skipped
+        assert sup.manifest.stage("retrieval_refresh")["status"] == \
+            "skipped"
+        view = _wait_fleet(
+            control,
+            lambda v: (v["models"]["default"]["fingerprints"]
+                       == ["fp-v2"]
+                       and not v["models"]["default"]
+                       ["mixed_fingerprints"]),
+            what="every replica on fp-v2")
+        for host in view["hosts"]:
+            assert host["fingerprints"] == ["fp-v2"], host
+
+        # ---- (2) regressed candidate: refused at the gate, fleet
+        # untouched
+        sup = pipeline_for("pipe-regressed", "v2b", shadow_fail)
+        assert sup.run() == 1
+        assert sup.manifest.terminal["outcome"] == "gate_refused"
+        assert sup.manifest.stage("promote") is None
+        view = control.fleet_view()
+        assert view["models"]["default"]["fingerprints"] == ["fp-v2"]
+        assert view["models"]["default"]["artifact"].endswith("v2")
+
+        # ---- (3) mid-fleet-swap failure: host 1 rejects v3 ->
+        # fleet-wide rollback, terminal promote_failed
+        sup = pipeline_for("pipe-rollback", "v3", shadow_pass)
+        assert sup.run() == 1
+        term = sup.manifest.terminal
+        assert term["outcome"] == "promote_failed"
+        assert term["detail"]["rollout_outcome"] == "rolled_back"
+        view = _wait_fleet(
+            control,
+            lambda v: v["models"]["default"]["fingerprints"]
+            == ["fp-v2"],
+            what="fleet rolled back to fp-v2")
+        assert not view["models"]["default"]["mixed_fingerprints"]
+        time.sleep(0.5)  # post-rollback traffic
+    finally:
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not malformed, f"dishonest responses: {malformed[:3]}"
+    assert statuses.count(200) > 0
+    # fresh request serves the rolled-back fingerprint
+    status, body, _ = _post(port, "/predict",
+                            "class A { int after() { return 1; } }")
+    assert status == 200
+    assert json.loads(body)["model_fingerprint"] == "fp-v2"
+    control.stop()
+    thread.join(timeout=60)
+    assert rc_holder["rc"] == 0

@@ -157,3 +157,97 @@ def test_packed_vocab_fingerprint_mismatch(tiny_vocabs, tiny_config):
         max_token_vocab_size=5, max_path_vocab_size=5, max_target_vocab_size=5)
     with pytest.raises(ValueError, match="different vocabularies"):
         PackedDataset(packed_path, other)
+
+
+# -------------------------------------------- resume cursor (text reader)
+#
+# The text reader honors the checkpoint data cursor like the packed
+# dataset does (PR-6 residue closed): the epoch-keyed shuffled order is
+# deterministic, so skipping the first `skip_rows` post-filter rows of
+# the resumed epoch obeys the packed reader's cursor laws — the resumed
+# stream is EXACTLY the uninterrupted stream minus its first skip_rows
+# rows, and later epochs are untouched.
+
+
+def _epoch_targets(batches):
+    """[per-epoch concatenated target_index arrays] from a marker
+    stream."""
+    from code2vec_tpu.data.reader import EpochEnd
+    epochs, current = [], []
+    for item in batches:
+        if isinstance(item, EpochEnd):
+            epochs.append(np.concatenate([b.target_index for b in current])
+                          if current else np.empty((0,), np.int32))
+            current = []
+        else:
+            current.append(item)
+    return epochs
+
+
+def _cursor_lines(n=14):
+    targets = ["get|name", "set|value", "run"]
+    ctxs = ["foo,P1,bar", "baz,P2,foo", "qux,P3,baz"]
+    return [f"{targets[i % 3]} {ctxs[i % 3]} {ctxs[(i + 1) % 3]}  "
+            for i in range(n)]
+
+
+def _text_reader(tiny_vocabs, tiny_config, skip_rows=0,
+                 parse_chunk_lines=3):
+    return PathContextReader(tiny_vocabs, tiny_config,
+                             EstimatorAction.Train,
+                             yield_epoch_markers=True,
+                             skip_rows=skip_rows,
+                             parse_chunk_lines=parse_chunk_lines)
+
+
+def test_text_reader_cursor_is_exact_stream_suffix(tiny_vocabs,
+                                                   tiny_config):
+    """batches(skip=k) == batches(skip=0) minus the first k rows of
+    epoch 0 — the packed reader's cursor law, on the text path. The
+    tiny parse_chunk_lines makes the skip span chunk boundaries."""
+    _write_c2v(tiny_config.train_data_path, _cursor_lines())
+    tiny_config.num_train_epochs = 2
+    # small buffer: the shuffle-boundary smear must not eat the whole
+    # first epoch (the law below is about the STREAM, not the marker)
+    tiny_config.shuffle_buffer_size = 2
+    full = _epoch_targets(list(_text_reader(tiny_vocabs, tiny_config)))
+    assert len(full) == 2 and len(full[0]) >= 8
+    for skip in (2, 4, 6):  # multiples of the batch size (the facade
+        # rounds the cursor down to a global batch multiple)
+        resumed = _epoch_targets(
+            list(_text_reader(tiny_vocabs, tiny_config,
+                              skip_rows=skip)))
+        np.testing.assert_array_equal(resumed[0], full[0][skip:])
+        np.testing.assert_array_equal(resumed[1], full[1])
+
+
+def test_text_reader_cursor_clears_at_epoch_boundary(tiny_vocabs,
+                                                     tiny_config):
+    """A stale over-long cursor consumes at most the first epoch —
+    the boundary marker clears it, so epoch 2 streams in full."""
+    _write_c2v(tiny_config.train_data_path, _cursor_lines())
+    tiny_config.num_train_epochs = 2
+    tiny_config.shuffle_buffer_size = 2
+    full = _epoch_targets(list(_text_reader(tiny_vocabs, tiny_config)))
+    resumed = _epoch_targets(
+        list(_text_reader(tiny_vocabs, tiny_config, skip_rows=10 ** 6)))
+    assert len(resumed[0]) == 0
+    np.testing.assert_array_equal(resumed[1], full[1])
+
+
+def test_text_reader_cursor_matches_packed_law_shape(tiny_vocabs,
+                                                     tiny_config):
+    """Same skip, same law on the packed reader — pinning that the two
+    pipelines agree on what a cursor MEANS (a count of post-filter
+    rows consumed off the epoch's deterministic order)."""
+    lines = _cursor_lines()
+    _write_c2v(tiny_config.train_data_path, lines)
+    packed_path = pack_c2v(tiny_config.train_data_path, tiny_vocabs, 4)
+    ds = PackedDataset(packed_path, tiny_vocabs)
+    full = _epoch_targets(list(ds.iter_batches(
+        2, EstimatorAction.Train, num_epochs=1, seed=0,
+        yield_epoch_markers=True)))
+    resumed = _epoch_targets(list(ds.iter_batches(
+        2, EstimatorAction.Train, num_epochs=1, seed=0,
+        yield_epoch_markers=True, skip_rows=4)))
+    np.testing.assert_array_equal(resumed[0], full[0][4:])
