@@ -231,6 +231,39 @@ def arguments_parser() -> ArgumentParser:
                         help="restarts the control plane grants each "
                              "host before escalating to fleet exit "
                              "(default 5)")
+    parser.add_argument("--fleet_routers", type=int, default=None,
+                        metavar="N",
+                        help="public edge router processes (README "
+                             "'Edge'): 1 (default) = the embedded "
+                             "router; N >= 2 spawns N stateless "
+                             "router agents on consecutive ports "
+                             "(--fleet_port..+N-1) sharing the fleet "
+                             "view, supervised with the host "
+                             "backoff/escalation policy")
+    parser.add_argument("--fleet_control", default=None,
+                        metavar="HOST:PORT",
+                        help="control-listener address a router agent "
+                             "polls for the shared fleet view "
+                             "(set by the control plane on router "
+                             "re-exec commands, not by operators)")
+    parser.add_argument("--fleet_no_affinity",
+                        action="store_true", default=None,
+                        help="disable consistent-hash cache affinity "
+                             "(routers then always weighted-sample; "
+                             "fleet-level cache hit rate decays "
+                             "as 1/N — see BENCH_SERVING.md)")
+    parser.add_argument("--fleet_launcher", default=None,
+                        metavar="TEMPLATE",
+                        help="remote HostLauncher wrapper template, "
+                             "e.g. 'ssh {address}' or 'docker exec "
+                             "{address}' (empty = local processes); "
+                             "needs the fleet run dir on a shared "
+                             "filesystem and reachable host ports")
+    parser.add_argument("--fleet_addresses", default=None,
+                        metavar="LIST",
+                        help="comma list of addresses hosts are "
+                             "placed on round-robin and reached at "
+                             "(default: --serve_host for every host)")
     parser.add_argument("--artifact", dest="serve_artifact", metavar="DIR",
                         help="serve/evaluate from a release artifact "
                              "(produced by the `export` subcommand) "
@@ -658,6 +691,10 @@ def config_from_args(argv=None) -> Config:
                                       "fleet_scale_cooldown_s",
                                       "fleet_swap_timeout_s",
                                       "fleet_max_host_restarts",
+                                      "fleet_routers",
+                                      "fleet_control",
+                                      "fleet_launcher",
+                                      "fleet_addresses",
                                       "serve_artifact",
                                       "export_artifact_path",
                                       "release_scheme",
@@ -693,6 +730,8 @@ def config_from_args(argv=None) -> Config:
                                       "serve_traffic_sample_every",
                                       "serve_traffic_sample_cap")
              if (value := getattr(args, knob)) is not None}
+    if args.fleet_no_affinity:
+        knobs["fleet_cache_affinity"] = False
     config = Config(
         predict=args.predict,
         serve=args.serve or serve_subcommand,
@@ -762,6 +801,16 @@ def main(argv=None) -> None:
     if config.pipeline:
         from code2vec_tpu.pipeline.supervisor import pipeline_main
         sys.exit(pipeline_main(config, argv=list(argv)))
+
+    # Edge router agent: a `fleet` re-exec child marked by
+    # C2V_FLEET_ROUTER never builds a model — it routes over a polled
+    # copy of the fleet view (serving/fleet/edge.py, README "Edge").
+    # Must dispatch before the fleet branch: the child's argv still
+    # says `fleet`.
+    if (config.serve and config.fleet
+            and "C2V_FLEET_ROUTER" in os.environ):
+        from code2vec_tpu.serving.fleet.edge import router_main
+        sys.exit(router_main(config))
 
     # Cross-host fleet: the control-plane PARENT never builds a model;
     # it launches one `serve` supervisor per host behind the

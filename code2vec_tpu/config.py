@@ -363,6 +363,37 @@ class Config:
     # fleet exit (the supervisor's deploy-problem philosophy, one
     # level up).
     fleet_max_host_restarts: int = 5
+    # -- edge tier (code2vec_tpu/serving/fleet/edge.py; README
+    # "Edge") --
+    # Public router processes. 1 (default) = the classic embedded
+    # router on the fleet port. N >= 2 = N stateless router AGENTS on
+    # consecutive ports (fleet_port..fleet_port+N-1; 0 = all auto),
+    # each polling the control plane's private control listener for
+    # the shared fleet view — any router serves any request (put them
+    # behind one DNS name / L4 VIP), and the control plane respawns a
+    # dead one with the host backoff/escalation policy.
+    fleet_routers: int = 1
+    # Control-listener address (HOST:PORT) a router agent polls; set
+    # by the control plane on the re-exec command line, not by
+    # operators.
+    fleet_control: str = ""
+    # Consistent-hash cache affinity (--fleet_no_affinity to disable):
+    # routers hash each request's normalized source onto a ring of the
+    # fully-healthy hosts and try that host first, so repeat traffic
+    # lands on the replica whose LRU cache already holds the entry;
+    # unhealthy/draining hosts leave the ring and selection falls back
+    # to weighted sampling. Response bytes are unaffected (the cache
+    # keys on fingerprint + normalized source per host).
+    fleet_cache_affinity: bool = True
+    # Remote HostLauncher wrapper template (empty = local processes):
+    # e.g. "ssh {address}" or "docker exec {address}" — {address} is
+    # each host's address from fleet_addresses. Contract: the fleet
+    # run dir on a shared filesystem (heartbeats readable) and
+    # reported ports reachable at the host's address.
+    fleet_launcher: str = ""
+    # Comma list of addresses hosts are placed on (round-robin) and
+    # reached at; empty = serve_host for every host.
+    fleet_addresses: str = ""
     # Rows per streamed target-table block in the blockwise top-k
     # prediction head (ops/topk.py): the eval/predict steps fold the
     # ~246K-name classifier through a running top-k merge + logsumexp
@@ -826,6 +857,22 @@ class Config:
             raise ValueError(
                 "fleet_max_host_restarts must be >= 0 (0 = escalate "
                 "on first host death).")
+        if self.fleet_routers < 1:
+            raise ValueError(
+                "fleet_routers must be >= 1 (1 = the embedded router; "
+                "N >= 2 = the edge router tier).")
+        if self.fleet_control and (
+                ":" not in self.fleet_control
+                or not self.fleet_control.rsplit(":", 1)[1].isdigit()):
+            raise ValueError(
+                "fleet_control must be HOST:PORT (it is set by the "
+                "control plane on router re-exec commands).")
+        if self.fleet_launcher and "{address}" in self.fleet_launcher \
+                and not self.fleet_addresses:
+            raise ValueError(
+                "fleet_launcher template uses {address} but "
+                "fleet_addresses is empty — list the machines hosts "
+                "should land on (comma-separated).")
         if self.serve_telemetry_port is not None and not (
                 0 <= self.serve_telemetry_port <= 65535):
             raise ValueError(

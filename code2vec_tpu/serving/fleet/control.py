@@ -38,6 +38,16 @@ is the consumer that endpoint was built for:
   supervisor (which coordinates the replica drains), and retires it
   when the process exits.
 
+- **Edge tier** (`--fleet_routers N`, N >= 2): the public address
+  becomes N stateless ROUTER processes on consecutive ports (VIP
+  convention documented in README "Edge"), each holding nothing but a
+  polled copy of the fleet view (serving/fleet/edge.py). The embedded
+  router demotes to a PRIVATE control listener the agents poll and
+  relay admin verbs to. Router processes are supervised exactly like
+  hosts: death or a stale heartbeat restarts them with the same
+  exponential backoff, the same `--fleet_max_host_restarts` budget and
+  the same escalation exit.
+
 `fleet_main` is the `fleet` CLI subcommand body: control plane + the
 health-gated router (serving/fleet/router.py) on the public port.
 """
@@ -46,6 +56,7 @@ from __future__ import annotations
 
 import json
 import os
+import shlex
 import signal
 import subprocess
 import sys
@@ -62,6 +73,10 @@ from code2vec_tpu.serving.fleet.router import DEFAULT_MODEL, FleetRouter
 from code2vec_tpu.serving.fleet.swap import FleetSwapDriver
 
 FLEET_HOST_ENV = "C2V_FLEET_HOST"
+# Router-agent child marker (cli.main dispatches on it) + the host's
+# reachable address, exported for address-templated remote launchers.
+FLEET_ROUTER_ENV = "C2V_FLEET_ROUTER"
+FLEET_HOST_ADDRESS_ENV = "C2V_FLEET_HOST_ADDRESS"
 # Seconds a host gets from spawn to its first supervisor heartbeat
 # (replica fork + model build happen below it; the supervisor itself
 # heartbeats within ~a second of starting).
@@ -75,6 +90,18 @@ _C_HOST_RESTARTS = obs.counter(
     "fleet_host_restarts_total",
     "host supervisor processes restarted by the fleet control plane "
     "(process death or stale host heartbeat)")
+
+_C_ROUTER_RESTARTS = obs.counter(
+    "edge_router_restarts_total",
+    "edge router processes restarted by the fleet control plane "
+    "(process death or stale router heartbeat)")
+
+
+def _g_routers(state: str):
+    return obs.gauge(
+        "edge_routers",
+        "edge-tier router processes by state (routing | down)",
+        state=state)
 
 
 def _c_scale_actions(direction: str):
@@ -141,6 +168,63 @@ class LocalHostLauncher(HostLauncher):
         logf = open(log_path, "ab")
         try:
             return subprocess.Popen(command, env=env,
+                                    stdout=logf, stderr=logf)
+        finally:
+            logf.close()
+
+
+class RemoteHostLauncher(HostLauncher):
+    """Wrapper-command launcher: the remote half of the HostLauncher
+    seam, good enough to demo a real multi-machine fleet from one CLI
+    (`--fleet_launcher "ssh {address}"` + `--fleet_addresses a,b,...`;
+    a container substrate is the same shape, e.g.
+    `"docker exec {address}"`).
+
+    `{address}` in the template is replaced by the host's reachable
+    address (exported as C2V_FLEET_HOST_ADDRESS), the template is
+    shlex-split into the wrapper argv, and the host command — plus the
+    C2V_*/PYTHONPATH/JAX* env the fleet children need — is flattened
+    into ONE `env K=V ... cmd` shell word, quoted, so it survives the
+    remote shell. The handle is the local wrapper process: ssh holds
+    the remote command's lifetime, so poll()/wait()/send_signal() keep
+    their meaning and a failed launch (unreachable machine, rejected
+    key, missing binary) surfaces as an immediate nonzero exit that
+    flows down the EXISTING host_down -> backoff -> host_escalation
+    incident path, never a new one.
+
+    Contract (unchanged from the seam): the host's --heartbeat_file
+    must end up readable by the control plane — run the fleet's run
+    dir on a shared filesystem — and the ports it reports reachable at
+    the host's address."""
+
+    # env worth exporting across the wrapper: the fleet/replica
+    # protocol markers plus interpreter/runtime selection. Everything
+    # else is the REMOTE machine's business.
+    _ENV_KEEP_PREFIXES = ("C2V_", "JAX_", "XLA_")
+    _ENV_KEEP = ("PYTHONPATH",)
+
+    def __init__(self, template: str):
+        if not (template or "").strip():
+            raise ValueError(
+                "RemoteHostLauncher needs a wrapper template, e.g. "
+                '"ssh {address}"')
+        self.template = template
+
+    def launch(self, command: List[str], env: Dict[str, str],
+               log_path: str):
+        address = env.get(FLEET_HOST_ADDRESS_ENV, "")
+        wrapper = shlex.split(
+            self.template.replace("{address}", address))
+        keep = {k: v for k, v in env.items()
+                if k in self._ENV_KEEP
+                or k.startswith(self._ENV_KEEP_PREFIXES)}
+        remote = " ".join(
+            ["env"]
+            + [f"{k}={shlex.quote(v)}" for k, v in sorted(keep.items())]
+            + [shlex.quote(c) for c in command])
+        logf = open(log_path, "ab")
+        try:
+            return subprocess.Popen(wrapper + [remote], env=env,
                                     stdout=logf, stderr=logf)
         finally:
             logf.close()
@@ -214,6 +298,43 @@ class _Host:
             return None
 
 
+class RouterSpec:
+    """One edge router process: id + CLI re-exec command (WITHOUT
+    --heartbeat_file — the control plane owns run files)."""
+
+    def __init__(self, router_id: str, command: List[str]):
+        self.id = router_id
+        self.command = list(command)
+
+
+class _Router:
+    def __init__(self, spec: RouterSpec, run_dir: str):
+        self.spec = spec
+        self.id = spec.id
+        self.router_dir = os.path.join(run_dir, spec.id)
+        os.makedirs(self.router_dir, exist_ok=True)
+        self.heartbeat_path = os.path.join(self.router_dir,
+                                           "router.heartbeat.json")
+        self.log_path = os.path.join(self.router_dir, "router.log")
+        self.proc = None
+        self.port: Optional[int] = None
+        self.restarts = 0
+        self.restart_at: Optional[float] = None  # backoff gate
+        self.spawned_at = 0.0
+        self.state = "down"
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def heartbeat(self) -> Optional[dict]:
+        try:
+            with open(self.heartbeat_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
 class ControlPlane:
     """Owns the host processes + their health/scaling state; the
     router consumes it through hosts_for/fleet_view/..."""
@@ -231,9 +352,20 @@ class ControlPlane:
             self.run_dir, "fleet.heartbeat.json"))
         self.hosts = [_Host(spec, self.run_dir) for spec in specs]
         self.models = sorted({h.model for h in self.hosts})
-        # per-model artifact currently rolled out — the rollback target
-        # for a failed coordinated swap (fleet/swap.py)
+        # edge-tier router processes (add_router); routers colocate
+        # with the control plane — they are the public address, not the
+        # capacity — so they always launch through the local seam even
+        # when hosts go through a remote one
+        self.routers: List[_Router] = []
+        self.router_launcher: HostLauncher = LocalHostLauncher()
+        # per-model (artifact, retrieval_index) PAIR currently rolled
+        # out — the artifact doubles as the rollback target for a
+        # failed coordinated swap (fleet/swap.py), and a (re)spawned
+        # host reconciles onto the pair, not just the artifact: a host
+        # dying after a pipeline retrieval_refresh must come back with
+        # the refreshed index, not none/stale
         self._artifacts: Dict[str, Optional[str]] = {}
+        self._retrieval_indexes: Dict[str, Optional[str]] = {}
         self._stop = threading.Event()
         self._escalated = False
         self._lock = threading.Lock()
@@ -247,8 +379,15 @@ class ControlPlane:
             log=self.log)
 
     def set_initial_artifact(self, model: str,
-                             artifact: Optional[str]) -> None:
+                             artifact: Optional[str],
+                             retrieval_index: Optional[str] = None
+                             ) -> None:
         self._artifacts[model] = artifact
+        self._retrieval_indexes[model] = retrieval_index
+
+    def add_router(self, spec: RouterSpec) -> None:
+        """Register an edge router process (before start())."""
+        self.routers.append(_Router(spec, self.run_dir))
 
     # ------------------------------------------------------------ spawn
 
@@ -265,17 +404,22 @@ class ControlPlane:
         from code2vec_tpu.serving.server import RELOAD_TARGET_FILENAME
         from code2vec_tpu.serving.supervisor import child_env
         current = self._artifacts.get(host.model)
+        index = self._retrieval_indexes.get(host.model)
         target_path = os.path.join(host.host_dir,
                                    RELOAD_TARGET_FILENAME)
-        if current and current != host.spec.boot_artifact:
+        if current and (current != host.spec.boot_artifact or index):
             # desired-state reconciliation across a host restart: the
-            # fleet committed a swap after this host's command was
-            # built, so its supervisor must deliver the CURRENT
-            # artifact to every replica at first heartbeat
+            # fleet committed a swap (and possibly a retrieval_refresh)
+            # after this host's command was built, so its supervisor
+            # must deliver the CURRENT (artifact, retrieval_index)
+            # PAIR to every replica at first heartbeat — the artifact
+            # alone would revive the model with no/stale index
+            payload = {"artifact": current,
+                       "requested_at": time.time()}
+            if index:
+                payload["retrieval_index"] = index
             obs.exporters._atomic_write(
-                target_path,
-                json.dumps({"artifact": current,
-                            "requested_at": time.time()}) + "\n")
+                target_path, json.dumps(payload) + "\n")
         else:
             try:
                 os.remove(target_path)
@@ -285,15 +429,55 @@ class ControlPlane:
                                        host.heartbeat_path]
         env = child_env(os.environ)
         env[FLEET_HOST_ENV] = host.id
-        host.proc = self.launcher.launch(command, env, host.log_path)
+        env[FLEET_HOST_ADDRESS_ENV] = host.address
+        try:
+            host.proc = self.launcher.launch(command, env,
+                                             host.log_path)
+        except OSError as e:
+            # a launcher that cannot even start its wrapper (missing
+            # ssh/docker binary, bad template) joins the ordinary
+            # death path: backoff, restart budget, escalation
+            host.proc = None
+            host.spawned_at = time.monotonic()
+            self._handle_host_death(host, f"launch failed ({e})")
+            return
         host.spawned_at = time.monotonic()
         host.restart_at = None
         self.log(f"Fleet host {host.id} (model {host.model}) spawned "
                  f"(pid {host.proc.pid})")
 
+    def _spawn_router(self, router: _Router) -> None:
+        try:
+            os.remove(router.heartbeat_path)
+        except OSError:
+            pass
+        router.port = None
+        from code2vec_tpu.serving.supervisor import child_env
+        command = router.spec.command + ["--heartbeat_file",
+                                         router.heartbeat_path]
+        env = child_env(os.environ)
+        env[FLEET_ROUTER_ENV] = router.id
+        # a router agent never builds a model: keep its startup at
+        # subprocess speed (same gate the chaos children use)
+        env.setdefault("C2V_HOST_WORKER", "1")
+        try:
+            router.proc = self.router_launcher.launch(
+                command, env, router.log_path)
+        except OSError as e:
+            router.proc = None
+            router.spawned_at = time.monotonic()
+            self._handle_router_death(router, f"launch failed ({e})")
+            return
+        router.spawned_at = time.monotonic()
+        router.restart_at = None
+        self.log(f"Edge router {router.id} spawned "
+                 f"(pid {router.proc.pid})")
+
     def start(self) -> None:
         for host in self.hosts:
             self._spawn(host)
+        for router in self.routers:
+            self._spawn_router(router)
         self._write_heartbeat("controlling")
 
     # ------------------------------------------------------------- http
@@ -351,6 +535,10 @@ class ControlPlane:
                 lambda h: self._check_host(h, now), hosts))
         elif hosts:
             self._check_host(hosts[0], now)
+        for router in self.routers:
+            if self._stop.is_set():
+                break
+            self._check_router(router, now)
         self._update_host_gauges()
         self._write_heartbeat("controlling")
 
@@ -448,7 +636,38 @@ class ControlPlane:
             host.state, host.weight = "healthy", 1.0
         self._scale_tick(host, now)
 
-    def _kill(self, host: _Host, sig=signal.SIGKILL) -> None:
+    def _check_router(self, router: _Router, now: float) -> None:
+        """Same supervision shape as _check_host, minus health/scaling:
+        a router is either routing (fresh heartbeat) or down."""
+        if router.restart_at is not None:
+            router.state = "down"
+            if now >= router.restart_at:
+                self._spawn_router(router)
+            return
+        rc = router.proc.poll() if router.proc is not None else 0
+        if rc is not None:
+            self._handle_router_death(router, f"exited rc={rc}")
+            return
+        hb = router.heartbeat()
+        if hb is None:
+            router.state = "down"
+            if now - router.spawned_at > HOST_STARTUP_GRACE_S:
+                self._kill(router)
+                self._handle_router_death(
+                    router, "no heartbeat within the startup grace "
+                            "(hung startup; killed)")
+            return
+        router.port = hb.get("port") or router.port
+        hb_age = time.time() - float(hb.get("wall_time", 0.0))
+        if hb_age > self._stale_after_s():
+            self._kill(router)
+            self._handle_router_death(
+                router, f"router heartbeat stale ({hb_age:.1f}s; "
+                        f"hung; killed)")
+            return
+        router.state = "routing"
+
+    def _kill(self, host, sig=signal.SIGKILL) -> None:
         if host.proc is not None and host.proc.poll() is None:
             try:
                 host.proc.send_signal(sig)
@@ -477,6 +696,35 @@ class ControlPlane:
         host.restart_at = time.monotonic() + backoff
         self.log(f"Fleet host {host.id} {why}; restart "
                  f"{host.restarts}/"
+                 f"{self.config.fleet_max_host_restarts} in "
+                 f"{backoff:.1f}s")
+
+    def _handle_router_death(self, router: _Router, why: str) -> None:
+        """The host backoff/escalation policy, applied to a router: a
+        SIGKILLed router under load is absorbed by the survivors and
+        respawned here; a router that cannot stay up exhausts the same
+        restart budget and escalates the same way."""
+        if router.proc is not None:
+            router.proc.wait()
+        router.state = "down"
+        if router.restarts >= self.config.fleet_max_host_restarts:
+            self.log(f"Edge router {router.id} {why}; restart budget "
+                     f"({self.config.fleet_max_host_restarts}) "
+                     f"exhausted — escalating")
+            self.flight.incident("router_escalation", immediate=True,
+                                 router=router.id, why=why,
+                                 restarts=router.restarts)
+            self._escalated = True
+            self._stop.set()
+            return
+        router.restarts += 1
+        _C_ROUTER_RESTARTS.inc()
+        self.flight.incident("router_down", router=router.id, why=why,
+                             restart=router.restarts)
+        backoff = min(0.5 * (2 ** (router.restarts - 1)), 10.0)
+        router.restart_at = time.monotonic() + backoff
+        self.log(f"Edge router {router.id} {why}; restart "
+                 f"{router.restarts}/"
                  f"{self.config.fleet_max_host_restarts} in "
                  f"{backoff:.1f}s")
 
@@ -562,6 +810,11 @@ class ControlPlane:
             for state in _HOST_STATES:
                 _g_hosts(model, state).set(
                     counts.get((model, state), 0))
+        if self.routers:
+            routing = sum(1 for r in self.routers
+                          if r.state == "routing")
+            _g_routers("routing").set(routing)
+            _g_routers("down").set(len(self.routers) - routing)
 
     # --------------------------------------------------- router surface
 
@@ -598,6 +851,7 @@ class ControlPlane:
             hosts.append({
                 "host": host.id,
                 "model": host.model,
+                "address": host.address,
                 "state": host.state,
                 "weight": host.weight,
                 "alive": host.alive,
@@ -623,11 +877,22 @@ class ControlPlane:
         return {
             "role": "fleet-control",
             "router_port": self.router.port if self.router else None,
+            "router_ports": sorted(r.port for r in self.routers
+                                   if r.port is not None),
+            "routers": [{
+                "router": r.id,
+                "state": r.state,
+                "alive": r.alive,
+                "pid": r.proc.pid if r.proc is not None else None,
+                "port": r.port,
+                "restarts": r.restarts,
+            } for r in self.routers],
             "models": {m: {
                 "hosts": sum(1 for h in self.hosts if h.model == m),
                 "routable": sum(1 for h in self.hosts
                                 if h.model == m and h.weight > 0),
                 "artifact": self._artifacts.get(m),
+                "retrieval_index": self._retrieval_indexes.get(m),
                 # >1 fingerprint = a swap window (or a wedged rollout):
                 # observable, and bounded by the canary-first driver
                 "fingerprints": sorted(fingerprints[m]),
@@ -719,8 +984,15 @@ class ControlPlane:
     def rollback_target(self, model: str) -> Optional[str]:
         return self._artifacts.get(model)
 
-    def set_artifact(self, model: str, artifact: str) -> None:
+    def set_artifact(self, model: str, artifact: str,
+                     retrieval_index: Optional[str] = None) -> None:
+        """Record the committed (artifact, retrieval_index) pair —
+        what a (re)spawned host reconciles onto. A plain model promote
+        clears the index: the rollout either refused or detached any
+        fingerprint-mismatched index, so reviving the old one on a
+        restart would serve stale vectors."""
         self._artifacts[model] = artifact
+        self._retrieval_indexes[model] = retrieval_index
 
     # -------------------------------------------------------------- run
 
@@ -728,7 +1000,14 @@ class ControlPlane:
         obs.exporters.write_heartbeat(
             self.heartbeat_path, status=status, role="fleet-control",
             router_port=self.router.port if self.router else None,
+            router_ports=sorted(r.port for r in self.routers
+                                if r.port is not None),
             escalated=self._escalated,
+            routers=[{"router": r.id, "state": r.state,
+                      "pid": r.proc.pid if r.proc is not None
+                      else None,
+                      "port": r.port, "restarts": r.restarts}
+                     for r in self.routers],
             hosts=[{"host": h.id, "model": h.model, "state": h.state,
                     "pid": h.proc.pid if h.proc is not None else None,
                     "port": h.port, "telemetry_port": h.telemetry_port,
@@ -758,12 +1037,30 @@ class ControlPlane:
                     "draining the router and every host"))
         if self.router is not None:
             self.router.drain()
+        # public intake stops FIRST: routers drain on SIGTERM (503 with
+        # Retry-After), then the hosts behind them
+        for router in self.routers:
+            self._kill(router, signal.SIGKILL if escalated
+                       else signal.SIGTERM)
         for host in self.hosts:
             self._kill(host, signal.SIGKILL if escalated
                        else signal.SIGTERM)
         budget = self.config.serve_drain_timeout_s + 20.0
         deadline = time.monotonic() + budget
         clean = not escalated
+        for router in self.routers:
+            if router.proc is None or router.restart_at is not None:
+                continue  # dead + reaped, waiting out backoff
+            try:
+                rc = router.proc.wait(
+                    timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                self._kill(router)
+                router.proc.wait()
+                rc = router.proc.returncode
+            if rc != 0:
+                clean = False
+                self.log(f"Edge router {router.id} exited rc={rc}")
         for host in self.hosts:
             if host.proc is None or host.retired:
                 continue
@@ -795,12 +1092,27 @@ class ControlPlane:
 
 _FLEET_VALUE_FLAGS = (
     "--fleet_hosts", "--fleet_port", "--fleet_models",
+    "--fleet_routers", "--fleet_control", "--fleet_launcher",
+    "--fleet_addresses",
     "--fleet_poll_interval", "--fleet_scale_min", "--fleet_scale_max",
     "--fleet_scale_up_shed_rate", "--fleet_scale_up_p95_ms",
     "--fleet_scale_up_ticks", "--fleet_scale_down_ticks",
     "--fleet_scale_cooldown", "--fleet_swap_timeout",
     "--fleet_max_host_restarts",
     # run files + ports are per host, owned by the control plane
+    "--heartbeat_file", "--metrics_file", "--trace_export",
+    "--serve_port", "--serve_telemetry_port",
+)
+# valueless fleet flags (argparse store_true) stripped the same way
+_FLEET_BOOL_FLAGS = ("--fleet_no_affinity",)
+
+# Router agents re-exec the SAME argv (keeping the `fleet` subcommand
+# — dispatch keys on C2V_FLEET_ROUTER) so they inherit the operator's
+# serve_*/fleet_* knobs, including the affinity toggle; only the
+# per-process run-file/port/topology flags are stripped.
+_ROUTER_STRIP_FLAGS = (
+    "--fleet_routers", "--fleet_control", "--fleet_port",
+    "--fleet_launcher", "--fleet_addresses",
     "--heartbeat_file", "--metrics_file", "--trace_export",
     "--serve_port", "--serve_telemetry_port",
 )
@@ -814,8 +1126,18 @@ def _host_base_command(argv: List[str], strip_artifact: bool
         argv[0] = "serve"
     for flag in _FLEET_VALUE_FLAGS:
         argv = strip_flag(argv, flag)
+    for flag in _FLEET_BOOL_FLAGS:
+        argv = strip_flag(argv, flag, has_value=False)
     if strip_artifact:
         argv = strip_flag(argv, "--artifact")
+    return [sys.executable, "-m", "code2vec_tpu.cli"] + argv
+
+
+def _router_base_command(argv: List[str]) -> List[str]:
+    from code2vec_tpu.serving.supervisor import strip_flag
+    argv = list(argv)
+    for flag in _ROUTER_STRIP_FLAGS:
+        argv = strip_flag(argv, flag)
     return [sys.executable, "-m", "code2vec_tpu.cli"] + argv
 
 
@@ -831,6 +1153,11 @@ def fleet_main(config, argv: Optional[List[str]] = None,
     single = not models
     if single:
         models = {DEFAULT_MODEL: config.serve_artifact}
+    if launcher is None and getattr(config, "fleet_launcher", ""):
+        launcher = RemoteHostLauncher(config.fleet_launcher)
+    addresses = [a.strip() for a in
+                 (getattr(config, "fleet_addresses", "") or "")
+                 .split(",") if a.strip()]
     specs: List[HostSpec] = []
     for model, artifact in models.items():
         base = (list(host_command) if host_command is not None
@@ -841,8 +1168,13 @@ def fleet_main(config, argv: Optional[List[str]] = None,
         if not single and artifact:
             cmd = cmd + ["--artifact", artifact]
         for i in range(config.fleet_hosts):
+            # remote fleets place hosts round-robin over the address
+            # list; the launcher template reaches each host at its own
+            # {address} and its reported ports are reachable there
+            address = (addresses[len(specs) % len(addresses)]
+                       if addresses else config.serve_host)
             specs.append(HostSpec(f"{model}-{i}", cmd, model=model,
-                                  address=config.serve_host,
+                                  address=address,
                                   boot_artifact=artifact))
     control = ControlPlane(config, specs, launcher=launcher,
                            log=config.log)
@@ -850,9 +1182,30 @@ def fleet_main(config, argv: Optional[List[str]] = None,
         control.set_initial_artifact(model, artifact)
     router_port = (config.fleet_port if config.fleet_port is not None
                    else config.serve_port)
-    control.router = FleetRouter(config, control,
-                                 host=config.serve_host,
-                                 port=router_port, log=config.log)
+    n_routers = max(1, int(getattr(config, "fleet_routers", 1) or 1))
+    if n_routers > 1:
+        # Edge tier: N stateless router processes on consecutive
+        # public ports (VIP convention: ONE DNS name, A-records /
+        # L4 VIP members at base..base+N-1 — README "Edge"). The
+        # embedded router demotes to the PRIVATE control listener the
+        # agents poll for the shared fleet view and relay admin verbs
+        # to; it binds loopback so the only public addresses are the
+        # agents'.
+        control.router = FleetRouter(config, control,
+                                     host="127.0.0.1", port=0,
+                                     log=config.log)
+        base = _router_base_command(list(argv or []))
+        control_address = f"127.0.0.1:{control.router.port}"
+        for i in range(n_routers):
+            port = router_port + i if router_port else 0
+            control.add_router(RouterSpec(
+                f"router-{i}",
+                base + ["--serve_port", str(port),
+                        "--fleet_control", control_address]))
+    else:
+        control.router = FleetRouter(config, control,
+                                     host=config.serve_host,
+                                     port=router_port, log=config.log)
     installed = threading.current_thread() is threading.main_thread()
     prev = {}
     if installed:
@@ -868,7 +1221,12 @@ def fleet_main(config, argv: Optional[List[str]] = None,
                     "router (canary-first, rollback on failure)"))
     config.log(f"Fleet: {len(specs)} host(s) x "
                f"{max(config.serve_replicas, 1)} replica(s), models "
-               f"{sorted(models)}, router port {control.router.port}")
+               f"{sorted(models)}, "
+               + (f"{n_routers} edge router(s) from port "
+                  f"{router_port or 'auto'} (control listener "
+                  f"127.0.0.1:{control.router.port})"
+                  if n_routers > 1
+                  else f"router port {control.router.port}"))
     try:
         return control.run()
     finally:

@@ -1,0 +1,237 @@
+"""Edge router agent: one stateless public router of the N-router tier.
+
+`fleet --fleet_routers N` (N >= 2) turns the fleet's single public
+router into N of THESE processes on consecutive ports — the VIP
+convention (README "Edge"): one DNS name / L4 VIP fronting ports
+base..base+N-1, any member serving any request, clients (or the VIP's
+health checks) retrying a refused connection against the next member.
+A router holds no state a poll cannot rebuild:
+
+- **Shared fleet view**: a `SharedFleetView` polls the control plane's
+  PRIVATE control listener (`--fleet_control HOST:PORT`) every
+  `--fleet_poll_interval` for the `/fleet` JSON and derives routing
+  candidates from it — weights, addresses, ports, per the control
+  plane's health derivation. Between polls the router serves from its
+  cached view; a stale-but-recent view mis-weights at worst (the
+  forward/retry loop still walks every candidate), it never blocks
+  intake. The staleness is observable (`view_age_s` in /healthz and
+  /fleet).
+- **Admin relay**: POST /admin/reload|scale|drain on ANY router is
+  relayed verbatim to the control listener, so the coordinated-swap /
+  scale / drain surface keeps working whichever member the VIP picks;
+  status codes (202 accepted, 409 swap-in-flight, 400/404) pass
+  through.
+- **Telemetry**: GET /metrics re-merges the control listener's
+  fleet-wide snapshot with this router's own registry (affinity and
+  routing counters) — counters sum, gauges pick up a `source` label on
+  top of their host/replica labels.
+- **Supervision contract**: the agent rewrites `--heartbeat_file`
+  every poll tick (port + status + view age); the control plane
+  restarts a dead or heartbeat-stale router with the SAME
+  backoff/escalation policy it applies to hosts, and a SIGTERM drains
+  (honest 503s with Retry-After) before exit 0.
+
+The routing logic itself — weighted sampling, deadline-bounded retry,
+consistent-hash cache affinity — is FleetRouter (serving/fleet/
+router.py), unchanged: this module only swaps its `control` surface
+for a polled remote one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+from code2vec_tpu import obs
+from code2vec_tpu.serving import telemetry
+from code2vec_tpu.serving.fleet.router import FleetRouter
+
+# re-exported for callers that only know the agent module
+from code2vec_tpu.serving.fleet.control import FLEET_ROUTER_ENV  # noqa: F401
+
+
+def _c_view_refresh(outcome: str):
+    return obs.counter(
+        "edge_view_refresh_total",
+        "fleet-view poll attempts by an edge router agent against the "
+        "control listener (ok | error — on error the router keeps "
+        "serving from its cached view)",
+        outcome=outcome)
+
+
+class SharedFleetView:
+    """The router agent's `control` surface, duck-typed against
+    FleetRouter's contract: hosts_for / fleet_view /
+    merged_fleet_metrics / request_swap / request_scale / drain_host,
+    all derived from (or relayed to) the control listener. This is the
+    WHOLE per-router state — a SIGKILLed router loses nothing the next
+    poll does not rebuild, which is what makes the tier stateless."""
+
+    def __init__(self, config, control_address: str, router_id: str,
+                 log=None):
+        host, _, port = control_address.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"--fleet_control must be HOST:PORT, got "
+                f"{control_address!r}")
+        self.config = config
+        self.base = f"http://{host}:{int(port)}"
+        self.router_id = router_id
+        self.log = log or config.log
+        self._view: dict = {}
+        self._fetched_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- poll
+
+    def refresh(self) -> bool:
+        try:
+            with urllib.request.urlopen(self.base + "/fleet",
+                                        timeout=3.0) as r:
+                view = json.loads(r.read())
+        except (OSError, ValueError):
+            _c_view_refresh("error").inc()
+            return False
+        with self._lock:
+            self._view = view
+            self._fetched_at = time.monotonic()
+        _c_view_refresh("ok").inc()
+        return True
+
+    def view_age_s(self) -> Optional[float]:
+        with self._lock:
+            if self._fetched_at is None:
+                return None
+            return round(time.monotonic() - self._fetched_at, 3)
+
+    # --------------------------------------------- FleetRouter contract
+
+    def hosts_for(self, model: str
+                  ) -> Optional[List[Tuple[float, str, tuple]]]:
+        with self._lock:
+            view = self._view
+        models = view.get("models") or {}
+        if not models:
+            # no view yet (control listener unreachable at boot): an
+            # empty candidate list is an honest retryable 503; a None
+            # would 404 a model that exists
+            return []
+        if model not in models:
+            return None
+        return [(float(h.get("weight") or 0.0), h["host"],
+                 (h.get("address") or "127.0.0.1", h.get("port")))
+                for h in view.get("hosts", [])
+                if h.get("model") == model and h.get("port")]
+
+    def fleet_view(self) -> dict:
+        with self._lock:
+            view = dict(self._view)
+        view["role"] = "fleet-router"
+        view["router"] = self.router_id
+        view["view_age_s"] = self.view_age_s()
+        return view
+
+    def merged_fleet_metrics(self) -> str:
+        own = obs.default_registry().render_prometheus()
+        try:
+            with urllib.request.urlopen(self.base + "/metrics",
+                                        timeout=3.0) as r:
+                fleet_text = r.read().decode("utf-8", errors="replace")
+        except (OSError, ValueError):
+            return own
+        return telemetry.merge_prometheus_snapshots(
+            {"fleet": fleet_text,
+             f"router:{self.router_id}": own},
+            gauge_label="source")
+
+    def _relay(self, path: str, payload: dict) -> Tuple[int, dict]:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(payload).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as r:
+                return r.getcode(), json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {"error": f"control listener HTTP {e.code}"}
+            return e.code, body
+        except (OSError, ValueError) as e:
+            return 503, {"error": f"control plane unreachable from "
+                                  f"router {self.router_id}: {e}"}
+
+    def request_swap(self, payload: dict) -> Tuple[int, dict]:
+        return self._relay("/admin/reload", payload)
+
+    def request_scale(self, host_id, n) -> Tuple[int, dict]:
+        return self._relay("/admin/scale",
+                           {"host": host_id, "replicas": n})
+
+    def drain_host(self, host_id) -> Tuple[int, dict]:
+        return self._relay("/admin/drain", {"host": host_id})
+
+
+def router_main(config) -> int:
+    """`fleet` CLI re-exec body for a router child (cli.main dispatches
+    here when C2V_FLEET_ROUTER is set, before any model work). Parks
+    on a poll/heartbeat loop until SIGTERM/SIGINT, then drains."""
+    router_id = os.environ.get(FLEET_ROUTER_ENV, "router")
+    control_address = getattr(config, "fleet_control", "") or ""
+    if not control_address:
+        config.log("fleet router child started without "
+                   "--fleet_control HOST:PORT — nothing to route for")
+        return 2
+    view = SharedFleetView(config, control_address, router_id,
+                           log=config.log)
+    view.refresh()  # best effort before the public port opens
+    router = FleetRouter(config, view, host=config.serve_host,
+                         port=config.serve_port, log=config.log)
+    heartbeat_path = config.heartbeat_file or os.path.join(
+        tempfile.mkdtemp(prefix="c2v-router-"),
+        "router.heartbeat.json")
+    stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda s, f: stop.set())
+        if hasattr(signal, "SIGHUP"):
+            signal.signal(
+                signal.SIGHUP,
+                lambda s, f: config.log(
+                    "SIGHUP ignored by the edge router: drive "
+                    "coordinated swaps via POST /admin/reload"))
+
+    def _heartbeat(status: str) -> None:
+        obs.exporters.write_heartbeat(
+            heartbeat_path, status=status, role="fleet-router",
+            router=router_id, port=router.port,
+            control=control_address, view_age_s=view.view_age_s())
+
+    config.log(f"Edge router {router_id} on port {router.port} "
+               f"(control listener {control_address})")
+    _heartbeat("routing")
+    while not stop.is_set():
+        # heartbeat cadence == view-poll cadence: the control plane's
+        # staleness threshold scales off the same knob
+        stop.wait(config.fleet_poll_interval_s)
+        if stop.is_set():
+            break
+        view.refresh()
+        _heartbeat("routing")
+    # drain: stop intake (honest 503 + Retry-After) and give in-flight
+    # forwards a moment before the listener closes under them
+    router.drain()
+    _heartbeat("draining")
+    time.sleep(min(2.0, getattr(config, "serve_drain_timeout_s", 2.0)))
+    router.close()
+    _heartbeat("done")
+    config.log(f"Edge router {router_id} drained and exiting")
+    return 0

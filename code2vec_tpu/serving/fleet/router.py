@@ -29,6 +29,19 @@ each host's `/fleet` + heartbeat staleness:
   group. Cache and fingerprint isolation is structural — a request can
   only ever reach a host mounting its model — and every response still
   carries the `model_fingerprint` of the exact weights that served it.
+- **Consistent-hash cache affinity** (`--fleet_no_affinity` to
+  disable): the replicas' LRU prediction caches are per-host, so under
+  pure weighted sampling a repeated request warms EVERY host before it
+  reliably hits — fleet-level hit rate decays as 1/N. Affinity hashes
+  the request's normalized source (the same normalization the cache
+  key uses, serving/cache.py) onto a consistent-hash ring of the
+  FULLY-HEALTHY hosts and tries that host first; retries (and the
+  whole selection when the preferred host is unhealthy/draining, i.e.
+  off the ring) fall back to the weighted order. Affinity only picks
+  WHICH host answers — response bytes are a host-local function of
+  (normalized source, knobs, model fingerprint), so the byte-equality
+  and fingerprint-keying cache invariants are untouched (pinned in
+  tests/test_edge.py).
 
 Fleet views are answered HERE, never forwarded: `GET /fleet` is the
 control plane's fleet JSON, `GET /metrics` the fleet-wide merge of
@@ -41,28 +54,70 @@ host drain.
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import http.server
 import json
 import random
 import threading
-from typing import Optional
+from typing import List, Optional, Tuple
 
 from code2vec_tpu import obs
 from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.serving.admission import (
     deadline_from_request, retry_after_seconds,
 )
+from code2vec_tpu.serving.cache import normalize_source
 from code2vec_tpu.serving.forwarding import (
     forward_with_retry, handle_admin_post,
 )
 
 DEFAULT_MODEL = "default"
 FORWARD_ENDPOINTS = ("/predict", "/embed", "/neighbors")
+# Virtual nodes per host on the affinity ring: enough that removing a
+# host spreads its keyspace ~evenly over the survivors, small enough
+# that rebuilding the ring on a health transition is trivial.
+AFFINITY_VNODES = 64
 
 _C_RETRIES = obs.counter(
     "fleet_router_retries_total",
     "forward attempts the fleet router retried on another host after "
     "a connection failure")
+
+
+def _c_affinity(outcome: str):
+    return obs.counter(
+        "fleet_router_affinity_total",
+        "cache-affinity routing decisions: preferred (the request's "
+        "consistent-hash host was healthy and tried first), fallback "
+        "(no fully-healthy host on the ring — pure weighted sampling)",
+        outcome=outcome)
+
+
+def _ring_point(value) -> int:
+    data = value if isinstance(value, bytes) else str(value).encode()
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def affinity_ring(host_ids) -> List[Tuple[int, str]]:
+    """Consistent-hash ring over host ids: each host owns
+    AFFINITY_VNODES points on a 64-bit circle. Ring membership is the
+    FULLY-HEALTHY host set, so a host leaving (death, open breaker,
+    drain) remaps only its own arcs — every other host keeps its keys
+    (and its warm cache entries)."""
+    return sorted((_ring_point(f"{host_id}#{i}"), host_id)
+                  for host_id in host_ids
+                  for i in range(AFFINITY_VNODES))
+
+
+def affinity_host(key: bytes, ring: List[Tuple[int, str]]
+                  ) -> Optional[str]:
+    """First ring point clockwise of the key's hash (wrapping)."""
+    if not ring:
+        return None
+    idx = bisect.bisect_left(ring, (_ring_point(key), ""))
+    return ring[idx % len(ring)][1]
 
 
 def _c_requests(endpoint: str, outcome: str):
@@ -102,6 +157,12 @@ class FleetRouter:
         self.control = control
         self.log = log or config.log
         self._draining = False
+        self.affinity = bool(getattr(config, "fleet_cache_affinity",
+                                     True))
+        # memoized ring keyed by the healthy-host id tuple: health
+        # transitions are rare relative to requests
+        self._ring: Tuple[Tuple[str, ...], List[Tuple[int, str]]] = \
+            ((), [])
         router = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -213,6 +274,8 @@ class FleetRouter:
             return
         ordered = weighted_order([(w, (host_id, addr))
                                   for w, host_id, addr in candidates])
+        if self.affinity and ordered:
+            self._apply_affinity(body, candidates, ordered)
         if not ordered:
             _c_requests(endpoint, "no_host").inc()
             handler._reply(503, {
@@ -240,6 +303,31 @@ class FleetRouter:
             retry_counter=_C_RETRIES,
             on_outcome=lambda outcome:
                 _c_requests(endpoint, outcome).inc())
+
+    def _apply_affinity(self, body: bytes, candidates,
+                        ordered) -> None:
+        """Move the request's consistent-hash host to the front of the
+        weighted order (in place). The affinity key is the NORMALIZED
+        source — whitespace variants of one snippet hash identically,
+        exactly as they share a cache entry on the host. The ring holds
+        only fully-healthy hosts; with none (or the preferred id gone
+        from the routable order) the weighted order stands."""
+        healthy = tuple(sorted(
+            host_id for w, host_id, _addr in candidates if w >= 1.0))
+        if not healthy:
+            _c_affinity("fallback").inc()
+            return
+        if self._ring[0] != healthy:
+            self._ring = (healthy, affinity_ring(healthy))
+        preferred = affinity_host(
+            normalize_source(body.decode("utf-8", errors="replace")),
+            self._ring[1])
+        for i, payload in enumerate(ordered):
+            if payload[0] == preferred:
+                ordered.insert(0, ordered.pop(i))
+                _c_affinity("preferred").inc()
+                return
+        _c_affinity("fallback").inc()
 
     # ------------------------------------------------------------ admin
 

@@ -65,7 +65,7 @@ class FleetSwapDriver:
     `swap_hosts(model)` (live hosts of the group, canary first),
     `host_reload(host, artifact)`, `host_fleet(host)` (fresh `/fleet`
     JSON or None), `rollback_target(model)` / `set_artifact(model,
-    artifact)`, `flight` and `log`."""
+    artifact, retrieval_index=None)`, `flight` and `log`."""
 
     def __init__(self, control, poll_interval_s: float = 0.25):
         self.control = control
@@ -168,7 +168,12 @@ class FleetSwapDriver:
                 control.log(f"Fleet swap canary {host.id} committed "
                             f"fingerprint {result}; rolling out to "
                             f"{len(hosts) - 1} more host(s)")
-        control.set_artifact(model, artifact)
+        # commit the PAIR: a host (re)spawned after this rollout must
+        # reconcile onto (artifact, retrieval_index), not the artifact
+        # alone — a retrieval_refresh survivor with no index would
+        # 503 every /neighbors until the next refresh
+        control.set_artifact(model, artifact,
+                             retrieval_index=retrieval_index)
         _c_swaps("committed").inc()
         self._set(state="committed", completed_at=time.time())
         control.flight.event("fleet_swap_committed", target=artifact,

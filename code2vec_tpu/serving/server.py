@@ -162,7 +162,7 @@ class PredictionServer:
     """
 
     def __init__(self, model, config=None, log=None,
-                 swap_build_model=None):
+                 swap_build_model=None, swap_mount_index=None):
         self.config = config or model.config
         self.log = log or self.config.log
         # The model reference is (model, fingerprint), swapped
@@ -228,9 +228,12 @@ class PredictionServer:
             on_transition=self._on_breaker_transition)
         self.extractor_breaker = CircuitBreaker("extractor", **breaker_kw)
         self.device_breaker = CircuitBreaker("device", **breaker_kw)
-        # swap_build_model: injection seam mirroring SwapManager's —
-        # the fleet chaos children swap between in-process fake models
-        self.swap = SwapManager(self, build_model=swap_build_model)
+        # swap_build_model/swap_mount_index: injection seams mirroring
+        # SwapManager's — the fleet chaos children swap between
+        # in-process fake models (and mount scripted index handles for
+        # the retrieval-refresh restart drills)
+        self.swap = SwapManager(self, build_model=swap_build_model,
+                                mount_index=swap_mount_index)
         self._httpd: Optional[socketserver.BaseServer] = None
         self._inflight = 0
         self._inflight_cond = threading.Condition()
@@ -1068,7 +1071,7 @@ def _heartbeat_fields(server: PredictionServer) -> dict:
 
 def serve_main(config, model=None, *, stop: Optional[threading.Event]
                = None, install_signals: Optional[bool] = None,
-               swap_build_model=None) -> int:
+               swap_build_model=None, swap_mount_index=None) -> int:
     """The `serve` CLI subcommand body: build the model, start the
     server, park until SIGTERM/SIGINT (or the injected `stop` event —
     the testable form), drain, exit. Returns the process exit code.
@@ -1085,7 +1088,8 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
         from code2vec_tpu.model_facade import Code2VecModel
         model = Code2VecModel(config)
     server = PredictionServer(model, config,
-                              swap_build_model=swap_build_model)
+                              swap_build_model=swap_build_model,
+                              swap_mount_index=swap_mount_index)
     if stop is None:
         stop = threading.Event()
     if install_signals is None:

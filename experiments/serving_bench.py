@@ -55,6 +55,8 @@ RESILIENCE_OUT_PATH = os.path.join(
     REPO, "experiments", "results", "serving_resilience.json")
 FLEET_OUT_PATH = os.path.join(
     REPO, "experiments", "results", "serving_fleet.json")
+EDGE_OUT_PATH = os.path.join(
+    REPO, "experiments", "results", "serving_edge.json")
 
 N_CLASSES = 24          # distinct request bodies in the corpus
 REQUESTS_PER_CLIENT = 24
@@ -996,6 +998,312 @@ def fleet_main() -> None:
     log(f"Wrote {FLEET_OUT_PATH}")
 
 
+def edge_main() -> None:
+    """`python experiments/serving_bench.py edge`: the PR-16 edge
+    drills against REAL CLI hosts — 2 router-agent subprocesses
+    sharing the fleet view over a private control listener, 2
+    single-replica `serve` hosts with warm LRU caches behind them.
+    Two measurements:
+
+    - router kill: one of the 2 routers is SIGKILLed under 4-client
+      closed-loop load; clients follow the VIP convention (fixed
+      member ports, next member on a refused/torn connection) and the
+      drill records the failed count (acceptance: 0), malformed count
+      (acceptance: 0) and the control plane's router respawn time.
+    - cache affinity: the same 24-source x 4-repeat replay against an
+      affinity-on fleet and a fresh affinity-off fleet; fleet-level
+      hit rate from the summed per-host `serving_cache_hits_total` /
+      `_misses_total` scraped off a router's merged /metrics. The
+      affinity arm must beat the weighted-sampling baseline strictly,
+      and every response must be byte-identical across arms.
+
+    Writes experiments/results/serving_edge.json."""
+    import signal as signal_mod
+    import socket
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving import telemetry
+    from code2vec_tpu.serving.fleet.control import (
+        ControlPlane, HostSpec, RouterSpec,
+    )
+    from code2vec_tpu.serving.fleet.router import FleetRouter
+
+    def log(msg: str) -> None:
+        print(msg, flush=True)
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    import tempfile
+
+    log("Building model + corpus for the edge drill ...")
+    model = build_model()
+    prefix = os.path.join(WORKDIR, "corpus")
+    save_base = os.path.join(WORKDIR, "edge-bench-model")
+    model.save(save_base)
+    bodies = make_corpus()
+    repeats = 4
+    # per-run root: a crashed earlier run's ORPHANED fleet (the control
+    # thread is a daemon) must never share heartbeat paths with this one
+    run_root = tempfile.mkdtemp(prefix="edge-", dir=WORKDIR)
+    host_cmd = [
+        sys.executable, "-m", "code2vec_tpu.cli", "serve",
+        "--data", prefix, "--load", save_base,
+        "--serve_batch_size", str(SERVE_BATCH),
+        "--serve_buckets", BUCKETS, "--serve_max_delay_ms", "5",
+        "--serve_cache_entries", "4096", "--extractor_pool_size", "2",
+        "--serve_heartbeat_interval", "1", "-v", "0",
+        "--serve_port", "0", "--serve_telemetry_port", "0"]
+
+    def start_fleet(affinity: bool, tag: str):
+        fleet_dir = os.path.join(run_root, tag)
+        os.makedirs(fleet_dir, exist_ok=True)
+        router_ports = [free_port(), free_port()]
+        config = Config(
+            serve=True, fleet=True, serve_host="127.0.0.1",
+            fleet_hosts=2, fleet_routers=2, fleet_poll_interval_s=0.5,
+            fleet_cache_affinity=affinity, fleet_max_host_restarts=5,
+            serve_drain_timeout_s=15.0,
+            # scaling off: the drills measure failover + affinity
+            fleet_scale_down_ticks=10_000_000,
+            fleet_scale_up_shed_rate=1.0,
+            heartbeat_file=os.path.join(fleet_dir, "fleet.heartbeat.json"),
+            verbose_mode=0)
+        control = ControlPlane(
+            config, [HostSpec("edge-0", host_cmd),
+                     HostSpec("edge-1", host_cmd)], log=lambda m: None)
+        # private control listener the router agents poll (fleet_main's
+        # n_routers>=2 topology, built by hand so the bench owns ports)
+        control.router = FleetRouter(config, control, host="127.0.0.1",
+                                     port=0, log=lambda m: None)
+        for i, port in enumerate(router_ports):
+            control.add_router(RouterSpec(
+                f"router-{i}",
+                [sys.executable, "-m", "code2vec_tpu.cli", "fleet",
+                 "--fleet_models", "default=/tmp/unused",
+                 "--serve_host", "127.0.0.1", "--serve_port", str(port),
+                 "--fleet_control", f"127.0.0.1:{control.router.port}",
+                 "--fleet_poll_interval", "0.5", "--verbose", "0"]
+                + (["--fleet_no_affinity"] if not affinity else [])))
+        rc_holder = {}
+        thread = threading.Thread(
+            target=lambda: rc_holder.update(rc=control.run()),
+            daemon=True)
+        thread.start()
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            view = control.fleet_view()
+            hosts_up = all(
+                h["weight"] > 0 and (h.get("replicas_serving") or 0) >= 1
+                for h in view["hosts"])
+            routing = [r for r in view.get("routers", [])
+                       if r["state"] == "routing" and r["port"]]
+            if hosts_up and len(routing) >= 2:
+                return control, thread, rc_holder, router_ports
+            time.sleep(0.5)
+        raise RuntimeError(f"edge fleet never came up: "
+                           f"{control.fleet_view()}")
+
+    def fleet_cache_counts(port: int) -> "tuple[float, float]":
+        """(hits, misses) summed fleet-wide off a router agent's
+        merged /metrics (control merges each host's replica-merged
+        snapshot; the router merges the control text with its own)."""
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            fams = telemetry.parse_prometheus_text(r.read().decode())
+
+        def total(name: str) -> float:
+            fam = fams.get(name)
+            if fam is None:
+                return 0.0
+            return sum(v for sub in fam.samples.values()
+                       for v in sub.values())
+
+        return (total("serving_cache_hits_total"),
+                total("serving_cache_misses_total"))
+
+    def replay_corpus(router_ports, response_bytes):
+        """24 sources x `repeats`, alternating routers, cold caches.
+        Records/validates per-source response bytes in-place."""
+        n = 0
+        for rep in range(repeats):
+            for i, body in enumerate(bodies):
+                port = router_ports[(rep + i) % len(router_ports)]
+                t0 = time.perf_counter()
+                while True:
+                    # startup transients — a router whose first view
+                    # poll hasn't landed answers an honest 503; a port
+                    # not yet bound refuses — are retried; neither
+                    # reaches a host cache, so hit/miss accounting is
+                    # unaffected
+                    try:
+                        status, payload = _post_status(port, body)
+                    except OSError:
+                        status, payload = -1, b""
+                    if status == 200:
+                        break
+                    assert (status in (-1, 503, 504)
+                            and time.perf_counter() - t0 < 30.0), (
+                        status, payload[:200])
+                    time.sleep(0.2)
+                ref = response_bytes.setdefault(i, payload)
+                assert payload == ref, (
+                    f"response bytes for source {i} changed")
+                n += 1
+        # the control plane scrapes host /metrics on its poll cadence;
+        # wait for the post-replay scrape to land
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            hits, misses = fleet_cache_counts(router_ports[0])
+            if hits + misses >= n:
+                return hits, misses
+            time.sleep(0.5)
+        raise RuntimeError(
+            f"host cache counters never covered the replay: "
+            f"{hits + misses} < {n}")
+
+    # ---- arm A: affinity ON; also hosts the router-kill drill
+    log("Starting affinity-on fleet (2 routers x 2 hosts) ...")
+    control, thread, rc_holder, ports = start_fleet(True, "affinity")
+    failures: list = []
+    malformed: list = []
+    stop_load = threading.Event()
+
+    def client(ci: int) -> None:
+        i = ci
+        while not stop_load.is_set():
+            body = bodies[i % len(bodies)]
+            t0 = time.perf_counter()
+            member = ci  # VIP: clients pin different start members
+            ok = False
+            while time.perf_counter() - t0 < 30.0:
+                port = ports[member % len(ports)]
+                try:
+                    status, payload = _post_status(port, body)
+                except Exception:  # refused/torn: next VIP member
+                    member += 1
+                    continue
+                try:
+                    parsed = json.loads(payload)
+                except ValueError:
+                    malformed.append((port, status, payload[:200]))
+                    break
+                if status == 200:
+                    if "methods" not in parsed:
+                        malformed.append((port, status, parsed))
+                    ok = True
+                    break
+                if status in (503, 504) and "error" in parsed:
+                    continue  # honest backpressure: retry
+                malformed.append((port, status, parsed))
+                break
+            if not ok and not stop_load.is_set():
+                failures.append((ci, i))
+            i += 1
+
+    try:
+        response_bytes: dict = {}
+        hits_on, misses_on = replay_corpus(ports, response_bytes)
+        rate_on = hits_on / (hits_on + misses_on)
+        log(f"  affinity on:  {int(hits_on)} hits / "
+            f"{int(misses_on)} misses (rate {rate_on:.2f})")
+
+        log("  SIGKILL drill: 4 clients across the VIP members ...")
+        clients = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(4)]
+        for t in clients:
+            t.start()
+        time.sleep(2.0)
+        victim = control.fleet_view()["routers"][0]
+        t_kill = time.perf_counter()
+        os.kill(victim["pid"], signal_mod.SIGKILL)
+        log(f"  SIGKILL router-0 (pid {victim['pid']})")
+        recovery_s = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            r0 = control.fleet_view()["routers"][0]
+            if (r0["pid"] not in (None, victim["pid"])
+                    and r0["state"] == "routing"
+                    and r0["restarts"] >= 1):
+                recovery_s = time.perf_counter() - t_kill
+                break
+            time.sleep(0.25)
+        if recovery_s is None:
+            raise RuntimeError(
+                f"router never respawned: {control.fleet_view()}")
+        time.sleep(1.5)  # post-recovery traffic through both members
+        stop_load.set()
+        for t in clients:
+            t.join(timeout=120)
+        # the respawned router rebinds its ORIGINAL port: the VIP
+        # never re-learns addresses
+        for port in ports:
+            status, _ = _post_status(port, bodies[0])
+            assert status == 200, f"member :{port} dead post-recovery"
+    finally:
+        # a failed drill must still tear the fleet down: the control
+        # thread is a daemon and would otherwise ORPHAN its children
+        stop_load.set()
+        control.stop()
+        thread.join(timeout=120)
+    log(f"  router respawned in {recovery_s:.2f}s; "
+        f"{len(failures)} failed, {len(malformed)} malformed; "
+        f"fleet rc={rc_holder.get('rc')}")
+
+    # ---- arm B: affinity OFF baseline (fresh fleet, cold caches)
+    log("Starting affinity-off baseline fleet ...")
+    control_b, thread_b, rc_b, ports_b = start_fleet(False, "baseline")
+    try:
+        response_bytes_b: dict = {}
+        hits_off, misses_off = replay_corpus(ports_b, response_bytes_b)
+    finally:
+        control_b.stop()
+        thread_b.join(timeout=120)
+    rate_off = hits_off / (hits_off + misses_off)
+    log(f"  affinity off: {int(hits_off)} hits / "
+        f"{int(misses_off)} misses (rate {rate_off:.2f})")
+
+    assert response_bytes == response_bytes_b, (
+        "affinity changed response bytes vs the baseline arm")
+    assert failures == [], f"failed requests: {failures[:5]}"
+    assert malformed == [], f"malformed responses: {malformed[:5]}"
+    assert rate_on > rate_off, (
+        f"affinity hit rate {rate_on:.2f} not above the "
+        f"weighted-sampling baseline {rate_off:.2f}")
+    result = {
+        "bench": "serving_edge",
+        "routers": 2,
+        "hosts": 2,
+        "corpus_sources": len(bodies),
+        "repeats": repeats,
+        "router_kill": {
+            "failed_requests": len(failures),
+            "malformed_responses": len(malformed),
+            "router_recovery_s": round(recovery_s, 2),
+            "fleet_exit_rc": rc_holder.get("rc"),
+        },
+        "cache_affinity": {
+            "affinity_on": {"hits": int(hits_on),
+                            "misses": int(misses_on),
+                            "hit_rate": round(rate_on, 3)},
+            "affinity_off": {"hits": int(hits_off),
+                             "misses": int(misses_off),
+                             "hit_rate": round(rate_off, 3)},
+            "responses_byte_identical_across_arms": True,
+            "baseline_fleet_exit_rc": rc_b.get("rc"),
+        },
+    }
+    os.makedirs(os.path.dirname(EDGE_OUT_PATH), exist_ok=True)
+    with open(EDGE_OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    log(f"Wrote {EDGE_OUT_PATH}")
+
+
 def main() -> None:
     def log(msg: str) -> None:
         print(msg, flush=True)
@@ -1050,6 +1358,8 @@ if __name__ == "__main__":
         tracing_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
         fleet_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "edge":
+        edge_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "p95":
         p95_main()
     else:

@@ -1,0 +1,198 @@
+#!/usr/bin/env python3
+"""Static CLI-knob documentation check (tier-1 via
+tests/test_knobs_doc.py) — the sibling of check_metrics_doc.py /
+check_faults_doc.py for the operator knob surface.
+
+Every long flag registered in code2vec_tpu/cli.py must appear in the
+README's canonical knob reference (the table between the
+`<!-- knobs-table:begin -->` / `<!-- knobs-table:end -->` markers in
+the "CLI knob reference" section), and every flag in that table must
+still be registered — a new knob cannot ship undocumented, and the
+table cannot rot as knobs are renamed away.
+
+Registered flags are extracted by AST walk: any
+`<parser>.add_argument("--name", ...)` call with literal option
+strings. A non-literal option string is an ERROR: a dynamically-named
+flag cannot be statically checked.
+
+The walk also checks the CLI -> Config WIRING: every flag's argparse
+dest (explicit `dest=` literal, else the long option name) must be a
+Config field (config.py) or appear in the closed `_ARGS_ONLY`
+allowlist of args config_from_args consumes by hand — so a new flag
+whose value silently never lands anywhere fails here, not in
+production.
+
+Usage: python scripts/check_knobs_doc.py  (exit 0 = consistent)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI_PATH = os.path.join(REPO_ROOT, "code2vec_tpu", "cli.py")
+CONFIG_PATH = os.path.join(REPO_ROOT, "code2vec_tpu", "config.py")
+README = os.path.join(REPO_ROOT, "README.md")
+
+BEGIN_MARKER = "<!-- knobs-table:begin -->"
+END_MARKER = "<!-- knobs-table:end -->"
+
+_FLAG_RE = re.compile(r"^--[a-z][a-z0-9_-]*$")  # dash: reference
+# compat (--logs-path); new knobs use lower_snake_case
+# the flag is the FIRST cell of a table row — backticked flags
+# elsewhere in a row are cross-references, not declarations
+_TABLE_FLAG_RE = re.compile(r"^\|\s*`(--[a-z][a-z0-9_-]*)`",
+                            re.MULTILINE)
+
+# argparse dests config_from_args consumes by HAND instead of piping
+# into a same-named Config field (renames, derived fields, pure-CLI
+# switches). Closed set: a new flag must either match a Config field
+# by dest or be deliberately added here.
+_ARGS_ONLY = {
+    # renamed on the way into Config (reference-CLI compat)
+    "load_path",              # -> Config.model_load_path
+    "save_path",              # -> Config.model_save_path
+    "data_path",              # -> Config.train_data_path_prefix
+    "test_path",              # -> Config.test_data_path
+    "batch_size",             # -> train_batch_size AND test_batch_size
+    "epochs",                 # -> Config.num_train_epochs
+    "sparse_embedding_update",  # -> use_sparse_embedding_update
+    # negative flags flipping a default-on Config field (argparse
+    # cannot express that as a same-named dest)
+    "no_quantize",            # -> release_quantize = False
+    "no_aot",                 # -> release_aot = False
+    "no_cursor_resume",       # -> cursor_resume = False
+    "no_packed_data",         # -> use_packed_data = False
+    "gspmd",                  # -> use_manual_tp_kernels = False
+    "fleet_no_affinity",      # -> fleet_cache_affinity = False
+    # reference-CLI compat no-op (the reference picked keras/tf here;
+    # this framework is jax-only and accepts-and-ignores the flag)
+    "dl_framework",
+}
+
+
+def _literal(node) -> object:
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def registered_flags() -> Dict[str, List[Tuple[int, str]]]:
+    """{long flag: [(lineno, dest)]} from an AST walk of cli.py.
+    Raises SystemExit on a non-literal option string."""
+    with open(CLI_PATH) as f:
+        tree = ast.parse(f.read(), filename=CLI_PATH)
+    flags: Dict[str, List[Tuple[int, str]]] = {}
+    errors: List[str] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+                and node.args):
+            continue
+        options: List[str] = []
+        for arg in node.args:
+            value = _literal(arg)
+            if not isinstance(value, str):
+                errors.append(
+                    f"cli.py:{node.lineno}: non-literal option string "
+                    f"in add_argument(...) — flags must be string "
+                    f"literals for the doc check to see them")
+                options = []
+                break
+            if value.startswith("-"):
+                options.append(value)
+            else:
+                break  # positional argument: not a knob
+        longs = [o for o in options if o.startswith("--")]
+        if not longs:
+            continue
+        dest = None
+        for kw in node.keywords:
+            if kw.arg == "dest":
+                dest = _literal(kw.value)
+        if dest is None:
+            dest = longs[0].lstrip("-").replace("-", "_")
+        for flag in longs:
+            if not _FLAG_RE.match(flag):
+                errors.append(
+                    f"cli.py:{node.lineno}: flag {flag!r} does not "
+                    f"match the --lower_snake_case convention")
+                continue
+            flags.setdefault(flag, []).append((node.lineno, dest))
+    if errors:
+        raise SystemExit("\n".join(errors))
+    return flags
+
+
+def config_fields() -> Set[str]:
+    """Annotated field names of the Config dataclass, by AST (no
+    package import — the checker must run anywhere)."""
+    with open(CONFIG_PATH) as f:
+        tree = ast.parse(f.read(), filename=CONFIG_PATH)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {stmt.target.id for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)}
+    raise SystemExit("config.py: no `class Config` found")
+
+
+def documented_flags() -> Set[str]:
+    """Backticked flags inside the README's marked knobs table."""
+    with open(README) as f:
+        text = f.read()
+    try:
+        begin = text.index(BEGIN_MARKER) + len(BEGIN_MARKER)
+        end = text.index(END_MARKER, begin)
+    except ValueError:
+        raise SystemExit(
+            f"README.md is missing the {BEGIN_MARKER} / {END_MARKER} "
+            f"markers around the knob reference table "
+            f"(README 'CLI knob reference')")
+    return set(_TABLE_FLAG_RE.findall(text[begin:end]))
+
+
+def check() -> List[str]:
+    """Returns a list of problems (empty = consistent)."""
+    registered = registered_flags()
+    documented = documented_flags()
+    fields = config_fields()
+    problems: List[str] = []
+    for flag in sorted(set(registered) - documented):
+        lines = ", ".join(str(ln) for ln, _ in registered[flag])
+        problems.append(
+            f"UNDOCUMENTED: {flag} (cli.py:{lines}) is missing from "
+            f"the README knob reference table")
+    for flag in sorted(documented - set(registered)):
+        problems.append(
+            f"STALE DOC: {flag} appears in the README knob reference "
+            f"table but is not registered in cli.py")
+    for flag in sorted(registered):
+        for lineno, dest in registered[flag]:
+            if dest not in fields and dest not in _ARGS_ONLY:
+                problems.append(
+                    f"UNWIRED: {flag} (cli.py:{lineno}) has dest "
+                    f"{dest!r} which is neither a Config field nor in "
+                    f"check_knobs_doc._ARGS_ONLY — its value would "
+                    f"silently go nowhere")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} knob-documentation problem(s). "
+              f"Update the README 'CLI knob reference' table "
+              f"(between the knobs-table markers).")
+        return 1
+    print(f"OK: {len(registered_flags())} CLI flags all documented, "
+          f"wired to Config, no stale table entries.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

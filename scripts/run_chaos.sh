@@ -47,6 +47,11 @@ FLEET_BUDGET=600
 # per attempt) plus the end-to-end promotion/refusal/rollback drill on
 # a real 2-host fake-model fleet under client load.
 PIPELINE_BUDGET=600
+# Horizontally-scaled edge: the router-SIGKILL-under-4-client-load
+# zero-failure drill and the N-routers-live coordinated swap +
+# host-respawn (artifact, retrieval_index) reconciliation drill — each
+# a 2-router x 2-host fake-model fleet, so the budget covers hangs.
+EDGE_BUDGET=600
 
 rc=0
 
@@ -73,6 +78,7 @@ run_suite "$SERVING_BUDGET" tests/test_serving_chaos.py "$@"
 run_suite "$RETRIEVAL_BUDGET" tests/test_retrieval.py "$@"
 run_suite "$FLEET_BUDGET" tests/test_fleet.py "$@"
 run_suite "$PIPELINE_BUDGET" tests/test_pipeline.py "$@"
+run_suite "$EDGE_BUDGET" tests/test_edge.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

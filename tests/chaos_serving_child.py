@@ -106,6 +106,7 @@ def main() -> int:
     fingerprint = overrides.pop(
         "fingerprint", f"fake-replica-model-pid{os.getpid()}")
     fake_swap = overrides.pop("fake_swap", False)
+    fake_retrieval = overrides.pop("fake_retrieval", False)
     swap_fail_targets = set(overrides.pop("swap_fail_targets", ()))
 
     from code2vec_tpu.config import Config
@@ -124,8 +125,33 @@ def main() -> int:
                 new.topk = 5
             return new
 
+    # The (artifact, retrieval_index) reconciliation drills ride a
+    # retrieval_index through the reload path; the real
+    # _mount_retrieval_index would reject the fake index dirs, so a
+    # fake mounter builds the minimal handle surface SwapManager and
+    # /healthz touch (fingerprint/attached/detach/status/default_topk).
+    mount_index = None
+    if fake_retrieval:
+        class _FakeRetrievalHandle:
+            def __init__(self, index_dir):
+                name = os.path.basename(str(index_dir).rstrip("/"))
+                self.fingerprint = f"idx-{name}"
+                self.attached = True
+                self.default_topk = 3
+
+            def detach(self, reason=""):
+                self.attached = False
+
+            def status(self):
+                return {"attached": self.attached,
+                        "fingerprint": self.fingerprint}
+
+        def mount_index(index_dir, model=None):
+            return _FakeRetrievalHandle(index_dir)
+
     return serve_main(config, model=model,
-                      swap_build_model=build_model)
+                      swap_build_model=build_model,
+                      swap_mount_index=mount_index)
 
 
 if __name__ == "__main__":

@@ -497,8 +497,9 @@ class _SwapControl:
     def rollback_target(self, model):
         return self._rollback
 
-    def set_artifact(self, model, artifact):
+    def set_artifact(self, model, artifact, retrieval_index=None):
         self.committed_artifact = artifact
+        self.committed_retrieval_index = retrieval_index
 
 
 def _run_swap(driver, artifact, **kw):

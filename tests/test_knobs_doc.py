@@ -1,0 +1,84 @@
+"""Tier-1 gate for scripts/check_knobs_doc.py: every long CLI flag
+registered in code2vec_tpu/cli.py must appear in the README "CLI knob
+reference" table and vice versa, and every flag's dest must land in a
+Config field (or the checker's closed _ARGS_ONLY allowlist) — a new
+knob cannot ship undocumented or silently unwired, and the table
+cannot keep flags the CLI dropped."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO_ROOT, "scripts", "check_knobs_doc.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_knobs_doc",
+                                                  CHECKER)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_every_registered_flag_is_documented_wired_and_vice_versa():
+    checker = _load_checker()
+    problems = checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_extracts_a_plausible_flag_set():
+    """The AST walk must actually see the parser: spot-check flags
+    from different layers (training, serving, fleet, edge, pipeline)
+    so a silently-broken walk cannot turn the doc check vacuous."""
+    checker = _load_checker()
+    flags = set(checker.registered_flags())
+    assert len(flags) >= 100
+    for expected in ("--load", "--serve_port", "--fleet_hosts",
+                     "--fleet_routers", "--fleet_control",
+                     "--fleet_no_affinity", "--fleet_launcher",
+                     "--fleet_addresses", "--pipeline_dir",
+                     "--retrieval_topk"):
+        assert expected in flags, f"{expected} missing from the walk"
+    # and the Config-field side of the wiring check
+    fields = checker.config_fields()
+    assert {"serve_port", "fleet_routers", "fleet_cache_affinity",
+            "fleet_launcher"} <= fields
+
+
+def test_checker_flags_undocumented_stale_and_unwired(tmp_path,
+                                                      monkeypatch):
+    """The check fails in ALL THREE directions: an
+    unregistered-but-documented flag, a registered-but-undocumented
+    flag, and a flag whose dest lands nowhere."""
+    checker = _load_checker()
+    readme = tmp_path / "README.md"
+    rows = "\n".join(f"| `{f}` | x | x |"
+                     for f in sorted(checker.registered_flags())
+                     if f != "--serve_port")
+    readme.write_text(
+        "# x\n<!-- knobs-table:begin -->\n"
+        f"{rows}\n| `--made_up_flag` | x | x |\n"
+        "<!-- knobs-table:end -->\n")
+    monkeypatch.setattr(checker, "README", str(readme))
+    problems = checker.check()
+    assert any("UNDOCUMENTED: --serve_port" in p for p in problems)
+    assert any("STALE DOC: --made_up_flag" in p for p in problems)
+    # unwired: a parser whose flag's dest is not a Config field
+    cli = tmp_path / "cli.py"
+    cli.write_text('parser.add_argument("--ghost_knob", type=int)\n')
+    monkeypatch.setattr(checker, "CLI_PATH", str(cli))
+    problems = checker.check()
+    assert any("UNWIRED: --ghost_knob" in p and "ghost_knob" in p
+               for p in problems)
+
+
+def test_checker_rejects_non_literal_option_strings(tmp_path,
+                                                    monkeypatch):
+    import pytest
+
+    checker = _load_checker()
+    cli = tmp_path / "cli.py"
+    cli.write_text('name = "--dyn"\nparser.add_argument(name)\n')
+    monkeypatch.setattr(checker, "CLI_PATH", str(cli))
+    with pytest.raises(SystemExit, match="non-literal"):
+        checker.registered_flags()

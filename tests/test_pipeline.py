@@ -718,7 +718,8 @@ def test_fleet_swap_driver_keys_on_retrieval_index(tmp_path):
         def rollback_target(self, model):
             return "/artifacts/v1"
 
-        def set_artifact(self, model, artifact):
+        def set_artifact(self, model, artifact,
+                         retrieval_index=None):
             pass
 
     driver = FleetSwapDriver(_Control(), poll_interval_s=0.05)
