@@ -264,6 +264,57 @@ def arguments_parser() -> ArgumentParser:
                         help="comma list of addresses hosts are "
                              "placed on round-robin and reached at "
                              "(default: --serve_host for every host)")
+    parser.add_argument("--fleet_tsdb_retention",
+                        dest="fleet_tsdb_retention_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="telemetry-history window the control "
+                             "plane keeps (obs/tsdb.py segment ring "
+                             "under the run dir; default 3600)")
+    parser.add_argument("--fleet_tsdb_max_mb", type=float,
+                        default=None, metavar="MB",
+                        help="byte cap on the on-disk history ring "
+                             "(oldest segments evicted first; "
+                             "default 64)")
+    parser.add_argument("--fleet_slo_availability", type=float,
+                        default=None, metavar="RATIO",
+                        help="availability SLO target: fraction of "
+                             "non-5xx/non-shed requests (default "
+                             "0.999; 0 disables the objective)")
+    parser.add_argument("--fleet_slo_latency_ms", type=float,
+                        default=None, metavar="MS",
+                        help="latency SLO threshold: requests "
+                             "completing under this many ms count as "
+                             "good (default 500; 0 disables)")
+    parser.add_argument("--fleet_slo_latency_target", type=float,
+                        default=None, metavar="RATIO",
+                        help="latency SLO target: fraction of "
+                             "requests that must beat the threshold "
+                             "(default 0.95; 0 disables)")
+    parser.add_argument("--fleet_slo_period",
+                        dest="fleet_slo_period_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="error-budget period for "
+                             "slo_error_budget_remaining (default "
+                             "2592000 = 30 days)")
+    parser.add_argument("--fleet_slo_window_scale", type=float,
+                        default=None, metavar="FACTOR",
+                        help="uniform scale on every burn-rate "
+                             "window (default 1.0 = the standard SRE "
+                             "5m/1h + 30m/6h pairs; shrink for "
+                             "drills so a page fires in seconds)")
+    parser.add_argument("--fleet_trace_id", default=None,
+                        metavar="HEX32",
+                        help="`fleet trace` collector: stitch this "
+                             "trace id's spans from every process's "
+                             "trace files into one Chrome trace on "
+                             "stdout (use with --fleet_trace_dir or "
+                             "--fleet_control)")
+    parser.add_argument("--fleet_trace_dir", default=None,
+                        metavar="DIR",
+                        help="fleet run dir to walk for *.trace.json "
+                             "span files when stitching locally "
+                             "(default: ask the live control plane "
+                             "at --fleet_control via GET /trace)")
     parser.add_argument("--artifact", dest="serve_artifact", metavar="DIR",
                         help="serve/evaluate from a release artifact "
                              "(produced by the `export` subcommand) "
@@ -695,6 +746,15 @@ def config_from_args(argv=None) -> Config:
                                       "fleet_control",
                                       "fleet_launcher",
                                       "fleet_addresses",
+                                      "fleet_tsdb_retention_s",
+                                      "fleet_tsdb_max_mb",
+                                      "fleet_slo_availability",
+                                      "fleet_slo_latency_ms",
+                                      "fleet_slo_latency_target",
+                                      "fleet_slo_period_s",
+                                      "fleet_slo_window_scale",
+                                      "fleet_trace_id",
+                                      "fleet_trace_dir",
                                       "serve_artifact",
                                       "export_artifact_path",
                                       "release_scheme",
@@ -801,6 +861,14 @@ def main(argv=None) -> None:
     if config.pipeline:
         from code2vec_tpu.pipeline.supervisor import pipeline_main
         sys.exit(pipeline_main(config, argv=list(argv)))
+
+    # Trace collector: `fleet --fleet_trace_id ID` stitches every
+    # process's span files (or a live control plane's, via
+    # --fleet_control) into ONE Chrome trace on stdout — it launches
+    # nothing. Must dispatch before the router/fleet branches.
+    if config.fleet and config.fleet_trace_id:
+        from code2vec_tpu.obs.stitch import stitch_main
+        sys.exit(stitch_main(config))
 
     # Edge router agent: a `fleet` re-exec child marked by
     # C2V_FLEET_ROUTER never builds a model — it routes over a polled

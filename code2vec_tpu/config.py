@@ -394,6 +394,31 @@ class Config:
     # Comma list of addresses hosts are placed on (round-robin) and
     # reached at; empty = serve_host for every host.
     fleet_addresses: str = ""
+    # -- telemetry history + SLO engine (obs/tsdb.py + obs/slo.py;
+    # README "SLO & history") --
+    # Window of poll-tick history the control plane keeps (memory +
+    # on-disk segment ring under <run dir>/tsdb/), and the ring's
+    # byte cap (oldest segments evicted first).
+    fleet_tsdb_retention_s: float = 3600.0
+    fleet_tsdb_max_mb: float = 64.0
+    # Availability objective: target fraction of non-5xx/non-shed
+    # requests (0 disables the objective).
+    fleet_slo_availability: float = 0.999
+    # Latency objective: target fraction of requests completing under
+    # the threshold (either at 0 disables the objective).
+    fleet_slo_latency_ms: float = 500.0
+    fleet_slo_latency_target: float = 0.95
+    # Error-budget period the slo_error_budget_remaining gauge is
+    # computed over (default 30 days), and a uniform scale applied to
+    # EVERY burn window — production keeps 1.0; tests/benches shrink
+    # it so a page fires in seconds through the real window pairing.
+    fleet_slo_period_s: float = 2592000.0
+    fleet_slo_window_scale: float = 1.0
+    # `fleet trace` collector inputs: the trace id to stitch, and
+    # either a run dir to walk locally or --fleet_control to ask a
+    # live control plane via GET /trace?id=.
+    fleet_trace_id: str = ""
+    fleet_trace_dir: str = ""
     # Rows per streamed target-table block in the blockwise top-k
     # prediction head (ops/topk.py): the eval/predict steps fold the
     # ~246K-name classifier through a running top-k merge + logsumexp
@@ -692,12 +717,14 @@ class Config:
         # reference: config.py:232-239, plus mesh-shape checks.
         if (not self.is_training and not self.is_loading
                 and not self.serve_artifact and not self.index_out
-                and not (self.fleet and self.fleet_models)):
+                and not (self.fleet and self.fleet_models)
+                and not (self.fleet and self.fleet_trace_id)):
             raise ValueError(
                 "Must train or load a model (or serve a release "
                 "artifact via --artifact; `index-build` alone needs "
                 "no model; `fleet` may carry its models in "
-                "--fleet_models).")
+                "--fleet_models; `fleet --fleet_trace_id` only "
+                "stitches trace files).")
         if self.is_loading and not os.path.isdir(self.model_load_dir):
             raise ValueError(
                 f"Model load dir `{self.model_load_dir}` does not exist.")
@@ -867,6 +894,31 @@ class Config:
             raise ValueError(
                 "fleet_control must be HOST:PORT (it is set by the "
                 "control plane on router re-exec commands).")
+        if self.fleet_tsdb_retention_s <= 0:
+            raise ValueError(
+                "fleet_tsdb_retention must be > 0 (the history window "
+                "the SLO engine and /query read from).")
+        if self.fleet_tsdb_max_mb <= 0:
+            raise ValueError(
+                "fleet_tsdb_max_mb must be > 0 (the on-disk segment "
+                "ring's byte cap).")
+        if not (0 <= self.fleet_slo_availability < 1):
+            raise ValueError(
+                "fleet_slo_availability must be in [0, 1) "
+                "(0 disables the objective; 1 allows no errors ever "
+                "and pages forever).")
+        if not (0 <= self.fleet_slo_latency_target < 1):
+            raise ValueError(
+                "fleet_slo_latency_target must be in [0, 1) "
+                "(0 disables the objective).")
+        if self.fleet_slo_latency_ms < 0:
+            raise ValueError("fleet_slo_latency_ms must be >= 0.")
+        if self.fleet_slo_period_s <= 0:
+            raise ValueError("fleet_slo_period must be > 0.")
+        if self.fleet_slo_window_scale <= 0:
+            raise ValueError(
+                "fleet_slo_window_scale must be > 0 (1.0 = the "
+                "standard SRE windows; smaller = faster drills).")
         if self.fleet_launcher and "{address}" in self.fleet_launcher \
                 and not self.fleet_addresses:
             raise ValueError(

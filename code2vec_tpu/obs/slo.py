@@ -1,0 +1,290 @@
+"""SLO burn-rate engine: declarative objectives evaluated against the
+telemetry history store (obs/tsdb.py) every control-plane poll tick.
+
+Alerting is Google-SRE MULTI-WINDOW MULTI-BURN-RATE (SRE Workbook ch.5):
+a single-threshold alert either pages on every blip (short window) or
+pages an hour late (long window). Instead, each severity pairs a LONG
+window (is the burn sustained?) with a SHORT window (is it still
+happening right now?) and fires only when BOTH exceed the threshold:
+
+    severity   long    short   burn threshold   budget consumed
+    page       1h      5m      14.4             2% of 30d in 1h
+    ticket     6h      30m     6.0              5% of 30d in 6h
+
+`burn rate` = error_ratio(window) / (1 - target): burn 1.0 spends the
+error budget exactly at the rate the objective allows; 14.4 exhausts a
+30-day budget in ~2 days. The short window also makes alerts RESET
+fast once the cause is fixed — a long-window-only alert keeps paging
+for the rest of the window.
+
+Two objective kinds ship declaratively from config:
+
+- **availability**: bad = `serving_requests_total` with a 5xx status
+  or `draining` (the shedding path), over all requests. Target e.g.
+  0.999.
+- **latency**: good = requests completing under `threshold_ms`,
+  estimated by linear interpolation inside `serving_request_seconds`
+  {phase="total"} buckets (the histogram_quantile trick, inverted).
+  Target e.g. 0.95 of requests under threshold.
+
+A page-severity burn is an INCIDENT: the engine triggers an immediate
+flight-recorder dump (`slo_burn`, the `host_escalation` discipline) so
+the ring around the offending requests — trace ids included — is on
+disk before anyone asks. Unlike an escalation it does NOT stop the
+fleet: an SLO burn is the fleet's judgment that users are hurting, not
+that the control loop is unsafe.
+
+Everything the engine concludes is re-derivable by an operator from
+`GET /query` (the tsdb surface) — the engine holds no private state
+beyond alert latching, so a control-plane restart reproduces the same
+burn rates from the same on-disk history.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from code2vec_tpu.obs import metrics as _metrics
+
+# (severity, long window s, short window s, burn-rate threshold)
+BURN_WINDOWS = (
+    ("page", 3600.0, 300.0, 14.4),
+    ("ticket", 21600.0, 1800.0, 6.0),
+)
+
+_HANDLES: dict = {}
+
+
+def _g_budget(slo: str):
+    key = ("budget", slo)
+    if key not in _HANDLES:
+        _HANDLES[key] = _metrics.default_registry().gauge(
+            "slo_error_budget_remaining",
+            "fraction of the objective's error budget left over the "
+            "configured period (1.0 = untouched, <0 = blown)", slo=slo)
+    return _HANDLES[key]
+
+
+def _g_burn(slo: str, window: str):
+    key = ("burn", slo, window)
+    if key not in _HANDLES:
+        _HANDLES[key] = _metrics.default_registry().gauge(
+            "slo_burn_rate",
+            "error-budget burn rate per evaluation window (1.0 = "
+            "spending exactly the budgeted rate)", slo=slo,
+            window=window)
+    return _HANDLES[key]
+
+
+def _c_alerts(slo: str, severity: str):
+    key = ("alerts", slo, severity)
+    if key not in _HANDLES:
+        _HANDLES[key] = _metrics.default_registry().counter(
+            "slo_alerts_total",
+            "multi-window burn-rate alerts fired (counted on the "
+            "inactive->firing transition, not per tick)", slo=slo,
+            severity=severity)
+    return _HANDLES[key]
+
+
+def count_below(buckets: Dict[str, float],
+                threshold_s: float) -> float:
+    """Estimated number of observations <= threshold from cumulative
+    {le: count} buckets — histogram_quantile's interpolation, run in
+    the other direction. Conservative at the edges: a threshold past
+    the largest finite bound credits only the finite mass (the +Inf
+    remainder has UNKNOWN latency and must not count as good)."""
+    pairs = []
+    for le, count in buckets.items():
+        bound = math.inf if le == "+Inf" else float(le)
+        pairs.append((bound, max(0.0, count)))
+    if not pairs:
+        return 0.0
+    pairs.sort()
+    running = 0.0
+    for i, (bound, cum) in enumerate(pairs):
+        running = max(running, cum)
+        pairs[i] = (bound, running)
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in pairs:
+        if threshold_s <= bound:
+            if math.isinf(bound):
+                return prev_cum  # the +Inf mass is not provably good
+            span = bound - prev_bound
+            if span <= 0:
+                return cum
+            frac = (threshold_s - prev_bound) / span
+            return prev_cum + (cum - prev_cum) * max(0.0,
+                                                     min(1.0, frac))
+        prev_bound, prev_cum = bound, cum
+    return prev_cum  # threshold beyond every finite bound
+
+
+class SloObjective:
+    """One declarative objective. `kind` is "availability" or
+    "latency"; `target` is the good-fraction objective (0.999 =
+    99.9%); latency adds `threshold_ms`. Disabled objectives
+    (target <= 0) are simply not constructed."""
+
+    __slots__ = ("name", "kind", "target", "threshold_ms")
+
+    def __init__(self, name: str, kind: str, target: float,
+                 threshold_ms: float = 0.0):
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"slo {name!r}: target must be in (0, 1), got "
+                f"{target}")
+        self.name = name
+        self.kind = kind
+        self.target = float(target)
+        self.threshold_ms = float(threshold_ms)
+
+    def error_ratio(self, tsdb, window_s: float,
+                    now: Optional[float] = None) -> float:
+        """Fraction of events in the window that violated the
+        objective; 0.0 on an empty window (no traffic burns no
+        budget)."""
+        if self.kind == "availability":
+            by_status = tsdb.increase_by(
+                "serving_requests_total", "status", window_s, now=now)
+            total = sum(by_status.values())
+            if total <= 0:
+                return 0.0
+            bad = sum(v for status, v in by_status.items()
+                      if status.startswith("5") or status == "draining")
+            return max(0.0, min(1.0, bad / total))
+        if self.kind == "latency":
+            buckets = tsdb.window_buckets(
+                "serving_request_seconds", window_s, now=now,
+                phase="total")
+            if not buckets:
+                return 0.0
+            inf_key = "+Inf"
+            total = max(buckets.get(inf_key, 0.0),
+                        max(buckets.values()))
+            if total <= 0:
+                return 0.0
+            good = count_below(buckets, self.threshold_ms / 1000.0)
+            return max(0.0, min(1.0, 1.0 - good / total))
+        raise ValueError(f"unknown slo kind {self.kind!r}")
+
+
+def objectives_from_config(config) -> List[SloObjective]:
+    """The declarative objective set, straight from the fleet_slo_*
+    knobs. A target of 0 disables that objective."""
+    objectives: List[SloObjective] = []
+    availability = float(getattr(config, "fleet_slo_availability",
+                                 0.0) or 0.0)
+    if availability > 0:
+        objectives.append(SloObjective(
+            name="availability", kind="availability",
+            target=availability))
+    latency_target = float(getattr(config, "fleet_slo_latency_target",
+                                   0.0) or 0.0)
+    latency_ms = float(getattr(config, "fleet_slo_latency_ms",
+                               0.0) or 0.0)
+    if latency_target > 0 and latency_ms > 0:
+        objectives.append(SloObjective(
+            name="latency", kind="latency", target=latency_target,
+            threshold_ms=latency_ms))
+    return objectives
+
+
+class SloEngine:
+    """Evaluates every objective against the tsdb each poll tick,
+    latches multi-window alerts, and exports the slo_* metric
+    families. `window_scale` shrinks every burn window by the same
+    factor — production keeps 1.0; tests and the bench drill use
+    small scales so a page fires in seconds, exercising the REAL
+    window pairing instead of a mocked clock."""
+
+    def __init__(self, objectives: List[SloObjective],
+                 period_s: float = 30 * 86400.0,
+                 window_scale: float = 1.0, flight=None, log=None):
+        self.objectives = list(objectives)
+        self.period_s = float(period_s)
+        self.window_scale = max(1e-6, float(window_scale))
+        self.flight = flight
+        self._log = log or (lambda msg: None)
+        # (slo name, severity) -> firing?  — alert latching so
+        # slo_alerts_total counts transitions, not ticks
+        self._firing: Dict[tuple, bool] = {}
+        self._last: List[dict] = []
+        for obj in self.objectives:  # eager metric registration
+            _g_budget(obj.name)
+            for severity, _, _, _ in BURN_WINDOWS:
+                _g_burn(obj.name, f"{severity}_long")
+                _g_burn(obj.name, f"{severity}_short")
+                _c_alerts(obj.name, severity)
+
+    def evaluate(self, tsdb, now: Optional[float] = None) -> List[dict]:
+        """One tick: returns the per-objective status list (also kept
+        for `status()`)."""
+        results: List[dict] = []
+        for obj in self.objectives:
+            budget_allowed = 1.0 - obj.target
+            er_period = obj.error_ratio(
+                tsdb, self.period_s * self.window_scale, now=now)
+            budget_remaining = 1.0 - er_period / budget_allowed
+            _g_budget(obj.name).set(round(budget_remaining, 6))
+            alerts = []
+            for severity, long_w, short_w, threshold in BURN_WINDOWS:
+                er_long = obj.error_ratio(
+                    tsdb, long_w * self.window_scale, now=now)
+                er_short = obj.error_ratio(
+                    tsdb, short_w * self.window_scale, now=now)
+                burn_long = er_long / budget_allowed
+                burn_short = er_short / budget_allowed
+                _g_burn(obj.name,
+                        f"{severity}_long").set(round(burn_long, 6))
+                _g_burn(obj.name,
+                        f"{severity}_short").set(round(burn_short, 6))
+                firing = (burn_long >= threshold
+                          and burn_short >= threshold)
+                key = (obj.name, severity)
+                was = self._firing.get(key, False)
+                self._firing[key] = firing
+                if firing and not was:
+                    _c_alerts(obj.name, severity).inc()
+                    self._log(
+                        f"slo: {obj.name} {severity} burn alert: "
+                        f"long={burn_long:.1f}x short="
+                        f"{burn_short:.1f}x threshold={threshold}x")
+                    if severity == "page" and self.flight is not None:
+                        # the host_escalation discipline: dump the
+                        # ring NOW, while the offending requests'
+                        # trace ids are still in it — but do NOT stop
+                        # the fleet; a burn means users hurt, not that
+                        # the control loop is unsafe
+                        self.flight.incident(
+                            "slo_burn", immediate=True, slo=obj.name,
+                            severity=severity,
+                            burn_long=round(burn_long, 3),
+                            burn_short=round(burn_short, 3),
+                            threshold=threshold)
+                alerts.append({
+                    "severity": severity,
+                    "window_long_s": long_w * self.window_scale,
+                    "window_short_s": short_w * self.window_scale,
+                    "threshold": threshold,
+                    "burn_long": round(burn_long, 6),
+                    "burn_short": round(burn_short, 6),
+                    "firing": firing,
+                })
+            results.append({
+                "slo": obj.name,
+                "kind": obj.kind,
+                "target": obj.target,
+                "threshold_ms": obj.threshold_ms or None,
+                "error_budget_remaining": round(budget_remaining, 6),
+                "alerts": alerts,
+            })
+        self._last = results
+        return results
+
+    def status(self) -> dict:
+        """The GET /slo payload: last evaluation, verbatim."""
+        return {"period_s": self.period_s * self.window_scale,
+                "window_scale": self.window_scale,
+                "objectives": self._last}

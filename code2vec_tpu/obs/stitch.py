@@ -1,0 +1,183 @@
+"""Cross-process trace stitching: one request (or rollout), one tree.
+
+Every process in a fleet exports its span ring as a Chrome trace file
+into the fleet run dir — replicas (`replica<i>.trace.json`, via
+--trace_export), host supervisors (`supervisor.trace.json`), routers
+(`router*.trace.json`), the control plane (`control.trace.json`), the
+pipeline supervisor (`pipeline.trace.json`). Each file is internally
+consistent but times spans on its OWN perf_counter epoch and labels
+them with its OWN pid — loading two of them together in Perfetto
+produces overlapping nonsense.
+
+This module walks a run dir for `*.trace.json`, keeps the span events
+carrying the requested `trace_id` (obs/reqtrace.py ids, propagated
+across process boundaries via `traceparent`), and rebases every kept
+event onto ONE wall-clock axis using the `trace_epoch_unix_s` each
+tracer records in `otherData` — so a router's forward span visibly
+CONTAINS the replica's handler span, which contains the batch span,
+across three processes. Source files get synthetic pids (Chrome trace
+pids are display lanes, not OS pids) named after their producing
+process, and torn/foreign files are skipped, not fatal — a stitcher
+that 500s on one half-written export is useless exactly when traces
+matter.
+
+Served live as `GET /trace?id=<32hex>` on the control plane (relayed
+by the edge routers) and offline as `fleet --fleet_trace_id ID
+--fleet_trace_dir RUNDIR`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+TRACE_FILE_SUFFIX = ".trace.json"
+
+
+def _load_trace_file(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if not isinstance(payload, dict) or not isinstance(
+                payload.get("traceEvents"), list):
+            return None
+        return payload
+    except (OSError, ValueError):
+        return None
+
+
+def trace_files(root: str) -> List[str]:
+    """Every *.trace.json under `root`, recursively, sorted for
+    deterministic pid assignment."""
+    out: List[str] = []
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            if name.endswith(TRACE_FILE_SUFFIX):
+                out.append(os.path.join(dirpath, name))
+    out.sort()
+    return out
+
+
+def stitch(paths: List[str], trace_id: str,
+           root: Optional[str] = None) -> dict:
+    """One Chrome trace holding every span of `trace_id` across all
+    `paths`, timestamps rebased to wall-clock microseconds. Returns a
+    parsed trace object (json.dump-ready); `otherData` carries the
+    source files and the kept span count so "this trace looks thin" is
+    checkable against "which processes contributed"."""
+    events: List[dict] = []
+    sources: List[dict] = []
+    for pid, path in enumerate(paths, start=1):
+        payload = _load_trace_file(path)
+        label = (os.path.relpath(path, root) if root else path)
+        if payload is None:
+            sources.append({"file": label, "spans": 0,
+                            "error": "unreadable or torn"})
+            continue
+        other = payload.get("otherData") or {}
+        try:
+            epoch_us = float(other.get("trace_epoch_unix_s", 0.0)) * 1e6
+        except (TypeError, ValueError):
+            epoch_us = 0.0
+        producer = ""
+        thread_names = {}
+        for ev in payload["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            if (ev.get("ph") == "M"
+                    and ev.get("name") == "thread_name"):
+                thread_names[ev.get("tid")] = (
+                    (ev.get("args") or {}).get("name"))
+            if (ev.get("ph") == "M"
+                    and ev.get("name") == "process_name"):
+                producer = (ev.get("args") or {}).get("name") or ""
+        kept = 0
+        kept_tids = set()
+        for ev in payload["traceEvents"]:
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            # member_trace_ids: the batcher's coalesced device-batch
+            # span is recorded ONCE, tagged with every member request's
+            # id — it belongs to each member's stitched trace
+            if args.get("trace_id") != trace_id and trace_id not in (
+                    args.get("member_trace_ids") or ()):
+                continue
+            try:
+                ts = float(ev.get("ts", 0.0)) + epoch_us
+                dur = float(ev.get("dur", 0.0))
+            except (TypeError, ValueError):
+                continue
+            events.append({"name": ev.get("name", ""), "ph": "X",
+                           "cat": "fleet", "ts": ts, "dur": dur,
+                           "pid": pid, "tid": ev.get("tid", 0),
+                           "args": args})
+            kept += 1
+            kept_tids.add(ev.get("tid", 0))
+        if kept:
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"{label}"
+                                 f"{' · ' + producer if producer else ''}"}})
+            for tid in kept_tids:
+                tname = thread_names.get(tid)
+                if tname:
+                    events.append({"name": "thread_name", "ph": "M",
+                                   "pid": pid, "tid": tid,
+                                   "args": {"name": tname}})
+        sources.append({"file": label, "spans": kept})
+    span_count = sum(s["spans"] for s in sources)
+    events.sort(key=lambda ev: (ev.get("ph") != "M",
+                                ev.get("ts", 0.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": trace_id,
+            "spans": span_count,
+            "sources": sources,
+            "producer": "code2vec_tpu.obs.stitch",
+        },
+    }
+
+
+def stitch_dir(root: str, trace_id: str) -> dict:
+    """Walk `root` for trace files and stitch `trace_id` out of them —
+    the GET /trace?id= body and the local collector's core."""
+    return stitch(trace_files(root), trace_id, root=root)
+
+
+def stitch_main(config) -> int:
+    """`fleet --fleet_trace_id ID` body: stitch locally from
+    --fleet_trace_dir, or ask a live control plane / router at
+    --fleet_control via GET /trace?id=. The stitched trace goes to
+    stdout (redirect into a .json and open in Perfetto)."""
+    import sys
+
+    trace_id = config.fleet_trace_id.strip()
+    if config.fleet_trace_dir:
+        result = stitch_dir(config.fleet_trace_dir, trace_id)
+    elif config.fleet_control:
+        import urllib.request
+        url = (f"http://{config.fleet_control}/trace?"
+               f"id={trace_id}")
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                result = json.loads(resp.read().decode())
+        except (OSError, ValueError) as e:
+            print(f"fleet trace: GET {url} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+    else:
+        print("fleet trace: need --fleet_trace_dir RUNDIR (offline) "
+              "or --fleet_control HOST:PORT (live)", file=sys.stderr)
+        return 2
+    json.dump(result, sys.stdout, sort_keys=True)
+    sys.stdout.write("\n")
+    spans = (result.get("otherData") or {}).get("spans", 0)
+    if not spans:
+        print(f"fleet trace: no spans found for trace id "
+              f"{trace_id!r}", file=sys.stderr)
+        return 1
+    return 0

@@ -90,6 +90,12 @@ class SpanTracer:
         self.enabled = False
 
     def enable(self) -> None:
+        # eager metric registration: a replica that never fills its ring
+        # still exports obs_spans_dropped_total=0 / high_water, so the
+        # merged scrape (and the SLO/tsdb layer above it) sees the
+        # series exist instead of inferring health from absence
+        _c_dropped()
+        _g_high_water()
         self.enabled = True
 
     def disable(self) -> None:
@@ -125,9 +131,15 @@ class SpanTracer:
                span_id: Optional[str] = None,
                parent_id: Optional[str] = None,
                attrs: Optional[dict] = None) -> None:
-        item = (name, start_s, dur_s, threading.get_ident(),
+        # a list, not a tuple: the last slot memoizes this span's
+        # serialized Chrome-trace event. Spans are immutable once
+        # recorded (attrs are captured "at close" by every call site),
+        # so periodic exporters — the serve heartbeat, the control
+        # poll tick — pay json encoding only for spans NEW since the
+        # previous export instead of re-encoding the whole ring
+        item = [name, start_s, dur_s, threading.get_ident(),
                 threading.current_thread().name,
-                trace_id, span_id, parent_id, attrs)
+                trace_id, span_id, parent_id, attrs, None]
         with self._lock:
             if len(self._buf) == self.capacity:
                 self._dropped += 1
@@ -160,25 +172,32 @@ class SpanTracer:
         pid = os.getpid()
         parts = []
         seen_tids = {}
-        for (name, start_s, dur_s, tid, tname,
-             trace_id, span_id, parent_id, attrs) in spans:
+        for item in spans:
+            (name, start_s, dur_s, tid, tname,
+             trace_id, span_id, parent_id, attrs, cached) = item
             if tid not in seen_tids:
                 seen_tids[tid] = tname
-            args = ""
-            if trace_id or span_id or parent_id or attrs:
-                payload = dict(attrs or {})
-                if trace_id:
-                    payload["trace_id"] = trace_id
-                if span_id:
-                    payload["span_id"] = span_id
-                if parent_id:
-                    payload["parent_id"] = parent_id
-                args = ',"args":%s' % json.dumps(payload, sort_keys=True)
-            parts.append(
-                '{"name":%s,"ph":"X","cat":"host","ts":%.3f,"dur":%.3f,'
-                '"pid":%d,"tid":%d%s}'
-                % (json.dumps(name), (start_s - self._epoch) * 1e6,
-                   dur_s * 1e6, pid, tid, args))
+            if cached is None:
+                args = ""
+                if trace_id or span_id or parent_id or attrs:
+                    payload = dict(attrs or {})
+                    if trace_id:
+                        payload["trace_id"] = trace_id
+                    if span_id:
+                        payload["span_id"] = span_id
+                    if parent_id:
+                        payload["parent_id"] = parent_id
+                    args = ',"args":%s' % json.dumps(payload,
+                                                     sort_keys=True)
+                cached = (
+                    '{"name":%s,"ph":"X","cat":"host","ts":%.3f,'
+                    '"dur":%.3f,"pid":%d,"tid":%d%s}'
+                    % (json.dumps(name), (start_s - self._epoch) * 1e6,
+                       dur_s * 1e6, pid, tid, args))
+                # idempotent fill outside any lock: every racer
+                # computes the identical string for an immutable span
+                item[9] = cached
+            parts.append(cached)
         for tid, tname in seen_tids.items():
             parts.append(
                 '{"name":"thread_name","ph":"M","pid":%d,"tid":%d,'

@@ -1,0 +1,584 @@
+"""Embedded windowed time-series store for fleet telemetry history.
+
+THE PROBLEM: every fleet signal so far is an instantaneous snapshot —
+the control plane's merged /metrics, the /fleet JSON, the replica
+heartbeats. The autoscaler keeps its own hand-rolled last-tick deltas
+per host, the flight recorder keeps its own ring, and any question of
+the form "what was the shed rate ten minutes ago" (or "did this burn
+start before or after the rollout") is unanswerable. An external
+Prometheus would answer it, but this stack is dependency-free by
+charter, and the control plane already holds every sample anyway —
+it scrapes all hosts each poll tick.
+
+THE FIX: the control plane appends each poll tick's PRE-merge snapshot
+set (one parsed family dict per source: `host:<id>` + `control`) to
+this store. Samples stay RAW — counters keep their monotonic lifetime
+values; reset detection happens at QUERY time via the one shared
+policy (`telemetry.counter_delta`), so a replica restart mid-window
+reads as the post-restart growth, never a negative rate. Queries
+(`increase` / `rate` / `quantile` over a window or a tick count) are
+what the autoscaler and the SLO engine (obs/slo.py) steer on.
+
+Durability is a crash-safe on-disk SEGMENT RING under `<dir>/`:
+ticks accumulate into the head segment `seg-<seq>.json`, rewritten
+atomically (tmp + os.replace, the obs/exporters discipline) on every
+append until it holds `ticks_per_segment` ticks, then sealed; a new
+head starts at the next sequence number. A kill at ANY boundary leaves
+either the previous head or the new one — never a half-written file
+the loader would trust. A segment that fails to parse on load (torn by
+an unclean filesystem, truncated, foreign) is REFUSED AND SKIPPED with
+a `tsdb_torn_segments_total` increment — one bad file costs its ticks,
+not the store. The ring is bounded two ways: ticks older than
+`retention_s` age out, and total bytes are capped at `max_mb`
+(oldest-first eviction, `tsdb_segments_pruned_total{reason}`).
+
+Query `now` defaults to the LAST TICK's timestamp, not the wall clock:
+a window query replayed after a control-plane restart (or in a test
+against a scripted stream) selects the same ticks and returns the same
+number — history that cannot be reproduced is not history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu.obs import metrics as _metrics
+from code2vec_tpu.serving import telemetry
+
+FORMAT = "c2v-tsdb-v1"
+_SEG_PREFIX = "seg-"
+_SEG_SUFFIX = ".json"
+
+# Lazy metric handles (the tracer.py discipline): importing the module
+# registers nothing; the first store constructed registers everything
+# eagerly so an idle store still exports zero-valued series.
+_HANDLES: dict = {}
+
+
+def _c_ticks():
+    if "ticks" not in _HANDLES:
+        _HANDLES["ticks"] = _metrics.default_registry().counter(
+            "tsdb_ticks_total",
+            "poll-tick sample sets appended to the telemetry history "
+            "store")
+    return _HANDLES["ticks"]
+
+
+def _c_torn():
+    if "torn" not in _HANDLES:
+        _HANDLES["torn"] = _metrics.default_registry().counter(
+            "tsdb_torn_segments_total",
+            "on-disk history segments refused at load (unparsable or "
+            "wrong format) — their ticks are lost, the store is not")
+    return _HANDLES["torn"]
+
+
+def _c_pruned(reason: str):
+    key = ("pruned", reason)
+    if key not in _HANDLES:
+        _HANDLES[key] = _metrics.default_registry().counter(
+            "tsdb_segments_pruned_total",
+            "history segments deleted by the ring bound that evicted "
+            "them (reason: retention | size)", reason=reason)
+    return _HANDLES[key]
+
+
+def _g_disk():
+    if "disk" not in _HANDLES:
+        _HANDLES["disk"] = _metrics.default_registry().gauge(
+            "tsdb_disk_bytes",
+            "bytes currently held by on-disk history segments")
+    return _HANDLES["disk"]
+
+
+def _h_append():
+    if "append" not in _HANDLES:
+        _HANDLES["append"] = _metrics.default_registry().histogram(
+            "tsdb_append_seconds",
+            "wall time per history append (parse + persist + prune) — "
+            "poll-tick overhead budget for the control plane")
+    return _HANDLES["append"]
+
+
+def _labels_to_json(key: telemetry.LabelsKey) -> List[List[str]]:
+    return [[k, v] for k, v in key]
+
+
+def _labels_from_json(raw) -> telemetry.LabelsKey:
+    return tuple((str(k), str(v)) for k, v in raw)
+
+
+def _families_to_json(families: Dict[str, telemetry.Family]) -> dict:
+    out = {}
+    for name, fam in families.items():
+        out[name] = {
+            "kind": fam.kind,
+            "samples": {
+                sub: [[_labels_to_json(labels), value]
+                      for labels, value in by_labels.items()]
+                for sub, by_labels in fam.samples.items()
+            },
+        }
+    return out
+
+
+def _families_from_json(raw: dict) -> Dict[str, telemetry.Family]:
+    families: Dict[str, telemetry.Family] = {}
+    for name, body in raw.items():
+        fam = telemetry.Family(str(name), str(body.get("kind",
+                                                       "untyped")))
+        for sub, pairs in body.get("samples", {}).items():
+            dest = fam.samples.setdefault(str(sub), {})
+            for labels_raw, value in pairs:
+                dest[_labels_from_json(labels_raw)] = float(value)
+        families[fam.name] = fam
+    return families
+
+
+class TsdbStore:
+    """Append-only windowed store of per-source parsed metric families,
+    persisted as a crash-safe segment ring. Thread-safe: the control
+    plane appends from its poll loop while router relays query
+    concurrently."""
+
+    def __init__(self, dir: str, retention_s: float = 3600.0,
+                 max_mb: float = 64.0, ticks_per_segment: int = 32,
+                 clock=time.time, log=None):
+        self.dir = dir
+        self.retention_s = float(retention_s)
+        self.max_bytes = float(max_mb) * 1024 * 1024
+        self.ticks_per_segment = max(1, int(ticks_per_segment))
+        self._clock = clock
+        self._log = log or (lambda msg: None)
+        self._lock = threading.Lock()
+        # (ts, {source: {family name: Family}}) oldest first
+        self._ticks: List[Tuple[float, Dict[str, Dict[
+            str, telemetry.Family]]]] = []
+        self._head_seq = 1
+        # head ticks as PRE-SERIALIZED JSON strings: _write_head runs
+        # on every poll tick and must not re-serialize the whole head
+        # segment each time — only the new tick pays json.dumps
+        self._head_parts: List[str] = []
+        # newest tick ts per sealed segment, so retention pruning
+        # never has to re-read segment files on the append path
+        self._seg_newest: Dict[int, float] = {}
+        self._head_newest = 0.0
+        self.torn_segments = 0
+        # eager metric registration — see module docstring
+        _c_ticks(), _c_torn(), _g_disk(), _h_append()
+        _c_pruned("retention"), _c_pruned("size")
+        os.makedirs(self.dir, exist_ok=True)
+        self._load()
+
+    # ---------------------------------------------------------- disk
+
+    def _segment_files(self) -> List[Tuple[int, str]]:
+        """[(seq, path)] sorted by seq; tmp files and foreign names are
+        not segments."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for name in names:
+            if not (name.startswith(_SEG_PREFIX)
+                    and name.endswith(_SEG_SUFFIX)):
+                continue
+            seq_raw = name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)]
+            if not seq_raw.isdigit():
+                continue
+            out.append((int(seq_raw), os.path.join(self.dir, name)))
+        out.sort()
+        return out
+
+    def _seg_path(self, seq: int) -> str:
+        return os.path.join(self.dir,
+                            f"{_SEG_PREFIX}{seq:08d}{_SEG_SUFFIX}")
+
+    def _load(self) -> None:
+        """Replay the ring into memory. Torn segments are skipped with
+        a counter — a 500 on the first query after an unclean restart
+        would punish exactly the moment history matters most."""
+        ticks: List[Tuple[float, dict]] = []
+        max_seq = 0
+        last_payload: List[dict] = []
+        for seq, path in self._segment_files():
+            max_seq = max(max_seq, seq)
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+                if (not isinstance(payload, dict)
+                        or payload.get("format") != FORMAT
+                        or not isinstance(payload.get("ticks"), list)):
+                    raise ValueError("bad segment schema")
+                seg_ticks = []
+                for tick in payload["ticks"]:
+                    seg_ticks.append((
+                        float(tick["ts"]),
+                        {str(src): _families_from_json(fams)
+                         for src, fams in tick["sources"].items()}))
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                self.torn_segments += 1
+                _c_torn().inc()
+                self._seg_newest[seq] = 0.0  # prune-eligible now
+                self._log(f"tsdb: skipping torn segment {path}: "
+                          f"{type(e).__name__}: {e}")
+                continue
+            ticks.extend(seg_ticks)
+            self._seg_newest[seq] = max(
+                (ts for ts, _ in seg_ticks), default=0.0)
+            last_payload = list(payload["ticks"])
+        ticks.sort(key=lambda t: t[0])
+        self._ticks = ticks
+        # resume the head: keep appending into the highest segment if
+        # it has room, else seal it by starting the next sequence
+        if max_seq and len(last_payload) < self.ticks_per_segment:
+            self._head_seq = max_seq
+            self._head_parts = [json.dumps(t) for t in last_payload]
+            self._head_newest = self._seg_newest.pop(max_seq, 0.0)
+        else:
+            self._head_seq = max_seq + 1
+            self._head_parts = []
+            self._head_newest = 0.0
+        # stale tmp files from a kill mid-write are dead weight
+        try:
+            for name in os.listdir(self.dir):
+                if ".tmp-" in name:
+                    os.unlink(os.path.join(self.dir, name))
+        except OSError:
+            pass
+        _g_disk().set(self._disk_bytes())
+
+    def _disk_bytes(self) -> int:
+        total = 0
+        for _, path in self._segment_files():
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        return total
+
+    def _write_head(self) -> None:
+        path = self._seg_path(self._head_seq)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        body = ('{"format": ' + json.dumps(FORMAT) + ', "ticks": ['
+                + ",".join(self._head_parts) + "]}")
+        with open(tmp, "w") as f:
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.retention_s
+        self._ticks = [t for t in self._ticks if t[0] >= cutoff]
+        files = self._segment_files()
+        # retention: drop sealed segments whose NEWEST tick is stale
+        # (the head is never deleted out from under the writer)
+        for seq, path in list(files):
+            if seq == self._head_seq:
+                continue
+            newest = self._seg_newest.get(seq)
+            if newest is None:
+                # a segment this store never wrote or loaded (another
+                # writer's leftovers): read it once and cache
+                try:
+                    with open(path) as f:
+                        payload = json.load(f)
+                    newest = max((float(t["ts"])
+                                  for t in payload.get("ticks", [])),
+                                 default=0.0)
+                except (OSError, ValueError, KeyError, TypeError):
+                    newest = 0.0  # torn: prune-eligible immediately
+                self._seg_newest[seq] = newest
+            if newest < cutoff:
+                try:
+                    os.unlink(path)
+                    _c_pruned("retention").inc()
+                    files.remove((seq, path))
+                    self._seg_newest.pop(seq, None)
+                except OSError:
+                    pass
+        # size: evict oldest-first until under the byte cap
+        total = 0
+        sizes = []
+        for seq, path in files:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            sizes.append((seq, path, size))
+            total += size
+        for seq, path, size in sizes:
+            if total <= self.max_bytes or seq == self._head_seq:
+                continue
+            try:
+                os.unlink(path)
+                _c_pruned("size").inc()
+                total -= size
+                self._seg_newest.pop(seq, None)
+            except OSError:
+                pass
+        _g_disk().set(total)
+
+    # -------------------------------------------------------- append
+
+    def append(self, snapshots: Dict[str, object],
+               now: Optional[float] = None) -> None:
+        """Append one poll tick: `snapshots` maps source id to
+        exposition TEXT or already-parsed families (the exact dict the
+        control plane feeds merge_prometheus_snapshots — per-source,
+        PRE-merge, because the merged text sums counters fleet-wide
+        and loses the per-host identity the autoscaler queries by)."""
+        t0 = time.perf_counter()
+        if now is None:
+            now = self._clock()
+        parsed: Dict[str, Dict[str, telemetry.Family]] = {}
+        for source, snap in snapshots.items():
+            parsed[str(source)] = (
+                snap if isinstance(snap, dict)
+                else telemetry.parse_prometheus_text(str(snap)))
+        with self._lock:
+            self._ticks.append((float(now), parsed))
+            self._head_parts.append(json.dumps({
+                "ts": float(now),
+                "sources": {src: _families_to_json(fams)
+                            for src, fams in parsed.items()}}))
+            self._write_head()
+            self._head_newest = max(self._head_newest, float(now))
+            if len(self._head_parts) >= self.ticks_per_segment:
+                # seal: next append starts a fresh segment
+                self._seg_newest[self._head_seq] = self._head_newest
+                self._head_seq += 1
+                self._head_parts = []
+                self._head_newest = 0.0
+            self._prune(float(now))
+        _c_ticks().inc()
+        _h_append().observe(time.perf_counter() - t0)
+
+    # ------------------------------------------------------- queries
+
+    def _window(self, window_s: Optional[float] = None,
+                ticks: Optional[int] = None,
+                now: Optional[float] = None) -> List[Tuple[
+                    float, Dict[str, Dict[str, telemetry.Family]]]]:
+        with self._lock:
+            all_ticks = list(self._ticks)
+        if not all_ticks:
+            return []
+        if ticks is not None:
+            return all_ticks[-max(0, int(ticks)):]
+        if now is None:
+            now = all_ticks[-1][0]  # replayable — see module docstring
+        cutoff = now - float(window_s or 0.0)
+        return [t for t in all_ticks if cutoff <= t[0] <= now]
+
+    @staticmethod
+    def _tick_value(families: Dict[str, telemetry.Family], name: str,
+                    subname: str, label_filter: dict,
+                    group_by: Optional[str] = None):
+        """Sum of one source's samples matching `label_filter` at one
+        tick — grouped by one label's value when `group_by` is set (the
+        SLO engine's by-status split). Returns None when the family is
+        absent (source not yet scraped ≠ counter at zero)."""
+        fam = families.get(name)
+        if fam is None:
+            return None
+        by_labels = fam.samples.get(subname)
+        if not by_labels:
+            return None
+        grouped: Dict[str, float] = {}
+        found = False
+        for labels, value in by_labels.items():
+            d = dict(labels)
+            if not all(d.get(k) == str(v)
+                       for k, v in label_filter.items()):
+                continue
+            found = True
+            key = d.get(group_by, "") if group_by else ""
+            grouped[key] = grouped.get(key, 0.0) + value
+        if not found:
+            return None
+        return grouped
+
+    def _series(self, name: str, subname: str,
+                window: List[Tuple[float, dict]],
+                source: Optional[str], label_filter: dict,
+                group_by: Optional[str] = None
+                ) -> Dict[Tuple[str, str], List[float]]:
+        """{(source, group key): [values oldest-first]} — one series
+        per source so reset detection happens where resets happen
+        (a host restart resets THAT host's counters, not the fleet's)."""
+        series: Dict[Tuple[str, str], List[float]] = {}
+        for _, sources in window:
+            for src, families in sources.items():
+                if source is not None and src != source:
+                    continue
+                grouped = self._tick_value(families, name, subname,
+                                           label_filter, group_by)
+                if grouped is None:
+                    continue
+                for key, value in grouped.items():
+                    series.setdefault((src, key), []).append(value)
+        return series
+
+    def series_len(self, name: str, window_s: Optional[float] = None,
+                   ticks: Optional[int] = None,
+                   now: Optional[float] = None,
+                   source: Optional[str] = None, **labels) -> int:
+        """Longest matching series in the window, in POINTS — "do I
+        have a window yet" for consumers that must not read an
+        absent-data tick as zero (the autoscaler's boot tick)."""
+        window = self._window(window_s, ticks, now)
+        series = self._series(name, name, window, source, labels)
+        return max((len(points) for points in series.values()),
+                   default=0)
+
+    def increase(self, name: str, window_s: Optional[float] = None,
+                 ticks: Optional[int] = None,
+                 now: Optional[float] = None,
+                 source: Optional[str] = None, **labels) -> float:
+        """Reset-aware counter increase over the window, summed across
+        matching sources and label sets."""
+        window = self._window(window_s, ticks, now)
+        series = self._series(name, name, window, source, labels)
+        return sum(telemetry.counter_increase(points)
+                   for points in series.values())
+
+    def increase_by(self, name: str, label: str,
+                    window_s: Optional[float] = None,
+                    ticks: Optional[int] = None,
+                    now: Optional[float] = None,
+                    source: Optional[str] = None,
+                    **labels) -> Dict[str, float]:
+        """{label value: reset-aware increase} — e.g. requests by
+        `status`, the availability SLO's raw material."""
+        window = self._window(window_s, ticks, now)
+        series = self._series(name, name, window, source, labels,
+                              group_by=label)
+        out: Dict[str, float] = {}
+        for (_, key), points in series.items():
+            out[key] = (out.get(key, 0.0)
+                        + telemetry.counter_increase(points))
+        return out
+
+    def rate(self, name: str, window_s: Optional[float] = None,
+             ticks: Optional[int] = None, now: Optional[float] = None,
+             source: Optional[str] = None, **labels) -> float:
+        """Per-second rate: increase over the time actually covered by
+        the selected ticks. Fewer than two ticks = no window = 0.0."""
+        window = self._window(window_s, ticks, now)
+        if len(window) < 2:
+            return 0.0
+        covered = window[-1][0] - window[0][0]
+        if covered <= 0:
+            return 0.0
+        series = self._series(name, name, window, source, labels)
+        total = sum(telemetry.counter_increase(points)
+                    for points in series.values())
+        return total / covered
+
+    def window_buckets(self, name: str,
+                       window_s: Optional[float] = None,
+                       ticks: Optional[int] = None,
+                       now: Optional[float] = None,
+                       source: Optional[str] = None,
+                       **labels) -> Dict[str, float]:
+        """{le: reset-aware cumulative increase} for one histogram over
+        the window — `quantile_from_buckets`-ready, also the latency
+        SLO's good/bad split input."""
+        window = self._window(window_s, ticks, now)
+        series = self._series(name, name + "_bucket", window, source,
+                              labels, group_by="le")
+        out: Dict[str, float] = {}
+        for (_, le), points in series.items():
+            if not le:
+                continue
+            out[le] = (out.get(le, 0.0)
+                       + telemetry.counter_increase(points))
+        return out
+
+    def quantile(self, name: str, q: float,
+                 window_s: Optional[float] = None,
+                 ticks: Optional[int] = None,
+                 now: Optional[float] = None,
+                 source: Optional[str] = None,
+                 **labels) -> Optional[float]:
+        """Windowed histogram quantile; None when the window holds no
+        samples."""
+        buckets = self.window_buckets(name, window_s, ticks, now,
+                                      source, **labels)
+        return telemetry.quantile_from_buckets(buckets, None, q)
+
+    # ------------------------------------------------------ operator
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._ticks)
+            oldest = self._ticks[0][0] if n else None
+            newest = self._ticks[-1][0] if n else None
+        return {
+            "ticks": n,
+            "oldest_ts": oldest,
+            "newest_ts": newest,
+            "span_s": (round(newest - oldest, 3)
+                       if n >= 2 else 0.0),
+            "segments": len(self._segment_files()),
+            "disk_bytes": self._disk_bytes(),
+            "torn_segments": self.torn_segments,
+            "retention_s": self.retention_s,
+            "max_bytes": int(self.max_bytes),
+        }
+
+    def query_range(self, params: Dict[str, str]) -> dict:
+        """The GET /query surface: flat string params (a parsed query
+        string). Reserved keys select the operation; every other key is
+        a label filter. Raises ValueError on a malformed query (the
+        HTTP layer maps it to 400)."""
+        params = dict(params)
+        op = params.pop("op", "rate")
+        name = params.pop("name", "")
+        window_raw = params.pop("window", "")
+        by = params.pop("by", "")
+        q_raw = params.pop("q", "")
+        source = params.pop("source", None)
+        now_raw = params.pop("now", "")
+        if op == "stats":
+            return {"op": "stats", "stats": self.stats()}
+        if not name:
+            raise ValueError("query needs name=<metric>")
+        try:
+            window_s = float(window_raw) if window_raw else 300.0
+            now = float(now_raw) if now_raw else None
+        except ValueError:
+            raise ValueError("window/now must be numbers")
+        base = {"op": op, "name": name, "window_s": window_s,
+                "source": source, "labels": params}
+        if op == "rate":
+            base["value"] = self.rate(name, window_s, now=now,
+                                      source=source, **params)
+        elif op == "increase":
+            if by:
+                base["by"] = by
+                base["value"] = self.increase_by(
+                    name, by, window_s, now=now, source=source,
+                    **params)
+            else:
+                base["value"] = self.increase(
+                    name, window_s, now=now, source=source, **params)
+        elif op == "quantile":
+            try:
+                q = float(q_raw) if q_raw else 0.95
+            except ValueError:
+                raise ValueError("q must be a number")
+            base["q"] = q
+            base["value"] = self.quantile(name, q, window_s, now=now,
+                                          source=source, **params)
+        else:
+            raise ValueError(
+                f"unknown op {op!r} (rate|increase|quantile|stats)")
+        return base

@@ -91,6 +91,9 @@ class PipelineContext:
         self.manifest = manifest
         self.run_dir = run_dir
         self.log = log
+        # set by the supervisor: the run's RequestTrace — stages that
+        # cross process boundaries (drive_fleet_swap) propagate it
+        self.trace = None
 
     def dir(self, name: str) -> str:
         path = os.path.join(self.run_dir, name)
@@ -245,6 +248,10 @@ def drive_fleet_swap(ctx, stage: str, artifact: str,
     payload: Dict = {"artifact": artifact, "model": model}
     if retrieval_index:
         payload["retrieval_index"] = retrieval_index
+    if getattr(ctx, "trace", None) is not None:
+        # the rollout's spans (router admin, swap driver, every host's
+        # reload fan-out) parent under the pipeline run's trace id
+        payload["traceparent"] = ctx.trace.traceparent()
     status, body = _http_json(stage, "POST", base + "/admin/reload",
                               payload)
     if status not in (200, 202):

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from code2vec_tpu import obs
+from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.pipeline.manifest import (
     PipelineManifest, PipelineStateError,
 )
@@ -93,6 +94,18 @@ class PipelineSupervisor:
             [name for name, _fn in self.stages], log=self.log)
         self.ctx = PipelineContext(config, self.manifest, self.run_dir,
                                    self.log)
+        # One trace id per pipeline run: every stage span — and,
+        # through drive_fleet_swap's traceparent, the whole fleet
+        # rollout it triggers — stitches under this id (`fleet trace`).
+        self.trace = RequestTrace.from_headers(None)
+        self.ctx.trace = self.trace
+        self.trace_path = getattr(config, "trace_export", None) \
+            or os.path.join(self.run_dir, "pipeline.trace.json")
+        if getattr(config, "trace_export", None):
+            obs.default_tracer().enable()
+            self.log(f"Pipeline run trace id {self.trace.trace_id} "
+                     f"(stitch with `fleet --fleet_trace_id "
+                     f"{self.trace.trace_id}`)")
 
     # ------------------------------------------------------- identity
 
@@ -139,6 +152,16 @@ class PipelineSupervisor:
             stages_committed=[n for n, _ in self.stages
                               if self.manifest.stage(n)], **extra)
 
+    def _export_trace(self) -> None:
+        if not getattr(self.config, "trace_export", None):
+            return
+        if not len(obs.default_tracer()):
+            return
+        try:
+            obs.default_tracer().export_chrome_trace(self.trace_path)
+        except OSError as e:
+            self.log(f"Pipeline trace export failed: {e}")
+
     # ------------------------------------------------------------ run
 
     def run(self) -> int:
@@ -164,7 +187,8 @@ class PipelineSupervisor:
             self.log(f"Pipeline stage {name}: starting")
             t0 = time.monotonic()
             try:
-                outputs = fn(self.ctx)
+                with self.trace.span(f"pipeline.{name}", stage=name):
+                    outputs = fn(self.ctx)
                 status = "committed"
             except StageSkipped as e:
                 outputs = {"reason": str(e)}
@@ -200,6 +224,7 @@ class PipelineSupervisor:
                                        status=status)
             _h_stage(name).observe(duration)
             _c_stage(name, status).inc()
+            self._export_trace()
             self.log(f"Pipeline stage {name}: {status} in "
                      f"{duration:.1f}s")
         detail = self._run_summary()
@@ -230,6 +255,7 @@ class PipelineSupervisor:
         self.flight.incident("pipeline_stage_failed", immediate=True,
                              stage=name, error=error)
         self._heartbeat("error", stage=name, error=error)
+        self._export_trace()
         self.log(f"Pipeline stage {name} FAILED (rerun resumes here): "
                  f"{error}")
         return 1
@@ -251,6 +277,7 @@ class PipelineSupervisor:
                              error=error, **safe_numbers)
         self._heartbeat(outcome, stage=stage, error=error,
                         gate=safe_numbers)
+        self._export_trace()
         self.log(f"Pipeline {outcome.upper()} at stage {stage}: "
                  f"{error}")
         return 1

@@ -68,6 +68,8 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from code2vec_tpu import obs
+from code2vec_tpu.obs import slo as obs_slo
+from code2vec_tpu.obs import tsdb as obs_tsdb
 from code2vec_tpu.serving import telemetry
 from code2vec_tpu.serving.fleet.router import DEFAULT_MODEL, FleetRouter
 from code2vec_tpu.serving.fleet.swap import FleetSwapDriver
@@ -277,10 +279,8 @@ class _Host:
         self.weight = 0.0
         self.view: Optional[dict] = None     # last /fleet JSON
         self.metrics_text: str = ""          # last /metrics text
-        # scaling windows (deltas between ticks) + hysteresis state
-        self.prev_requests: Optional[float] = None
-        self.prev_sheds: float = 0.0
-        self.prev_buckets: Optional[Dict[str, float]] = None
+        # scaling hysteresis state (the WINDOWS live in the control
+        # plane's tsdb now — reset-aware, restart-surviving)
         self.up_ticks = 0
         self.idle_ticks = 0
         self.cooldown_until = 0.0
@@ -377,6 +377,31 @@ class ControlPlane:
             dump_dir=self.run_dir,
             max_dumps=getattr(config, "serve_flight_max_dumps", 64),
             log=self.log)
+        # telemetry history + SLO judgment (obs/tsdb.py, obs/slo.py):
+        # every poll tick's pre-merge snapshot set lands in the
+        # segment ring under the run dir; the autoscaler and the SLO
+        # engine both read windows back out of it, and GET /query
+        # exposes the same windows to operators
+        self.tsdb = obs_tsdb.TsdbStore(
+            os.path.join(self.run_dir, "tsdb"),
+            retention_s=getattr(config, "fleet_tsdb_retention_s",
+                                3600.0),
+            max_mb=getattr(config, "fleet_tsdb_max_mb", 64.0),
+            log=self.log)
+        self.slo = obs_slo.SloEngine(
+            obs_slo.objectives_from_config(config),
+            period_s=getattr(config, "fleet_slo_period_s",
+                             2592000.0),
+            window_scale=getattr(config, "fleet_slo_window_scale",
+                                 1.0),
+            flight=self.flight, log=self.log)
+        # cross-process stitching: the control plane records swap /
+        # admin spans into its own ring and exports them beside the
+        # hosts' files so `fleet trace` sees the whole tree
+        self._trace_path = os.path.join(self.run_dir,
+                                        "control.trace.json")
+        if getattr(config, "trace_export", None):
+            obs.default_tracer().enable()
 
     def set_initial_artifact(self, model: str,
                              artifact: Optional[str],
@@ -399,8 +424,6 @@ class ControlPlane:
         host.port = host.telemetry_port = None
         host.view = None
         host.metrics_text = ""
-        host.prev_requests = None
-        host.prev_buckets = None
         from code2vec_tpu.serving.server import RELOAD_TARGET_FILENAME
         from code2vec_tpu.serving.supervisor import child_env
         current = self._artifacts.get(host.model)
@@ -427,6 +450,14 @@ class ControlPlane:
                 pass
         command = host.spec.command + ["--heartbeat_file",
                                        host.heartbeat_path]
+        if getattr(self.config, "trace_export", None):
+            # thread span-file export down the tree: the host
+            # supervisor exports its own ring into the host dir and
+            # hands each replica a per-replica path there, so every
+            # span file `fleet trace` stitches lives under ONE run dir
+            command = command + [
+                "--trace_export",
+                os.path.join(host.host_dir, "supervisor.trace.json")]
         env = child_env(os.environ)
         env[FLEET_HOST_ENV] = host.id
         env[FLEET_HOST_ADDRESS_ENV] = host.address
@@ -455,6 +486,12 @@ class ControlPlane:
         from code2vec_tpu.serving.supervisor import child_env
         command = router.spec.command + ["--heartbeat_file",
                                          router.heartbeat_path]
+        if getattr(self.config, "trace_export", None):
+            # router forward/retry spans join the stitched tree: each
+            # agent exports its ring into its run dir each poll tick
+            command = command + [
+                "--trace_export",
+                os.path.join(router.router_dir, "router.trace.json")]
         env = child_env(os.environ)
         env[FLEET_ROUTER_ENV] = router.id
         # a router agent never builds a model: keep its startup at
@@ -539,6 +576,31 @@ class ControlPlane:
             if self._stop.is_set():
                 break
             self._check_router(router, now)
+        # ONE history tick per poll: the same pre-merge snapshot set
+        # merged_fleet_metrics reads — per-source, so the autoscaler
+        # can query one host's window and a host restart resets only
+        # that host's series
+        snapshots: Dict[str, object] = {
+            f"host:{h.id}": h.metrics_text
+            for h in hosts if h.metrics_text}
+        snapshots["control"] = (
+            obs.default_registry().render_prometheus())
+        try:
+            self.tsdb.append(snapshots, now=time.time())
+        except OSError as e:
+            self.log(f"tsdb append failed ({e}); history tick lost")
+        # scaling decisions read the freshly-appended window
+        for host in hosts:
+            if self._stop.is_set():
+                break
+            self._scale_tick(host, now)
+        self.slo.evaluate(self.tsdb)
+        tracer = obs.default_tracer()
+        if tracer.enabled and len(tracer):
+            try:
+                tracer.export_chrome_trace(self._trace_path)
+            except OSError:
+                pass
         self._update_host_gauges()
         self._write_heartbeat("controlling")
 
@@ -634,7 +696,6 @@ class ControlPlane:
             host.state, host.weight = "degraded", UNHEALTHY_WEIGHT
         else:
             host.state, host.weight = "healthy", 1.0
-        self._scale_tick(host, now)
 
     def _check_router(self, router: _Router, now: float) -> None:
         """Same supervision shape as _check_host, minus health/scaling:
@@ -732,36 +793,33 @@ class ControlPlane:
 
     def _scale_tick(self, host: _Host, now: float) -> None:
         """One hysteresis-damped scaling decision for one host, over
-        the window since the last tick."""
+        the last-two-ticks window of the telemetry history store —
+        the tsdb owns reset detection (telemetry.counter_delta), so a
+        replica restart zeroing counters reads as the post-restart
+        growth, never a negative delta or a phantom idle tick."""
         cfg = self.config
         view = host.view
         if not view or host.state == "down":
-            host.prev_requests = None  # stale window; resample
-            return
-        totals = sheds = 0.0
-        for replica in view.get("replicas", []):
-            totals += float(replica.get("requests_total") or 0)
-            sheds += float(replica.get("requests_shed_total") or 0)
-        buckets = telemetry.histogram_buckets(
-            host.metrics_text, "serving_request_seconds",
-            phase="total") if cfg.fleet_scale_up_p95_ms > 0 else {}
-        if host.prev_requests is None or totals < host.prev_requests:
-            # first tick, or a replica restart zeroed counters: seed
-            # the window, decide next tick
-            host.prev_requests, host.prev_sheds = totals, sheds
-            host.prev_buckets = buckets
             host.up_ticks = host.idle_ticks = 0
             return
-        d_req = totals - host.prev_requests
-        d_shed = max(0.0, sheds - host.prev_sheds)
+        source = f"host:{host.id}"
+        if self.tsdb.series_len("serving_requests_total", ticks=2,
+                                source=source) < 2:
+            # first tick after (re)spawn: no window yet, decide next
+            # tick — boot must not read as idle
+            host.up_ticks = host.idle_ticks = 0
+            return
+        d_req = self.tsdb.increase("serving_requests_total", ticks=2,
+                                   source=source)
+        d_shed = self.tsdb.increase("serving_requests_shed_total",
+                                    ticks=2, source=source)
         shed_rate = (d_shed / d_req) if d_req > 0 else 0.0
         p95_ms = None
         if cfg.fleet_scale_up_p95_ms > 0:
-            p95 = telemetry.quantile_from_buckets(
-                buckets, host.prev_buckets, 0.95)
+            p95 = self.tsdb.quantile(
+                "serving_request_seconds", 0.95, ticks=2,
+                source=source, phase="total")
             p95_ms = None if p95 is None else p95 * 1000.0
-        host.prev_requests, host.prev_sheds = totals, sheds
-        host.prev_buckets = buckets
         up = (shed_rate > cfg.fleet_scale_up_shed_rate
               or (p95_ms is not None
                   and p95_ms > cfg.fleet_scale_up_p95_ms))
@@ -903,6 +961,34 @@ class ControlPlane:
             "hosts": hosts,
         }
 
+    # ------------------------------------------------- history surface
+
+    def query_range(self, params: Dict[str, str]) -> dict:
+        """GET /query body: a tsdb range query (op=rate | increase |
+        quantile | stats). ValueError maps to 400 at the HTTP layer."""
+        return self.tsdb.query_range(params)
+
+    def slo_status(self) -> dict:
+        """GET /slo body: the SLO engine's last evaluation plus the
+        history depth it judged from."""
+        status = self.slo.status()
+        status["tsdb"] = self.tsdb.stats()
+        return status
+
+    def trace_spans(self, trace_id: str) -> dict:
+        """GET /trace?id= body: every process's span files under the
+        run dir, stitched into one Chrome trace for `trace_id`. The
+        control plane's own ring is exported first so spans recorded
+        since the last poll tick are included."""
+        from code2vec_tpu.obs import stitch
+        tracer = obs.default_tracer()
+        if tracer.enabled and len(tracer):
+            try:
+                tracer.export_chrome_trace(self._trace_path)
+            except OSError:
+                pass
+        return stitch.stitch_dir(self.run_dir, str(trace_id))
+
     # ---------------------------------------------------- admin surface
 
     def request_swap(self, payload: dict) -> Tuple[int, dict]:
@@ -910,7 +996,8 @@ class ControlPlane:
         status = self.swap.request(
             payload.get("artifact"), model=model,
             rollback_to=payload.get("rollback"),
-            retrieval_index=payload.get("retrieval_index"))
+            retrieval_index=payload.get("retrieval_index"),
+            traceparent=payload.get("traceparent"))
         return 202, {"accepted": True, "swap": status}
 
     def request_scale(self, host_id, n) -> Tuple[int, dict]:
@@ -966,10 +1053,16 @@ class ControlPlane:
                 if h.model == model and h.alive and not h.draining]
 
     def host_reload(self, host: _Host, artifact: str,
-                    retrieval_index: Optional[str] = None):
+                    retrieval_index: Optional[str] = None,
+                    traceparent: Optional[str] = None):
         payload = {"artifact": artifact}
         if retrieval_index:
             payload["retrieval_index"] = str(retrieval_index)
+        if traceparent:
+            # rides INSIDE the body: the host telemetry listener's
+            # post handlers never see HTTP headers (supervisor
+            # _admin_reload parents its fan-out span under this)
+            payload["traceparent"] = traceparent
         return self._post(host, "/admin/reload", payload)
 
     def host_fleet(self, host: _Host) -> Optional[dict]:
@@ -1099,6 +1192,11 @@ _FLEET_VALUE_FLAGS = (
     "--fleet_scale_up_ticks", "--fleet_scale_down_ticks",
     "--fleet_scale_cooldown", "--fleet_swap_timeout",
     "--fleet_max_host_restarts",
+    "--fleet_tsdb_retention", "--fleet_tsdb_max_mb",
+    "--fleet_slo_availability", "--fleet_slo_latency_ms",
+    "--fleet_slo_latency_target", "--fleet_slo_period",
+    "--fleet_slo_window_scale",
+    "--fleet_trace_id", "--fleet_trace_dir",
     # run files + ports are per host, owned by the control plane
     "--heartbeat_file", "--metrics_file", "--trace_export",
     "--serve_port", "--serve_telemetry_port",
@@ -1113,6 +1211,7 @@ _FLEET_BOOL_FLAGS = ("--fleet_no_affinity",)
 _ROUTER_STRIP_FLAGS = (
     "--fleet_routers", "--fleet_control", "--fleet_port",
     "--fleet_launcher", "--fleet_addresses",
+    "--fleet_trace_id", "--fleet_trace_dir",
     "--heartbeat_file", "--metrics_file", "--trace_export",
     "--serve_port", "--serve_telemetry_port",
 )

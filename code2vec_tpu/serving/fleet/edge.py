@@ -46,6 +46,7 @@ import tempfile
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import List, Optional, Tuple
 
@@ -69,7 +70,8 @@ def _c_view_refresh(outcome: str):
 class SharedFleetView:
     """The router agent's `control` surface, duck-typed against
     FleetRouter's contract: hosts_for / fleet_view /
-    merged_fleet_metrics / request_swap / request_scale / drain_host,
+    merged_fleet_metrics / query_range / slo_status / trace_spans /
+    request_swap / request_scale / drain_host,
     all derived from (or relayed to) the control listener. This is the
     WHOLE per-router state — a SIGKILLed router loses nothing the next
     poll does not rebuild, which is what makes the tier stateless."""
@@ -151,6 +153,39 @@ class SharedFleetView:
              f"router:{self.router_id}": own},
             gauge_label="source")
 
+    def _relay_get(self, path: str) -> dict:
+        """GET relay for the telemetry-history surface (/query /slo
+        /trace): the history lives in the control plane's embedded
+        tsdb, so any router answers from the same store. A control 400
+        re-raises as ValueError (the router handler's bad-query
+        mapping); unreachable control is a 503-shaped error body."""
+        try:
+            with urllib.request.urlopen(self.base + path,
+                                        timeout=10.0) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {"error": f"control listener HTTP {e.code}"}
+            if e.code == 400:
+                raise ValueError(body.get("error", "bad query"))
+            return body
+        except (OSError, ValueError) as e:
+            return {"error": f"control plane unreachable from "
+                             f"router {self.router_id}: {e}"}
+
+    def query_range(self, params: dict) -> dict:
+        return self._relay_get(
+            "/query?" + urllib.parse.urlencode(params))
+
+    def slo_status(self) -> dict:
+        return self._relay_get("/slo")
+
+    def trace_spans(self, trace_id: str) -> dict:
+        return self._relay_get(
+            "/trace?" + urllib.parse.urlencode({"id": trace_id}))
+
     def _relay(self, path: str, payload: dict) -> Tuple[int, dict]:
         req = urllib.request.Request(
             self.base + path, data=json.dumps(payload).encode(),
@@ -192,6 +227,13 @@ def router_main(config) -> int:
         return 2
     view = SharedFleetView(config, control_address, router_id,
                            log=config.log)
+    trace_path = getattr(config, "trace_export", None)
+    if trace_path:
+        # span ring on: forward/retry/admin spans export per poll tick
+        # into the run dir the control plane assigned, where `fleet
+        # trace` / GET /trace?id= stitches them with every other
+        # process's file
+        obs.default_tracer().enable()
     view.refresh()  # best effort before the public port opens
     router = FleetRouter(config, view, host=config.serve_host,
                          port=config.serve_port, log=config.log)
@@ -226,12 +268,23 @@ def router_main(config) -> int:
             break
         view.refresh()
         _heartbeat("routing")
+        if trace_path and len(obs.default_tracer()):
+            try:
+                obs.default_tracer().export_chrome_trace(trace_path)
+            except OSError as e:
+                config.log(f"Edge router {router_id}: trace export "
+                           f"failed: {e}")
     # drain: stop intake (honest 503 + Retry-After) and give in-flight
     # forwards a moment before the listener closes under them
     router.drain()
     _heartbeat("draining")
     time.sleep(min(2.0, getattr(config, "serve_drain_timeout_s", 2.0)))
     router.close()
+    if trace_path and len(obs.default_tracer()):
+        try:
+            obs.default_tracer().export_chrome_trace(trace_path)
+        except OSError:
+            pass  # exiting anyway; the per-tick export is recent
     _heartbeat("done")
     config.log(f"Edge router {router_id} drained and exiting")
     return 0

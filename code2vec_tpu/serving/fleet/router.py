@@ -45,7 +45,11 @@ each host's `/fleet` + heartbeat staleness:
 
 Fleet views are answered HERE, never forwarded: `GET /fleet` is the
 control plane's fleet JSON, `GET /metrics` the fleet-wide merge of
-every host's (already replica-merged) snapshot. `POST /admin/reload`
+every host's (already replica-merged) snapshot. `GET /query` relays a
+telemetry-history range query (obs/tsdb.py), `GET /slo` the SLO
+engine's burn-rate status, and `GET /trace?id=` the stitched
+cross-process trace — all answered by the control plane's embedded
+store, so history survives any single router. `POST /admin/reload`
 starts the canary-first coordinated hot-swap (serving/fleet/swap.py),
 `POST /admin/scale {"host": ..., "replicas": N}` overrides one host's
 replica count, `POST /admin/drain {"host": ...}` starts a coordinated
@@ -60,6 +64,8 @@ import http.server
 import json
 import random
 import threading
+import time
+import urllib.parse
 from typing import List, Optional, Tuple
 
 from code2vec_tpu import obs
@@ -147,7 +153,8 @@ class FleetRouter:
     """One public HTTP listener over a `control` object exposing:
     `hosts_for(model) -> Optional[List[(weight, host_id, (addr,
     port))]]` (None = unknown model), `fleet_view()`,
-    `merged_fleet_metrics()`, `request_swap(payload)`,
+    `merged_fleet_metrics()`, `query_range(params)`, `slo_status()`,
+    `trace_spans(trace_id)`, `request_swap(payload)`,
     `request_scale(host_id, n)`, `drain_host(host_id)` — duck-typed so
     tests drive the router on a stub control plane."""
 
@@ -201,6 +208,23 @@ class FleetRouter:
                             .encode(),
                             ctype="text/plain; version=0.0.4; "
                                   "charset=utf-8")
+                    elif path == "/query":
+                        try:
+                            self._reply(200,
+                                        router.control.query_range(
+                                            self._params()))
+                        except ValueError as e:
+                            self._reply(400, {"error": str(e)})
+                    elif path == "/slo":
+                        self._reply(200, router.control.slo_status())
+                    elif path == "/trace":
+                        tid = (self._params().get("id") or "").strip()
+                        if not tid:
+                            self._reply(400, {
+                                "error": "missing ?id=<trace id>"})
+                        else:
+                            self._reply(
+                                200, router.control.trace_spans(tid))
                     else:
                         self._reply(404, {"error":
                                           f"no such endpoint: {path}"})
@@ -208,6 +232,10 @@ class FleetRouter:
                     # get an HTTP error, never a torn connection
                     self._reply(500, {"error":
                                       f"{type(e).__name__}: {e}"})
+
+            def _params(self) -> dict:
+                return dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlsplit(self.path).query))
 
             def do_POST(self):  # noqa: N802 (stdlib API name)
                 path = self.path.split("?", 1)[0]
@@ -235,29 +263,70 @@ class FleetRouter:
                          name="fleet-router", daemon=True).start()
         self.log(f"Fleet router on http://{self._httpd.server_address[0]}"
                  f":{self.port} (POST /predict /embed /neighbors "
-                 f"routed by X-Model; GET /fleet /metrics /healthz; "
+                 f"routed by X-Model; GET /fleet /metrics /healthz "
+                 f"/query /slo /trace; "
                  f"POST /admin/reload /admin/scale /admin/drain)")
 
     # ---------------------------------------------------------- forward
 
     def _forward(self, handler, path: str) -> None:
         endpoint = path.lstrip("/")
-        length = int(handler.headers.get("Content-Length", 0))
-        body = handler.rfile.read(length) if length else b""
         trace = RequestTrace.from_headers(
             handler.headers.get("traceparent"))
+        # Shim the reply to capture the terminal status: the router
+        # tier records every forwarded request into the flight
+        # recorder, so an SLO-burn dump at this process holds the
+        # offending requests' trace ids, not just the burn numbers.
+        t0 = time.monotonic()
+        terminal = {}
+        orig_reply = handler._reply
+
+        def reply(code, payload, headers=None,
+                  ctype="application/json"):
+            terminal["status"] = code
+            orig_reply(code, payload, headers, ctype=ctype)
+
+        handler._reply = reply
+        # The forward span opens BEFORE any traceparent is serialized:
+        # the parent id propagated to the host must name a span this
+        # router actually records, or the stitched trace breaks at the
+        # router hop.
+        fwd_span = None
+        try:
+            with trace.span(f"router.forward {endpoint}",
+                            endpoint=endpoint) as fwd_span:
+                self._forward_in_span(handler, path, endpoint, trace,
+                                      fwd_span)
+        finally:
+            obs.default_flight_recorder().record_request(
+                trace_id=trace.trace_id, endpoint="/" + endpoint,
+                status=int(terminal.get("status", 0)),
+                duration_s=time.monotonic() - t0,
+                reason=(fwd_span.attrs.get("outcome")
+                        if fwd_span is not None else None))
+
+    def _forward_in_span(self, handler, path: str, endpoint: str,
+                         trace, fwd_span) -> None:
+        length = int(handler.headers.get("Content-Length", 0))
+        body = handler.rfile.read(length) if length else b""
         trace_headers = {"X-Trace-Id": trace.trace_id,
                          "traceparent": trace.traceparent()}
         deadline = deadline_from_request(
             self.config, handler.headers.get("X-Deadline-Ms"))
         model = (handler.headers.get("X-Model") or "").strip() \
             or DEFAULT_MODEL
+        fwd_span.attrs["model"] = model
         fwd_headers = {"traceparent": trace.traceparent()}
         for name in ("Content-Type", "X-Deadline-Ms", "X-Model"):
             if handler.headers.get(name):
                 fwd_headers[name] = handler.headers[name]
+
+        def outcome(kind: str) -> None:
+            fwd_span.attrs["outcome"] = kind
+            _c_requests(endpoint, kind).inc()
+
         if self._draining:
-            _c_requests(endpoint, "draining").inc()
+            outcome("draining")
             handler._reply(503, {"error": "fleet is draining",
                                  "trace_id": trace.trace_id},
                            dict(trace_headers, **{
@@ -266,7 +335,7 @@ class FleetRouter:
             return
         candidates = self.control.hosts_for(model)
         if candidates is None:
-            _c_requests(endpoint, "unknown_model").inc()
+            outcome("unknown_model")
             handler._reply(404, {
                 "error": f"no such model: {model!r} (X-Model header; "
                          f"see GET /fleet for the mounted models)",
@@ -277,7 +346,7 @@ class FleetRouter:
         if self.affinity and ordered:
             self._apply_affinity(body, candidates, ordered)
         if not ordered:
-            _c_requests(endpoint, "no_host").inc()
+            outcome("no_host")
             handler._reply(503, {
                 "error": f"no routable host for model {model!r}",
                 "trace_id": trace.trace_id},
@@ -301,8 +370,7 @@ class FleetRouter:
             unreachable_error=f"no host reachable for model {model!r}",
             retry_after=str(retry_after_seconds(1.0)),
             retry_counter=_C_RETRIES,
-            on_outcome=lambda outcome:
-                _c_requests(endpoint, outcome).inc())
+            on_outcome=outcome)
 
     def _apply_affinity(self, body: bytes, candidates,
                         ordered) -> None:
@@ -332,15 +400,24 @@ class FleetRouter:
     # ------------------------------------------------------------ admin
 
     def _admin(self, handler, path: str) -> None:
+        trace = RequestTrace.from_headers(
+            handler.headers.get("traceparent"))
+
         def dispatch(payload: dict):
-            if path == "/admin/reload":
-                return self.control.request_swap(payload)
-            if path == "/admin/scale":
-                return self.control.request_scale(
-                    payload.get("host"), payload.get("replicas"))
-            if path == "/admin/drain":
-                return self.control.drain_host(payload.get("host"))
-            return 404, {"error": f"no such endpoint: {path}"}
+            with trace.span(f"router.admin {path}", endpoint=path):
+                if path == "/admin/reload":
+                    # the rollout's spans parent under this admin
+                    # request: `fleet trace` shows operator -> router
+                    # -> swap driver -> every host as one tree
+                    payload.setdefault("traceparent",
+                                       trace.traceparent())
+                    return self.control.request_swap(payload)
+                if path == "/admin/scale":
+                    return self.control.request_scale(
+                        payload.get("host"), payload.get("replicas"))
+                if path == "/admin/drain":
+                    return self.control.drain_host(payload.get("host"))
+                return 404, {"error": f"no such endpoint: {path}"}
 
         handle_admin_post(
             handler, dispatch,

@@ -36,6 +36,7 @@ import time
 from typing import List, Optional
 
 from code2vec_tpu import obs
+from code2vec_tpu.obs.reqtrace import RequestTrace
 
 
 def _c_swaps(outcome: str):
@@ -75,7 +76,7 @@ class FleetSwapDriver:
         self._status = {"state": "idle", "target": None, "model": None,
                         "target_fingerprint": None, "error": None,
                         "hosts": [], "started_at": None,
-                        "completed_at": None}
+                        "completed_at": None, "trace_id": None}
 
     def status(self) -> dict:
         with self._lock:
@@ -94,12 +95,18 @@ class FleetSwapDriver:
 
     def request(self, artifact, model: str = "default",
                 rollback_to: Optional[str] = None,
-                retrieval_index: Optional[str] = None) -> dict:
+                retrieval_index: Optional[str] = None,
+                traceparent: Optional[str] = None) -> dict:
         """Kick off an async rollout; returns the fresh status. Raises
         ValueError on a bad request, FleetSwapBusy while one runs.
         `retrieval_index` rides the reload to every replica, which
         mounts it atomically with its model flip (the pipeline's
-        retrieval-refresh rollout; rollbacks never carry one)."""
+        retrieval-refresh rollout; rollbacks never carry one).
+        `traceparent` adopts the caller's trace (the router's admin
+        span, the pipeline's run trace); absent, the rollout mints its
+        own trace id — either way every per-host reload span carries
+        ONE id `fleet trace` can stitch, surfaced as `trace_id` in
+        status()."""
         if not artifact:
             raise ValueError('no artifact: body must be '
                              '{"artifact": DIR[, "model": NAME]}')
@@ -115,14 +122,16 @@ class FleetSwapDriver:
                     f"no live host in model group {model!r} to swap")
             rollback = (rollback_to
                         or self.control.rollback_target(model))
+            trace = RequestTrace.from_headers(traceparent)
             self._status.update(
                 state="canary", target=str(artifact), model=model,
                 target_fingerprint=None, error=None, hosts=[],
-                started_at=time.time(), completed_at=None)
+                started_at=time.time(), completed_at=None,
+                trace_id=trace.trace_id)
             self._worker = threading.Thread(
                 target=self._run,
                 args=(str(artifact), model, hosts, rollback,
-                      retrieval_index),
+                      retrieval_index, trace),
                 name="fleet-swap", daemon=True)
             self._worker.start()
         return self.status()
@@ -131,18 +140,32 @@ class FleetSwapDriver:
 
     def _run(self, artifact: str, model: str, hosts: List,
              rollback: Optional[str],
-             retrieval_index: Optional[str] = None) -> None:
+             retrieval_index: Optional[str] = None,
+             trace: Optional[RequestTrace] = None) -> None:
         control = self.control
+        trace = trace or RequestTrace.from_headers(None)
         control.flight.event("fleet_swap_start", target=artifact,
                              model=model, hosts=len(hosts),
                              retrieval_index=retrieval_index,
-                             canary=hosts[0].id)
+                             canary=hosts[0].id,
+                             trace_id=trace.trace_id)
+        with trace.span(f"fleet.rollout {model}", artifact=artifact,
+                        model=model, hosts=len(hosts)):
+            self._run_in_span(artifact, model, hosts, rollback,
+                              retrieval_index, trace)
+
+    def _run_in_span(self, artifact: str, model: str, hosts: List,
+                     rollback: Optional[str],
+                     retrieval_index: Optional[str],
+                     trace: RequestTrace) -> None:
+        control = self.control
         target_fp: Optional[str] = None
         committed: List = []
         for i, host in enumerate(hosts):
             ok, result = self._swap_host(host, artifact,
                                          expect_fp=target_fp,
-                                         retrieval_index=retrieval_index)
+                                         retrieval_index=retrieval_index,
+                                         trace=trace)
             if not ok:
                 self._host_outcome(host.id, f"failed: {result}")
                 control.flight.event("fleet_swap_halt", host=host.id,
@@ -158,7 +181,8 @@ class FleetSwapDriver:
                                 f"canary {host.id}: {result}")
                     return
                 self._rollback(committed + [host], rollback, model,
-                               first_error=f"{host.id}: {result}")
+                               first_error=f"{host.id}: {result}",
+                               trace=trace)
                 return
             self._host_outcome(host.id, "committed")
             committed.append(host)
@@ -183,7 +207,8 @@ class FleetSwapDriver:
                     f"fingerprint {target_fp} ({artifact})")
 
     def _rollback(self, touched: List, rollback: Optional[str],
-                  model: str, first_error: str) -> None:
+                  model: str, first_error: str,
+                  trace: Optional[RequestTrace] = None) -> None:
         control = self.control
         if not rollback:
             _c_swaps("failed").inc()
@@ -201,7 +226,8 @@ class FleetSwapDriver:
                     f"{len(touched)} host(s) back to {rollback}")
         clean = True
         for host in touched:
-            ok, result = self._swap_host(host, rollback, expect_fp=None)
+            ok, result = self._swap_host(host, rollback, expect_fp=None,
+                                         trace=trace)
             self._host_outcome(
                 host.id, "rolled_back" if ok
                 else f"rollback_failed: {result}")
@@ -222,16 +248,31 @@ class FleetSwapDriver:
 
     def _swap_host(self, host, artifact: str,
                    expect_fp: Optional[str],
-                   retrieval_index: Optional[str] = None):
+                   retrieval_index: Optional[str] = None,
+                   trace: Optional[RequestTrace] = None):
         """Drive one host's supervisor reload fan-out and poll its
         /fleet until every replica lands one converged fingerprint with
         swap_state ready. Returns (True, fingerprint) or (False, why).
         `expect_fp` (post-canary) additionally pins WHICH fingerprint —
         a host converging on anything else is a failure (two artifacts
         claiming one dir, a stale cache on one host)."""
+        if trace is None:
+            return self._swap_host_in_span(host, artifact, expect_fp,
+                                           retrieval_index, None)
+        with trace.span(f"rollout.host {host.id}", host=host.id,
+                        artifact=artifact) as host_span:
+            ok, result = self._swap_host_in_span(
+                host, artifact, expect_fp, retrieval_index, trace)
+            host_span.attrs["outcome"] = \
+                "committed" if ok else f"failed: {result}"
+            return ok, result
+
+    def _swap_host_in_span(self, host, artifact, expect_fp,
+                           retrieval_index, trace):
         control = self.control
-        ok, why = control.host_reload(host, artifact,
-                                      retrieval_index=retrieval_index)
+        ok, why = control.host_reload(
+            host, artifact, retrieval_index=retrieval_index,
+            traceparent=trace.traceparent() if trace else None)
         if not ok:
             return False, f"reload request failed: {why}"
         timeout = float(getattr(control.config, "fleet_swap_timeout_s",

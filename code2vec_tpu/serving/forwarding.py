@@ -83,34 +83,46 @@ def forward_with_retry(
             retry_counter.inc()
         timeout = (min(_UNBOUNDED_TIMEOUT_S, max(remaining, 0.05))
                    if deadline.bounded else _UNBOUNDED_TIMEOUT_S)
-        try:
-            conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+        # per-attempt span: retries are visible as siblings in the
+        # stitched trace (parented under the caller's forward span)
+        with trace.span(f"{what}.attempt {label}", target=label,
+                        attempt=attempt) as attempt_span:
             try:
-                conn.request(method, path, body=body, headers=fwd_headers)
-                resp = conn.getresponse()
-                payload = resp.read()
-                out_headers = {}
-                for name in _RELAY_HEADERS:
-                    if resp.getheader(name):
-                        out_headers[name] = resp.getheader(name)
-                # a backend always stamps these; belt-and-braces for
-                # any terminal status that somehow lacks them
-                out_headers.setdefault("X-Trace-Id", trace.trace_id)
-                out_headers.setdefault("traceparent", trace.traceparent())
-                if on_outcome:
-                    on_outcome("forwarded")
-                reply(resp.status, payload, out_headers,
-                      resp.getheader("Content-Type", "application/json"))
-                return
-            finally:
-                conn.close()
-        except (OSError, http.client.HTTPException) as e:
-            # dead / draining / mid-restart backend — including one
-            # that died MID-RESPONSE (IncompleteRead/BadStatusLine are
-            # HTTPException, not OSError): the client never sees a
-            # torn response — retry the next candidate
-            last_err = f"{label}: {type(e).__name__}: {e}"
-            continue
+                conn = http.client.HTTPConnection(addr, port,
+                                                  timeout=timeout)
+                try:
+                    conn.request(method, path, body=body,
+                                 headers=fwd_headers)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    attempt_span.attrs["status"] = resp.status
+                    out_headers = {}
+                    for name in _RELAY_HEADERS:
+                        if resp.getheader(name):
+                            out_headers[name] = resp.getheader(name)
+                    # a backend always stamps these; belt-and-braces
+                    # for any terminal status that somehow lacks them
+                    out_headers.setdefault("X-Trace-Id",
+                                           trace.trace_id)
+                    out_headers.setdefault("traceparent",
+                                           trace.traceparent())
+                    if on_outcome:
+                        on_outcome("forwarded")
+                    reply(resp.status, payload, out_headers,
+                          resp.getheader("Content-Type",
+                                         "application/json"))
+                    return
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException) as e:
+                # dead / draining / mid-restart backend — including
+                # one that died MID-RESPONSE (IncompleteRead/
+                # BadStatusLine are HTTPException, not OSError): the
+                # client never sees a torn response — retry the next
+                # candidate
+                attempt_span.attrs["error"] = type(e).__name__
+                last_err = f"{label}: {type(e).__name__}: {e}"
+                continue
     if on_outcome:
         on_outcome("unreachable")
     json_reply(503, f"{unreachable_error} ({last_err})",

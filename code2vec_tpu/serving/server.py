@@ -1066,6 +1066,10 @@ def _heartbeat_fields(server: PredictionServer) -> dict:
         "requests_total": total("serving_requests_total"),
         "requests_shed_total": total("serving_requests_shed_total"),
         "requests_expired_total": total("serving_requests_expired_total"),
+        # span-ring pressure: lets /fleet show which replica's trace
+        # export is truncated when a stitched trace is missing spans
+        "spans_dropped": obs.default_tracer().dropped,
+        "span_ring_high_water": obs.default_tracer().high_water,
     }
 
 
@@ -1131,7 +1135,9 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
             prev_hup = signal.signal(signal.SIGHUP, _on_hup)
     if config.trace_export:
         # bulk per-request span trees ride the same ring the trainer
-        # uses; exported as ONE Chrome trace at shutdown
+        # uses; exported as one Chrome trace every heartbeat tick (so
+        # live `fleet trace` stitching and a crash both see recent
+        # spans) and finally at shutdown
         obs.default_tracer().enable()
     server.start()
 
@@ -1149,6 +1155,12 @@ def serve_main(config, model=None, *, stop: Optional[threading.Event]
             # (serving/telemetry.py) — rewritten every ticker interval,
             # not just at exit
             obs.exporters.write_prometheus(config.metrics_file)
+        if config.trace_export and len(obs.default_tracer()):
+            try:
+                obs.default_tracer().export_chrome_trace(
+                    config.trace_export)
+            except OSError:
+                pass  # next tick retries; shutdown still exports
 
     def _heartbeat_loop():
         while not hb_stop.wait(config.serve_heartbeat_interval_s):

@@ -222,6 +222,13 @@ class Supervisor:
             self.child_command = ([sys.executable, "-m",
                                    "code2vec_tpu.cli"] + stripped)
         self.trace_export = bool(getattr(config, "trace_export", None))
+        # the supervisor's OWN span ring (proxy forwards, reload
+        # fan-outs) exports to the --trace_export path the control
+        # plane assigned this host; replicas get derived per-replica
+        # paths in the same run dir
+        self.trace_export_path = getattr(config, "trace_export", None)
+        if self.trace_export_path:
+            obs.default_tracer().enable()
         self.traffic_sample = getattr(config,
                                       "serve_traffic_sample_file", None)
         base = (os.path.dirname(os.path.abspath(config.heartbeat_file))
@@ -573,9 +580,17 @@ class Supervisor:
         return 200, self.request_scale(payload.get("replicas"))
 
     def _admin_reload(self, payload: dict):
-        return 202, self.reload_all(
-            payload.get("artifact"),
-            retrieval_index=payload.get("retrieval_index"))
+        # The fleet swap driver threads its rollout traceparent INSIDE
+        # the JSON body (the telemetry listener's post handlers never
+        # see HTTP headers): this host's fan-out span parents under the
+        # rollout trace, so `fleet trace` shows operator -> router ->
+        # swap driver -> every host as one tree.
+        trace = RequestTrace.from_headers(payload.get("traceparent"))
+        with trace.span("host.reload_fanout",
+                        artifact=str(payload.get("artifact"))):
+            return 202, self.reload_all(
+                payload.get("artifact"),
+                retrieval_index=payload.get("retrieval_index"))
 
     # ---------------------------------------------------------- monitor
 
@@ -832,17 +847,26 @@ class Supervisor:
                 # replica ever saw the request
                 trace = RequestTrace.from_headers(
                     self.headers.get("traceparent"))
+                # the proxy span opens BEFORE the traceparent is
+                # re-serialized for the replica: the parent id handed
+                # downstream must name a span this process records, or
+                # the stitched trace breaks at the host hop
+                with trace.span(f"host.proxy {self.path}") as px_span:
+                    self._forward_in_span(method, body, trace, px_span)
+
+            def _forward_in_span(self, method, body, trace,
+                                 px_span) -> None:
                 trace_headers = {"X-Trace-Id": trace.trace_id,
                                  "traceparent": trace.traceparent()}
                 deadline = deadline_from_request(
                     sup.config, self.headers.get("X-Deadline-Ms"))
-                fwd_headers = {}
-                for name in ("Content-Type", "X-Deadline-Ms",
-                             "traceparent"):
+                fwd_headers = {"traceparent": trace.traceparent()}
+                for name in ("Content-Type", "X-Deadline-Ms"):
                     if self.headers.get(name):
                         fwd_headers[name] = self.headers[name]
                 ports = sup._live_ports()
                 if not ports:
+                    px_span.attrs["outcome"] = "no_replica"
                     self._reply(503, json.dumps(
                         {"error": "no live replica",
                          "trace_id": trace.trace_id}).encode() + b"\n",
@@ -868,7 +892,9 @@ class Supervisor:
                     reply=self._reply,
                     what="replicas",
                     unreachable_error="all replicas unreachable",
-                    retry_after=str(retry_after_seconds(1.0)))
+                    retry_after=str(retry_after_seconds(1.0)),
+                    on_outcome=lambda outcome: px_span.attrs.update(
+                        outcome=outcome))
 
             def do_GET(self):  # noqa: N802
                 # fleet views are answered HERE, not forwarded: a
@@ -972,6 +998,7 @@ class Supervisor:
         self._write_heartbeat("supervising")
         last_hb = time.monotonic()
         last_warmth = time.monotonic()
+        last_trace = time.monotonic()
         try:
             while not self._stop.is_set():
                 # liveness pipes double as the wakeup: a dying replica
@@ -1013,6 +1040,16 @@ class Supervisor:
                 if now - last_hb >= 1.0:
                     self._write_heartbeat("supervising")
                     last_hb = now
+                if (self.trace_export_path and now - last_trace >= 5.0
+                        and len(obs.default_tracer())):
+                    # periodic (not per-request) export: the stitcher
+                    # reads files, so a crash loses at most 5s of spans
+                    try:
+                        obs.default_tracer().export_chrome_trace(
+                            self.trace_export_path)
+                    except OSError as e:
+                        self.log(f"Supervisor trace export failed: {e}")
+                    last_trace = now
         finally:
             rc = self._shutdown()
         return rc
@@ -1068,6 +1105,12 @@ class Supervisor:
                 pass
         if self._telemetry is not None:
             self._telemetry.close()
+        if self.trace_export_path and len(obs.default_tracer()):
+            try:
+                obs.default_tracer().export_chrome_trace(
+                    self.trace_export_path)
+            except OSError:
+                pass  # exiting anyway; the periodic export is recent
         self._write_heartbeat(
             "error" if (escalated or not clean) else "done",
             escalated=escalated)

@@ -47,6 +47,26 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 LabelsKey = Tuple[Tuple[str, str], ...]
 
+# label-string -> parsed key: snapshots repeat the same label strings
+# on every scrape, and the telemetry history parses every host
+# snapshot once per poll tick — each distinct label set pays the
+# findall/sort/unescape once per process, not once per sample line.
+# Bounded so a pathological high-cardinality exporter cannot grow it
+# without limit; reads/writes are atomic under the GIL.
+_LABELS_CACHE: Dict[str, LabelsKey] = {}
+_LABELS_CACHE_MAX = 8192
+
+
+def _labels_key(labels_raw: str) -> LabelsKey:
+    key = _LABELS_CACHE.get(labels_raw)
+    if key is None:
+        key = tuple(sorted(
+            (k, _unescape(v))
+            for k, v in _LABEL_RE.findall(labels_raw)))
+        if len(_LABELS_CACHE) < _LABELS_CACHE_MAX:
+            _LABELS_CACHE[labels_raw] = key
+    return key
+
 
 def _unescape(v: str) -> str:
     return (v.replace("\\n", "\n").replace('\\"', '"')
@@ -127,9 +147,8 @@ def parse_prometheus_text(text: str) -> Dict[str, Family]:
         sample_name, _, labels_raw, value_raw = m.groups()
         fam_name = subname_to_family.get(sample_name, sample_name)
         fam = families.setdefault(fam_name, Family(fam_name))
-        labels: LabelsKey = tuple(sorted(
-            (k, _unescape(v))
-            for k, v in _LABEL_RE.findall(labels_raw or "")))
+        labels: LabelsKey = (_labels_key(labels_raw)
+                             if labels_raw else ())
         try:
             value = _parse_value(value_raw)
         except ValueError:
@@ -229,6 +248,42 @@ def histogram_buckets(text_or_families, name: str,
     return out
 
 
+def counter_delta(cur: float, prev: Optional[float]) -> float:
+    """Reset-aware window delta for ONE monotonic counter reading.
+
+    Counters are lifetime-cumulative and reset to zero when their
+    process restarts, so a raw `cur - prev` can go NEGATIVE mid-window
+    — and a negative delta silently corrupts every rate/ratio derived
+    from it. THE one reset policy, shared by the autoscaler
+    (fleet/control.py), the SLO engine (obs/slo.py) and the tsdb range
+    queries (obs/tsdb.py): a decrease means the counter restarted near
+    zero, so the new reading counts IN FULL (Prometheus `increase`
+    semantics, without the extrapolation). `prev=None` means "no
+    window yet" and yields 0.0 — never a lifetime-sized spike."""
+    if prev is None:
+        return 0.0
+    cur = float(cur)
+    prev = float(prev)
+    if cur >= prev:
+        return cur - prev
+    return max(0.0, cur)  # reset: restarted from ~0
+
+
+def counter_increase(points) -> float:
+    """Reset-aware increase over a SERIES of monotonic counter
+    readings (oldest first): the sum of `counter_delta` steps, so a
+    mid-window restart contributes the post-restart growth instead of
+    poisoning the whole window. Fewer than two points = no window =
+    0.0."""
+    total = 0.0
+    prev: Optional[float] = None
+    for value in points:
+        if prev is not None:
+            total += counter_delta(value, prev)
+        prev = float(value)
+    return total
+
+
 def quantile_from_buckets(cur: Dict[str, float],
                           prev: Optional[Dict[str, float]],
                           q: float) -> Optional[float]:
@@ -237,17 +292,33 @@ def quantile_from_buckets(cur: Dict[str, float],
     buckets and the quantile is computed over the delta — counters are
     lifetime-cumulative, and an autoscaler steering off the lifetime
     p95 would never see a regression fade. Linear interpolation inside
-    the bucket (Prometheus histogram_quantile semantics); a quantile
-    landing in the +Inf bucket returns the largest finite bound (a
-    conservative floor). None when the window holds no samples."""
+    the bucket (Prometheus histogram_quantile semantics).
+
+    Every input shape yields a DEFINED value (never NaN, never a
+    negative bound): a quantile landing in the +Inf bucket returns the
+    largest finite bound (a conservative floor) — or +inf when +Inf is
+    the ONLY bucket (mass exists but no finite bound does; +inf trips
+    any latency threshold, which is the honest reading). A mid-window
+    counter reset (cur < prev, a replica restart) falls back to the
+    reset-aware `counter_delta` per bucket and the cumulative counts
+    are re-monotonized, so the interpolation never sees a negative
+    bucket width. None when the window holds no samples (an empty
+    window is data ABSENCE, not a zero latency)."""
     prev = prev or {}
     deltas = []
     for le, count in cur.items():
         bound = math.inf if le == "+Inf" else float(le)
-        deltas.append((bound, max(0.0, count - prev.get(le, 0.0))))
+        deltas.append((bound,
+                       counter_delta(count, prev.get(le, 0.0))))
     if not deltas:
         return None
     deltas.sort()
+    # re-monotonize: per-bucket reset corrections (or a torn scrape)
+    # can leave cumulative counts locally decreasing
+    running = 0.0
+    for i, (bound, cum) in enumerate(deltas):
+        running = max(running, cum)
+        deltas[i] = (bound, running)
     total = deltas[-1][1]  # the +Inf (or widest) cumulative count
     if total <= 0:
         return None
@@ -257,7 +328,9 @@ def quantile_from_buckets(cur: Dict[str, float],
         if cum >= rank:
             if math.isinf(bound):
                 finite = [b for b, _ in deltas if not math.isinf(b)]
-                return finite[-1] if finite else None
+                # +Inf-only histogram with mass: no finite bound
+                # exists; +inf trips any threshold (honest reading)
+                return finite[-1] if finite else math.inf
             prev_cum = 0.0
             for b2, c2 in deltas:
                 if b2 >= bound:
@@ -282,7 +355,8 @@ def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
                 "requests_expired_total": None,
                 "shed_rate": None, "swap_state": None,
                 "swap_target": None, "swap_retrieval_index": None,
-                "inflight": None}
+                "inflight": None, "spans_dropped": None,
+                "span_ring_high_water": None}
     total = heartbeat.get("requests_total")
     shed = heartbeat.get("requests_shed_total")
     shed_rate = None
@@ -305,6 +379,10 @@ def fleet_replica_view(heartbeat: Optional[dict], now: float) -> dict:
         "swap_target": heartbeat.get("swap_target"),
         "swap_retrieval_index": heartbeat.get("swap_retrieval_index"),
         "inflight": heartbeat.get("inflight"),
+        # span-ring pressure: a stitched trace missing spans is
+        # diagnosable only if drops are visible per replica
+        "spans_dropped": heartbeat.get("spans_dropped"),
+        "span_ring_high_water": heartbeat.get("span_ring_high_water"),
     }
 
 

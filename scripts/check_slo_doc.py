@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Static SLO documentation check (tier-1 via tests/test_slo_doc.py) —
+the sibling of check_metrics_doc.py / check_knobs_doc.py for the SLO
+surface.
+
+Every objective the engine can declare (obs/slo.py
+`objectives_from_config` — `SloObjective(name="...")` with a literal
+name) must have a row in the README's SLO reference (the table between
+the `<!-- slo-table:begin -->` / `<!-- slo-table:end -->` markers in
+the "SLO & history" section), and every SLO named in that table must
+still be declared — a new objective cannot ship undocumented, and the
+table cannot rot as objectives are renamed away.
+
+The walk also cross-checks the alert severities: every severity in
+`BURN_WINDOWS` must appear (backticked) inside the marked section, so
+the burn-rate windows table cannot silently drift from the engine.
+
+Names are extracted by AST walk; a non-literal `name=` in an
+`SloObjective(...)` call is an ERROR — a dynamically-named objective
+cannot be statically checked.
+
+Usage: python scripts/check_slo_doc.py  (exit 0 = consistent)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLO_PATH = os.path.join(REPO_ROOT, "code2vec_tpu", "obs", "slo.py")
+README = os.path.join(REPO_ROOT, "README.md")
+
+BEGIN_MARKER = "<!-- slo-table:begin -->"
+END_MARKER = "<!-- slo-table:end -->"
+
+# the SLO name is the FIRST cell of a table row — backticked names
+# elsewhere in a row are cross-references, not declarations
+_TABLE_SLO_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`", re.MULTILINE)
+
+
+def _literal(node) -> object:
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def declared_slos() -> Set[str]:
+    """Literal `name=` values of every SloObjective(...) call in
+    obs/slo.py. Raises SystemExit on a non-literal name."""
+    with open(SLO_PATH) as f:
+        tree = ast.parse(f.read(), filename=SLO_PATH)
+    names: Set[str] = set()
+    errors: List[str] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "SloObjective"):
+            continue
+        name = None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name = _literal(kw.value)
+        if node.args:  # positional name
+            name = _literal(node.args[0])
+        if not isinstance(name, str):
+            errors.append(
+                f"obs/slo.py:{node.lineno}: non-literal name in "
+                f"SloObjective(...) — objective names must be string "
+                f"literals for the doc check to see them")
+            continue
+        names.add(name)
+    if errors:
+        raise SystemExit("\n".join(errors))
+    if not names:
+        raise SystemExit(
+            "obs/slo.py: no SloObjective(name=...) declarations found "
+            "— did the construction site move out of AST reach?")
+    return names
+
+
+def declared_severities() -> Set[str]:
+    """First element of every BURN_WINDOWS tuple, by AST."""
+    with open(SLO_PATH) as f:
+        tree = ast.parse(f.read(), filename=SLO_PATH)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and node.targets
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "BURN_WINDOWS"):
+            continue
+        severities: Set[str] = set()
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if (isinstance(elt, (ast.Tuple, ast.List)) and elt.elts
+                        and isinstance(_literal(elt.elts[0]), str)):
+                    severities.add(_literal(elt.elts[0]))
+        if severities:
+            return severities
+    raise SystemExit("obs/slo.py: no literal BURN_WINDOWS tuple found")
+
+
+def _marked_section() -> str:
+    with open(README) as f:
+        text = f.read()
+    try:
+        begin = text.index(BEGIN_MARKER) + len(BEGIN_MARKER)
+        end = text.index(END_MARKER, begin)
+    except ValueError:
+        raise SystemExit(
+            f"README.md is missing the {BEGIN_MARKER} / {END_MARKER} "
+            f"markers around the SLO reference table (README "
+            f"'SLO & history')")
+    return text[begin:end]
+
+
+def documented_slos() -> Set[str]:
+    return set(_TABLE_SLO_RE.findall(_marked_section()))
+
+
+def check() -> List[str]:
+    """Returns a list of problems (empty = consistent)."""
+    declared = declared_slos()
+    severities = declared_severities()
+    # the burn-windows table lives inside the same markers and its
+    # first cell is the severity — not a stale objective
+    documented = documented_slos() - severities
+    section = _marked_section()
+    problems: List[str] = []
+    for name in sorted(declared - documented):
+        problems.append(
+            f"UNDOCUMENTED: SLO {name!r} (obs/slo.py "
+            f"objectives_from_config) is missing from the README SLO "
+            f"reference table")
+    for name in sorted(documented - declared):
+        problems.append(
+            f"STALE DOC: SLO {name!r} appears in the README SLO "
+            f"reference table but is not declared in obs/slo.py")
+    for severity in sorted(severities):
+        if f"`{severity}`" not in section:
+            problems.append(
+                f"UNDOCUMENTED: burn-rate severity {severity!r} "
+                f"(obs/slo.py BURN_WINDOWS) is not mentioned in the "
+                f"README SLO section")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} SLO-documentation problem(s). "
+              f"Update the README 'SLO & history' table (between the "
+              f"slo-table markers).")
+        return 1
+    print(f"OK: {len(declared_slos())} SLO objective(s) and "
+          f"{len(declared_severities())} severity(ies) all documented, "
+          f"no stale table entries.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -376,15 +376,31 @@ def _view(requests, sheds, desired=2):
                           "requests_shed_total": sheds}]}
 
 
+def _scale_metrics(requests, sheds):
+    """The host /metrics slice the autoscaler's tsdb window reads."""
+    return (
+        "# TYPE serving_requests_total counter\n"
+        f'serving_requests_total{{endpoint="/predict",status="200"}}'
+        f" {requests}\n"
+        "# TYPE serving_requests_shed_total counter\n"
+        f"serving_requests_shed_total {sheds}\n")
+
+
+def _scale_ticker(control, host, now):
+    def tick(requests, sheds):
+        host.view = _view(requests, sheds)
+        control.tsdb.append(
+            {f"host:{host.id}": _scale_metrics(requests, sheds)},
+            now=now[0])
+        control._scale_tick(host, now[0])
+        now[0] += 1.0
+    return tick
+
+
 def test_scale_up_needs_consecutive_ticks_and_respects_max(tmp_path):
     config = _scale_config()
     control, host, posts = _policy_control(tmp_path, config)
-    now = [100.0]
-
-    def tick(requests, sheds):
-        host.view = _view(requests, sheds)
-        control._scale_tick(host, now[0])
-        now[0] += 1.0
+    tick = _scale_ticker(control, host, [100.0])
 
     tick(100, 0)        # seed the window
     tick(200, 50)       # shed_rate 0.5 -> up_tick 1: hysteresis holds
@@ -402,12 +418,7 @@ def test_scale_up_needs_consecutive_ticks_and_respects_max(tmp_path):
 def test_scale_up_blocked_by_cooldown_then_idle_scales_down(tmp_path):
     config = _scale_config(fleet_scale_cooldown_s=3600.0)
     control, host, posts = _policy_control(tmp_path, config)
-    now = [100.0]
-
-    def tick(requests, sheds):
-        host.view = _view(requests, sheds)
-        control._scale_tick(host, now[0])
-        now[0] += 1.0
+    tick = _scale_ticker(control, host, [100.0])
 
     tick(100, 0)
     tick(200, 50)
@@ -431,18 +442,24 @@ def test_scale_up_blocked_by_cooldown_then_idle_scales_down(tmp_path):
     assert posts[-1][2] == {"replicas": 2}  # no action below the floor
 
 
-def test_scale_window_reseeds_after_replica_restart(tmp_path):
-    """A replica restart zeroes its counters; the next tick must
-    reseed the window, not read a huge negative delta as idle."""
+def test_scale_window_survives_replica_restart(tmp_path):
+    """A replica restart zeroes its counters mid-window. The tsdb's
+    reset-aware increase (telemetry.counter_delta) reads the
+    post-restart values as growth IN FULL — never a negative delta,
+    never a phantom idle tick, and no lost decision tick."""
     config = _scale_config()
     control, host, posts = _policy_control(tmp_path, config)
-    host.view = _view(1000, 0)
-    control._scale_tick(host, 100.0)
-    host.view = _view(50, 10)   # counters went BACKWARD (restart)
-    control._scale_tick(host, 101.0)
-    assert host.idle_ticks == 0 and host.up_ticks == 0
-    assert posts == []
-    assert host.prev_requests == 50
+    tick = _scale_ticker(control, host, [100.0])
+    tick(1000, 0)
+    tick(50, 10)   # counters went BACKWARD (restart)
+    # 50 post-restart requests, 10 shed -> a real over-threshold tick
+    assert host.idle_ticks == 0 and host.up_ticks == 1
+    assert posts == []  # hysteresis still holds at 1 tick
+    # and the boot tick itself never reads as idle
+    control2, host2, posts2 = _policy_control(tmp_path / "b", config)
+    tick2 = _scale_ticker(control2, host2, [100.0])
+    tick2(100, 0)
+    assert host2.idle_ticks == 0 and posts2 == []
 
 
 # ------------------------------------------------ swap driver (stub)
@@ -482,9 +499,11 @@ class _SwapControl:
     def swap_hosts(self, model):
         return list(self.hosts) if model == "default" else None
 
-    def host_reload(self, host, artifact, retrieval_index=None):
+    def host_reload(self, host, artifact, retrieval_index=None,
+                    traceparent=None):
         host.apply_reload(artifact)
         host.retrieval_index = retrieval_index
+        host.reload_traceparent = traceparent
         return True, ""
 
     def host_fleet(self, host):
@@ -1053,11 +1072,25 @@ class _FakeDeadline:
         return self._vals.pop(0) if self._vals else 0.0
 
 
+class _FakeSpan:
+    def __init__(self, attrs):
+        self.attrs = attrs
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
 class _FakeTrace:
     trace_id = "f" * 32
 
     def traceparent(self):
         return f"00-{self.trace_id}-{'b' * 16}-01"
+
+    def span(self, name, **attrs):
+        return _FakeSpan(attrs)
 
 
 def _run_forward(targets, deadline=None, **kw):

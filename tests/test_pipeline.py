@@ -697,7 +697,8 @@ def test_fleet_swap_driver_keys_on_retrieval_index(tmp_path):
         def swap_hosts(self, model):
             return [host]
 
-        def host_reload(self, h, artifact, retrieval_index=None):
+        def host_reload(self, h, artifact, retrieval_index=None,
+                        traceparent=None):
             # apply DELAYED: the window where the stale promote state
             # is all the driver can see
             def later():

@@ -1,0 +1,67 @@
+"""Tier-1 gate for scripts/check_slo_doc.py: every SLO objective the
+engine declares (obs/slo.py objectives_from_config) must have a row in
+the README SLO reference table and vice versa, and every BURN_WINDOWS
+severity must be mentioned in the marked section — a new objective
+cannot ship undocumented, and the table cannot keep objectives the
+engine dropped."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO_ROOT, "scripts", "check_slo_doc.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_slo_doc",
+                                                  CHECKER)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_every_declared_slo_is_documented_and_vice_versa():
+    checker = _load_checker()
+    problems = checker.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_extracts_the_objective_and_severity_sets():
+    """The AST walk must actually see the engine: the two shipped
+    objectives and the two burn severities, so a silently-broken walk
+    cannot turn the doc check vacuous."""
+    checker = _load_checker()
+    assert {"availability", "latency"} <= checker.declared_slos()
+    assert checker.declared_severities() == {"page", "ticket"}
+
+
+def test_checker_flags_undocumented_stale_and_missing_severity(
+        tmp_path, monkeypatch):
+    """The check fails in all three directions: a declared-but-
+    undocumented objective, a documented-but-undeclared one, and a
+    burn severity absent from the section."""
+    checker = _load_checker()
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "# x\n<!-- slo-table:begin -->\n"
+        "| `availability` | x | x |\n"
+        "| `made_up_slo` | x | x |\n"
+        "severities: `ticket`\n"
+        "<!-- slo-table:end -->\n")
+    monkeypatch.setattr(checker, "README", str(readme))
+    problems = checker.check()
+    assert any("UNDOCUMENTED: SLO 'latency'" in p for p in problems)
+    assert any("STALE DOC: SLO 'made_up_slo'" in p for p in problems)
+    assert any("severity 'page'" in p for p in problems)
+
+
+def test_checker_rejects_non_literal_objective_names(tmp_path,
+                                                     monkeypatch):
+    import pytest
+
+    checker = _load_checker()
+    slo = tmp_path / "slo.py"
+    slo.write_text('name = "dyn"\nSloObjective(name=name, target=0.9)\n')
+    monkeypatch.setattr(checker, "SLO_PATH", str(slo))
+    with pytest.raises(SystemExit, match="non-literal"):
+        checker.declared_slos()

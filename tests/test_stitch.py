@@ -1,0 +1,136 @@
+"""Cross-process trace stitching (obs/stitch.py): span files from
+several processes — each on its own perf_counter epoch and pid — come
+back as ONE wall-clock-rebased Chrome trace for a trace id, with the
+coalesced batch span shared into every member's trace and torn files
+skipped, not fatal."""
+
+import json
+import os
+
+import pytest
+
+from code2vec_tpu.obs import stitch
+
+TID = "a" * 32
+OTHER = "b" * 32
+
+
+def _trace_file(path, epoch_s, events, producer="proc"):
+    payload = {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": producer}},
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 7,
+             "args": {"name": "worker"}},
+        ] + events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_epoch_unix_s": epoch_s,
+                      "producer": producer},
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def _span(name, ts_us, dur_us, trace_id=TID, tid=7, **attrs):
+    return {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+            "pid": 1, "tid": tid,
+            "args": dict({"trace_id": trace_id, "span_id": "s" + name,
+                          "parent_id": None}, **attrs)}
+
+
+def test_stitch_rebases_onto_one_wall_clock_axis(tmp_path):
+    root = str(tmp_path)
+    # the router booted at epoch 1000 and forwarded at its local 5ms
+    # (wall 1000.005..1000.015); the replica booted at epoch 1000.006
+    # and handled at its local 1ms (wall 1000.007..1000.011) — on the
+    # wall clock the forward CONTAINS the handler
+    _trace_file(os.path.join(root, "router.trace.json"), 1000.0,
+                [_span("router.forward /predict", 5_000, 10_000),
+                 _span("noise", 0, 1, trace_id=OTHER)],
+                producer="router")
+    _trace_file(os.path.join(root, "run", "replica0.trace.json"),
+                1000.006,
+                [_span("request", 1_000, 4_000)], producer="replica")
+    out = stitch.stitch_dir(root, TID)
+    spans = [ev for ev in out["traceEvents"] if ev["ph"] == "X"]
+    assert [s["name"] for s in spans] == ["router.forward /predict",
+                                         "request"]
+    fwd, req = spans
+    # rebased: ts is wall-clock microseconds, and the hop nests
+    assert fwd["ts"] == pytest.approx(1000.0 * 1e6 + 5_000)
+    assert req["ts"] == pytest.approx(1000.006 * 1e6 + 1_000)
+    assert fwd["ts"] <= req["ts"]
+    assert req["ts"] + req["dur"] <= fwd["ts"] + fwd["dur"]
+    # one display lane per source file, labeled file · producer
+    assert fwd["pid"] != req["pid"]
+    names = {ev["args"]["name"] for ev in out["traceEvents"]
+             if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert "router.trace.json · router" in names
+    assert os.path.join("run", "replica0.trace.json") + " · replica" \
+        in names
+    other = out["otherData"]
+    assert other["trace_id"] == TID and other["spans"] == 2
+    assert {s["file"]: s["spans"] for s in other["sources"]} == {
+        "router.trace.json": 1,
+        os.path.join("run", "replica0.trace.json"): 1}
+
+
+def test_batch_span_is_shared_into_member_traces(tmp_path):
+    # the batcher records the coalesced device batch ONCE, with no
+    # trace id of its own — only the member list. It must appear in
+    # EVERY member's stitched trace.
+    root = str(tmp_path)
+    batch = {"name": "serving_batch", "ph": "X", "ts": 10, "dur": 5,
+             "pid": 1, "tid": 7,
+             "args": {"span_id": "sb", "parent_id": None,
+                      "member_trace_ids": [TID, OTHER]}}
+    _trace_file(os.path.join(root, "replica0.trace.json"), 0.0,
+                [_span("request", 0, 20), batch])
+    for tid in (TID, OTHER):
+        out = stitch.stitch_dir(root, tid)
+        kept = {ev["name"] for ev in out["traceEvents"]
+                if ev["ph"] == "X"}
+        assert "serving_batch" in kept
+    assert stitch.stitch_dir(root, "c" * 32)["otherData"]["spans"] == 0
+
+
+def test_torn_and_foreign_files_are_skipped_not_fatal(tmp_path):
+    root = str(tmp_path)
+    _trace_file(os.path.join(root, "ok.trace.json"), 0.0,
+                [_span("request", 0, 1)])
+    with open(os.path.join(root, "torn.trace.json"), "w") as f:
+        f.write('{"traceEvents": [half')
+    with open(os.path.join(root, "foreign.trace.json"), "w") as f:
+        json.dump({"not": "a trace"}, f)
+    out = stitch.stitch_dir(root, TID)
+    assert out["otherData"]["spans"] == 1
+    by_file = {s["file"]: s for s in out["otherData"]["sources"]}
+    assert by_file["torn.trace.json"]["error"] == "unreadable or torn"
+    assert by_file["foreign.trace.json"]["spans"] == 0
+    # a heartbeat json next to the traces is not a trace file at all
+    with open(os.path.join(root, "heartbeat.json"), "w") as f:
+        f.write("{}")
+    assert [os.path.basename(p) for p in stitch.trace_files(root)] == [
+        "foreign.trace.json", "ok.trace.json", "torn.trace.json"]
+
+
+def test_stitch_main_offline_dir_mode(tmp_path, capsys):
+    root = str(tmp_path)
+    _trace_file(os.path.join(root, "router.trace.json"), 0.0,
+                [_span("router.forward /predict", 0, 10)])
+
+    class Cfg:
+        fleet_trace_id = TID
+        fleet_trace_dir = root
+        fleet_control = ""
+
+    assert stitch.stitch_main(Cfg()) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["otherData"]["spans"] == 1
+    # unknown id: still a valid (empty) trace, rc 1 so scripts notice
+    Cfg.fleet_trace_id = "d" * 32
+    assert stitch.stitch_main(Cfg()) == 1
+    # neither a dir nor a control plane: usage error
+    Cfg.fleet_trace_dir = ""
+    assert stitch.stitch_main(Cfg()) == 2

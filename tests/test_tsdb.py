@@ -1,0 +1,373 @@
+"""Telemetry history store (obs/tsdb.py) + SLO burn-rate engine
+(obs/slo.py): segment-ring crash safety (torn segments skipped with a
+counter, never a 500), retention/size sweep bounds, query-equals-replay
+after restart, reset-aware range queries, and multi-window burn-rate
+alert semantics (latching, page -> flight dump, no fleet stop)."""
+
+import json
+import math
+import os
+
+import pytest
+
+from code2vec_tpu.obs import slo as slo_mod
+from code2vec_tpu.obs import tsdb as tsdb_mod
+from code2vec_tpu.obs.slo import SloEngine, SloObjective, count_below, \
+    objectives_from_config
+from code2vec_tpu.obs.tsdb import TsdbStore
+from code2vec_tpu.serving import telemetry
+
+
+def _requests_text(by_status, endpoint="/predict"):
+    lines = ["# TYPE serving_requests_total counter"]
+    for status, n in sorted(by_status.items()):
+        lines.append(
+            f'serving_requests_total{{endpoint="{endpoint}",'
+            f'status="{status}"}} {n}')
+    return "\n".join(lines) + "\n"
+
+
+def _latency_text(buckets, phase="total"):
+    lines = ["# TYPE serving_request_seconds histogram"]
+    for le, n in buckets.items():
+        lines.append(
+            f'serving_request_seconds_bucket{{le="{le}",'
+            f'phase="{phase}"}} {n}')
+    return "\n".join(lines) + "\n"
+
+
+def _store(tmp_path, **kw):
+    kw.setdefault("retention_s", 3600.0)
+    kw.setdefault("max_mb", 64.0)
+    return TsdbStore(str(tmp_path / "tsdb"), **kw)
+
+
+# ----------------------------------------------------------- queries
+
+
+def test_increase_rate_and_by_status_across_sources(tmp_path):
+    store = _store(tmp_path)
+    for i, t in enumerate((100.0, 110.0, 120.0)):
+        store.append({
+            "host:a": _requests_text({"200": 10.0 * (i + 1),
+                                      "500": 1.0 * i}),
+            "host:b": _requests_text({"200": 5.0 * (i + 1)}),
+        }, now=t)
+    # summed across sources; window defaults `now` to the last tick
+    assert store.increase("serving_requests_total",
+                          window_s=30.0) == pytest.approx(30.0 + 2.0)
+    assert store.rate("serving_requests_total",
+                      window_s=30.0) == pytest.approx(32.0 / 20.0)
+    by = store.increase_by("serving_requests_total", "status",
+                           window_s=30.0)
+    assert by == {"200": pytest.approx(30.0), "500": pytest.approx(2.0)}
+    # per-source filter
+    assert store.increase("serving_requests_total", window_s=30.0,
+                          source="host:b") == pytest.approx(10.0)
+    # label filter falls through to the sample labels
+    assert store.increase("serving_requests_total", window_s=30.0,
+                          status="500") == pytest.approx(2.0)
+
+
+def test_counter_reset_mid_window_counts_restart_in_full(tmp_path):
+    store = _store(tmp_path)
+    for t, v in ((100.0, 50.0), (110.0, 60.0), (120.0, 4.0)):
+        store.append({"host:a": _requests_text({"200": v})}, now=t)
+    # 50 -> 60 (+10) then restart to 4 (+4), never negative
+    assert store.increase("serving_requests_total",
+                          window_s=30.0) == pytest.approx(14.0)
+
+
+def test_windowed_quantile_and_buckets(tmp_path):
+    store = _store(tmp_path)
+    store.append({"host:a": _latency_text(
+        {"0.1": 0.0, "0.5": 0.0, "+Inf": 0.0})}, now=100.0)
+    store.append({"host:a": _latency_text(
+        {"0.1": 90.0, "0.5": 99.0, "+Inf": 100.0})}, now=110.0)
+    buckets = store.window_buckets("serving_request_seconds",
+                                   window_s=30.0, phase="total")
+    assert buckets == {"0.1": pytest.approx(90.0),
+                       "0.5": pytest.approx(99.0),
+                       "+Inf": pytest.approx(100.0)}
+    p50 = store.quantile("serving_request_seconds", 0.5,
+                         window_s=30.0, phase="total")
+    assert p50 is not None and p50 <= 0.1
+    # empty window holds no samples
+    assert store.quantile("serving_request_seconds", 0.5,
+                          window_s=30.0, now=10.0,
+                          phase="total") is None
+
+
+def test_quantile_from_buckets_inf_only_mass_is_inf():
+    # the hardened central helper: a histogram whose only populated
+    # bucket is +Inf has no finite bound — the honest read is +inf
+    # (trips any threshold), not None and not a made-up number
+    assert telemetry.quantile_from_buckets(
+        {"+Inf": 10.0}, None, 0.5) == math.inf
+    assert telemetry.quantile_from_buckets({}, None, 0.5) is None
+
+
+def test_query_range_ops_and_validation(tmp_path):
+    store = _store(tmp_path)
+    store.append({"host:a": _requests_text({"200": 0.0})}, now=100.0)
+    store.append({"host:a": _requests_text({"200": 30.0})}, now=130.0)
+    out = store.query_range({"op": "increase",
+                             "name": "serving_requests_total",
+                             "window": "60", "status": "200"})
+    assert out["value"] == pytest.approx(30.0)
+    assert out["labels"] == {"status": "200"}
+    out = store.query_range({"op": "rate",
+                             "name": "serving_requests_total"})
+    assert out["value"] == pytest.approx(1.0)
+    stats = store.query_range({"op": "stats"})["stats"]
+    assert stats["ticks"] == 2 and stats["torn_segments"] == 0
+    for bad in ({"op": "nope", "name": "x"},
+                {"op": "rate"},  # no name
+                {"op": "rate", "name": "x", "window": "abc"},
+                {"op": "quantile", "name": "x", "q": "abc"}):
+        with pytest.raises(ValueError):
+            store.query_range(bad)
+
+
+# ------------------------------------------------- crash-safe ring
+
+
+def test_segments_seal_and_query_equals_replay(tmp_path):
+    """The replay pin: reopen the dir cold and the SAME query returns
+    the SAME number (burn rates are reproducible after a control-plane
+    restart)."""
+    store = _store(tmp_path, ticks_per_segment=3)
+    for i in range(8):
+        store.append({"host:a": _requests_text(
+            {"200": 10.0 * i, "500": float(i)})}, now=100.0 + 10.0 * i)
+    segs = [p for _, p in store._segment_files()]
+    assert len(segs) == 3  # 3 + 3 + 2-tick head
+    want = store.increase("serving_requests_total", window_s=1000.0)
+    by_want = store.increase_by("serving_requests_total", "status",
+                                window_s=1000.0)
+    reopened = TsdbStore(store.dir)
+    assert reopened.stats()["ticks"] == 8
+    assert reopened.increase("serving_requests_total",
+                             window_s=1000.0) == pytest.approx(want)
+    assert reopened.increase_by(
+        "serving_requests_total", "status", window_s=1000.0
+    ) == {k: pytest.approx(v) for k, v in by_want.items()}
+
+
+def test_kill_at_every_boundary_never_500s(tmp_path):
+    """Crash drill: after every append, take the on-disk state as a
+    kill point, additionally tear the newest segment (truncate) or
+    drop in a stale tmp file, and prove a cold reopen (a) never
+    raises, (b) skips the torn segment with the counter, (c) still
+    answers queries from the surviving ticks."""
+    import shutil
+
+    src = _store(tmp_path, ticks_per_segment=2)
+    kill_points = []
+    for i in range(5):
+        src.append({"host:a": _requests_text({"200": float(i)})},
+                   now=100.0 + i)
+        point = tmp_path / f"kill{i}"
+        shutil.copytree(src.dir, str(point))
+        kill_points.append((i, point))
+    for i, point in kill_points:
+        # clean kill: rename is atomic, every appended tick survives
+        store = TsdbStore(str(point))
+        assert store.stats()["ticks"] == i + 1
+        assert store.torn_segments == 0
+        store.query_range({"op": "rate",
+                           "name": "serving_requests_total"})
+        # torn newest segment (half a write that dodged the rename
+        # protocol, e.g. disk corruption): skipped + counted, older
+        # sealed segments still serve
+        segs = sorted(p for p in os.listdir(str(point))
+                      if p.startswith("seg-"))
+        newest = os.path.join(str(point), segs[-1])
+        with open(newest, "r+") as f:
+            body = f.read()
+            f.seek(0)
+            f.truncate()
+            f.write(body[:max(1, len(body) // 2)])
+        # plus a stale tmp file from a kill mid-write
+        with open(os.path.join(str(point),
+                               "seg-99999999.json.tmp-123"), "w") as f:
+            f.write("{half")
+        store = TsdbStore(str(point))
+        assert store.torn_segments == 1
+        assert store.stats()["torn_segments"] == 1
+        # the torn segment's ticks are lost; every sealed one survives
+        assert store.stats()["ticks"] == (i + 1) - (i % 2 + 1)
+        store.query_range({"op": "increase",
+                           "name": "serving_requests_total"})
+        # the stale tmp file was swept
+        assert not [p for p in os.listdir(str(point)) if ".tmp-" in p]
+
+
+def test_foreign_and_schema_torn_segments_are_skipped(tmp_path):
+    store = _store(tmp_path)
+    store.append({"host:a": _requests_text({"200": 1.0})}, now=100.0)
+    # foreign format marker
+    with open(os.path.join(store.dir, "seg-00000099.json"), "w") as f:
+        json.dump({"format": "someone-elses", "ticks": []}, f)
+    # not even JSON
+    with open(os.path.join(store.dir, "seg-00000098.json"), "w") as f:
+        f.write("not json")
+    # foreign NAME is not a segment at all — untouched, uncounted
+    with open(os.path.join(store.dir, "notes.json"), "w") as f:
+        f.write("keep me")
+    reopened = TsdbStore(store.dir)
+    assert reopened.torn_segments == 2
+    assert reopened.stats()["ticks"] == 1
+    assert os.path.exists(os.path.join(store.dir, "notes.json"))
+
+
+def test_retention_sweep_prunes_old_sealed_segments(tmp_path):
+    store = _store(tmp_path, retention_s=50.0, ticks_per_segment=2)
+    for i in range(6):
+        store.append({"host:a": _requests_text({"200": float(i)})},
+                     now=100.0 + 20.0 * i)
+    # now=200; cutoff=150 — ticks 100,120,140 (the first two sealed
+    # segments' newest ticks are 120 and 160) -> first segment pruned
+    stats = store.stats()
+    assert stats["oldest_ts"] >= 150.0
+    files = store._segment_files()
+    assert all(seq >= 2 for seq, _ in files)
+    # in-memory window agrees with the sweep
+    assert store.series_len("serving_requests_total",
+                            window_s=1e9) == 3
+
+
+def test_size_sweep_evicts_oldest_but_never_the_head(tmp_path):
+    store = _store(tmp_path, max_mb=0.0005, ticks_per_segment=1)
+    pruned0 = tsdb_mod._c_pruned("size").value
+    for i in range(20):
+        store.append({"host:a": _requests_text({"200": float(i)})},
+                     now=100.0 + i)
+    files = store._segment_files()
+    assert files, "the head segment must never be evicted"
+    assert store._disk_bytes() <= 2 * store.max_bytes
+    assert tsdb_mod._c_pruned("size").value > pruned0
+    # newest segments survive, oldest were evicted (the head seals
+    # the moment it fills at ticks_per_segment=1, so the newest FILE
+    # is the just-sealed predecessor of the empty head sequence)
+    assert files[-1][0] >= store._head_seq - 1
+    assert files[0][0] > 1
+
+
+# -------------------------------------------------------- SLO engine
+
+
+class _Flight:
+    def __init__(self):
+        self.incidents = []
+
+    def incident(self, reason, immediate=False, **detail):
+        self.incidents.append((reason, immediate, detail))
+
+
+def _slo_tsdb(tmp_path, by_status_per_tick):
+    store = _store(tmp_path)
+    for i, by_status in enumerate(by_status_per_tick):
+        store.append({"host:a": _requests_text(by_status)},
+                     now=100.0 + 10.0 * i)
+    return store
+
+
+def test_slo_healthy_traffic_fires_nothing(tmp_path):
+    store = _slo_tsdb(tmp_path, [{"200": 100.0 * i} for i in range(4)])
+    flight = _Flight()
+    engine = SloEngine([SloObjective("availability", "availability",
+                                     0.99)], flight=flight)
+    results = engine.evaluate(store)
+    (avail,) = results
+    assert avail["slo"] == "availability"
+    assert avail["error_budget_remaining"] == pytest.approx(1.0)
+    assert all(not a["firing"] for a in avail["alerts"])
+    assert flight.incidents == []
+    assert engine.status()["objectives"] == results
+
+
+def test_slo_burn_pages_dumps_flight_and_latches(tmp_path):
+    # every request 5xx: error ratio 1.0 / budget 0.01 = 100x burn —
+    # over page (14.4x) AND ticket (6x) on both windows
+    store = _slo_tsdb(tmp_path,
+                      [{"500": 50.0 * i} for i in range(4)])
+    flight = _Flight()
+    logs = []
+    alerts0 = slo_mod._c_alerts("availability", "page").value
+    # 10s-apart ticks sit inside even the 5m short window at scale 1
+    engine = SloEngine([SloObjective("availability", "availability",
+                                     0.99)],
+                       flight=flight, log=logs.append)
+    (avail,) = engine.evaluate(store)
+    assert avail["error_budget_remaining"] < 0  # blown
+    by_sev = {a["severity"]: a for a in avail["alerts"]}
+    assert by_sev["page"]["firing"] and by_sev["ticket"]["firing"]
+    assert by_sev["page"]["burn_long"] == pytest.approx(100.0)
+    # page dumps the flight ring immediately — and ONLY page
+    assert [(r, imm) for r, imm, _ in flight.incidents] \
+        == [("slo_burn", True)]
+    assert flight.incidents[0][2]["severity"] == "page"
+    # latching: a second burning tick is the SAME alert
+    engine.evaluate(store)
+    assert slo_mod._c_alerts("availability",
+                             "page").value == alerts0 + 1
+    assert len(flight.incidents) == 1
+    assert len([m for m in logs if "page burn alert" in m]) == 1
+    # recovery resets the latch; a fresh burn counts again
+    healthy = _slo_tsdb(tmp_path / "h",
+                        [{"200": 100.0 * i} for i in range(4)])
+    engine.evaluate(healthy)
+    engine.evaluate(store)
+    assert slo_mod._c_alerts("availability",
+                             "page").value == alerts0 + 2
+
+
+def test_slo_latency_objective_reads_windowed_buckets(tmp_path):
+    store = _store(tmp_path)
+    store.append({"host:a": _latency_text(
+        {"0.1": 0.0, "+Inf": 0.0})}, now=100.0)
+    # 95% of requests over the 100ms threshold
+    store.append({"host:a": _latency_text(
+        {"0.1": 5.0, "+Inf": 100.0})}, now=110.0)
+    flight = _Flight()
+    # budget 0.05, error ratio 0.95 -> 19x burn, over the 14.4x page bar
+    engine = SloEngine([SloObjective("latency", "latency", 0.95,
+                                     threshold_ms=100.0)],
+                       flight=flight)
+    (lat,) = engine.evaluate(store)
+    assert lat["threshold_ms"] == 100.0
+    assert {a["severity"] for a in lat["alerts"]
+            if a["firing"]} == {"page", "ticket"}
+    assert flight.incidents
+    # no traffic burns no budget
+    empty = _store(tmp_path / "e")
+    (lat,) = engine.evaluate(empty)
+    assert lat["error_budget_remaining"] == pytest.approx(1.0)
+
+
+def test_count_below_edges():
+    buckets = {"0.1": 90.0, "0.5": 99.0, "+Inf": 100.0}
+    assert count_below(buckets, 0.1) == pytest.approx(90.0)
+    # interpolates inside a finite span
+    assert count_below(buckets, 0.3) == pytest.approx(94.5)
+    # the +Inf mass is never provably good
+    assert count_below(buckets, 10.0) == pytest.approx(99.0)
+    assert count_below({}, 0.1) == 0.0
+    assert count_below({"+Inf": 10.0}, 0.1) == 0.0
+
+
+def test_objectives_from_config_disables_and_validates():
+    class Cfg:
+        fleet_slo_availability = 0.999
+        fleet_slo_latency_target = 0.95
+        fleet_slo_latency_ms = 500.0
+
+    objs = {o.name: o for o in objectives_from_config(Cfg())}
+    assert set(objs) == {"availability", "latency"}
+    assert objs["latency"].threshold_ms == 500.0
+    Cfg.fleet_slo_availability = 0.0
+    Cfg.fleet_slo_latency_ms = 0.0
+    assert objectives_from_config(Cfg()) == []
+    with pytest.raises(ValueError, match="target"):
+        SloObjective("x", "availability", 1.0)
