@@ -62,6 +62,19 @@ def arguments_parser() -> ArgumentParser:
                         help="max milliseconds a request waits for "
                              "batch-mates before dispatching anyway "
                              "(default 10; 0 = no coalescing)")
+    parser.add_argument("--serve_continuous", action="store_true",
+                        default=None,
+                        help="continuous batching: admit arriving rows "
+                             "into the next device step of an already-"
+                             "forming slot (zero-copy parse into the "
+                             "slot buffer; a row arriving while a step "
+                             "is on device rides the NEXT step instead "
+                             "of opening a fresh delay window)")
+    parser.add_argument("--serve_inflight_steps", type=int, default=None,
+                        metavar="N",
+                        help="device steps the continuous batcher may "
+                             "keep in flight at once (default 2; only "
+                             "read with --serve_continuous)")
     parser.add_argument("--serve_buckets", default=None, metavar="LIST",
                         help="comma-separated padded-context-count "
                              "buckets for the predict path (default "
@@ -356,6 +369,16 @@ def arguments_parser() -> ArgumentParser:
                         metavar="N",
                         help="coarse-quantizer size of the MIPS head "
                              "(default 0 = sqrt(vocab) auto)")
+    parser.add_argument("--serve_mips_crossover", type=int, default=None,
+                        metavar="ROWS",
+                        help="batch-shape-aware head dispatch: device "
+                             "batches with at most ROWS live rows "
+                             "route to the MIPS head, bulk shapes to "
+                             "the exact blockwise head (default -1 = "
+                             "adopt the crossover calibrated at "
+                             "export, or all-MIPS for artifacts "
+                             "without one; 0 = exact-only bit-for-bit; "
+                             "requires --serve_mips_nprobe > 0)")
     parser.add_argument("--overlap_allreduce",
                         dest="overlap_grad_allreduce",
                         action="store_true", default=None,
@@ -711,6 +734,8 @@ def config_from_args(argv=None) -> Config:
                                       "serve_port", "serve_host",
                                       "serve_batch_size",
                                       "serve_max_delay_ms",
+                                      "serve_continuous",
+                                      "serve_inflight_steps",
                                       "serve_buckets",
                                       "serve_cache_entries",
                                       "extractor_pool_size",
@@ -760,6 +785,7 @@ def config_from_args(argv=None) -> Config:
                                       "release_scheme",
                                       "serve_mips_nprobe",
                                       "serve_mips_nlist",
+                                      "serve_mips_crossover",
                                       "overlap_grad_allreduce",
                                       "overlap_bucket_mb",
                                       "topk_block_size",

@@ -224,6 +224,18 @@ class Config:
     # batcher dispatches anyway: the latency price of coalescing on an
     # idle server (a busy server fills batches and never waits).
     serve_max_delay_ms: float = 10.0
+    # Continuous batching (serving/batcher.py ContinuousBatcher): admit
+    # newly-arrived rows into the next device step of an already-forming
+    # slot instead of collect-then-dispatch — a row arriving while a
+    # step is on device rides the NEXT step rather than opening a fresh
+    # delay window — and parse extractor output straight into the
+    # slot's padded (rows, contexts) buffer (zero-copy request path).
+    # An idle server behaves exactly like the classic batcher.
+    serve_continuous: bool = False
+    # Device steps the continuous batcher may keep in flight at once
+    # (worker threads; step N+1 launches as soon as step N's dispatch
+    # returns). Only read with --serve_continuous.
+    serve_inflight_steps: int = 2
     # Padded-context-count buckets for the predict path (comma list;
     # max_contexts is always appended, entries >= max_contexts or not
     # divisible by cp are dropped): every predict batch pads its context
@@ -461,6 +473,17 @@ class Config:
     serve_mips_nprobe: int = 0
     # Coarse-quantizer size of the MIPS head; 0 = sqrt(real vocab) auto.
     serve_mips_nlist: int = 0
+    # Batch-shape-aware exact/MIPS head dispatch (release/runtime.py):
+    # device batches with at most this many LIVE rows route to the MIPS
+    # head, bulk shapes to the exact blockwise head — the PR-14 residue
+    # (MIPS wins 10-56x single-row, loses at bulk) resolved per batch
+    # instead of per server. -1 = adopt the crossover the export
+    # calibration recorded in the artifact meta (mips_crossover), or
+    # legacy all-MIPS when the artifact carries none; 0 = exact-only,
+    # bit-for-bit identical to serving with nprobe 0; > 0 = explicit
+    # crossover row count. Requires serve_mips_nprobe > 0 to take
+    # effect (there is no MIPS head to dispatch to otherwise).
+    serve_mips_crossover: int = -1
     # Overlap the gradient all-reduce with the optimizer apply
     # (parallel/overlap.py): the train step splits into backward (no
     # cross-host reduce) + per-bucket all-reduce+Adam jits dispatched
@@ -1020,12 +1043,14 @@ class Config:
             raise ValueError(
                 "serve_mips_nlist must be >= 0 (0 = sqrt(vocab) auto).")
         if self.serve_mips_nprobe > 0:
-            if not (self.serve or self.predict):
+            if not (self.serve or self.predict
+                    or self.export_artifact_path):
                 raise ValueError(
                     "serve_mips_nprobe applies to serve/--predict (the "
-                    "prediction head); eval/embed always use the exact "
-                    "blockwise path, so the knob would be a silent "
-                    "no-op here.")
+                    "prediction head) and export (which calibrates and "
+                    "records the exact/MIPS crossover in the artifact "
+                    "meta); eval/embed always use the exact blockwise "
+                    "path, so the knob would be a silent no-op here.")
             if self.is_testing:
                 raise ValueError(
                     "--serve_mips_nprobe cannot be combined with "
@@ -1033,6 +1058,20 @@ class Config:
                     "exact blockwise head. Measure MIPS agreement and "
                     "speedup with experiments/quant_bench.py "
                     "(BENCH_QUANT.md) instead.")
+        if self.serve_mips_crossover < -1:
+            raise ValueError(
+                "serve_mips_crossover must be >= -1 (-1 = adopt the "
+                "artifact's calibrated crossover, 0 = exact-only, "
+                "> 0 = explicit crossover row count).")
+        if self.serve_mips_crossover > 0 and self.serve_mips_nprobe == 0:
+            raise ValueError(
+                "serve_mips_crossover > 0 requires serve_mips_nprobe "
+                "> 0: there is no MIPS head to dispatch small batches "
+                "to without an IVF probe budget.")
+        if self.serve_inflight_steps < 1:
+            raise ValueError(
+                "serve_inflight_steps must be >= 1 (device steps the "
+                "continuous batcher may keep in flight).")
         if self.overlap_bucket_mb <= 0:
             raise ValueError("overlap_bucket_mb must be > 0.")
         if self.overlap_grad_allreduce and self.use_sparse_embedding_update:

@@ -53,6 +53,16 @@ class ModelPredictionResults(NamedTuple):
     code_vector: Optional[np.ndarray] = None
 
 
+def _head_dispatch_counter(head: str):
+    """Per-head device-batch routing counter. A helper (not a module
+    global) because the label value is dynamic; the metric NAME stays a
+    literal for scripts/check_metrics_doc.py."""
+    return obs.counter(
+        "serving_head_dispatch_total",
+        "device predict batches routed per retrieval head "
+        "(head=exact|mips; batch-shape-aware dispatch)", head=head)
+
+
 class BucketedPredictMixin:
     """The bucketed predict path shared by the training facade and the
     release-artifact runtime (release/runtime.py): line parsing, context
@@ -251,27 +261,80 @@ class BucketedPredictMixin:
             results.extend(self._predict_chunk(lines, bs,
                                                with_code_vectors))
 
+    def alloc_predict_batch(self, batch_size: int):
+        """A reusable pad-filled slot buffer for the zero-copy serving
+        path (serving/batcher.py ContinuousBatcher): requests parse
+        straight into disjoint row ranges via `parse_lines_into` and
+        the whole buffer ships through `predict_parsed`."""
+        from code2vec_tpu.data.reader import empty_predict_batch
+        return empty_predict_batch(batch_size, self.config.max_contexts,
+                                   self.vocabs)
+
+    def parse_lines_into(self, lines: List[str], out, row_offset: int
+                         ) -> None:
+        """Parse extractor lines into `out`'s rows starting at
+        row_offset (zero-copy: no per-request RowBatch intermediate)."""
+        parse_context_lines(lines, self.vocabs, self.config.max_contexts,
+                            EstimatorAction.Predict, keep_strings=True,
+                            out=out, row_offset=row_offset)
+
+    def _dispatch_predict_step(self, n: int, bs: int, m: int):
+        """Pick the compiled step for a batch with n live rows ->
+        (step, padded_rows, head). The facade always pads to the full
+        serve batch and runs one head for every shape (MIPS when the
+        nprobe knob is on and the table is unsharded, exact otherwise);
+        ReleaseModel overrides this with batch-shape-aware exact/MIPS
+        dispatch. Every device batch increments
+        serving_head_dispatch_total{head} via the shared predict
+        path."""
+        head = "exact" if self._get_mips_topk() is None else "mips"
+        return self._get_bucketed_predict_step(bs, m), bs, head
+
     def _predict_chunk(self, lines: List[str], bs: int,
                        with_code_vectors: bool
                        ) -> List[ModelPredictionResults]:
-        config = self.config
-        from code2vec_tpu.data.reader import _pad_rows, slice_contexts
-        from code2vec_tpu.serving.batcher import bucket_for
-        chunk = parse_context_lines(lines, self.vocabs, config.max_contexts,
+        chunk = parse_context_lines(lines, self.vocabs,
+                                    self.config.max_contexts,
                                     EstimatorAction.Predict,
                                     keep_strings=True)
-        n = len(lines)
+        return self._predict_parsed(chunk, len(lines), bs,
+                                    with_code_vectors)
+
+    def predict_parsed(self, chunk, n: int,
+                       batch_size: Optional[int] = None,
+                       with_code_vectors: Optional[bool] = None
+                       ) -> List[ModelPredictionResults]:
+        """Predict over an ALREADY-PARSED RowBatch (first `n` rows are
+        live) — the zero-copy serving entry: the continuous batcher
+        hands the slot buffer straight here, skipping the line-parse
+        the classic path pays per coalesced batch."""
+        bs = int(batch_size or self._default_predict_batch_size())
+        if with_code_vectors is None:
+            with_code_vectors = self.config.export_code_vectors
+        return self._predict_parsed(chunk, n, bs, with_code_vectors)
+
+    def _predict_parsed(self, chunk, n: int, bs: int,
+                        with_code_vectors: bool
+                        ) -> List[ModelPredictionResults]:
+        from code2vec_tpu.data.reader import _pad_rows, slice_contexts
+        from code2vec_tpu.serving.batcher import bucket_for
         # Deepest VALID context column decides the bucket: the slice
-        # below only ever removes all-padding columns.
+        # below only ever removes all-padding columns. (Slot buffers
+        # keep unclaimed rows' masks zeroed, so pooled reuse cannot
+        # inflate the bucket.)
         any_valid_col = chunk.context_valid_mask.any(axis=0)
         deepest = (int(np.nonzero(any_valid_col)[0][-1]) + 1
                    if any_valid_col.any() else 1)
         m = bucket_for(deepest, self.context_buckets)
         chunk = slice_contexts(chunk, m)
-        # Pad the row count to the fixed serve batch size: row count and
+        step, padded_rows, head = self._dispatch_predict_step(n, bs, m)
+        _head_dispatch_counter(head).inc()
+        if chunk.target_index.shape[0] > padded_rows:
+            from code2vec_tpu.data.reader import truncate_rows
+            chunk = truncate_rows(chunk, padded_rows)
+        # Pad the row count to the step's fixed row shape: row count and
         # context bucket together fully determine the compiled shape.
-        padded = _pad_rows(chunk, bs)
-        step = self._get_bucketed_predict_step(bs, m)
+        padded = _pad_rows(chunk, padded_rows)
         arrays = device_put_batch(padded, self.mesh)
         out = self._call_predict_step(step, arrays)
         results: List[ModelPredictionResults] = []

@@ -28,6 +28,18 @@ agreement vs exact per nprobe is measured by experiments/quant_bench.py
 (BENCH_QUANT.md), with the tuned value documented as the smallest
 nprobe keeping agreement >= 0.99. nprobe = nlist searches every row and
 pins equality with the exact head in tests/test_quant.py.
+
+Head dispatch (PR 18): MIPS wins by an order of magnitude at single-row
+shapes but the exact blockwise head wins at bulk, where the candidate
+gather stops amortizing — so serving routes PER BATCH SHAPE. Batches
+with at most `--serve_mips_crossover` live rows take this head
+(compiled at the crossover row shape, small batches repad down);
+larger batches take the exact head at the serve shape. The default
+(-1) adopts the crossover the export calibration measured into the
+artifact meta (`mips_crossover`, see release/runtime.py:
+calibrate_mips_crossover) and falls back to legacy all-MIPS when the
+artifact predates calibration; `--serve_mips_crossover 0` disables the
+head entirely, bit-for-bit the nprobe=0 exact path.
 """
 
 from __future__ import annotations

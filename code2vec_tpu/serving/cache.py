@@ -51,7 +51,15 @@ def normalize_source(code: str) -> bytes:
 
 
 def cache_key(code: str, **knobs) -> str:
-    h = hashlib.blake2b(normalize_source(code), digest_size=16)
+    return cache_key_normalized(normalize_source(code), **knobs)
+
+
+def cache_key_normalized(normalized: bytes, **knobs) -> str:
+    """Key from an ALREADY-normalized source (one `normalize_source`
+    pass per request: the server reuses the same bytes for the initial
+    probe, the traffic-sampler key and the hot-swap re-key instead of
+    re-collapsing the whole body each time)."""
+    h = hashlib.blake2b(normalized, digest_size=16)
     for name in sorted(knobs):
         h.update(f"\x00{name}={knobs[name]}".encode())
     return h.hexdigest()

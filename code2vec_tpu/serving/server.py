@@ -101,9 +101,13 @@ from code2vec_tpu.serving.admission import (
     AdmissionController, Deadline, DeadlineExceeded, Shed,
     deadline_from_request, expired_counter, retry_after_seconds,
 )
-from code2vec_tpu.serving.batcher import DynamicBatcher
+from code2vec_tpu.serving.batcher import (
+    ContinuousBatcher, DynamicBatcher, StaleParse,
+)
 from code2vec_tpu.serving.breaker import CircuitBreaker
-from code2vec_tpu.serving.cache import PredictionCache, cache_key
+from code2vec_tpu.serving.cache import (
+    PredictionCache, cache_key_normalized, normalize_source,
+)
 from code2vec_tpu.serving.extractor_bridge import (
     ExtractionTimeout, ExtractorCrash,
 )
@@ -151,6 +155,62 @@ class _HTTPError(Exception):
         self.code = code
 
 
+class _ContinuousBackend:
+    """ContinuousBatcher's model adapter: the zero-copy slot path.
+
+    Every method reads the server's (model, fingerprint) reference
+    exactly once, so parse and predict each bind to one weights
+    generation; `predict_rows` refuses (StaleParse) when the slot's
+    parse-time fingerprint is no longer the live one — the batcher then
+    re-parses via `predict_lines` under the current model, preserving
+    one-fingerprint-per-batch across hot-swaps."""
+
+    def __init__(self, server: "PredictionServer"):
+        self._server = server
+
+    def supports_rows(self) -> bool:
+        """The CURRENT model exposes the zero-copy slot surface (the
+        facade and ReleaseModel both do via BucketedPredictMixin; a
+        swapped-in minimal model may not). Checked per submit, so
+        slots formed after a swap to a lines-only model degrade to the
+        predict_lines path instead of failing on a missing method."""
+        model, _ = self._server._model_ref
+        return (hasattr(model, "parse_lines_into")
+                and hasattr(model, "alloc_predict_batch")
+                and hasattr(model, "predict_parsed"))
+
+    def alloc(self, rows: int):
+        model, _ = self._server._model_ref
+        return model.alloc_predict_batch(rows)
+
+    def parse_into(self, lines, buffer, row_offset: int) -> str:
+        model, fp = self._server._model_ref
+        model.parse_lines_into(lines, buffer, row_offset)
+        return fp
+
+    def predict_rows(self, buffer, n_rows: int, fingerprint: str):
+        server = self._server
+        model, fp = server._model_ref
+        if fp != fingerprint:
+            raise StaleParse(
+                f"slot rows were parsed under fingerprint "
+                f"{fingerprint}; live model is {fp}")
+        server.device_breaker.check()
+        try:
+            results = model.predict_parsed(
+                buffer, n_rows,
+                batch_size=server.config.serve_batch_size,
+                with_code_vectors=True)
+        except BaseException:
+            server.device_breaker.record(ok=False)
+            raise
+        server.device_breaker.record(ok=True)
+        return [(r, fp) for r in results]
+
+    def predict_lines(self, lines):
+        return self._server._batched_predict(lines)
+
+
 class PredictionServer:
     """Owns the pool + batcher + cache + admission gate + breakers +
     swap manager around one (swappable) model.
@@ -177,11 +237,21 @@ class PredictionServer:
         # the SAME batches (a per-endpoint batcher would halve fill);
         # the step computes vectors anyway, the flag only materializes
         # them host-side, and _render decides per endpoint what ships.
-        self.batcher = DynamicBatcher(
-            self._batched_predict,
+        batcher_kw = dict(
             max_batch_rows=self.config.serve_batch_size,
             max_delay_s=self.config.serve_max_delay_ms / 1000.0,
             buckets=model.context_buckets)
+        if getattr(self.config, "serve_continuous", False):
+            # --serve_continuous: slot-reservation dispatcher + the
+            # zero-copy parse-into-slot path (batcher.ContinuousBatcher)
+            self.batcher = ContinuousBatcher(
+                self._batched_predict,
+                inflight_steps=getattr(self.config,
+                                       "serve_inflight_steps", 2),
+                backend=_ContinuousBackend(self), **batcher_kw)
+        else:
+            self.batcher = DynamicBatcher(self._batched_predict,
+                                          **batcher_kw)
         self.cache = PredictionCache(self.config.serve_cache_entries)
         self.topk = self.config.top_k_words_considered_during_prediction
         # Live-traffic sample for the continuous-training pipeline's
@@ -439,8 +509,11 @@ class PredictionServer:
             knobs = self._neighbor_knobs(params)
             knobs["index"] = self.retrieval.fingerprint
         model, fp = self._model_ref
-        key = cache_key(code, endpoint=endpoint, topk=self.topk,
-                        model=fp, **knobs)
+        # ONE normalization pass per request: the same bytes feed the
+        # cache probe here and the hot-swap re-key below.
+        normalized = normalize_source(code)
+        key = cache_key_normalized(normalized, endpoint=endpoint,
+                                   topk=self.topk, model=fp, **knobs)
         with trace.span("cache_lookup") as sp:
             cached = self.cache.get(key)
             sp.attrs["hit"] = cached is not None
@@ -488,8 +561,10 @@ class PredictionServer:
                 # the model was hot-swapped between our cache probe and
                 # the device batch: key the entry by the weights that
                 # actually computed it, never the stale fingerprint
-                key = cache_key(code, endpoint=endpoint, topk=self.topk,
-                                model=result_fp, **knobs)
+                key = cache_key_normalized(normalized,
+                                           endpoint=endpoint,
+                                           topk=self.topk,
+                                           model=result_fp, **knobs)
             self.cache.put(key, body)
             return body
         except Shed:
@@ -663,7 +738,11 @@ class PredictionServer:
                         "max_delay_ms":
                             self.batcher.max_delay_s * 1000.0,
                         "batches_dispatched":
-                            self.batcher.batches_dispatched},
+                            self.batcher.batches_dispatched,
+                        "continuous":
+                            isinstance(self.batcher, ContinuousBatcher),
+                        "inflight_rides":
+                            getattr(self.batcher, "rides", 0)},
             "cache": {"capacity": self.cache.capacity,
                       "entries": len(self.cache)},
             "admission": {

@@ -12,6 +12,8 @@
 # Usage: scripts/run_serving_bench.sh [extra args passed to the bench]
 #        scripts/run_serving_bench.sh resilience   # PR-9 overload +
 #        kill-replica scenarios -> results/serving_resilience.json
+#        scripts/run_serving_bench.sh mixed        # PR-18 continuous-
+#        batching + head-dispatch paired A/B -> results/serving_mixed.json
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."

@@ -1135,3 +1135,119 @@ def test_config_rejects_mips_misuse():
                test_data_path="x.c2v", serve_mips_nprobe=4).verify()
     Config(train_data_path_prefix="<t>", serve=True,
            serve_mips_nprobe=4).verify()
+
+
+@roofline
+def test_config_rejects_crossover_misuse():
+    with pytest.raises(ValueError, match="serve_mips_crossover"):
+        Config(train_data_path_prefix="<t>", serve=True,
+               serve_mips_nprobe=4, serve_mips_crossover=-2).verify()
+    with pytest.raises(ValueError, match="no MIPS head"):
+        Config(train_data_path_prefix="<t>", serve=True,
+               serve_mips_crossover=2).verify()  # nprobe unset
+    Config(train_data_path_prefix="<t>", serve=True,
+           serve_mips_nprobe=4, serve_mips_crossover=2).verify()
+    # 0 (exact-only) is legal with or without a probe budget
+    Config(train_data_path_prefix="<t>", serve=True,
+           serve_mips_nprobe=4, serve_mips_crossover=0).verify()
+
+
+@roofline
+def test_release_hybrid_dispatch_parity_at_crossover(exported):
+    """Per-batch-shape head dispatch at the crossover boundary: with
+    --serve_mips_crossover 1 a single-row predict routes to the MIPS
+    head compiled at the crossover shape while a bulk predict takes the
+    exact blockwise head at the serve shape — and at full probe both
+    sides of the boundary must agree with the exact-only model (the
+    PR-14 agreement bar is exact equality at nprobe = nlist)."""
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, meta = exported
+    single = ["name|x1 tok1,p1,tok1 tok2,p2,tok2" + " " * 14]
+    bulk = ["name|x1 tok1,p1,tok1" + " " * 15,
+            "name|x2 tok3,p3,tok3" + " " * 15,
+            "name|x3 tok1,p2,tok2" + " " * 15]
+    cfg = dataclasses.replace(model.config, train_data_path_prefix=None,
+                              model_load_path=None,
+                              serve_artifact=art_dir)
+    exact = ReleaseModel(cfg, log=lambda m: None)
+    hybrid_cfg = dataclasses.replace(cfg, serve_mips_nprobe=10_000,
+                                     serve_mips_nlist=8,
+                                     serve_mips_crossover=1)
+    rm = ReleaseModel(hybrid_cfg, log=lambda m: None)
+    assert rm.mips_rows == 1 and not rm._mips_all
+    # hybrid keeps the original-order table device-resident: the exact
+    # head serves every bulk batch (all-MIPS skips it)
+    assert "target_embedding" in rm.params
+    for mine, ref in zip(rm.predict(single), exact.predict(single)):
+        assert mine.topk_predicted_words == ref.topk_predicted_words
+        np.testing.assert_allclose(mine.topk_predicted_words_scores,
+                                   ref.topk_predicted_words_scores,
+                                   rtol=1e-4)
+    # the single row compiled/ran the MIPS step at the crossover shape,
+    # cached apart from the exact serve-shape steps
+    assert rm._mips_predict_steps and \
+        all(rows == 1 for rows, _ in rm._mips_predict_steps)
+    for mine, ref in zip(rm.predict(bulk), exact.predict(bulk)):
+        assert mine.topk_predicted_words == ref.topk_predicted_words
+        np.testing.assert_allclose(mine.topk_predicted_words_scores,
+                                   ref.topk_predicted_words_scores,
+                                   rtol=1e-4)
+    assert all(rows == int(meta["serve_batch_size"])
+               for rows, _ in rm._predict_steps)
+
+
+@roofline
+def test_release_crossover_zero_restores_exact_bitforbit(exported):
+    """--serve_mips_crossover 0 with a probe budget set must be
+    bit-for-bit the nprobe=0 path: no head built, no reordered device
+    copy, byte-identical scores."""
+    from code2vec_tpu.release.runtime import ReleaseModel
+    model, art_dir, _ = exported
+    lines = ["name|x1 tok1,p1,tok1 tok2,p2,tok2" + " " * 14,
+             "name|x2 tok3,p3,tok3" + " " * 15]
+    cfg = dataclasses.replace(model.config, train_data_path_prefix=None,
+                              model_load_path=None,
+                              serve_artifact=art_dir)
+    exact = ReleaseModel(cfg, log=lambda m: None)
+    off = dataclasses.replace(cfg, serve_mips_nprobe=4,
+                              serve_mips_nlist=8, serve_mips_crossover=0)
+    rm = ReleaseModel(off, log=lambda m: None)
+    assert rm.mips_head is None and rm._mips_step is None
+    assert rm.mips_rows == 0 and not rm._mips_all
+    assert "target_embedding" in rm.params
+    for mine, ref in zip(rm.predict(lines), exact.predict(lines)):
+        assert mine.topk_predicted_words == ref.topk_predicted_words
+        np.testing.assert_array_equal(
+            np.asarray(mine.topk_predicted_words_scores),
+            np.asarray(ref.topk_predicted_words_scores))
+
+
+@roofline
+def test_export_calibration_records_crossover(tmp_path):
+    """An exporter configured with a MIPS head runs the head-crossover
+    calibration pass: meta gains mips_crossover (largest MIPS-winning
+    row count) + the timing table, on disk and in the returned dict —
+    and the content fingerprint is unchanged vs an uncalibrated export
+    of the same tables (the fingerprint core excludes calibration)."""
+    from code2vec_tpu.release.artifact import export_artifact
+    model = _tiny_model(tmp_path)
+    plain = export_artifact(model, str(tmp_path / "plain"), aot=False,
+                            log=lambda m: None)
+    assert "mips_crossover" not in plain
+    old_cfg = model.config
+    model.config = dataclasses.replace(old_cfg, serve_mips_nprobe=4,
+                                       serve_mips_nlist=4)
+    try:
+        cal = export_artifact(model, str(tmp_path / "cal"), aot=False,
+                              log=lambda m: None)
+    finally:
+        model.config = old_cfg
+    assert isinstance(cal["mips_crossover"], int)
+    assert 0 <= cal["mips_crossover"] <= int(cal["serve_batch_size"])
+    assert cal["mips_calibration"]
+    for timing in cal["mips_calibration"].values():
+        assert set(timing) == {"exact", "mips"}
+    assert cal["fingerprint"] == plain["fingerprint"]
+    with open(os.path.join(tmp_path, "cal", "release_meta.json")) as f:
+        on_disk = json.load(f)
+    assert on_disk["mips_crossover"] == cal["mips_crossover"]

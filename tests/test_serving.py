@@ -333,6 +333,228 @@ def test_batcher_error_propagates_and_drain_refuses():
         f2.result(timeout=10)
 
 
+def test_device_time_tracker_caches_sorted_view():
+    """p95 runs per admission, samples land per batch: the sorted view
+    must be cached between records (O(1) no-new-sample path) and
+    invalidated by record()."""
+    from code2vec_tpu.serving.batcher import _DeviceTimeTracker
+    tr = _DeviceTimeTracker()
+    for v in (0.4, 0.1, 0.3, 0.2):
+        tr.record(7, v)
+    assert tr.p95(7) == 0.4
+    cached = tr._sorted[7]
+    assert tr.p95(7) == 0.4
+    assert tr._sorted[7] is cached, "no-new-sample path re-sorted"
+    tr.record(7, 0.05)
+    assert 7 not in tr._sorted, "record() must invalidate the view"
+    assert tr.p95(7) == 0.4
+    assert tr._sorted[7] is not cached
+
+
+def test_batch_span_attrs_shared_and_thread_count_stable():
+    """The dispatch thread builds ONE batch-span attrs dict per batch —
+    every member trace holds the same object by reference, not a
+    per-member dict construction; and the classic batcher runs exactly
+    one dispatcher thread."""
+    from code2vec_tpu.obs.reqtrace import RequestTrace
+    from code2vec_tpu.serving.batcher import DynamicBatcher
+    before = threading.active_count()
+    batcher = DynamicBatcher(lambda lines: [l for l in lines],
+                             max_batch_rows=3, max_delay_s=2.0)
+    assert threading.active_count() == before + 1
+    traces = [RequestTrace() for _ in range(3)]
+    futures = []
+
+    def submit(i):
+        futures.append(batcher.submit([f"line{i}"], trace=traces[i]))
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for f in list(futures):
+        f.result(timeout=10)
+    assert batcher.batches_dispatched == 1
+    batch_attrs = [attrs for tr in traces
+                   for (name, _, _, _, _, attrs) in tr._spans
+                   if name == "batch"]
+    assert len(batch_attrs) == 3
+    assert batch_attrs[0] is batch_attrs[1] is batch_attrs[2], \
+        "batch-span attrs must be one shared dict per batch"
+    assert batch_attrs[0]["requests"] == 3
+    batcher.drain()
+
+
+# ------------------------------------------------ continuous batcher
+
+
+def test_continuous_row_rides_step_n_plus_1():
+    """A row admitted while step N is on device rides step N+1 the
+    moment the worker frees — never a fresh max_delay_s window, never
+    step N+2 when a slot is free."""
+    from code2vec_tpu.serving.batcher import ContinuousBatcher
+    calls = []
+
+    def predict(lines):
+        calls.append(list(lines))
+        time.sleep(0.25)
+        return [l.upper() for l in lines]
+
+    batcher = ContinuousBatcher(predict, max_batch_rows=4,
+                                max_delay_s=2.0, inflight_steps=1)
+    # four rows fill the slot -> step N dispatches immediately
+    f1 = batcher.submit(["a1", "a2", "a3", "a4"])
+    time.sleep(0.1)                      # step N is on device now
+    t0 = time.perf_counter()
+    f2 = batcher.submit(["b"])           # admitted mid-step-N
+    assert f1.result(timeout=10) == ["A1", "A2", "A3", "A4"]
+    assert f2.result(timeout=10) == ["B"]
+    waited = time.perf_counter() - t0
+    # rode step N+1 (~0.15s left of N + 0.25s of N+1) instead of
+    # opening a fresh 2s delay window or waiting for step N+2
+    assert waited < 1.0, waited
+    assert batcher.batches_dispatched == 2
+    assert calls == [["a1", "a2", "a3", "a4"], ["b"]]
+    assert batcher.rides == 1
+    batcher.drain()
+
+
+def test_continuous_refusal_against_inflight_eta():
+    """Deadline-infeasible refusal is re-expressed against the
+    in-flight step's ETA: a budget that covers the bucket p95 alone but
+    NOT eta + p95 is refused while a step occupies the only worker, and
+    admitted once the worker is free."""
+    from code2vec_tpu.serving.admission import (
+        Deadline, DeadlineInfeasible,
+    )
+    from code2vec_tpu.serving.batcher import ContinuousBatcher
+    release = threading.Event()
+
+    def predict(lines):
+        release.wait(10)
+        return list(lines)
+
+    batcher = ContinuousBatcher(predict, max_batch_rows=1,
+                                max_delay_s=0.0, inflight_steps=1)
+    for _ in range(4):
+        batcher.device_times.record(None, 0.5)   # p95 = 0.5s
+    f1 = batcher.submit(["x"])                   # occupies the worker
+    deadline_waited = time.perf_counter() + 2.0
+    while batcher._inflight == 0:
+        assert time.perf_counter() < deadline_waited
+        time.sleep(0.005)
+    # 0.8s budget > p95 0.5s (the classic check would admit), but the
+    # in-flight step needs ~0.5s more before a worker frees: refused.
+    f2 = batcher.submit(["y"], deadline=Deadline(0.8))
+    with pytest.raises(DeadlineInfeasible):
+        f2.result(timeout=5)
+    release.set()
+    f1.result(timeout=10)
+    while batcher._inflight:
+        time.sleep(0.005)
+    # worker free -> eta 0 -> the same budget is feasible again
+    f3 = batcher.submit(["z"], deadline=Deadline(0.8))
+    assert f3.result(timeout=10) == ["z"]
+    batcher.drain()
+
+
+def test_continuous_drain_flushes_partial_slot():
+    from code2vec_tpu.serving.batcher import ContinuousBatcher
+    batcher = ContinuousBatcher(lambda lines: [l * 2 for l in lines],
+                                max_batch_rows=100, max_delay_s=30.0)
+    f = batcher.submit(["q"])
+    batcher.drain(timeout=10)
+    assert f.result(timeout=1) == ["qq"]
+    f2 = batcher.submit(["z"])
+    with pytest.raises(RuntimeError, match="draining"):
+        f2.result(timeout=5)
+
+
+def test_continuous_serial_client_byte_identical(served_model,
+                                                 fake_extractor,
+                                                 tmp_path):
+    """For a serial client (no concurrency, so continuous batching has
+    nothing to chain) the zero-copy slot path must answer byte-for-byte
+    what collect-then-dispatch answers."""
+    import dataclasses
+    from code2vec_tpu.serving.server import PredictionServer
+    codes = [
+        "class A { int f(int n) { return n; } } NCTX2",
+        "class B { int g() { return 2; } int h() { return 3; } NCTX5 }",
+        "class C { void noop() { } } NCTX1",
+    ]
+    classic = PredictionServer(served_model, served_model.config,
+                               log=lambda m: None)
+    continuous = PredictionServer(
+        served_model,
+        dataclasses.replace(served_model.config, serve_continuous=True,
+                            serve_inflight_steps=2),
+        log=lambda m: None)
+    try:
+        from code2vec_tpu.serving.batcher import ContinuousBatcher
+        assert isinstance(continuous.batcher, ContinuousBatcher)
+        assert not isinstance(classic.batcher, ContinuousBatcher)
+        for endpoint in ("predict", "embed"):
+            for code in codes:
+                s1, b1, _ = classic.handle_request(endpoint, code)
+                s2, b2, _ = continuous.handle_request(endpoint, code)
+                assert (s1, s2) == (200, 200)
+                assert b1 == b2, (endpoint, code)
+        # the continuous arm really took the zero-copy rows path: its
+        # batches dispatched without a single lines-mode fallback
+        assert continuous.batcher.batches_dispatched >= len(codes)
+    finally:
+        classic.drain(timeout=10)
+        continuous.drain(timeout=10)
+
+
+def test_continuous_stale_parse_falls_back_to_lines_path():
+    """A slot whose rows were parsed under a fingerprint that is no
+    longer live (the model hot-swapped between parse and dispatch) must
+    be re-dispatched through predict_lines under the CURRENT model —
+    results settle normally, every response from one batch carries one
+    fingerprint, no error surfaces to the caller."""
+    from code2vec_tpu.serving.batcher import ContinuousBatcher, StaleParse
+
+    calls = {"rows": 0, "lines": 0}
+
+    class _Buf:
+        def __init__(self, rows):
+            self.context_valid_mask = np.zeros((rows, 4), np.float32)
+            self.example_valid = np.zeros((rows,), bool)
+
+    class _Backend:
+        def supports_rows(self):
+            return True
+
+        def alloc(self, rows):
+            return _Buf(rows)
+
+        def parse_into(self, lines, buffer, row_offset):
+            return "fpOLD"
+
+        def predict_rows(self, buffer, n_rows, fingerprint):
+            calls["rows"] += 1
+            raise StaleParse("model swapped after parse")
+
+        def predict_lines(self, lines):
+            calls["lines"] += 1
+            return [f"fpNEW:{ln}" for ln in lines]
+
+    b = ContinuousBatcher(max_batch_rows=4, max_delay_s=0.005,
+                          backend=_Backend(), inflight_steps=1)
+    try:
+        futs = [b.submit([f"l{i}"]) for i in range(2)]
+        out = [f.result(timeout=5) for f in futs]
+    finally:
+        b.drain(timeout=5)
+    assert calls["rows"] >= 1, "rows path never attempted"
+    assert calls["lines"] >= 1, "StaleParse did not fall back to lines"
+    assert out == [["fpNEW:l0"], ["fpNEW:l1"]]
+
+
 def test_parse_buckets_and_bucket_for():
     from code2vec_tpu.serving.batcher import bucket_for, parse_buckets
     assert parse_buckets("32,64,128", 200) == (32, 64, 128, 200)
