@@ -386,12 +386,21 @@ def arguments_parser() -> ArgumentParser:
                              "split the train step into backward + "
                              "per-bucket all-reduce+Adam dispatches so "
                              "communication overlaps the optimizer "
-                             "apply (dense GSPMD data-parallel only; "
+                             "apply (dense optimizer; dp meshes, or "
+                             "tp/cp with --manual_tp_kernels; "
                              "BENCH_ROOFLINE.md 'Roofline levers')")
     parser.add_argument("--overlap_bucket_mb", type=float, default=None,
                         metavar="MB",
                         help="target gradient-bucket size for "
                              "--overlap_allreduce (default 32)")
+    parser.add_argument("--overlap_in_backward",
+                        action="store_true", default=None,
+                        help="in-backward bucket completion for "
+                             "--overlap_allreduce: split the backward "
+                             "itself by bucket so bucket i's "
+                             "all-reduce+apply dispatches while bucket "
+                             "i+1's backward runs (costs one forward "
+                             "per extra bucket; BENCH_INPUT.md A/B)")
     parser.add_argument("--no_aot", action="store_true",
                         help="skip the jax.export AOT lowerings in the "
                              "exported artifact (consumers then always "
@@ -599,6 +608,23 @@ def arguments_parser() -> ArgumentParser:
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--no_packed_data", action="store_true",
                         help="stream text .c2v instead of packed .c2vb")
+    parser.add_argument("--train_corpus_manifest", metavar="FILE",
+                        default=None,
+                        help="train from a corpus manifest (JSON list "
+                             "of .c2vb shards — incumbent pack + delta "
+                             "shards) as one logical row space with "
+                             "the same epoch-keyed global shuffle as a "
+                             "single pack; build/grow it with the "
+                             "`corpus` subcommand (README 'Training at "
+                             "pod scale')")
+    parser.add_argument("--prefetch_double_buffer",
+                        action="store_true", default=None,
+                        help="double-buffer device transfers: issue "
+                             "batch N+1's device_put before handing "
+                             "batch N to the step loop, overlapping "
+                             "the transfer with step dispatch (one "
+                             "extra batch of device memory; watch "
+                             "train_input_bound_fraction)")
     parser.add_argument("--gspmd", action="store_true",
                         help="disable the manual shard_map TP kernels and "
                              "rely on GSPMD sharding propagation")
@@ -651,6 +677,24 @@ def arguments_parser() -> ArgumentParser:
                              "already consumed (cursor resume works on "
                              "any host count; see README 'Elastic "
                              "resume')")
+    parser.add_argument("--corpus_create", metavar="SHARD[,SHARD...]",
+                        default=None,
+                        help="(`corpus` subcommand) build a new "
+                             "manifest at --train_corpus_manifest over "
+                             "these .c2vb shards, in order (shard "
+                             "order defines global row ids); refuses "
+                             "mixed-vocab shard sets")
+    parser.add_argument("--corpus_add", metavar="SHARD", default=None,
+                        help="(`corpus` subcommand) append one .c2vb "
+                             "delta shard to the manifest — pure "
+                             "append, existing row ids stay stable; "
+                             "refused on vocab-fingerprint mismatch")
+    parser.add_argument("--corpus_validate", action="store_true",
+                        default=None,
+                        help="(`corpus` subcommand) re-read every "
+                             "listed shard's header/meta and fail on "
+                             "drift (row count changed, mixed vocab) "
+                             "instead of just printing the manifest")
     parser.add_argument("--preprocess_workers", type=int, default=0,
                         metavar="N",
                         help="host worker processes for the on-demand "
@@ -699,7 +743,7 @@ def config_from_args(argv=None) -> Config:
     # `index-build` and `export-embeddings` are the retrieval-stack
     # jobs (README "Retrieval").
     subcommands = ("serve", "fleet", "export", "embed", "index-build",
-                   "export-embeddings", "pipeline")
+                   "export-embeddings", "pipeline", "corpus")
     subcommand = argv[0] if argv and argv[0] in subcommands else None
     if subcommand:
         argv = argv[1:]
@@ -726,6 +770,12 @@ def config_from_args(argv=None) -> Config:
             "the `pipeline` subcommand requires --pipeline_dir DIR "
             "(plus --load CKPT, --pipeline_raw FILE, "
             "--pipeline_incumbent DIR and --test CORPUS)")
+    if subcommand == "corpus" and not args.train_corpus_manifest:
+        raise SystemExit(
+            "the `corpus` subcommand requires --train_corpus_manifest "
+            "FILE (plus --corpus_create/--corpus_add/--corpus_validate "
+            "for the mutation/check actions; plain `corpus` lists the "
+            "manifest)")
     knobs = {knob: value for knob in ("adam_mu_dtype", "adam_nu_dtype",
                                       "on_nonfinite_loss",
                                       "extractor_timeout_s",
@@ -788,6 +838,9 @@ def config_from_args(argv=None) -> Config:
                                       "serve_mips_crossover",
                                       "overlap_grad_allreduce",
                                       "overlap_bucket_mb",
+                                      "overlap_in_backward",
+                                      "prefetch_double_buffer",
+                                      "train_corpus_manifest",
                                       "topk_block_size",
                                       "embed_out", "embed_dtype",
                                       "embed_shard_rows",
@@ -823,6 +876,10 @@ def config_from_args(argv=None) -> Config:
         serve=args.serve or serve_subcommand,
         fleet=subcommand == "fleet",
         pipeline=subcommand == "pipeline",
+        corpus=subcommand == "corpus",
+        corpus_create=args.corpus_create,
+        corpus_add=args.corpus_add,
+        corpus_validate=bool(args.corpus_validate),
         model_save_path=args.save_path,
         model_load_path=args.load_path,
         train_data_path_prefix=args.data_path,
@@ -872,12 +929,52 @@ def config_from_args(argv=None) -> Config:
     return config
 
 
+def corpus_main(config) -> int:
+    """`corpus` subcommand: sharded-corpus manifest tooling. Never
+    builds a model — fingerprints come from the shards' own meta
+    sidecars, so the manifest can be managed on a machine that has no
+    vocabularies loaded."""
+    from code2vec_tpu.data import packed
+    manifest_path = config.train_corpus_manifest
+    try:
+        if config.corpus_create:
+            shards = [s for s in config.corpus_create.split(",") if s]
+            packed.create_manifest(manifest_path, shards)
+            config.log(f"created {manifest_path} "
+                       f"({len(shards)} shard(s))")
+        if config.corpus_add:
+            packed.append_manifest_shard(manifest_path, config.corpus_add)
+            config.log(f"appended {config.corpus_add} to {manifest_path}")
+        manifest = packed.load_manifest(manifest_path)
+        if config.corpus_validate:
+            reports = packed.validate_manifest(manifest_path)
+        else:
+            reports = manifest["shards"]
+    except (ValueError, OSError) as e:
+        config.log(f"corpus: {e}")
+        return 1
+    total = sum(r["rows"] for r in reports)
+    config.log(f"{manifest_path}: {len(reports)} shard(s), {total} rows, "
+               f"max_contexts={manifest['max_contexts']}, vocab "
+               f"fingerprint {manifest.get('vocab_fingerprint')}"
+               + (" [validated]" if config.corpus_validate else ""))
+    for r in reports:
+        config.log(f"  {r['path']}: {r['rows']} rows, "
+                   f"fingerprint={r.get('vocab_fingerprint')}")
+    return 0
+
+
 def main(argv=None) -> None:
     # dispatch mirrors reference code2vec.py:16-37
     if argv is None:
         argv = sys.argv[1:]
     config = config_from_args(argv)
     config.verify()
+
+    # Corpus manifest tooling: pure file-level job, no model, no
+    # distributed runtime (README "Training at pod scale").
+    if config.corpus:
+        sys.exit(corpus_main(config))
 
     # Continuous-training pipeline: the supervisor PARENT never builds
     # a model either — each stage re-execs this CLI (train/export/

@@ -177,6 +177,14 @@ class Config:
     dropout_prng_impl: str = "rbg"
     # Prefer the packed int32 binary sidecar (.c2vb) when present.
     use_packed_data: bool = True
+    # Train from a corpus MANIFEST (data/packed.py ShardedCorpus): a
+    # JSON file listing N .c2vb shards — the incumbent pack plus any
+    # continuous-training delta shards — presented as one logical row
+    # space with the same epoch-keyed global shuffle as a single pack
+    # (the PR-6 cursor laws hold verbatim across shard counts). Built
+    # and grown with the `corpus` subcommand / pipeline ingest stage.
+    # Overrides --data's packed file for training when set.
+    train_corpus_manifest: Optional[str] = None
     # Host worker processes for the offline data compile: the on-demand
     # .c2v -> .c2vb pack at training startup (model_facade) and the
     # fused raw-corpus compiler (data/preprocess.py compile_corpus).
@@ -186,6 +194,12 @@ class Config:
     preprocess_workers: int = 0
     # Number of batches the host pipeline keeps in flight ahead of device.
     prefetch_batches: int = 4
+    # Double-buffer device transfers (utils/prefetch.py): issue the
+    # device_put for batch N+1 before handing batch N to the step loop,
+    # so the N+1 transfer overlaps step N's dispatch instead of
+    # serializing after it. One extra batch of device memory; the
+    # train_input_bound_fraction gauge reads whether it pays off.
+    prefetch_double_buffer: bool = False
     # When set, a jax.profiler trace of train batches 10-20 is written
     # here (viewable in TensorBoard / Perfetto).
     profile_dir: Optional[str] = None
@@ -489,12 +503,24 @@ class Config:
     # cross-host reduce) + per-bucket all-reduce+Adam jits dispatched
     # back to back, so bucket i's apply overlaps bucket i+1's reduce
     # and the host never blocks on one monolithic step chain. Dense
-    # GSPMD data-parallel only (tp = cp = 1); measured at 2 hosts in
-    # BENCH_ROOFLINE.md "Roofline levers".
+    # optimizer only; data-parallel GSPMD meshes, or manual-kernel
+    # tp/cp meshes (--manual_tp_kernels — the manual forward runs per
+    # shard and the bucket reducers psum each leaf over exactly the
+    # axes it is replicated on). Measured at 2 hosts in
+    # BENCH_ROOFLINE.md "Roofline levers" and BENCH_INPUT.md.
     overlap_grad_allreduce: bool = False
     # Target bytes per gradient bucket, in MB (leaves bigger than one
     # bucket get their own).
     overlap_bucket_mb: float = 32.0
+    # True in-backward bucket completion (parallel/overlap.py): split
+    # the backward itself by bucket so bucket i's all-reduce + Adam
+    # apply dispatches while bucket i+1's backward is still running,
+    # instead of overlapping only the post-backward reduce chain.
+    # Costs one extra forward per bucket beyond the first (no
+    # cross-bucket activation reuse at the jit seam) — the
+    # input-bench A/B (BENCH_INPUT.md) records whether the overlap
+    # buys more than the recompute. Requires overlap_grad_allreduce.
+    overlap_in_backward: bool = False
     # Also AOT-export (jax.export) the bucketed serve functions into
     # the artifact, one per (serve_batch_size, context bucket) shape,
     # so a serving replica cold-starts from deserialized lowerings
@@ -560,6 +586,21 @@ class Config:
     # ingest delta -> fine-tune -> export -> shadow-eval -> canary
     # promote -> retrieval refresh, journaled per stage.
     pipeline: bool = False
+    # `corpus` subcommand: manifest tooling for the sharded training
+    # corpus (--train_corpus_manifest) — list shards, create a
+    # manifest, append a delta shard, validate shard headers and vocab
+    # fingerprints. Never builds a model.
+    corpus: bool = False
+    # Comma-separated .c2vb shard paths to build a new manifest from
+    # (`corpus --corpus_create`). Shard order defines global row ids.
+    corpus_create: Optional[str] = None
+    # One .c2vb delta shard to append to the manifest (`corpus
+    # --corpus_add`); refused on vocab-fingerprint mismatch.
+    corpus_add: Optional[str] = None
+    # Re-read every listed shard's header and meta and fail on any
+    # drift (rows changed, mixed vocab); plain `corpus` only prints
+    # the manifest.
+    corpus_validate: bool = False
     # Pipeline state root: journaled manifest, per-stage work dirs,
     # candidate checkpoint/artifact. One dir = one run; a killed run
     # rerun with the SAME inputs resumes from the last committed stage.
@@ -740,12 +781,13 @@ class Config:
         # reference: config.py:232-239, plus mesh-shape checks.
         if (not self.is_training and not self.is_loading
                 and not self.serve_artifact and not self.index_out
+                and not self.corpus
                 and not (self.fleet and self.fleet_models)
                 and not (self.fleet and self.fleet_trace_id)):
             raise ValueError(
                 "Must train or load a model (or serve a release "
-                "artifact via --artifact; `index-build` alone needs "
-                "no model; `fleet` may carry its models in "
+                "artifact via --artifact; `index-build` and `corpus` "
+                "alone need no model; `fleet` may carry its models in "
                 "--fleet_models; `fleet --fleet_trace_id` only "
                 "stitches trace files).")
         if self.is_loading and not os.path.isdir(self.model_load_dir):
@@ -1080,11 +1122,22 @@ class Config:
                 "--sparse_embedding_update: the sparse path already "
                 "exchanges (ids, rows) lists instead of table-shaped "
                 "gradients.")
-        if self.overlap_grad_allreduce and (self.tp > 1 or self.cp > 1):
+        if (self.overlap_grad_allreduce and (self.tp > 1 or self.cp > 1)
+                and not self.use_manual_tp_kernels):
             raise ValueError(
-                "overlap_grad_allreduce supports data-parallel meshes "
-                "only (tp = cp = 1): the split backward runs the plain "
-                "module forward per data shard.")
+                "overlap_grad_allreduce on a tp/cp-sharded mesh requires "
+                "--manual_tp_kernels: the split backward runs the forward "
+                "per shard, which only the manual-kernel path does under "
+                "tp/cp sharding (GSPMD tp/cp keeps the stock fused step).")
+        if self.train_corpus_manifest and not self.use_packed_data:
+            raise ValueError(
+                "--train_corpus_manifest requires packed data: the "
+                "manifest lists .c2vb shards (drop --no_packed_data).")
+        if self.overlap_in_backward and not self.overlap_grad_allreduce:
+            raise ValueError(
+                "overlap_in_backward requires overlap_grad_allreduce: "
+                "in-backward completion is a scheduling mode of the "
+                "bucketed overlap step.")
         if self.export_artifact_path and not self.is_loading:
             raise ValueError(
                 "export (--artifact_out) requires --load: the artifact "

@@ -882,3 +882,460 @@ class PackedDataset:
             epoch += 1
             if yield_epoch_markers:
                 yield EpochEnd(epoch)
+
+
+# -------------------------------------------------- sharded corpus manifest
+#
+# A corpus manifest is a small JSON file listing N `.c2vb` shards (the
+# incumbent pack plus any continuous-training delta shards) that
+# ShardedCorpus presents as ONE logical row space. Shard paths are
+# stored relative to the manifest's directory so the whole corpus
+# directory can be moved/rsynced as a unit. The manifest pins one vocab
+# fingerprint: every shard must have been packed with the same
+# vocabularies, or the global row ids would mean different things in
+# different shards.
+
+MANIFEST_VERSION = 1
+MANIFEST_SUFFIX = ".manifest.json"
+
+
+def _shard_meta_fingerprint(shard_path: str) -> Optional[str]:
+    meta_path = shard_path + ".meta.json"
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        return json.load(f).get("vocab_fingerprint")
+
+
+def _manifest_shard_path(manifest_path: str, entry: dict) -> str:
+    p = entry["path"]
+    if os.path.isabs(p):
+        return p
+    return os.path.join(os.path.dirname(os.path.abspath(manifest_path)), p)
+
+
+def load_manifest(manifest_path: str) -> dict:
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    if not isinstance(manifest, dict) or "shards" not in manifest:
+        raise ValueError(f"{manifest_path}: not a corpus manifest "
+                         f"(missing 'shards')")
+    version = manifest.get("version")
+    if version != MANIFEST_VERSION:
+        raise ValueError(f"{manifest_path}: unsupported corpus manifest "
+                         f"version {version}")
+    if not manifest["shards"]:
+        raise ValueError(f"{manifest_path}: corpus manifest lists no shards")
+    return manifest
+
+
+def save_manifest(manifest_path: str, manifest: dict) -> None:
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, manifest_path)
+
+
+def _manifest_entry(manifest_path: str, shard_path: str) -> dict:
+    """One manifest entry for a shard: relative path when the shard
+    lives under the manifest's directory, plus the header row count and
+    the shard meta's vocab fingerprint (None when the shard has no
+    sidecar meta)."""
+    rows, max_contexts = PackedDataset.read_header(shard_path)
+    base = os.path.dirname(os.path.abspath(manifest_path))
+    abs_shard = os.path.abspath(shard_path)
+    rel = os.path.relpath(abs_shard, base)
+    path = rel if not rel.startswith("..") else abs_shard
+    return {"path": path, "rows": rows, "max_contexts": max_contexts,
+            "vocab_fingerprint": _shard_meta_fingerprint(shard_path)}
+
+
+def _check_entry_vocab(manifest_path: str, manifest: dict,
+                       entry: dict) -> None:
+    """Refuse mixing shards packed with different vocabularies: the
+    manifest fingerprint is pinned by the first fingerprinted shard and
+    every later shard must match it."""
+    fp = entry.get("vocab_fingerprint")
+    pinned = manifest.get("vocab_fingerprint")
+    if fp and pinned and fp != pinned:
+        raise ValueError(
+            f"{manifest_path}: refusing mixed-vocab manifest — shard "
+            f"{entry['path']} was packed with vocab fingerprint {fp} but "
+            f"the manifest pins {pinned}; re-pack the shard with the "
+            f"manifest's vocabularies (or build a new manifest).")
+    if fp and not pinned:
+        manifest["vocab_fingerprint"] = fp
+    if entry["max_contexts"] != manifest["max_contexts"]:
+        raise ValueError(
+            f"{manifest_path}: shard {entry['path']} has max_contexts="
+            f"{entry['max_contexts']} but the manifest pins "
+            f"{manifest['max_contexts']}; re-pack the shard.")
+
+
+def create_manifest(manifest_path: str, shard_paths: List[str]) -> dict:
+    """Build a corpus manifest over existing `.c2vb` shards (in the
+    given order — global row ids follow shard order, so order is part
+    of the corpus identity)."""
+    if not shard_paths:
+        raise ValueError("a corpus manifest needs at least one shard")
+    first = _manifest_entry(manifest_path, shard_paths[0])
+    manifest = {"version": MANIFEST_VERSION,
+                "max_contexts": first["max_contexts"],
+                "vocab_fingerprint": first["vocab_fingerprint"],
+                "shards": [first]}
+    for shard in shard_paths[1:]:
+        entry = _manifest_entry(manifest_path, shard)
+        _check_entry_vocab(manifest_path, manifest, entry)
+        manifest["shards"].append(entry)
+    save_manifest(manifest_path, manifest)
+    return manifest
+
+
+def append_manifest_shard(manifest_path: str, shard_path: str) -> dict:
+    """Append one delta shard to an existing manifest (the continuous-
+    training accumulation step: the corpus grows, nothing re-packs).
+    Pure append — existing entries are never rewritten, so global row
+    ids of already-listed rows are stable. Refuses duplicates and
+    vocab-fingerprint mismatches."""
+    manifest = load_manifest(manifest_path)
+    entry = _manifest_entry(manifest_path, shard_path)
+    abs_new = _manifest_shard_path(manifest_path, entry)
+    for existing in manifest["shards"]:
+        if os.path.abspath(_manifest_shard_path(
+                manifest_path, existing)) == os.path.abspath(abs_new):
+            raise ValueError(f"{manifest_path}: shard {entry['path']} is "
+                             f"already listed")
+    _check_entry_vocab(manifest_path, manifest, entry)
+    manifest["shards"].append(entry)
+    save_manifest(manifest_path, manifest)
+    return manifest
+
+
+def validate_manifest(manifest_path: str,
+                      vocabs: Optional[Code2VecVocabs] = None) -> List[dict]:
+    """Re-check every shard against the manifest: file present, header
+    readable, row count unchanged, max_contexts and vocab fingerprint
+    consistent (and matching `vocabs` when given). Returns one report
+    dict per shard; raises on the first inconsistency."""
+    manifest = load_manifest(manifest_path)
+    want_fp = (vocabs_fingerprint(vocabs) if vocabs is not None
+               else manifest.get("vocab_fingerprint"))
+    reports = []
+    for entry in manifest["shards"]:
+        shard = _manifest_shard_path(manifest_path, entry)
+        rows, max_contexts = PackedDataset.read_header(shard)
+        if rows != entry["rows"]:
+            raise ValueError(
+                f"{manifest_path}: shard {entry['path']} has {rows} rows "
+                f"but the manifest recorded {entry['rows']}; the shard "
+                f"changed after it was listed — rebuild the manifest.")
+        _check_entry_vocab(manifest_path, manifest, dict(entry))
+        fp = _shard_meta_fingerprint(shard)
+        if fp and want_fp and fp != want_fp:
+            raise ValueError(
+                f"{shard} was packed with different vocabularies "
+                f"(fingerprint {fp} != {want_fp}); re-pack it.")
+        reports.append({"path": entry["path"], "rows": rows,
+                        "max_contexts": max_contexts,
+                        "vocab_fingerprint": fp})
+    return reports
+
+
+class ShardedCorpus:
+    """PackedDataset-shaped view over a MANIFEST of `.c2vb` shards.
+
+    One logical row space: global row id r lives in the shard whose
+    cumulative-row interval contains r, at local offset
+    r - offsets[shard]. Because the global id space is exactly the
+    shard-order concatenation, the epoch-keyed training order is a pure
+    function of (seed, epoch) over the global filtered row set —
+    identical to a single-file PackedDataset holding the same rows, and
+    identical across shard counts and host counts. The PR-6 cursor laws
+    (resume-at-epoch-e == uninterrupted-at-epoch-e; batch-as-set
+    invariance across host counts) therefore hold verbatim: nothing is
+    materialized, hosts stride the same global permutation.
+
+    Delta shards appended to the manifest while a corpus is OPEN are
+    not seen: the shard list is snapshotted at construction, and
+    `adopt_appended_shards` refuses to extend the row space mid-epoch
+    (a permutation drawn over N rows cannot grow to N+k rows without
+    changing which rows batch b holds). Call it between epochs — or,
+    as the continuous-training pipeline does, reopen per fine-tune run.
+    """
+
+    def __init__(self, manifest_path: str, vocabs: Code2VecVocabs,
+                 shard_index: int = 0, num_shards: int = 1):
+        self.path = manifest_path
+        self.vocabs = vocabs
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self._recs: List[np.memmap] = []
+        self._shard_paths: List[str] = []
+        self._offsets = np.zeros((1,), dtype=np.int64)
+        self.max_contexts = 0
+        self._target_strings: Optional[List[str]] = None
+        self._filtered_cache: dict = {}
+        self._mid_epoch = False
+        manifest = load_manifest(manifest_path)
+        self._open_shards(manifest, manifest["shards"])
+
+    def _open_shards(self, manifest: dict, entries: List[dict]) -> None:
+        """Open (additional) shard memmaps and extend the offset table.
+        Validates each shard the way PackedDataset validates its one
+        file: header magic/version, manifest row count, max_contexts
+        agreement, vocab fingerprint against the live vocabs."""
+        fp = vocabs_fingerprint(self.vocabs)
+        pinned = manifest.get("vocab_fingerprint")
+        if pinned and pinned != fp:
+            raise ValueError(
+                f"{self.path} was built for vocab fingerprint {pinned} but "
+                f"the loaded vocabularies have {fp}; re-pack the corpus.")
+        for entry in entries:
+            shard = _manifest_shard_path(self.path, entry)
+            with open(shard, "rb") as f:
+                magic, version, n, m = _HEADER.unpack(f.read(_HEADER.size))
+            if magic != _MAGIC:
+                raise ValueError(f"{shard} is not a .c2vb file")
+            if version != _VERSION:
+                raise ValueError(f"{shard}: unsupported .c2vb version "
+                                 f"{version}")
+            if n != entry["rows"]:
+                raise ValueError(
+                    f"{self.path}: shard {entry['path']} has {n} rows but "
+                    f"the manifest recorded {entry['rows']}; rebuild the "
+                    f"manifest.")
+            if not self._recs:
+                self.max_contexts = m
+            elif m != self.max_contexts:
+                raise ValueError(
+                    f"{self.path}: shard {entry['path']} has max_contexts="
+                    f"{m}, corpus has {self.max_contexts}; re-pack it.")
+            shard_fp = _shard_meta_fingerprint(shard)
+            if shard_fp and shard_fp != fp:
+                raise ValueError(
+                    f"{shard} was packed with different vocabularies "
+                    f"(fingerprint {shard_fp} != {fp}); re-pack it.")
+            self._recs.append(np.memmap(shard, dtype=np.int32, mode="r",
+                                        offset=_HEADER.size,
+                                        shape=(n, 1 + 3 * m)))
+            self._shard_paths.append(shard)
+            self._offsets = np.append(self._offsets, self._offsets[-1] + n)
+        self.num_rows_total = int(self._offsets[-1])
+        self.row_ids = np.arange(self.shard_index, self.num_rows_total,
+                                 self.num_shards)
+        self._filtered_cache.clear()
+        self._target_strings = None
+
+    @staticmethod
+    def read_manifest_rows(manifest_path: str) -> int:
+        """Total row count recorded by a manifest, without opening any
+        shard memmap (the facade's example-count fast path)."""
+        return sum(entry["rows"]
+                   for entry in load_manifest(manifest_path)["shards"])
+
+    @property
+    def num_shard_files(self) -> int:
+        return len(self._recs)
+
+    def __len__(self) -> int:
+        return len(self.row_ids)
+
+    def adopt_appended_shards(self) -> int:
+        """Pick up shards appended to the manifest since open (or since
+        the last adoption). Legal only BETWEEN epochs: mid-epoch the
+        global permutation is already drawn over the current row set,
+        so growing it would silently change the epoch's batches — the
+        exact corruption the cursor laws forbid. Returns the number of
+        shards adopted."""
+        if self._mid_epoch:
+            raise RuntimeError(
+                f"{self.path}: delta-shard adoption refused mid-epoch; the "
+                f"epoch's global permutation is already drawn — retry at "
+                f"the next epoch boundary.")
+        manifest = load_manifest(self.path)
+        entries = manifest["shards"]
+        if len(entries) < len(self._recs):
+            raise ValueError(f"{self.path}: manifest shrank while open "
+                             f"({len(entries)} shards < {len(self._recs)} "
+                             f"adopted); rebuild the corpus.")
+        for i, shard in enumerate(self._shard_paths):
+            listed = _manifest_shard_path(self.path, entries[i])
+            if os.path.abspath(listed) != os.path.abspath(shard):
+                raise ValueError(
+                    f"{self.path}: manifest rewrote shard {i} "
+                    f"({entries[i]['path']}) while open; only pure appends "
+                    f"can be adopted — rebuild the corpus.")
+        new = entries[len(self._recs):]
+        if new:
+            self._open_shards(manifest, new)
+        return len(new)
+
+    @property
+    def target_strings(self) -> Optional[List[str]]:
+        """Concatenated per-shard `.targets` sidecars, in shard order —
+        global indexing matches the row id space. All-or-nothing: a
+        corpus where only some shards carry sidecars cannot label every
+        row, so it reports None (same contract as a missing sidecar)."""
+        if self._target_strings is None:
+            strings: List[str] = []
+            for shard, rec in zip(self._shard_paths, self._recs):
+                sidecar = shard + ".targets"
+                if not os.path.exists(sidecar):
+                    return None
+                with open(sidecar, "r") as f:
+                    part = f.read().splitlines()
+                if len(part) != rec.shape[0]:
+                    raise ValueError(
+                        f"{sidecar} has {len(part)} rows but {shard} has "
+                        f"{rec.shape[0]}; re-pack the shard.")
+                strings.extend(part)
+            self._target_strings = strings
+        return self._target_strings
+
+    def _gather_rec(self, rows: np.ndarray) -> np.ndarray:
+        """Copy the records for GLOBAL row ids `rows` out of the shard
+        memmaps, preserving request order (the permutation order IS the
+        training order)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        rec = np.empty((len(rows), 1 + 3 * self.max_contexts),
+                       dtype=np.int32)
+        shard_of = np.searchsorted(self._offsets, rows, side="right") - 1
+        local = rows - self._offsets[shard_of]
+        for s in np.unique(shard_of):
+            idx = np.nonzero(shard_of == s)[0]
+            rec[idx] = self._recs[s][local[idx]]
+        return rec
+
+    def gather(self, rows: np.ndarray,
+               with_target_strings: bool = False) -> RowBatch:
+        m = self.max_contexts
+        rec = self._gather_rec(rows)
+        src = rec[:, 1:1 + m]
+        pth = rec[:, 1 + m:1 + 2 * m]
+        tgt = rec[:, 1 + 2 * m:]
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        mask = ((src != token_pad) | (tgt != token_pad) | (pth != path_pad))
+        strings = None
+        if with_target_strings and self.target_strings is not None:
+            strings = [self.target_strings[r] for r in rows]
+        return RowBatch(
+            source_token_indices=src,
+            path_indices=pth,
+            target_token_indices=tgt,
+            context_valid_mask=mask.astype(np.float32),
+            target_index=rec[:, 0],
+            example_valid=np.ones((len(rows),), dtype=bool),
+            target_strings=strings,
+        )
+
+    def _filter_rows(self, rows: np.ndarray,
+                     estimator_action: EstimatorAction) -> np.ndarray:
+        """The PackedDataset row filter over GLOBAL ids, chunked so one
+        chunk's records are gathered across shards at most once."""
+        m = self.max_contexts
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        keep_chunks = []
+        for start in range(0, len(rows), 1 << 18):
+            chunk = np.asarray(rows[start:start + (1 << 18)], dtype=np.int64)
+            rec = self._gather_rec(chunk)
+            src = rec[:, 1:1 + m]
+            pth = rec[:, 1 + m:1 + 2 * m]
+            tgt = rec[:, 1 + 2 * m:]
+            any_valid = ((src != token_pad) | (tgt != token_pad)
+                         | (pth != path_pad)).any(axis=1)
+            if estimator_action.is_train:
+                any_valid &= rec[:, 0] > self.vocabs.target_vocab.oov_index
+            keep_chunks.append(chunk[any_valid])
+        return (np.concatenate(keep_chunks) if keep_chunks
+                else np.empty((0,), np.int64))
+
+    def _filtered_row_ids(self,
+                          estimator_action: EstimatorAction) -> np.ndarray:
+        cached = self._filtered_cache.get(estimator_action)
+        if cached is None:
+            cached = self._filter_rows(self.row_ids, estimator_action)
+            self._filtered_cache[estimator_action] = cached
+        return cached
+
+    def _global_filtered_row_ids(
+            self, estimator_action: EstimatorAction) -> np.ndarray:
+        if self.num_shards == 1:
+            return self._filtered_row_ids(estimator_action)
+        key = ("global", estimator_action)
+        cached = self._filtered_cache.get(key)
+        if cached is None:
+            cached = self._filter_rows(
+                np.arange(self.num_rows_total, dtype=np.int64),
+                estimator_action)
+            self._filtered_cache[key] = cached
+        return cached
+
+    def steps_per_epoch(self, batch_size: int,
+                        estimator_action: EstimatorAction,
+                        skip_rows: int = 0) -> int:
+        if estimator_action.is_train:
+            n = len(self._global_filtered_row_ids(estimator_action))
+            steps = n // (batch_size * self.num_shards)
+            if skip_rows:
+                skip_local = min(skip_rows // self.num_shards,
+                                 steps * batch_size)
+                return (steps * batch_size - skip_local) // batch_size
+            return steps
+        n = len(self._filtered_row_ids(estimator_action))
+        return -(-n // batch_size)  # eval pads the tail batch
+
+    def iter_batches(self, batch_size: int,
+                     estimator_action: EstimatorAction,
+                     num_epochs: int = 1, seed: int = 0,
+                     repeat_endlessly: bool = False,
+                     with_target_strings: bool = False,
+                     yield_epoch_markers: bool = False,
+                     start_epoch: int = 0,
+                     skip_rows: int = 0) -> Iterator[RowBatch]:
+        """PackedDataset.iter_batches, verbatim, over the manifest's
+        global row space — same epoch keying, same truncate-then-stride
+        host split, same skip_rows remap, so every cursor law carries
+        over unchanged. Marks the corpus mid-epoch while an epoch's
+        batches are in flight (what `adopt_appended_shards` checks)."""
+        if estimator_action.is_train:
+            epoch = 0
+            while repeat_endlessly or epoch < num_epochs:
+                # re-read per epoch (a cache hit unless shards were
+                # adopted at the boundary): an adopted delta shard joins
+                # the NEXT epoch's permutation, never a drawn one
+                rows = self._global_filtered_row_ids(estimator_action)
+                steps = len(rows) // (batch_size * self.num_shards)
+                perm = _epoch_rng(seed, start_epoch + epoch).permutation(rows)
+                seq = perm[self.shard_index::self.num_shards][
+                    :steps * batch_size]
+                if epoch == 0 and skip_rows:
+                    seq = seq[skip_rows // self.num_shards:]
+                n_full = (len(seq) // batch_size) * batch_size
+                self._mid_epoch = True
+                try:
+                    for start in range(0, n_full, batch_size):
+                        yield self.gather(seq[start:start + batch_size],
+                                          with_target_strings)
+                finally:
+                    self._mid_epoch = False
+                epoch += 1
+                if yield_epoch_markers:
+                    yield EpochEnd(epoch)
+            return
+        rows = self._filtered_row_ids(estimator_action)
+        epoch = 0
+        while repeat_endlessly or epoch < num_epochs:
+            n_full = (len(rows) // batch_size) * batch_size
+            for start in range(0, n_full, batch_size):
+                yield self.gather(rows[start:start + batch_size],
+                                  with_target_strings)
+            tail = len(rows) - n_full
+            if tail:
+                batch = self.gather(rows[n_full:], with_target_strings)
+                yield reader_mod._pad_rows(batch, batch_size)
+            epoch += 1
+            if yield_epoch_markers:
+                yield EpochEnd(epoch)

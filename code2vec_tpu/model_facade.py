@@ -126,6 +126,32 @@ class BucketedPredictMixin:
         cached[c2v_path] = ds
         return ds
 
+    def _train_corpus(self):
+        """The training data source: the sharded corpus view when a
+        manifest is configured (--train_corpus_manifest — the incumbent
+        pack plus accumulated delta shards as ONE logical row space,
+        same epoch-keyed global order as a single pack), else the
+        single packed file derived from --data. Memoized alongside
+        `_packed_dataset`'s cache: the filter scan is O(rows)."""
+        config = self.config
+        manifest = getattr(config, "train_corpus_manifest", None)
+        if not manifest:
+            return self._packed_dataset(config.train_data_path)
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = self._packed_cache = {}
+        if manifest in cached:
+            return cached[manifest]
+        from code2vec_tpu.data.packed import ShardedCorpus
+        shard_index, num_shards = distributed.host_shard()
+        ds = ShardedCorpus(manifest, self.vocabs,
+                           shard_index=shard_index, num_shards=num_shards)
+        self.log(f"Training corpus: {manifest} "
+                 f"({ds.num_shard_files} shard(s), "
+                 f"{ds.num_rows_total} rows)")
+        cached[manifest] = ds
+        return ds
+
     def _require_single_process(self, what: str) -> None:
         """Multi-host training/eval requires packed data: the streaming
         text reader cannot know its post-filter batch count before the
@@ -514,7 +540,14 @@ class Code2VecModel(BucketedPredictMixin):
     def _init_num_of_examples(self):
         # reference: model_base.py:77-96 (.num_examples sidecar cache)
         config = self.config
-        if config.is_training:
+        if config.is_training and getattr(config, "train_corpus_manifest",
+                                          None):
+            from code2vec_tpu.data.packed import ShardedCorpus
+            config.num_train_examples = ShardedCorpus.read_manifest_rows(
+                config.train_corpus_manifest)
+            self.log(f"    Number of train examples: "
+                     f"{config.num_train_examples} (corpus manifest)")
+        elif config.is_training:
             config.num_train_examples = self._count_examples(config.train_data_path)
             self.log(f"    Number of train examples: {config.num_train_examples}")
         if config.is_testing:
@@ -541,7 +574,7 @@ class Code2VecModel(BucketedPredictMixin):
                      f"epochs (budget {config.num_train_epochs}); nothing "
                      f"to train. Raise --epochs to continue.")
         if config.use_packed_data:
-            ds = self._packed_dataset(config.train_data_path)
+            ds = self._train_corpus()
             skip_rows = self._cursor_skip_rows()
             # Remembered for save_fn: a SECOND preemption inside the
             # resumed (still-incomplete) epoch must record the restored

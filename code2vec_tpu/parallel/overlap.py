@@ -36,11 +36,27 @@ a mesh folds in the data-axis index (the manual-kernel path's
 discipline) — same distribution, different draw than the unbucketed
 GSPMD step's single global mask.
 
-Scope: dense optimizer, GSPMD, tp = cp = 1 (config.verify enforces;
-the sparse path already exchanges rows instead of tables, and the
-manual-TP path owns its own collectives). Works with mesh=None too
-(pure pipelining of apply dispatches — the measurable win is on 2+
-hosts, experiments/overlap_bench.py).
+Scope: dense optimizer, on either backward flavor — the GSPMD
+tp = cp = 1 path, or the manual-kernel tp/cp path (the per-shard
+backward then runs the explicit tp_ops forward and the per-leaf
+reducers psum each gradient over exactly the mesh axes its spec
+leaves replicated, which for a tp-sharded table skips the sharded
+axis — the same storage-replication transpose rule the monolithic
+manual step applies). The sparse path exchanges rows instead of
+tables and stays monolithic. Works with mesh=None too (pure
+pipelining of apply dispatches — the measurable win is on 2+ hosts,
+experiments/overlap_bench.py).
+
+`config.overlap_in_backward` goes one step further: instead of one
+whole-model backward followed by K bucket dispatches, the backward
+itself is split per bucket (grad w.r.t. only that bucket's leaves —
+one extra forward per bucket), and bucket i's reduce+apply is
+dispatched BEFORE bucket i+1's backward. On device the bucket-i psum
+rides the interconnect while bucket i+1's backward occupies the
+compute units — true in-backward completion, at the cost of the
+recomputed forwards. Whether that trades profitably is
+hardware-dependent; experiments/input_bench.py measures it and
+BENCH_INPUT.md records the verdict either way.
 """
 
 from __future__ import annotations
@@ -124,6 +140,13 @@ def build_overlap_train_step(builder, example_state) -> Callable:
     bucket_bytes = int(float(config.overlap_bucket_mb) * (1 << 20))
     buckets = plan_buckets(params, bucket_bytes)
     param_specs = mesh_lib.param_specs(params)
+    manual = bool(getattr(builder, "manual", False))
+    in_backward = bool(getattr(config, "overlap_in_backward", False))
+    batch_specs = tuple(
+        mesh_lib.batch_specs()[name] for name in (
+            "source_token_indices", "path_indices",
+            "target_token_indices", "context_valid_mask",
+            "target_index", "example_valid"))
 
     # ------------------------------------------------------- backward
 
@@ -138,46 +161,109 @@ def build_overlap_train_step(builder, example_state) -> Callable:
         # exactly the unbucketed step's sum-CE / batch_size loss
         return jnp.sum(ce) / global_batch
 
-    if mesh is None:
-        def backward_fn(p, src, pth, tgt, mask, labels, valid, rng, step):
+    # make_loss_fn(batch..., rng, step) -> (loss_of_params, finish):
+    # `loss_of_params(p)` is the per-shard loss whose gradient is this
+    # shard's PARTIAL gradient, `finish(local)` turns the per-shard
+    # scalar into the exact global loss. One factory per backward
+    # flavor; both the whole-model backward and the per-bucket
+    # in-backward variant trace through it.
+    if manual:
+        def make_loss_fn(src, pth, tgt, mask, labels, valid, rng, step):
+            # per-shard dropout folding (data/ctx axis indexes) happens
+            # inside _manual_rows_to_code — same draw as the monolithic
+            # manual step
             dropout_rng = jax.random.fold_in(rng, step)
-            loss, grads = jax.value_and_grad(local_loss_fn)(
-                p, src, pth, tgt, mask, labels, valid, dropout_rng,
-                labels.shape[0])
-            return grads, loss
 
-        backward = jax.jit(backward_fn)
-    else:
-        batch_specs = tuple(
-            mesh_lib.batch_specs()[name] for name in (
-                "source_token_indices", "path_indices",
-                "target_token_indices", "context_valid_mask",
-                "target_index", "example_valid"))
-        dp = dict(zip(mesh.axis_names,
-                      mesh.devices.shape))[AXIS_DATA]
+            def loss_of_params(p):
+                code_vectors, _ = builder._manual_encode(
+                    p, src, pth, tgt, mask,
+                    deterministic=False, dropout_rng=dropout_rng)
+                loss, _ = builder._manual_ce(p, code_vectors, labels,
+                                             valid)
+                return loss
 
-        def per_shard_backward(p, src, pth, tgt, mask, labels, valid,
-                               rng, step):
+            # _manual_ce already psums the scalar over the data axis
+            return loss_of_params, (lambda local: local)
+    elif mesh is not None:
+        dp = dict(zip(mesh.axis_names, mesh.devices.shape))[AXIS_DATA]
+
+        def make_loss_fn(src, pth, tgt, mask, labels, valid, rng, step):
             # distinct dropout per data shard (the manual path's
             # discipline); tp = cp = 1 so no other axes draw
             dropout_rng = jax.random.fold_in(
                 jax.random.fold_in(rng, step),
                 jax.lax.axis_index(AXIS_DATA))
-            local, grads = jax.value_and_grad(local_loss_fn)(
-                p, src, pth, tgt, mask, labels, valid, dropout_rng,
-                labels.shape[0] * dp)
-            # grads stay UNREDUCED (each shard's partial); only the
-            # scalar loss is summed here
-            loss = jax.lax.psum(local, AXIS_DATA)
-            return grads, loss
 
+            def loss_of_params(p):
+                return local_loss_fn(p, src, pth, tgt, mask, labels,
+                                     valid, dropout_rng,
+                                     labels.shape[0] * dp)
+
+            return loss_of_params, (
+                lambda local: jax.lax.psum(local, AXIS_DATA))
+    else:
+        def make_loss_fn(src, pth, tgt, mask, labels, valid, rng, step):
+            dropout_rng = jax.random.fold_in(rng, step)
+
+            def loss_of_params(p):
+                return local_loss_fn(p, src, pth, tgt, mask, labels,
+                                     valid, dropout_rng, labels.shape[0])
+
+            return loss_of_params, (lambda local: local)
+
+    def full_backward(p, src, pth, tgt, mask, labels, valid, rng, step):
+        loss_fn, finish = make_loss_fn(src, pth, tgt, mask, labels,
+                                       valid, rng, step)
+        local, grads = jax.value_and_grad(loss_fn)(p)
+        # grads stay UNREDUCED (each shard's partial); only the scalar
+        # loss is finished here
+        return grads, finish(local)
+
+    if in_backward:
+        backward = None  # replaced by the per-bucket backwards below
+    elif mesh is None:
+        backward = jax.jit(full_backward)
+    else:
         from code2vec_tpu.training.step import _shard_map
         sharded = _shard_map(
-            per_shard_backward, mesh=mesh,
+            full_backward, mesh=mesh,
             in_specs=(param_specs,) + batch_specs + (P(), P()),
             out_specs=(param_specs, P()),
             check_vma=False)
         backward = jax.jit(sharded)
+
+    def make_bucket_backward(names: Sequence[str], with_loss: bool):
+        """Backward restricted to one bucket's leaves: grad w.r.t. only
+        those params (the rest are constants — no grad computed for
+        them), at the cost of re-running the forward. Only bucket 0
+        returns the loss; all buckets share the identical dropout draw,
+        so the per-bucket grads are pieces of ONE consistent whole-model
+        gradient."""
+        sub_specs = {k: param_specs[k] for k in names}
+
+        def bucket_backward(p, src, pth, tgt, mask, labels, valid,
+                            rng, step):
+            loss_fn, finish = make_loss_fn(src, pth, tgt, mask, labels,
+                                           valid, rng, step)
+
+            def sub_loss(p_sub):
+                return loss_fn({**p, **p_sub})
+
+            p_sub = {k: p[k] for k in names}
+            if with_loss:
+                local, g_sub = jax.value_and_grad(sub_loss)(p_sub)
+                return g_sub, finish(local)
+            return jax.grad(sub_loss)(p_sub)
+
+        if mesh is None:
+            return jax.jit(bucket_backward)
+        from code2vec_tpu.training.step import _shard_map
+        sharded = _shard_map(
+            bucket_backward, mesh=mesh,
+            in_specs=(param_specs,) + batch_specs + (P(), P()),
+            out_specs=(sub_specs, P()) if with_loss else sub_specs,
+            check_vma=False)
+        return jax.jit(sharded)
 
     # --------------------------------------------------- bucket steps
 
@@ -211,11 +297,19 @@ def build_overlap_train_step(builder, example_state) -> Callable:
         # params/mu/nu donate (updated in place); grads are NOT listed:
         # there is no same-shaped output left for them once the params
         # aliased, and XLA's unusable-donation warning would fire every
-        # compile.
-        return jax.jit(bucket_step, donate_argnums=(0, 1, 2))
+        # compile. In-backward mode must NOT donate the params: every
+        # per-bucket backward re-reads the FULL original param dict, and
+        # bucket i's apply is dispatched before bucket i+1's backward —
+        # donating bucket i's params would invalidate buffers the later
+        # backwards still consume (transient cost: one params copy).
+        donate = (1, 2) if in_backward else (0, 1, 2)
+        return jax.jit(bucket_step, donate_argnums=donate)
 
     adam_type = type(core)
     bucket_fns = [make_bucket_fn(names) for names in buckets]
+    bucket_backwards = ([make_bucket_backward(names, with_loss=(i == 0))
+                         for i, names in enumerate(buckets)]
+                        if in_backward else None)
 
     h_bucket = obs.histogram(
         "train_overlap_bucket_dispatch_seconds",
@@ -223,8 +317,11 @@ def build_overlap_train_step(builder, example_state) -> Callable:
 
     def train_step(state, src, pth, tgt, mask, labels, valid, rng):
         import time as _time
-        grads, loss = backward(state.params, src, pth, tgt, mask,
-                               labels, valid, rng, state.step)
+        if in_backward:
+            grads, loss = None, None
+        else:
+            grads, loss = backward(state.params, src, pth, tgt, mask,
+                                   labels, valid, rng, state.step)
         adam = state.opt_state[0]
         rest = tuple(state.opt_state[1:])
         new_params = {}
@@ -232,14 +329,27 @@ def build_overlap_train_step(builder, example_state) -> Callable:
         new_nu = {}
         new_count = None
         new_rest = rest
-        for fn, names in zip(bucket_fns, buckets):
+        for i, (fn, names) in enumerate(zip(bucket_fns, buckets)):
             t0 = _time.perf_counter()
+            if in_backward:
+                # bucket i's reduce+apply is enqueued before bucket
+                # i+1's backward: the psum rides the interconnect while
+                # the next backward occupies the compute units
+                if i == 0:
+                    g_sub, loss = bucket_backwards[0](
+                        state.params, src, pth, tgt, mask, labels,
+                        valid, rng, state.step)
+                else:
+                    g_sub = bucket_backwards[i](
+                        state.params, src, pth, tgt, mask, labels,
+                        valid, rng, state.step)
+            else:
+                g_sub = {k: grads[k] for k in names}
             p_sub = {k: state.params[k] for k in names}
             p_out, opt_out = fn(p_sub,
                                 {k: adam.mu[k] for k in names},
                                 {k: adam.nu[k] for k in names},
-                                adam.count, rest,
-                                {k: grads[k] for k in names})
+                                adam.count, rest, g_sub)
             new_params.update(p_out)
             new_mu.update(opt_out[0].mu)
             new_nu.update(opt_out[0].nu)
@@ -255,12 +365,19 @@ def build_overlap_train_step(builder, example_state) -> Callable:
                           opt_state=opt_state), loss
 
     n_leaves = len(params)
+    if mesh is None:
+        flavor = "single-device (apply pipelining only)"
+    elif manual:
+        flavor = "manual-kernel tp/cp (per-leaf replicated-axes psum)"
+    else:
+        flavor = "data-parallel psum per bucket"
     train_step.overlap_buckets = len(buckets)
+    train_step.overlap_in_backward = in_backward
     train_step.overlap_description = (
         f"{len(buckets)} gradient bucket(s) over {n_leaves} leaves "
         f"(<= {config.overlap_bucket_mb:g} MB each, backward-completion "
-        f"order {[list(b) for b in buckets]}), "
-        f"{'data-parallel psum per bucket' if mesh is not None else 'single-device (apply pipelining only)'}")
+        f"order {[list(b) for b in buckets]}), {flavor}"
+        + (", in-backward per-bucket completion" if in_backward else ""))
     obs.gauge("train_overlap_buckets",
               "gradient buckets of the overlapped train step "
               "(0/absent = unbucketed single-program step)"

@@ -285,7 +285,9 @@ def run_ingest(ctx: PipelineContext) -> Dict:
     """Pack new raw extractor output as a delta shard against the
     FROZEN incumbent vocab (no re-histogram, no sampling tiers — OOV
     is the designed fate of genuinely new words, and its rate is the
-    exported aging signal)."""
+    exported aging signal). With --train_corpus_manifest, the shard is
+    additionally APPENDED to the corpus manifest — the accumulated
+    multi-shard corpus grows without ever re-packing prior data."""
     config = ctx.config
     raw = config.pipeline_raw
     if not raw or not os.path.isfile(raw):
@@ -326,19 +328,68 @@ def run_ingest(ctx: PipelineContext) -> Dict:
             f"packed at {packed}; target OOV "
             f"{oov['target_oov_rate']:.4f}, context OOV "
             f"{oov['context_oov_rate']:.4f}")
-    return {"delta_prefix": delta_prefix, "packed": packed,
-            "rows": rows, "train_rows": train_rows,
-            "incumbent_ckpt": incumbent_ckpt,
-            "target_oov_rate": oov["target_oov_rate"],
-            "context_oov_rate": oov["context_oov_rate"]}
+    outputs = {"delta_prefix": delta_prefix, "packed": packed,
+               "rows": rows, "train_rows": train_rows,
+               "incumbent_ckpt": incumbent_ckpt,
+               "target_oov_rate": oov["target_oov_rate"],
+               "context_oov_rate": oov["context_oov_rate"]}
+    manifest_path = getattr(config, "train_corpus_manifest", None)
+    if manifest_path:
+        # ACCUMULATE instead of re-pack: the delta shard joins the
+        # corpus manifest (pure append — incumbent pack + every prior
+        # delta stay byte-identical), so fine-tune trains over the
+        # WHOLE accumulated corpus through ShardedCorpus rather than
+        # the delta alone. Idempotent under re-run: a shard already
+        # listed is left alone (pack_raw committed it atomically).
+        from code2vec_tpu.data.packed import (
+            _manifest_shard_path, append_manifest_shard, create_manifest,
+            load_manifest,
+        )
+        try:
+            if not os.path.isfile(manifest_path):
+                manifest = create_manifest(manifest_path, [packed])
+            else:
+                manifest = load_manifest(manifest_path)
+                listed = {os.path.abspath(
+                    _manifest_shard_path(manifest_path, e))
+                    for e in manifest["shards"]}
+                if os.path.abspath(packed) in listed:
+                    ctx.log(f"Pipeline ingest: {packed} already in "
+                            f"{manifest_path} (re-run); manifest "
+                            f"unchanged")
+                else:
+                    manifest = append_manifest_shard(manifest_path,
+                                                     packed)
+        except (ValueError, OSError) as e:
+            # mixed vocab / drifted shard: refuse loudly — training on
+            # a silently inconsistent corpus is the one unacceptable
+            # outcome
+            raise StageFailed("ingest",
+                              f"corpus manifest accumulation refused: "
+                              f"{e}")
+        total = sum(int(e["rows"]) for e in manifest["shards"])
+        ctx.log(f"Pipeline ingest: corpus manifest {manifest_path} now "
+                f"{len(manifest['shards'])} shard(s), {total} rows")
+        obs.gauge("pipeline_corpus_shards",
+                  "shards in the accumulated training-corpus manifest"
+                  ).set(len(manifest["shards"]))
+        obs.gauge("pipeline_corpus_rows",
+                  "total packed rows across the accumulated "
+                  "training-corpus manifest").set(total)
+        outputs.update(manifest=manifest_path,
+                       manifest_shards=len(manifest["shards"]),
+                       manifest_rows=total)
+    return outputs
 
 
 def run_finetune(ctx: PipelineContext) -> Dict:
-    """Fine-tune from the latest committed checkpoint on the delta
-    shard, in a child CLI process (elastic-restore path: `--load`
-    resolves to the newest VALID artifact and restores on whatever
-    host count/mesh the child runs). A rerun after a mid-train kill
-    resumes from the candidate's own newest committed checkpoint."""
+    """Fine-tune from the latest committed checkpoint — on the delta
+    shard alone, or (manifest mode) on the WHOLE accumulated corpus
+    via --train_corpus_manifest — in a child CLI process
+    (elastic-restore path: `--load` resolves to the newest VALID
+    artifact and restores on whatever host count/mesh the child runs).
+    A rerun after a mid-train kill resumes from the candidate's own
+    newest committed checkpoint."""
     config = ctx.config
     ingest = ctx.outputs("ingest")
     save_base = os.path.join(ctx.dir("candidate"), "ckpt")
@@ -350,7 +401,12 @@ def run_finetune(ctx: PipelineContext) -> Dict:
     _, incumbent_epoch = newest_committed_checkpoint(
         config.model_load_path)
     total_epochs = incumbent_epoch + config.pipeline_finetune_epochs
-    batch = max(1, min(config.train_batch_size, ingest["train_rows"]))
+    # batch bounded by what the corpus can fill: the delta alone, or —
+    # in manifest mode — the whole accumulated corpus (packed rows
+    # upper-bound the trainable rows; with any realistic corpus the
+    # configured batch wins)
+    row_cap = int(ingest.get("manifest_rows") or ingest["train_rows"])
+    batch = max(1, min(config.train_batch_size, row_cap))
     argv = ["--data", ingest["delta_prefix"],
             "--load", load_from,
             "--save", save_base,
@@ -361,6 +417,11 @@ def run_finetune(ctx: PipelineContext) -> Dict:
             os.path.join(ctx.dir("finetune"), "train.heartbeat.json"),
             "--metrics_file",
             os.path.join(ctx.dir("finetune"), "train.metrics.prom")]
+    if ingest.get("manifest"):
+        # train over the accumulated multi-shard corpus, not the delta
+        # re-pack (the tentpole: ingest appends, fine-tune reads the
+        # manifest through ShardedCorpus)
+        argv += ["--train_corpus_manifest", ingest["manifest"]]
     ctx.run_cli(argv, "finetune", "train")
     candidate, cand_epoch = newest_committed_checkpoint(save_base)
     if candidate is None:

@@ -240,16 +240,20 @@ class HostSpec:
     def __init__(self, host_id: str, command: List[str],
                  model: str = DEFAULT_MODEL,
                  address: str = "127.0.0.1",
-                 boot_artifact: Optional[str] = None):
+                 boot_artifact: Optional[str] = None,
+                 boot_retrieval_index: Optional[str] = None):
         self.id = host_id
         self.command = list(command)
         self.model = model
         self.address = address
-        # the artifact baked into `command` — when the model group has
-        # since been swapped to a different one, a (re)spawned host
-        # gets a reload-target file so its replicas converge onto the
-        # fleet's CURRENT artifact instead of reviving the boot one
+        # the (artifact, retrieval_index) pair baked into `command` —
+        # when the model group has since been swapped to a different
+        # one, a (re)spawned host gets a reload-target file (and the
+        # first-heartbeat reconcile re-checks over HTTP) so its
+        # replicas converge onto the fleet's CURRENT pair instead of
+        # reviving the boot one
         self.boot_artifact = boot_artifact
+        self.boot_retrieval_index = boot_retrieval_index
 
 
 class _Host:
@@ -285,6 +289,13 @@ class _Host:
         self.idle_ticks = 0
         self.cooldown_until = 0.0
         self.desired_replicas: Optional[int] = None
+        # set by _spawn, cleared by the first-heartbeat reconcile:
+        # the control plane checks this host's reported reload state
+        # against the committed (artifact, retrieval_index) pair once
+        # per spawn (the reload-target file covers only locally
+        # launched hosts; a remote host or a self-restarted
+        # supervisor never reads it)
+        self.needs_reconcile = False
 
     @property
     def alive(self) -> bool:
@@ -430,7 +441,9 @@ class ControlPlane:
         index = self._retrieval_indexes.get(host.model)
         target_path = os.path.join(host.host_dir,
                                    RELOAD_TARGET_FILENAME)
-        if current and (current != host.spec.boot_artifact or index):
+        boot_index = host.spec.boot_retrieval_index
+        if current and (current != host.spec.boot_artifact
+                        or (index or None) != (boot_index or None)):
             # desired-state reconciliation across a host restart: the
             # fleet committed a swap (and possibly a retrieval_refresh)
             # after this host's command was built, so its supervisor
@@ -474,6 +487,7 @@ class ControlPlane:
             return
         host.spawned_at = time.monotonic()
         host.restart_at = None
+        host.needs_reconcile = True
         self.log(f"Fleet host {host.id} (model {host.model}) spawned "
                  f"(pid {host.proc.pid})")
 
@@ -656,6 +670,8 @@ class ControlPlane:
         raw = self._fetch(host, "/metrics")
         if raw is not None:
             host.metrics_text = raw.decode("utf-8", errors="replace")
+        if host.needs_reconcile and host.view is not None:
+            self._reconcile_host(host)
         breaker_open = False
         replicas_serving = 0
         if host.view:
@@ -696,6 +712,60 @@ class ControlPlane:
             host.state, host.weight = "degraded", UNHEALTHY_WEIGHT
         else:
             host.state, host.weight = "healthy", 1.0
+
+    def _reconcile_host(self, host: _Host) -> None:
+        """First-heartbeat desired-state reconcile of a (re)spawned
+        host onto the committed (artifact, retrieval_index) PAIR.
+
+        The reload-target file _spawn writes only reaches hosts
+        launched on the control plane's own filesystem; a
+        RemoteHostLauncher host boots on another machine, and a
+        supervisor that restarted its own replicas never re-reads the
+        file. So the control plane checks what the host itself
+        REPORTS — its last fanned-out reload (artifact + index) or,
+        absent one, its boot artifact — against the committed pair at
+        the first healthy view after every spawn, and re-issues
+        /admin/reload with the full pair on any disagreement. Skipped
+        while a coordinated swap is in flight (the swap driver owns
+        convergence then; the flag stays set, so the check re-runs on
+        the next tick)."""
+        desired_artifact = self._artifacts.get(host.model)
+        desired_index = self._retrieval_indexes.get(host.model)
+        if not desired_artifact:
+            host.needs_reconcile = False
+            return
+        if self.swap.status().get("state") in ("canary", "rolling"):
+            return
+        last = (host.view or {}).get("last_reload") or {}
+        if last.get("artifact"):
+            have_artifact = last["artifact"]
+            have_index = last.get("retrieval_index")
+        else:
+            # no fan-out processed yet: the host serves what its boot
+            # command mounted
+            have_artifact = host.spec.boot_artifact
+            have_index = host.spec.boot_retrieval_index
+        if (have_artifact == desired_artifact
+                and (have_index or None) == (desired_index or None)):
+            host.needs_reconcile = False
+            return
+        ok, body = self.host_reload(host, desired_artifact,
+                                    retrieval_index=desired_index)
+        if ok:
+            host.needs_reconcile = False
+            self.flight.event("host_reconciled", host=host.id,
+                              artifact=desired_artifact,
+                              retrieval_index=desired_index)
+            self.log(
+                f"Reconciled host {host.id} onto committed pair "
+                f"(artifact {desired_artifact}, index "
+                f"{desired_index or 'none'}; host reported "
+                f"{have_artifact}/{have_index or 'none'})")
+        else:
+            # retried at the next poll tick; the host is freshly up,
+            # so a transient refusal here is common
+            self.log(f"Host {host.id} reconcile reload refused: "
+                     f"{body[:200]}")
 
     def _check_router(self, router: _Router, now: float) -> None:
         """Same supervision shape as _check_host, minus health/scaling:
@@ -1272,13 +1342,20 @@ def fleet_main(config, argv: Optional[List[str]] = None,
             # {address} and its reported ports are reachable there
             address = (addresses[len(specs) % len(addresses)]
                        if addresses else config.serve_host)
-            specs.append(HostSpec(f"{model}-{i}", cmd, model=model,
-                                  address=address,
-                                  boot_artifact=artifact))
+            specs.append(HostSpec(
+                f"{model}-{i}", cmd, model=model, address=address,
+                boot_artifact=artifact,
+                boot_retrieval_index=getattr(config, "retrieval_index",
+                                             None)))
     control = ControlPlane(config, specs, launcher=launcher,
                            log=config.log)
     for model, artifact in models.items():
-        control.set_initial_artifact(model, artifact)
+        # the boot pair includes any --retrieval_index: a host that
+        # dies before the first promote must come back with the index
+        # it was launched to serve, not none
+        control.set_initial_artifact(
+            model, artifact,
+            retrieval_index=getattr(config, "retrieval_index", None))
     router_port = (config.fleet_port if config.fleet_port is not None
                    else config.serve_port)
     n_routers = max(1, int(getattr(config, "fleet_routers", 1) or 1))

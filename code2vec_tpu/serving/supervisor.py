@@ -569,6 +569,11 @@ class Supervisor:
                                              f"{e}"})
         status = {"artifact": artifact, "requested_at": time.time(),
                   "replicas": results}
+        if retrieval_index:
+            # the control plane's respawn reconcile compares this
+            # reported pair against its committed pair — the artifact
+            # alone would read as "index missing" forever
+            status["retrieval_index"] = str(retrieval_index)
         self._last_reload = status
         self.flight.event("host_reload_fanout", artifact=artifact,
                           replicas=len(results))

@@ -241,8 +241,9 @@ class Trainer:
         win_data_wait = 0.0        # host-side step-time breakdown,
         win_dispatch = 0.0         # accumulated over the log window
         last_avg_loss = float("nan")
-        prefetcher = DevicePrefetcher(batches, self.mesh,
-                                      depth=config.prefetch_batches)
+        prefetcher = DevicePrefetcher(
+            batches, self.mesh, depth=config.prefetch_batches,
+            double_buffer=getattr(config, "prefetch_double_buffer", False))
         watcher = None
         if getattr(config, "save_on_preemption", True):
             watcher = PreemptionWatcher(log).install()
@@ -567,6 +568,17 @@ class Trainer:
                     reg.gauge("train_window_loss_sync_seconds",
                               "loss sync at the last log boundary"
                               ).set(sync_s)
+                    # "Is the step loop input-bound at N hosts?" as ONE
+                    # number: the share of the window's wall time the
+                    # host spent blocked waiting for input. ~0 = device-
+                    # bound (scaling out hosts buys nothing on input);
+                    # approaching 1 = feed-bound (shard the corpus /
+                    # enable --prefetch_double_buffer before buying
+                    # more compute).
+                    reg.gauge("train_input_bound_fraction",
+                              "fraction of the last log window the step "
+                              "loop spent blocked on input data"
+                              ).set(win_data_wait / max(elapsed, 1e-9))
                     if tb is not None:
                         step = int(np.asarray(jax.device_get(state.step)))
                         tb.scalar("train/loss", last_avg_loss, step)

@@ -123,11 +123,15 @@ class TrainStepBuilder:
                 f"{self.config.use_sparse_embedding_update}; pass the same "
                 f"config to create_train_state and TrainStepBuilder.")
         if getattr(self.config, "overlap_grad_allreduce", False) \
-                and not sparse and not self.manual:
+                and not sparse:
             # Bucketed async all-reduce overlap (parallel/overlap.py):
             # backward + K per-bucket reduce+apply dispatches instead
-            # of one monolithic program. config.verify keeps this to
-            # the dense GSPMD data-parallel case (tp = cp = 1).
+            # of one monolithic program. Covers the dense GSPMD
+            # data-parallel case AND the manual-kernel tp/cp path (the
+            # builder's _manual_encode/_manual_ce supply the per-shard
+            # backward; the per-leaf reducers psum over exactly each
+            # leaf's replicated axes). Sparse stays monolithic — it
+            # exchanges rows, not tables.
             from code2vec_tpu.parallel.overlap import (
                 build_overlap_train_step,
             )

@@ -52,11 +52,13 @@ class DevicePrefetcher:
     _SENTINEL = object()
 
     def __init__(self, batches: Iterable, mesh, depth: int = 4,
-                 keep_host_batch: bool = False):
+                 keep_host_batch: bool = False,
+                 double_buffer: bool = False):
         self.batches = batches
         self.mesh = mesh
         self.depth = max(1, depth)
         self.keep_host_batch = keep_host_batch
+        self.double_buffer = double_buffer
         self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
@@ -103,15 +105,30 @@ class DevicePrefetcher:
             self._put(self._SENTINEL)
 
     def __iter__(self) -> Iterator:
+        # Double-buffering (`double_buffer=True`) holds ONE transferred
+        # batch back: batch N+1's device_put is dispatched before batch
+        # N is handed to the step loop, so the N+1 transfer rides under
+        # step N's dispatch instead of serializing after it. The
+        # transfer still runs on THIS thread (see the class docstring:
+        # a second runtime-client thread measured 2-3x worse) — only
+        # the dispatch order changes. Costs one extra batch of device
+        # memory and one batch of startup latency; EpochEnd markers
+        # flush the held batch first so ordering is preserved.
         self._thread.start()
+        pending = None
         try:
             while True:
                 item = self._queue.get()
                 if item is self._SENTINEL:
                     if self._error is not None:
                         raise self._error
+                    if pending is not None:
+                        yield pending
                     return
                 if isinstance(item, EpochEnd):
+                    if pending is not None:
+                        out, pending = pending, None
+                        yield out
                     yield item
                     continue
                 _G_DEPTH.set(self._queue.qsize())
@@ -122,7 +139,14 @@ class DevicePrefetcher:
                 _H_DEVICE_PUT.observe(dur)
                 obs.default_tracer().maybe_record("prefetch_device_put",
                                                   t0, dur)
-                yield (arrays, batch if self.keep_host_batch else None)
+                staged = (arrays, batch if self.keep_host_batch else None)
+                if not self.double_buffer:
+                    yield staged
+                elif pending is None:
+                    pending = staged  # prime: hold batch 0, put batch 1
+                else:
+                    out, pending = pending, staged
+                    yield out
         finally:
             # consumer stopped (normally, by exception, or abandoned):
             # release the worker so it can exit and drop the reader

@@ -620,6 +620,102 @@ def test_respawned_host_reconciles_onto_artifact_index_pair(tmp_path):
     assert "retrieval_index" not in payload
 
 
+def test_first_heartbeat_reconcile_reaches_remote_hosts(tmp_path):
+    """The respawn reconcile must ride the host's own telemetry
+    surface, not the control plane's local filesystem: a remote host
+    (or a supervisor that restarted by itself) never reads the
+    reload-target file, so the control plane compares the host's
+    REPORTED reload state against the committed (artifact, index) pair
+    at the first view after every spawn and re-issues /admin/reload on
+    disagreement."""
+    from code2vec_tpu.serving.fleet.control import ControlPlane, HostSpec
+
+    config = Config(
+        serve=True, fleet=True, serve_host="127.0.0.1", verbose_mode=0,
+        fleet_models="default=/a/v1",
+        heartbeat_file=str(tmp_path / "fleet.heartbeat.json"))
+    control = ControlPlane(
+        config,
+        [HostSpec("default-0", ["host-cmd"], boot_artifact="/a/v1")],
+        launcher=_RecordingLauncher(), log=lambda m: None)
+    control.set_initial_artifact("default", "/a/v1")
+    host = control.hosts[0]
+    posts = []
+    control._post = lambda h, path, payload, timeout=10.0: (
+        posts.append((h.id, path, dict(payload))) or (True, "{}"))
+
+    control._spawn(host)
+    assert host.needs_reconcile
+    # boot pair == committed pair: no reload, flag cleared
+    host.view = {"replicas": []}
+    control._reconcile_host(host)
+    assert not host.needs_reconcile and posts == []
+
+    # the fleet commits a refreshed pair, then the host dies and comes
+    # back reporting only its boot artifact (remote host: the
+    # reload-target file never reached its filesystem)
+    control.set_artifact("default", "/a/v2", retrieval_index="/idx/r9")
+    control._spawn(host)
+    host.view = {"replicas": []}
+    control._reconcile_host(host)
+    assert posts == [("default-0", "/admin/reload",
+                      {"artifact": "/a/v2",
+                       "retrieval_index": "/idx/r9"})]
+    assert not host.needs_reconcile
+
+    # a host that already processed the fan-out (its view reports the
+    # committed pair) is left alone
+    control._spawn(host)
+    host.view = {"last_reload": {"artifact": "/a/v2",
+                                 "retrieval_index": "/idx/r9"}}
+    posts.clear()
+    control._reconcile_host(host)
+    assert posts == [] and not host.needs_reconcile
+
+    # artifact matches but the index is missing from the report (the
+    # residue this PR closes: supervisor status omitted it) -> the
+    # FULL pair is re-issued
+    control._spawn(host)
+    host.view = {"last_reload": {"artifact": "/a/v2"}}
+    control._reconcile_host(host)
+    assert posts and posts[-1][2] == {"artifact": "/a/v2",
+                                      "retrieval_index": "/idx/r9"}
+
+    # an in-flight coordinated swap defers to the swap driver: no
+    # competing reload, the flag stays set for the next tick
+    control._spawn(host)
+    host.view = {"last_reload": {"artifact": "/a/v1"}}
+    control.swap._set(state="rolling")
+    posts.clear()
+    control._reconcile_host(host)
+    assert posts == [] and host.needs_reconcile
+
+
+def test_supervisor_last_reload_reports_index_pair(tmp_path):
+    """fleet_view's last_reload must carry the retrieval_index it
+    fanned out — the control plane's reconcile compares pairs, and an
+    artifact-only report would read as 'index missing' forever."""
+    from code2vec_tpu import obs
+    from code2vec_tpu.serving.supervisor import Supervisor
+
+    config = Config(serve=True, serve_host="127.0.0.1", verbose_mode=0,
+                    heartbeat_file=str(tmp_path / "sup.heartbeat.json"))
+    sup = Supervisor.__new__(Supervisor)
+    sup.config = config
+    sup.replicas = []
+    sup.run_dir = str(tmp_path)
+    sup.reuseport = False
+    sup.log = lambda m: None
+    sup.flight = obs.default_flight_recorder()
+    status = sup.reload_all("/a/v2", retrieval_index="/idx/r9")
+    sup._last_reload = status
+    assert status["artifact"] == "/a/v2"
+    assert status["retrieval_index"] == "/idx/r9"
+    # and a plain reload omits the key (pair semantics: absent index
+    # means none mounted, not unknown)
+    assert "retrieval_index" not in sup.reload_all("/a/v3")
+
+
 def test_fleet_view_carries_pair_and_router_tier(tmp_path):
     from code2vec_tpu.serving.fleet.control import (
         ControlPlane, HostSpec, RouterSpec,

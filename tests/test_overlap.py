@@ -28,7 +28,7 @@ PARITY_ATOL = 1e-7
 
 
 def _build(overlap, mesh=None, *, dropout_keep=1.0, bucket_mb=0.003,
-           nu_dtype="bfloat16"):
+           nu_dtype="bfloat16", in_backward=False):
     from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
     from code2vec_tpu.training.state import create_train_state, make_optimizer
     from code2vec_tpu.training.step import TrainStepBuilder
@@ -38,6 +38,7 @@ def _build(overlap, mesh=None, *, dropout_keep=1.0, bucket_mb=0.003,
                     dp=(2 if mesh is not None else 1),
                     adam_nu_dtype=nu_dtype,
                     overlap_grad_allreduce=overlap,
+                    overlap_in_backward=in_backward,
                     overlap_bucket_mb=bucket_mb)
     dims = ModelDims(token_vocab_size=50, path_vocab_size=40,
                      target_vocab_size=30, token_dim=8, path_dim=8)
@@ -71,17 +72,23 @@ def _batch(mesh=None):
     return device_put_batch(Batch(*arrays), mesh)
 
 
-def _run_parity(mesh, steps=3):
+def _run_parity(mesh, steps=3, in_backward=False):
     step_ref, s_ref = _build(False, mesh)
-    step_ov, s_ov = _build(True, mesh)
+    step_ov, s_ov = _build(True, mesh, in_backward=in_backward)
     assert step_ov.overlap_buckets >= 2, step_ov.overlap_description
     arrays = _batch(mesh)
     key = jax.random.PRNGKey(7)
     for i in range(steps):
         s_ref, l_ref = step_ref(s_ref, *arrays, key)
         s_ov, l_ov = step_ov(s_ov, *arrays, key)
-        assert float(l_ref) == float(l_ov), \
-            f"step {i}: loss {float(l_ref)} != {float(l_ov)}"
+        if in_backward:
+            # the loss comes from bucket 0's restricted backward, whose
+            # program fuses differently — same math, not bit-pinned
+            np.testing.assert_allclose(float(l_ref), float(l_ov),
+                                       rtol=1e-6, err_msg=f"step {i}")
+        else:
+            assert float(l_ref) == float(l_ov), \
+                f"step {i}: loss {float(l_ref)} != {float(l_ov)}"
     for k in s_ref.params:
         np.testing.assert_allclose(
             np.asarray(s_ov.params[k]), np.asarray(s_ref.params[k]),
@@ -109,6 +116,90 @@ def test_overlap_parity_dp2_mesh():
     from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
     mesh = make_mesh(MeshPlan(dp=2))
     _run_parity(mesh)
+
+
+def test_overlap_parity_in_backward_single_device():
+    """overlap_in_backward: per-bucket backwards (one extra forward per
+    bucket, shared dropout draw) produce the same update as the
+    whole-model backward."""
+    _run_parity(None, in_backward=True)
+
+
+def test_overlap_parity_in_backward_dp2_mesh():
+    from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+    mesh = make_mesh(MeshPlan(dp=2))
+    step, _ = _build(True, mesh, in_backward=True)
+    assert step.overlap_in_backward
+    assert "in-backward" in step.overlap_description
+    _run_parity(mesh, in_backward=True)
+
+
+def _build_manual(overlap, mesh, *, in_backward=False, dropout_keep=1.0):
+    from code2vec_tpu.models.code2vec import Code2VecModule, ModelDims
+    from code2vec_tpu.training.state import create_train_state, make_optimizer
+    from code2vec_tpu.training.step import TrainStepBuilder
+    config = Config(train_data_path_prefix="<t>", train_batch_size=8,
+                    max_contexts=6, compute_dtype="float32",
+                    dropout_keep_rate=dropout_keep,
+                    dp=2, tp=2, use_manual_tp_kernels=True,
+                    overlap_grad_allreduce=overlap,
+                    overlap_in_backward=in_backward,
+                    overlap_bucket_mb=0.003)
+    config.verify()
+    # vocab sizes divisible by tp=2, so no target padding in play
+    dims = ModelDims(token_vocab_size=50, path_vocab_size=40,
+                     target_vocab_size=30, token_dim=8, path_dim=8)
+    module = Code2VecModule(dims=dims, compute_dtype=jnp.float32,
+                            dropout_keep_rate=dropout_keep)
+    opt = make_optimizer(config)
+    state = create_train_state(module, opt, jax.random.PRNGKey(0),
+                               mesh=mesh, config=config)
+    builder = TrainStepBuilder(module, opt, config, mesh=mesh)
+    assert builder.manual
+    return builder.make_train_step(state), state
+
+
+def test_overlap_parity_manual_tp_mesh():
+    """The manual-kernel tp/cp backward through the overlap builder
+    computes the same step as the monolithic manual shard_map step
+    (identical dropout folding discipline, so losses line up too)."""
+    from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+    mesh = make_mesh(MeshPlan(dp=2, tp=2))
+    arrays = _batch(mesh)
+    key = jax.random.PRNGKey(7)
+    step_ref, s_ref = _build_manual(False, mesh)
+    step_ov, s_ov = _build_manual(True, mesh)
+    assert step_ov.overlap_buckets >= 2, step_ov.overlap_description
+    assert "manual" in step_ov.overlap_description
+    for i in range(3):
+        s_ref, l_ref = step_ref(s_ref, *arrays, key)
+        s_ov, l_ov = step_ov(s_ov, *arrays, key)
+        np.testing.assert_allclose(float(l_ref), float(l_ov),
+                                   rtol=1e-6, err_msg=f"step {i}")
+    for k in s_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(s_ov.params[k]), np.asarray(s_ref.params[k]),
+            rtol=PARITY_RTOL, atol=PARITY_ATOL, err_msg=k)
+
+
+def test_overlap_parity_manual_in_backward():
+    """Manual tp/cp x in-backward completion: still the same step."""
+    from code2vec_tpu.parallel.mesh import MeshPlan, make_mesh
+    mesh = make_mesh(MeshPlan(dp=2, tp=2))
+    arrays = _batch(mesh)
+    key = jax.random.PRNGKey(7)
+    step_ref, s_ref = _build_manual(False, mesh)
+    step_ib, s_ib = _build_manual(True, mesh, in_backward=True)
+    assert step_ib.overlap_in_backward
+    for i in range(2):
+        s_ref, l_ref = step_ref(s_ref, *arrays, key)
+        s_ib, l_ib = step_ib(s_ib, *arrays, key)
+        np.testing.assert_allclose(float(l_ref), float(l_ib),
+                                   rtol=1e-6, err_msg=f"step {i}")
+    for k in s_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(s_ib.params[k]), np.asarray(s_ref.params[k]),
+            rtol=PARITY_RTOL, atol=PARITY_ATOL, err_msg=k)
 
 
 def test_overlap_parity_f32_adam_state():
@@ -179,15 +270,25 @@ def test_config_rejects_overlap_with_sparse_or_tp():
     base = dict(train_data_path_prefix="<t>", overlap_grad_allreduce=True)
     with pytest.raises(ValueError, match="sparse"):
         Config(**base, use_sparse_embedding_update=True).verify()
-    with pytest.raises(ValueError, match="data-parallel"):
-        Config(**base, tp=2, max_contexts=200).verify()
-    with pytest.raises(ValueError, match="data-parallel"):
-        Config(**base, cp=2, max_contexts=200).verify()
+    # tp/cp sharding needs the manual-kernel path (GSPMD tp/cp keeps
+    # the stock fused step)
+    with pytest.raises(ValueError, match="manual_tp_kernels"):
+        Config(**base, tp=2, max_contexts=200,
+               use_manual_tp_kernels=False).verify()
+    with pytest.raises(ValueError, match="manual_tp_kernels"):
+        Config(**base, cp=2, max_contexts=200,
+               use_manual_tp_kernels=False).verify()
     with pytest.raises(ValueError, match="overlap_bucket_mb"):
         Config(train_data_path_prefix="<t>",
                overlap_bucket_mb=0).verify()
-    # the supported combo passes
+    with pytest.raises(ValueError, match="overlap_in_backward"):
+        Config(train_data_path_prefix="<t>",
+               overlap_in_backward=True).verify()
+    # the supported combos pass
     Config(**base, dp=2).verify()
+    Config(**base, tp=2, max_contexts=200,
+           use_manual_tp_kernels=True).verify()
+    Config(**base, dp=2, overlap_in_backward=True).verify()
 
 
 def test_overlap_refuses_foreign_opt_state():

@@ -770,6 +770,69 @@ def test_ingest_packs_delta_against_frozen_vocab_with_oov(
     assert out2["rows"] == 3
 
 
+def test_ingest_accumulates_manifest_and_finetune_uses_it(
+        tmp_path, tiny_vocabs):
+    """Manifest mode: ingest APPENDS each delta shard to the corpus
+    manifest (idempotent under re-run), and fine-tune hands the child
+    trainer --train_corpus_manifest instead of the delta alone."""
+    from code2vec_tpu.pipeline.stages import run_finetune
+    ckpt = tmp_path / "ckpt_iter3"
+    ckpt.mkdir()
+    (ckpt / "code2vec_manifest.json").write_text("{}")
+    (ckpt / "code2vec_meta.json").write_text(json.dumps({"epoch": 3}))
+    tiny_vocabs.save(str(ckpt / "dictionaries.bin"))
+    raw = tmp_path / "delta.raw.txt"
+    raw.write_text("get|name foo,P1,bar baz,P2,qux\n"
+                   "get|name foo,P1,bar\n"
+                   "run nope,P9,bar\n")
+    manifest = tmp_path / "corpus.manifest.json"
+    config = Config(verbose_mode=0, max_contexts=4,
+                    pipeline_raw=str(raw),
+                    model_load_path=str(tmp_path / "ckpt"),
+                    train_corpus_manifest=str(manifest))
+    ctx = PipelineContext(config, None, str(tmp_path / "run"),
+                          lambda m: None)
+    os.makedirs(ctx.run_dir, exist_ok=True)
+    out = run_ingest(ctx)
+    assert out["manifest"] == str(manifest)
+    assert out["manifest_shards"] == 1
+    assert out["manifest_rows"] == out["rows"] == 3
+    # re-run: the same shard path is NOT appended twice
+    out2 = run_ingest(ctx)
+    assert out2["manifest_shards"] == 1
+    # a later pipeline run (fresh run dir -> fresh shard) accumulates
+    ctx2 = PipelineContext(config, None, str(tmp_path / "run2"),
+                           lambda m: None)
+    os.makedirs(ctx2.run_dir, exist_ok=True)
+    out3 = run_ingest(ctx2)
+    assert out3["manifest_shards"] == 2
+    assert out3["manifest_rows"] == 6
+
+    class _Rec:
+        @staticmethod
+        def stage(name):
+            return {"outputs": out3}
+
+    ctx2.manifest = _Rec()
+    captured = {}
+
+    def fake_run_cli(argv, stage, name):
+        captured["argv"] = list(argv)
+        cand = tmp_path / "run2" / "candidate" / "ckpt_iter4"
+        cand.mkdir(parents=True, exist_ok=True)
+        (cand / "code2vec_manifest.json").write_text("{}")
+        (cand / "code2vec_meta.json").write_text(
+            json.dumps({"epoch": 4}))
+
+    ctx2.run_cli = fake_run_cli
+    ft = run_finetune(ctx2)
+    argv = captured["argv"]
+    assert "--train_corpus_manifest" in argv
+    assert argv[argv.index("--train_corpus_manifest") + 1] == \
+        str(manifest)
+    assert ft["candidate_ckpt"].endswith("ckpt_iter4")
+
+
 def test_ingest_refuses_untrainable_delta(tmp_path, tiny_vocabs):
     ckpt = tmp_path / "ckpt_iter1"
     ckpt.mkdir()

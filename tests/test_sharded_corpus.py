@@ -1,0 +1,258 @@
+"""Cursor laws under multi-shard manifests (ShardedCorpus,
+data/packed.py).
+
+The PR-6 cursor laws must hold VERBATIM across shard counts, because
+the per-epoch permutation is a pure function of (seed, epoch) over the
+GLOBAL row space the manifest's shard order defines:
+
+- the multi-shard stream is byte-for-byte the single-pack stream over
+  the same rows,
+- the per-step global batch SET is invariant across host counts,
+- resuming mid-epoch — even at a DIFFERENT host count — reproduces the
+  uninterrupted stream's remaining batch sets exactly,
+- a delta shard appended mid-run is refused until the next epoch
+  boundary, then joins the next epoch's permutation.
+
+Doubles as the tier-1 fast smoke of the multi-shard reader (everything
+here is tiny and CPU-only).
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.packed import (
+    PackedDataset, ShardedCorpus, append_manifest_shard, create_manifest,
+    load_manifest, pack_c2v, validate_manifest,
+)
+from code2vec_tpu.data.reader import EpochEnd, EstimatorAction
+
+
+def _distinct_lines(n, start=0):
+    """n distinct trainable rows (known targets, known contexts)."""
+    targets = ["get|name", "set|value", "run"]
+    tokens = ["foo", "bar", "baz", "qux"]
+    paths = ["P1", "P2", "P3"]
+    combos = itertools.islice(
+        itertools.product(targets, tokens, paths, tokens, paths), start,
+        start + n)
+    return [f"{t} {a},{p},{b} {b},{q},{a}" for t, a, p, b, q in combos]
+
+
+def _pack_lines(tmp_path, vocabs, name, lines, max_contexts=4):
+    c2v = str(tmp_path / f"{name}.train.c2v")
+    with open(c2v, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return pack_c2v(c2v, vocabs, max_contexts)
+
+
+def _make_manifest(tmp_path, vocabs, groups, name="corpus"):
+    shards = [_pack_lines(tmp_path, vocabs, f"{name}-shard{i}", lines)
+              for i, lines in enumerate(groups)]
+    manifest = str(tmp_path / f"{name}.manifest.json")
+    create_manifest(manifest, shards)
+    return manifest
+
+
+def _batch_sig(batch):
+    """One hashable signature per row (all packed int fields)."""
+    rec = np.concatenate(
+        [np.asarray(batch.target_index)[:, None].astype(np.int32),
+         np.asarray(batch.source_token_indices).astype(np.int32),
+         np.asarray(batch.path_indices).astype(np.int32),
+         np.asarray(batch.target_token_indices).astype(np.int32),
+         np.asarray(batch.context_valid_mask).astype(np.int32)], axis=1)
+    return [rec[i].tobytes() for i in range(rec.shape[0])]
+
+
+def _train_batches(ds, batch_size, **kw):
+    return [b for b in ds.iter_batches(batch_size, EstimatorAction.Train,
+                                       **kw)
+            if not isinstance(b, EpochEnd)]
+
+
+# ------------------------------------------------------ smoke / basics
+
+
+def test_multishard_stream_equals_single_pack(tmp_path, tiny_vocabs):
+    """3-shard manifest vs ONE pack over the same rows in the same
+    order: identical global row space -> byte-identical train stream."""
+    lines = _distinct_lines(40)
+    groups = [lines[:13], lines[13:26], lines[26:]]
+    manifest = _make_manifest(tmp_path, tiny_vocabs, groups)
+    single = _pack_lines(tmp_path, tiny_vocabs, "single", lines)
+
+    corpus = ShardedCorpus(manifest, tiny_vocabs)
+    packed = PackedDataset(single, tiny_vocabs)
+    assert len(corpus) == packed.num_rows_total == 40
+    assert corpus.num_shard_files == 3
+    assert corpus.steps_per_epoch(8, EstimatorAction.Train) == \
+        packed.steps_per_epoch(8, EstimatorAction.Train)
+
+    for action in (EstimatorAction.Train, EstimatorAction.Evaluate):
+        got = _train_batches(corpus, 8, num_epochs=2, seed=3) \
+            if action.is_train else list(corpus.iter_batches(8, action))
+        want = _train_batches(packed, 8, num_epochs=2, seed=3) \
+            if action.is_train else list(packed.iter_batches(8, action))
+        assert len(got) == len(want) and len(got) > 0
+        for g, w in zip(got, want):
+            assert _batch_sig(g) == _batch_sig(w)
+
+
+def test_validate_manifest_counts_and_fingerprints(tmp_path, tiny_vocabs):
+    manifest = _make_manifest(
+        tmp_path, tiny_vocabs,
+        [_distinct_lines(5), _distinct_lines(7, start=5)])
+    entries = validate_manifest(manifest, vocabs=tiny_vocabs)
+    assert [e["rows"] for e in entries] == [5, 7]
+    assert len({e["vocab_fingerprint"] for e in entries}) == 1
+    assert ShardedCorpus.read_manifest_rows(manifest) == 12
+
+
+def test_mixed_vocab_append_refused(tmp_path, tiny_vocabs):
+    from code2vec_tpu.vocab import Code2VecVocabs, WordFreqDicts
+    manifest = _make_manifest(tmp_path, tiny_vocabs,
+                              [_distinct_lines(5)])
+    other = Code2VecVocabs.create_from_freq_dicts(
+        WordFreqDicts(token_to_count={"foo": 3, "bar": 1},
+                      path_to_count={"P1": 2},
+                      target_to_count={"run": 2},
+                      num_train_examples=4),
+        max_token_vocab_size=5, max_path_vocab_size=5,
+        max_target_vocab_size=5)
+    alien = _pack_lines(tmp_path, other, "alien", ["run foo,P1,bar"])
+    with pytest.raises(ValueError, match="mixed-vocab"):
+        append_manifest_shard(manifest, alien)
+    # the manifest is unchanged by the refused append
+    assert len(load_manifest(manifest)["shards"]) == 1
+
+
+# --------------------------------------------------------- cursor laws
+
+
+def test_batch_sets_invariant_across_host_counts(tmp_path, tiny_vocabs):
+    """4-shard manifest: per-step global batch SET identical at 1, 2
+    and 4 hosts (truncate-before-stride, global permutation)."""
+    manifest = _make_manifest(
+        tmp_path, tiny_vocabs,
+        [_distinct_lines(12, start=12 * i) for i in range(4)])
+    ref = [_batch_sig(b) for b in _train_batches(
+        ShardedCorpus(manifest, tiny_vocabs), 8, num_epochs=1, seed=5)]
+    assert len(ref) == 6  # 48 rows / Bg=8
+    for hosts in (2, 4):
+        per_host = [
+            [_batch_sig(b) for b in _train_batches(
+                ShardedCorpus(manifest, tiny_vocabs, shard_index=h,
+                              num_shards=hosts),
+                8 // hosts, num_epochs=1, seed=5)]
+            for h in range(hosts)]
+        assert all(len(s) == len(ref) for s in per_host)
+        for step, want in enumerate(ref):
+            union = sorted(sum((s[step] for s in per_host), []))
+            assert union == sorted(want), f"hosts={hosts} step={step}"
+
+
+def test_resume_mid_epoch_at_different_host_count(tmp_path, tiny_vocabs):
+    """THE pod-scale elastic-resume law: consume k steps at 1 host,
+    resume at 2 hosts with the same cursor — the remaining steps (and
+    the following epoch) reproduce the uninterrupted batch sets."""
+    manifest = _make_manifest(
+        tmp_path, tiny_vocabs,
+        [_distinct_lines(12, start=12 * i) for i in range(4)])
+    Bg, consumed_steps = 8, 3
+    full = [_batch_sig(b) for b in _train_batches(
+        ShardedCorpus(manifest, tiny_vocabs), Bg, num_epochs=2, seed=5)]
+    assert len(full) == 12  # 6 steps/epoch x 2 epochs
+    skip = consumed_steps * Bg
+    resumed_hosts = [
+        [_batch_sig(b) for b in _train_batches(
+            ShardedCorpus(manifest, tiny_vocabs, shard_index=h,
+                          num_shards=2),
+            Bg // 2, num_epochs=2, seed=5, start_epoch=0,
+            skip_rows=skip)]
+        for h in range(2)]
+    want = full[consumed_steps:]
+    assert all(len(s) == len(want) for s in resumed_hosts)
+    for step, ref in enumerate(want):
+        union = sorted(resumed_hosts[0][step] + resumed_hosts[1][step])
+        assert union == sorted(ref), f"resumed step {step}"
+
+
+def test_resume_at_epoch_boundary_matches_uninterrupted(
+        tmp_path, tiny_vocabs):
+    """start_epoch=e with no cursor == the uninterrupted run's epoch e,
+    regardless of shard count (the permutation keys on the absolute
+    epoch index)."""
+    manifest = _make_manifest(
+        tmp_path, tiny_vocabs, [_distinct_lines(10),
+                                _distinct_lines(10, start=10)])
+    corpus = ShardedCorpus(manifest, tiny_vocabs)
+    full = [_batch_sig(b) for b in _train_batches(
+        corpus, 4, num_epochs=3, seed=9)]
+    steps = len(full) // 3
+    resumed = [_batch_sig(b) for b in _train_batches(
+        corpus, 4, num_epochs=2, seed=9, start_epoch=1)]
+    assert resumed == full[steps:]
+
+
+def test_mid_epoch_append_refused_until_boundary(tmp_path, tiny_vocabs):
+    """A delta shard appended mid-run: the manifest append itself is
+    fine (pure file append), but the OPEN corpus refuses to adopt it
+    while an epoch is in flight — adoption lands at the next epoch
+    boundary and the new rows join the NEXT epoch's permutation."""
+    manifest = _make_manifest(tmp_path, tiny_vocabs,
+                              [_distinct_lines(8),
+                               _distinct_lines(8, start=8)])
+    corpus = ShardedCorpus(manifest, tiny_vocabs)
+    gen = corpus.iter_batches(4, EstimatorAction.Train, num_epochs=2,
+                              seed=1, yield_epoch_markers=True)
+    first = next(gen)
+    assert not isinstance(first, EpochEnd)
+
+    delta = _pack_lines(tmp_path, tiny_vocabs, "delta",
+                        _distinct_lines(6, start=16))
+    append_manifest_shard(manifest, delta)
+    with pytest.raises(RuntimeError, match="mid-epoch"):
+        corpus.adopt_appended_shards()
+    assert len(corpus) == 16  # refusal left the open view untouched
+
+    # drain to the epoch boundary (the EpochEnd marker suspends the
+    # generator BETWEEN epochs)
+    item = next(gen)
+    while not isinstance(item, EpochEnd):
+        item = next(gen)
+    adopted = corpus.adopt_appended_shards()
+    assert adopted == 1 and len(corpus) == 22
+
+    # epoch 2 draws over the grown global row space: some batch now
+    # contains a delta row
+    delta_sigs = set()
+    ds_delta = PackedDataset(delta, tiny_vocabs)
+    delta_sigs.update(_batch_sig(
+        ds_delta.gather(np.arange(ds_delta.num_rows_total))))
+    epoch2 = []
+    for item in gen:
+        if isinstance(item, EpochEnd):
+            break
+        epoch2.extend(_batch_sig(item))
+    assert len(epoch2) == (22 // 4) * 4
+    assert delta_sigs & set(epoch2), \
+        "adopted delta rows never drawn in the next epoch"
+
+
+def test_manifest_relative_paths_survive_move(tmp_path, tiny_vocabs):
+    """Shard paths are stored relative to the manifest: moving the
+    whole directory keeps the corpus openable (pod-scale corpora live
+    on shared filesystems that mount at different roots)."""
+    src = tmp_path / "a"
+    src.mkdir()
+    manifest = _make_manifest(src, tiny_vocabs, [_distinct_lines(5)])
+    entry = load_manifest(manifest)["shards"][0]
+    assert not os.path.isabs(entry["path"])
+    dst = tmp_path / "b"
+    os.rename(src, dst)
+    moved = str(dst / os.path.basename(manifest))
+    corpus = ShardedCorpus(moved, tiny_vocabs)
+    assert len(corpus) == 5
