@@ -112,6 +112,26 @@ def arguments_parser() -> ArgumentParser:
                              "cache-miss pipeline before excess load "
                              "is shed with 503 + Retry-After "
                              "(default 64)")
+    parser.add_argument("--serve_tenants", type=str, default=None,
+                        metavar="NAME=W,...",
+                        help="named tenants and their admission "
+                             "weights (e.g. acme=4,dev=1; bare name = "
+                             "weight 1). Unset = tenancy off: serving "
+                             "behavior is byte-identical to a build "
+                             "without the feature")
+    parser.add_argument("--serve_tenant_default_weight", type=float,
+                        default=None, metavar="W",
+                        help="admission weight for tenants not named "
+                             "in --serve_tenants, including the "
+                             "implicit 'default' tenant (default 1.0)")
+    parser.add_argument("--serve_tenant_qps", type=str, default=None,
+                        metavar="NAME=QPS,...",
+                        help="per-tenant token-bucket rate quotas "
+                             "(e.g. acme=50,dev=5, or a bare number "
+                             "applied to every tenant); 0 = uncapped "
+                             "(the default). Over-quota requests are "
+                             "shed 503 shed_reason=tenant_quota with "
+                             "Retry-After from the bucket refill")
     parser.add_argument("--serve_breaker_window",
                         dest="serve_breaker_window_s", type=float,
                         default=None, metavar="SECONDS",
@@ -793,6 +813,9 @@ def config_from_args(argv=None) -> Config:
                                       "serve_deadline_ms",
                                       "serve_deadline_max_ms",
                                       "serve_queue_depth",
+                                      "serve_tenants",
+                                      "serve_tenant_default_weight",
+                                      "serve_tenant_qps",
                                       "serve_breaker_window_s",
                                       "serve_breaker_failure_ratio",
                                       "serve_breaker_min_requests",

@@ -286,6 +286,26 @@ class Config:
     # 503 + Retry-After instead of queueing unboundedly
     # (serving_requests_shed_total{reason=...}).
     serve_queue_depth: int = 64
+    # Weighted-fair multi-tenancy (serving/tenancy.py; README
+    # "Multi-tenancy"): "name=weight,..." declares the tenants sharing
+    # this server and their relative admission shares (the `X-Tenant`
+    # request header names the tenant; absent = "default"; tenants not
+    # listed here collapse into one "other" bucket). Each
+    # recently-active tenant owns weight/sum(active weights) of
+    # serve_queue_depth; an over-share tenant sheds as 503
+    # shed_reason=tenant_quota while in-share tenants keep their full
+    # deadline budget. Empty (the default) disables the tenancy layer
+    # entirely — responses are byte-identical to a tenancy-free build.
+    serve_tenants: str = ""
+    # Admission-share weight for tenants NOT named in serve_tenants
+    # (the "default" tenant and the collapsed "other" bucket).
+    serve_tenant_default_weight: float = 1.0
+    # Per-tenant rate quota (deterministic token bucket, qps): either
+    # one bare number applied to every tenant, or "name=qps,..." per
+    # tenant. 0 / unset = uncapped. An over-quota request sheds as
+    # tenant_quota with Retry-After derived from that tenant's own
+    # bucket refill time. Only read when serve_tenants is set.
+    serve_tenant_qps: str = ""
     # Circuit breakers (extractor pool + device step): rolling failure
     # window length, the failure ratio that opens the breaker once
     # min_requests samples exist, and the open->half-open probe
@@ -871,6 +891,20 @@ class Config:
             raise ValueError(
                 "serve_queue_depth must be >= 1 (the admission gate "
                 "needs room for at least one request).")
+        try:
+            from code2vec_tpu.serving.tenancy import (
+                parse_tenant_qps, parse_tenant_weights,
+            )
+            parse_tenant_weights(self.serve_tenants)
+            parse_tenant_qps(self.serve_tenant_qps)
+        except ValueError as e:
+            # a typo'd tenant spec must fail at startup, not skew
+            # production fairness silently
+            raise ValueError(str(e))
+        if self.serve_tenant_default_weight <= 0:
+            raise ValueError(
+                "serve_tenant_default_weight must be > 0 (it is the "
+                "admission share of every unconfigured tenant).")
         if self.serve_breaker_window_s <= 0:
             raise ValueError("serve_breaker_window_s must be > 0.")
         if not (0 < self.serve_breaker_failure_ratio <= 1):

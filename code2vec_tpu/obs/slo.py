@@ -27,6 +27,14 @@ Two objective kinds ship declaratively from config:
   {phase="total"} buckets (the histogram_quantile trick, inverted).
   Target e.g. 0.95 of requests under threshold.
 
+With tenancy on (serving/tenancy.py) the three request families carry
+a bounded `tenant` label; fleet-wide objectives here are unaffected
+(the engine sums across labels), and PER-TENANT burn rates need no new
+objective kind — the tsdb's `/query` endpoint already accepts any
+leftover query param as a label filter, so
+`/query?op=burn&name=serving_requests_total&tenant=acme` scopes the
+same math to one tenant (recipe in README "Multi-tenancy").
+
 A page-severity burn is an INCIDENT: the engine triggers an immediate
 flight-recorder dump (`slo_burn`, the `host_escalation` discipline) so
 the ring around the offending requests — trace ids included — is on

@@ -58,7 +58,8 @@ class PipelineManifest:
     @classmethod
     def load_or_create(cls, pipeline_dir: str, params_fingerprint: str,
                        stage_names: List[str],
-                       log=None) -> "PipelineManifest":
+                       log=None,
+                       model: Optional[str] = None) -> "PipelineManifest":
         path = os.path.join(os.path.abspath(pipeline_dir), MANIFEST_NAME)
         if os.path.isfile(path):
             try:
@@ -94,6 +95,12 @@ class PipelineManifest:
             "schema_version": SCHEMA_VERSION,
             "params_fingerprint": params_fingerprint,
             "stage_names": list(stage_names),
+            # the X-Model group this run promotes for: a postmortem of
+            # a refused promote reads WHICH group the run targeted
+            # straight off the manifest instead of re-deriving it from
+            # flags (the group is validated against the router's
+            # --fleet_models map by FleetSwapDriver.request)
+            "model": model,
             "created_at": time.time(),
             "stages": {},
             "journal": [],

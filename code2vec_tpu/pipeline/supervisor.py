@@ -91,7 +91,8 @@ class PipelineSupervisor:
         self.manifest = PipelineManifest.load_or_create(
             self.run_dir,
             params_fingerprint or self._params_fingerprint(),
-            [name for name, _fn in self.stages], log=self.log)
+            [name for name, _fn in self.stages], log=self.log,
+            model=config.pipeline_model)
         self.ctx = PipelineContext(config, self.manifest, self.run_dir,
                                    self.log)
         # One trace id per pipeline run: every stage span — and,

@@ -24,7 +24,17 @@ outcome instead of an emergent hang:
 Shed vocabulary (one counter family, pinned in tests and alerted on —
 README "Operating the server"):
 
-    serving_requests_shed_total{reason=queue_full|deadline|breaker|draining}
+    serving_requests_shed_total{reason=queue_full|deadline|breaker|
+                                draining|tenant_quota}
+
+With a tenancy policy (serving/tenancy.py, `--serve_tenants`) the gate
+is additionally weighted-fair: each recently-active tenant owns a
+share of `max_depth` proportional to its configured weight, a tenant
+over its share (or over its token-bucket rate quota) sheds as
+`tenant_quota` with a Retry-After derived from ITS OWN state — the
+bucket's refill time for a rate shed, its own in-flight drain estimate
+for a share shed — never the fleet-wide queue estimate, while
+in-share tenants keep their full deadline budget.
 
 `Shed` (503, the request was never worked on — retry elsewhere/later)
 is deliberately distinct from `DeadlineExceeded` (504, the request was
@@ -53,14 +63,18 @@ _G_DEPTH = obs.gauge(
     "finished (the admission queue bound applies to this)")
 
 
+_SHED_HELP = (
+    "requests refused with an honest 503 before any pipeline work: "
+    "queue_full (admission depth at the bound), deadline (estimated "
+    "wait or device time exceeds the request's remaining budget), "
+    "breaker (a circuit breaker is open), draining (SIGTERM grace), "
+    "tenant_quota (the tenant is over its fair share or rate quota — "
+    "serving/tenancy.py)")
+
+
 def _shed_counter(reason: str):
-    return obs.counter(
-        "serving_requests_shed_total",
-        "requests refused with an honest 503 before any pipeline work: "
-        "queue_full (admission depth at the bound), deadline (estimated "
-        "wait or device time exceeds the request's remaining budget), "
-        "breaker (a circuit breaker is open), draining (SIGTERM grace)",
-        reason=reason)
+    return obs.counter("serving_requests_shed_total", _SHED_HELP,
+                       reason=reason)
 
 
 def expired_counter(stage: str):
@@ -172,16 +186,33 @@ class AdmissionController:
     path); until the first completion seeds the EWMA only the hard
     depth bound sheds, so a cold server never refuses its first
     requests on a bogus estimate.
+
+    With `tenancy` (a serving/tenancy.TenantPolicy) the gate is
+    weighted-fair: `admit(deadline, tenant=label)` first charges the
+    tenant's token bucket (over-rate ⇒ `tenant_quota` shed whose
+    Retry-After is the BUCKET's refill time), then checks the tenant's
+    share of `max_depth`. The share bound is weight-proportional over
+    the tenants seen inside the policy's active window — a lone tenant
+    keeps the whole queue (and behaves bit-identically to the
+    tenancy-free gate), while under contention each tenant's in-flight
+    depth is capped at floor(max_depth x weight / active weights), so
+    the most-over-share tenant is always the first refused and the sum
+    of bounds never exceeds the global bound. A share shed's
+    Retry-After is the TENANT's own drain estimate (its depth x EWMA /
+    concurrency), not the fleet-wide wait.
     """
 
     def __init__(self, max_depth: int, concurrency: int = 1,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2, tenancy=None):
         self.max_depth = max(1, int(max_depth))
         self.concurrency = max(1, int(concurrency))
         self._alpha = float(ewma_alpha)
+        self.tenancy = tenancy
         self._lock = threading.Lock()
         self._depth = 0
         self._ewma_s: Optional[float] = None
+        self._tenant_depth: dict = {}
+        self._tenant_seen: dict = {}   # label -> last admit-attempt ts
 
     @property
     def depth(self) -> int:
@@ -195,9 +226,68 @@ class AdmissionController:
                 return None
             return self._depth * self._ewma_s / self.concurrency
 
-    def admit(self, deadline: Optional[Deadline] = None) -> None:
-        fault_point("admission_enqueue")
+    def tenant_depth(self, label: str) -> int:
         with self._lock:
+            return self._tenant_depth.get(label, 0)
+
+    def tenant_bound(self, label: str) -> int:
+        """This tenant's current in-flight bound (for /healthz and the
+        fairness-law tests): its weighted share of `max_depth` over
+        the recently-active tenant set."""
+        with self._lock:
+            return self._tenant_bound_locked(label)
+
+    def _tenant_bound_locked(self, label: str) -> int:
+        pol = self.tenancy
+        now = pol.clock()
+        self._tenant_seen[label] = now
+        horizon = now - pol.active_window_s
+        for t in [t for t, ts in self._tenant_seen.items()
+                  if ts < horizon and not self._tenant_depth.get(t)]:
+            del self._tenant_seen[t]
+        active = set(self._tenant_seen) | set(self._tenant_depth)
+        total = sum(pol.weight(t) for t in active)
+        if total <= 0:
+            return self.max_depth
+        # floor keeps sum(bounds) <= max_depth, so in-share tenants
+        # never hit the global queue_full path while every contender
+        # respects its share; max(1,...) keeps a tiny-weight tenant
+        # servable at all.
+        return max(1, int(self.max_depth * pol.weight(label) / total))
+
+    def admit(self, deadline: Optional[Deadline] = None,
+              tenant: Optional[str] = None) -> None:
+        fault_point("admission_enqueue")
+        pol = self.tenancy
+        if pol is not None and tenant is not None:
+            bucket = pol.bucket(tenant)
+            if bucket is not None and not bucket.try_take():
+                # the bugfix contract: a rate-quota shed's Retry-After
+                # comes from THIS tenant's bucket refill time, never
+                # the fleet-wide queue-wait estimate
+                raise Shed(
+                    "tenant_quota",
+                    f"tenant {tenant!r} is over its rate quota",
+                    retry_after_s=bucket.retry_after_s())
+        with self._lock:
+            if pol is not None and tenant is not None:
+                bound = self._tenant_bound_locked(tenant)
+                held = self._tenant_depth.get(tenant, 0)
+                # bound == max_depth means no contention (a lone
+                # tenant owns the whole queue): fall through to the
+                # global gate so the shed reason — and the behavior —
+                # stay exactly the tenancy-free queue_full
+                if held >= bound and bound < self.max_depth:
+                    # tenant-scoped wait: how long until ITS in-flight
+                    # requests drain, not the whole queue's
+                    wait = (self._ewma_s or 1.0) * max(held, 1) \
+                        / self.concurrency
+                    raise Shed(
+                        "tenant_quota",
+                        f"tenant {tenant!r} is over its fair share "
+                        f"({held}/{bound} of {self.max_depth} in "
+                        f"flight)",
+                        retry_after_s=wait)
             if self._depth >= self.max_depth:
                 wait = (self._ewma_s or 1.0) * self.max_depth \
                     / self.concurrency
@@ -217,11 +307,21 @@ class AdmissionController:
                         f"{max(deadline.remaining(), 0) * 1e3:.0f}ms",
                         retry_after_s=est)
             self._depth += 1
+            if pol is not None and tenant is not None:
+                self._tenant_depth[tenant] = \
+                    self._tenant_depth.get(tenant, 0) + 1
             _G_DEPTH.set(self._depth)
 
-    def finish(self, duration_s: float) -> None:
+    def finish(self, duration_s: float,
+               tenant: Optional[str] = None) -> None:
         with self._lock:
             self._depth = max(0, self._depth - 1)
+            if tenant is not None:
+                held = self._tenant_depth.get(tenant, 0) - 1
+                if held > 0:
+                    self._tenant_depth[tenant] = held
+                else:
+                    self._tenant_depth.pop(tenant, None)
             _G_DEPTH.set(self._depth)
             if duration_s >= 0:
                 if self._ewma_s is None:

@@ -103,12 +103,12 @@ def bucket_for(n_contexts: int, buckets: Sequence[int]) -> int:
 
 class _Pending:
     __slots__ = ("lines", "future", "t_submit", "phases", "deadline",
-                 "bucket", "trace", "settled")
+                 "bucket", "trace", "settled", "tenant")
 
     def __init__(self, lines: List[str], phases: Optional[dict],
                  deadline: Optional[Deadline] = None,
                  bucket: Optional[int] = None,
-                 trace=None):
+                 trace=None, tenant: Optional[str] = None):
         self.lines = lines
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
@@ -116,6 +116,10 @@ class _Pending:
         self.deadline = deadline
         self.bucket = bucket
         self.trace = trace
+        # collapsed tenant label (serving/tenancy.py) — the batchers'
+        # DWRR fill and per-slot share caps key on it; None when the
+        # tenancy layer is off
+        self.tenant = tenant
         # continuous batcher: an item settled early (504 / parse error)
         # stays in its slot (its rows are reserved in the fixed-shape
         # buffer, mask-zeroed) but is skipped at result fan-out
@@ -168,14 +172,23 @@ class DynamicBatcher:
     `max_batch_rows` rows; one oversized group (a file with more methods
     than the cap) dispatches alone — predict_fn chunks internally, so
     correctness never depends on the cap.
+
+    With `tenancy` (serving/tenancy.TenantPolicy) a batch with MORE
+    than one tenant pending fills in deficit-weighted-round-robin
+    order across per-tenant sub-queues (tenancy.dwrr_take) instead of
+    global FIFO, so one tenant's backlog cannot monopolize a device
+    batch; a single tenant (or no policy) keeps the exact FIFO path.
     """
 
     def __init__(self, predict_fn: Callable[[List[str]], List],
                  max_batch_rows: int = 64, max_delay_s: float = 0.01,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 tenancy=None):
         self.predict_fn = predict_fn
         self.max_batch_rows = max(1, int(max_batch_rows))
         self.max_delay_s = max(0.0, float(max_delay_s))
+        self.tenancy = tenancy
+        self._dwrr_state: dict = {}
         # Context-bucket list (model.context_buckets) for per-bucket
         # device-time estimates; None = one global estimate (the
         # standalone/unit-test construction).
@@ -208,8 +221,9 @@ class DynamicBatcher:
     def submit(self, lines: Sequence[str],
                phases: Optional[dict] = None,
                deadline: Optional[Deadline] = None,
-               trace=None) -> Future:
-        item = _Pending(list(lines), phases, deadline, trace=trace)
+               trace=None, tenant: Optional[str] = None) -> Future:
+        item = _Pending(list(lines), phases, deadline, trace=trace,
+                        tenant=tenant)
         if not item.lines:
             item.future.set_result([])
             return item.future
@@ -320,6 +334,21 @@ class DynamicBatcher:
         self._pending = alive
 
     def _take_locked(self) -> List[_Pending]:
+        if self.tenancy is not None:
+            from code2vec_tpu.serving.tenancy import dwrr_take
+            picked = dwrr_take(self._pending, self.max_batch_rows,
+                               self.tenancy.weight, self._dwrr_state)
+            if picked is not None:
+                # >1 tenant pending: weighted-fair interleave. None ⇒
+                # a single tenant's queue — the FIFO loop below is
+                # byte-identical to the tenancy-free batcher.
+                chosen = set(picked)
+                take = [self._pending[i] for i in picked]
+                self._pending = [item for j, item
+                                 in enumerate(self._pending)
+                                 if j not in chosen]
+                self._pending_rows -= sum(len(i.lines) for i in take)
+                return take
         take: List[_Pending] = []
         rows = 0
         while self._pending:
@@ -513,12 +542,13 @@ class ContinuousBatcher:
                  = None,
                  max_batch_rows: int = 64, max_delay_s: float = 0.01,
                  buckets: Optional[Sequence[int]] = None,
-                 inflight_steps: int = 2, backend=None):
+                 inflight_steps: int = 2, backend=None, tenancy=None):
         if predict_fn is None and backend is None:
             raise ValueError("ContinuousBatcher needs a predict_fn or "
                              "a backend")
         self.predict_fn = predict_fn
         self.backend = backend
+        self.tenancy = tenancy
         self.max_batch_rows = max(1, int(max_batch_rows))
         self.max_delay_s = max(0.0, float(max_delay_s))
         self.buckets = tuple(buckets) if buckets else None
@@ -544,11 +574,34 @@ class ContinuousBatcher:
 
     _bucket_of = DynamicBatcher._bucket_of
 
+    def _tenant_cap_hit_locked(self, slot: "_Slot",
+                               tenant: Optional[str], n: int) -> bool:
+        """Per-slot share cap: in a slot already SHARED by other
+        tenants, one tenant may reserve at most its weighted share of
+        the slot's rows — overflow opens the next slot instead of
+        squeezing batch-mates out. A slot holding a single tenant (the
+        common case, and every tenancy-off run) is never capped, so
+        the classic fill behavior is untouched."""
+        if self.tenancy is None or not slot.items:
+            return False
+        tenants = {i.tenant for i in slot.items}
+        if tenants == {tenant}:
+            return False
+        held = sum(len(i.lines) for i in slot.items
+                   if i.tenant == tenant)
+        total_w = sum(self.tenancy.weight(t)
+                      for t in tenants | {tenant})
+        cap = max(1, int(self.max_batch_rows
+                         * self.tenancy.weight(tenant)
+                         / (total_w or 1.0)))
+        return held + n > cap
+
     def submit(self, lines: Sequence[str],
                phases: Optional[dict] = None,
                deadline: Optional[Deadline] = None,
-               trace=None) -> Future:
-        item = _Pending(list(lines), phases, deadline, trace=trace)
+               trace=None, tenant: Optional[str] = None) -> Future:
+        item = _Pending(list(lines), phases, deadline, trace=trace,
+                        tenant=tenant)
         if not item.lines:
             item.future.set_result([])
             return item.future
@@ -587,7 +640,8 @@ class ContinuousBatcher:
                 return item.future
             slot = self._slots[-1] if self._slots else None
             if (slot is None or slot.sealed or slot.kind != kind
-                    or slot.rows + n > self.max_batch_rows):
+                    or slot.rows + n > self.max_batch_rows
+                    or self._tenant_cap_hit_locked(slot, tenant, n)):
                 if slot is not None and not slot.sealed:
                     slot.sealed = True
                 buffer = self._get_buffer_locked() if kind == "rows" \

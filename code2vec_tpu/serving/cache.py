@@ -17,6 +17,13 @@ tests/test_serving.py).
 
 Thread-safe: the HTTP server handles requests on a thread per
 connection. Hits, misses and evictions are first-class counters.
+
+Tenancy (serving/tenancy.py) deliberately does NOT split this cache
+per tenant: the key is a pure content fingerprint, so two tenants
+sending the same method body get the same bytes — the hit path stays
+byte-equal to the miss path regardless of who asks, and hits stay
+PRE-ADMISSION (a cache hit costs no pipeline capacity, so it is never
+counted against a tenant's share or rate quota).
 """
 
 from __future__ import annotations

@@ -75,7 +75,7 @@ from code2vec_tpu.serving.admission import (
 )
 from code2vec_tpu.serving.cache import normalize_source
 from code2vec_tpu.serving.forwarding import (
-    forward_with_retry, handle_admin_post,
+    REQUEST_FORWARD_HEADERS, forward_with_retry, handle_admin_post,
 )
 
 DEFAULT_MODEL = "default"
@@ -317,7 +317,7 @@ class FleetRouter:
             or DEFAULT_MODEL
         fwd_span.attrs["model"] = model
         fwd_headers = {"traceparent": trace.traceparent()}
-        for name in ("Content-Type", "X-Deadline-Ms", "X-Model"):
+        for name in REQUEST_FORWARD_HEADERS:
             if handler.headers.get(name):
                 fwd_headers[name] = handler.headers[name]
 

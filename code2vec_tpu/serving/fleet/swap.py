@@ -116,7 +116,16 @@ class FleetSwapDriver:
                                     self._status["target"])
             hosts = self.control.swap_hosts(model)
             if hosts is None:
-                raise ValueError(f"no such model: {model!r}")
+                # a pipeline promoting for a group the router does not
+                # map (--fleet_models) must be refused HERE — loudly,
+                # before any host is touched — not discovered as an
+                # ambiguous non-convergence at canary time
+                known = getattr(self.control, "models", None) or []
+                raise ValueError(
+                    f"no such model: {model!r}; this fleet serves "
+                    f"model group(s) {sorted(known)!r} — check the "
+                    f"pipeline's --pipeline_model against the "
+                    f"router's --fleet_models map")
             if not hosts:
                 raise ValueError(
                     f"no live host in model group {model!r} to swap")

@@ -30,6 +30,15 @@ _UNBOUNDED_TIMEOUT_S = 300.0
 # and the PR-12 trace-correlation contract.
 _RELAY_HEADERS = ("Retry-After", "X-Trace-Id", "traceparent")
 
+# Request headers the routing tier forwards verbatim to backends: the
+# body framing, the client's deadline budget, the model-group selector,
+# and the tenant identity (serving/tenancy.py). ONE tuple shared by the
+# fleet router and the supervisor proxy so a header added to the
+# serving contract can never silently stop at one hop — pinned in
+# tests/test_tenancy.py.
+REQUEST_FORWARD_HEADERS = ("Content-Type", "X-Deadline-Ms", "X-Model",
+                           "X-Tenant")
+
 
 def forward_with_retry(
     *,

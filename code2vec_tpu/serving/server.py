@@ -98,7 +98,7 @@ from code2vec_tpu import obs
 from code2vec_tpu.obs.flight import default_flight_recorder
 from code2vec_tpu.obs.reqtrace import RequestTrace
 from code2vec_tpu.serving.admission import (
-    AdmissionController, Deadline, DeadlineExceeded, Shed,
+    _SHED_HELP, AdmissionController, Deadline, DeadlineExceeded, Shed,
     deadline_from_request, expired_counter, retry_after_seconds,
 )
 from code2vec_tpu.serving.batcher import (
@@ -114,6 +114,9 @@ from code2vec_tpu.serving.extractor_bridge import (
 from code2vec_tpu.serving.extractor_pool import ExtractorPool
 from code2vec_tpu.serving.interactive import parse_prediction_results
 from code2vec_tpu.serving.swap import SwapError, SwapManager
+from code2vec_tpu.serving.tenancy import (
+    TENANT_HEADER, TenantPolicy, tenant_metric,
+)
 from code2vec_tpu.utils.faults import FaultInjected
 
 _PIPELINE_PHASES = ("queue_wait", "extract", "batch_wait", "device")
@@ -143,9 +146,11 @@ def _total_hist(status: str):
                          phase="total", status=status)
 
 
+_REQUESTS_HELP = "HTTP requests by endpoint and outcome"
+
+
 def _requests_counter(endpoint: str, status: str):
-    return obs.counter("serving_requests_total",
-                       "HTTP requests by endpoint and outcome",
+    return obs.counter("serving_requests_total", _REQUESTS_HELP,
                        endpoint=endpoint, status=status)
 
 
@@ -237,10 +242,16 @@ class PredictionServer:
         # the SAME batches (a per-endpoint batcher would halve fill);
         # the step computes vectors anyway, the flag only materializes
         # them host-side, and _render decides per endpoint what ships.
+        # Tenancy policy (serving/tenancy.py): None when
+        # --serve_tenants is unset — the whole tenant layer is then
+        # inert and the serve path is bit-identical to a build without
+        # it (pinned in tests/test_tenancy.py).
+        self.tenancy = TenantPolicy.from_config(self.config)
         batcher_kw = dict(
             max_batch_rows=self.config.serve_batch_size,
             max_delay_s=self.config.serve_max_delay_ms / 1000.0,
-            buckets=model.context_buckets)
+            buckets=model.context_buckets,
+            tenancy=self.tenancy)
         if getattr(self.config, "serve_continuous", False):
             # --serve_continuous: slot-reservation dispatcher + the
             # zero-copy parse-into-slot path (batcher.ContinuousBatcher)
@@ -274,7 +285,8 @@ class PredictionServer:
                 log=self.log)
         self.admission = AdmissionController(
             max_depth=self.config.serve_queue_depth,
-            concurrency=self.config.extractor_pool_size)
+            concurrency=self.config.extractor_pool_size,
+            tenancy=self.tenancy)
         # Flight recorder (obs/flight.py): terminal request records +
         # anomaly events, dumped on incident (README "Telemetry"). Dump
         # dir defaults next to the heartbeat file so the supervisor's
@@ -395,7 +407,8 @@ class PredictionServer:
     def handle_request(self, endpoint: str, code: str,
                        deadline: Optional[Deadline] = None,
                        params: Optional[Dict] = None,
-                       trace: Optional[RequestTrace] = None
+                       trace: Optional[RequestTrace] = None,
+                       tenant: Optional[str] = None
                        ) -> Tuple[int, bytes, Dict[str, str]]:
         """Full serve path for one request -> (http_status, body,
         extra_headers). EVERY terminal status lands in
@@ -404,21 +417,39 @@ class PredictionServer:
         invisible. Every request carries a trace (inbound `traceparent`
         or minted here): the id rides the X-Trace-Id response header,
         the span tree lands in the ring tracer, and the terminal record
-        goes into the flight recorder."""
+        goes into the flight recorder.
+
+        `tenant` is the raw X-Tenant header value; with a tenancy
+        policy it is collapsed onto the closed label set for
+        scheduling and metrics, recorded verbatim in the trace and
+        flight record. Without a policy it is ignored entirely."""
         t0 = time.perf_counter()
         if trace is None:
             trace = RequestTrace()
+        tlabel: Optional[str] = None
+        if self.tenancy is not None:
+            tenant = self.tenancy.resolve(tenant)
+            tlabel = self.tenancy.label(tenant)
         root = trace.span("request", endpoint=endpoint)
         root.__enter__()
+        if tlabel is not None:
+            root.attrs["tenant"] = tenant
         phases: Dict[str, float] = {}
         status, body, headers = 500, b"", {}
         reason: Optional[str] = None
         try:
             body = self._handle(endpoint, code, deadline, phases,
-                                params=params, trace=trace)
+                                params=params, trace=trace,
+                                tenant=tlabel)
             status = 200
         except Shed as e:
-            e.count()
+            if tlabel is None:
+                e.count()
+            else:
+                tenant_metric(
+                    "counter", "serving_requests_shed_total",
+                    _SHED_HELP, tlabel, self.tenancy.labels,
+                    reason=e.reason).inc()
             status = 503
             reason = e.reason
             # jittered: a synchronized shed (open breaker, drain) must
@@ -462,12 +493,28 @@ class PredictionServer:
             phases = dict(list(phases.items()))
             for phase, dur in phases.items():
                 _H_PHASE[phase].observe(dur)
-            _total_hist(str(status)).observe(total)
-            _requests_counter(endpoint, str(status)).inc()
+            if tlabel is None:
+                _total_hist(str(status)).observe(total)
+                _requests_counter(endpoint, str(status)).inc()
+            else:
+                # tenancy on: the terminal-status families carry a
+                # `tenant` label (bounded by the policy's closed set;
+                # serving/tenancy.tenant_metric is the guard). The
+                # per-phase histograms above stay tenant-free — phases
+                # are a pipeline property, not a tenant one.
+                tenant_metric(
+                    "histogram", "serving_request_seconds",
+                    _PHASE_HELP, tlabel, self.tenancy.labels,
+                    phase="total", status=str(status)).observe(total)
+                tenant_metric(
+                    "counter", "serving_requests_total",
+                    _REQUESTS_HELP, tlabel, self.tenancy.labels,
+                    endpoint=endpoint, status=str(status)).inc()
             self.flight.record_request(
                 trace_id=trace.trace_id, endpoint=endpoint,
                 status=status, duration_s=total, phases=phases,
-                reason=reason, fingerprint=self.model_fingerprint)
+                reason=reason, fingerprint=self.model_fingerprint,
+                **({} if tlabel is None else {"tenant": tenant}))
             headers.setdefault("X-Trace-Id", trace.trace_id)
             headers.setdefault("traceparent", trace.traceparent())
         return status, body, headers
@@ -491,7 +538,8 @@ class PredictionServer:
                 deadline: Optional[Deadline],
                 phases: Dict[str, float],
                 params: Optional[Dict] = None,
-                trace: Optional[RequestTrace] = None) -> bytes:
+                trace: Optional[RequestTrace] = None,
+                tenant: Optional[str] = None) -> bytes:
         if trace is None:
             trace = RequestTrace()
         if not code.strip():
@@ -523,7 +571,7 @@ class PredictionServer:
             # path down with it (pinned in tests/test_serving_chaos.py).
             return cached  # type: ignore[return-value]
         with trace.span("admission"):
-            self.admission.admit(deadline)
+            self.admission.admit(deadline, tenant=tenant)
         t_admit = time.perf_counter()
         worked = True
         try:
@@ -532,7 +580,8 @@ class PredictionServer:
             if self.traffic is not None:
                 self.traffic.record(lines)
             future = self.batcher.submit(lines, phases=phases,
-                                         deadline=deadline, trace=trace)
+                                         deadline=deadline, trace=trace,
+                                         tenant=tenant)
             try:
                 if deadline is not None and deadline.bounded:
                     # Backstop: the batcher settles expired futures
@@ -577,7 +626,8 @@ class PredictionServer:
             raise
         finally:
             self.admission.finish(
-                (time.perf_counter() - t_admit) if worked else -1.0)
+                (time.perf_counter() - t_admit) if worked else -1.0,
+                tenant=tenant)
 
     def _extract(self, code: str, deadline: Optional[Deadline],
                  phases: Dict[str, float],
@@ -758,6 +808,11 @@ class PredictionServer:
             },
             "breakers": {"extractor": self.extractor_breaker.state,
                          "device": self.device_breaker.state},
+            # weighted-fair tenancy (README "Multi-tenancy"); absent
+            # key semantics preserved for tenancy-off deployments by
+            # only adding it when a policy is configured
+            **({} if self.tenancy is None
+               else {"tenancy": self.tenancy.healthz()}),
             # request-scoped telemetry (README "Telemetry"): whether
             # ?debug=trace is honored, and the flight recorder's state
             "telemetry": {
@@ -877,9 +932,25 @@ class PredictionServer:
 
                 deadline = deadline_from_request(
                     server.config, self.headers.get("X-Deadline-Ms"))
+                # tenant identity is parsed ONCE here at the edge; the
+                # fleet router / supervisor proxy forward the header
+                # verbatim (forwarding.REQUEST_FORWARD_HEADERS)
+                tenant = self.headers.get(TENANT_HEADER)
                 if not server._enter_request():
-                    Shed("draining", "").count()
-                    _requests_counter(endpoint, "draining").inc()
+                    if server.tenancy is None:
+                        Shed("draining", "").count()
+                        _requests_counter(endpoint, "draining").inc()
+                    else:
+                        tl = server.tenancy.label(tenant)
+                        tenant_metric(
+                            "counter", "serving_requests_shed_total",
+                            _SHED_HELP, tl, server.tenancy.labels,
+                            reason="draining").inc()
+                        tenant_metric(
+                            "counter", "serving_requests_total",
+                            _REQUESTS_HELP, tl,
+                            server.tenancy.labels, endpoint=endpoint,
+                            status="draining").inc()
                     self._error(503, "server is draining",
                                 extra_headers=trace_headers(
                                     **{"Retry-After": str(
@@ -899,7 +970,7 @@ class PredictionServer:
                         return
                     status, body, headers = server.handle_request(
                         endpoint, code_text, deadline, params=params,
-                        trace=trace)
+                        trace=trace, tenant=tenant)
                     if ("debug=trace" in query.split("&")
                             and server.config.serve_debug_trace):
                         # post-cache injection: hits and misses both

@@ -69,7 +69,7 @@ from code2vec_tpu.serving.admission import (
     deadline_from_request, retry_after_seconds,
 )
 from code2vec_tpu.serving.forwarding import (
-    forward_with_retry, handle_admin_post,
+    REQUEST_FORWARD_HEADERS, forward_with_retry, handle_admin_post,
 )
 
 REPLICA_ENV = "C2V_SERVE_REPLICA"
@@ -866,7 +866,7 @@ class Supervisor:
                 deadline = deadline_from_request(
                     sup.config, self.headers.get("X-Deadline-Ms"))
                 fwd_headers = {"traceparent": trace.traceparent()}
-                for name in ("Content-Type", "X-Deadline-Ms"):
+                for name in REQUEST_FORWARD_HEADERS:
                     if self.headers.get(name):
                         fwd_headers[name] = self.headers[name]
                 ports = sup._live_ports()

@@ -16,7 +16,10 @@ command). This module parses those snapshots and merges them:
 - **counters** and **histograms** (bucket counts, `_sum`, `_count`) are
   SUMMED across replicas — `serving_requests_total` on the merged
   endpoint equals the sum of the per-replica counters (pinned in
-  tests/test_telemetry.py);
+  tests/test_telemetry.py). Merging keys on the FULL label set, so
+  the tenant label (serving/tenancy.py) sums per tenant through this
+  merge — and through the router's fleet-wide merge above it — with
+  no tenancy-specific code here;
 - **gauges** are NOT summable (the mean of two breaker states is
   nonsense) — each replica's gauge exports with an added
   `replica="<i>"` label.

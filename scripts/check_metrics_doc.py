@@ -53,6 +53,13 @@ _DYNAMIC_REGISTRATIONS = {
     os.path.join("evaluation", "evaluator.py"): (
         "eval_top1_acc", "eval_topk_acc", "eval_subtoken_precision",
         "eval_subtoken_recall", "eval_subtoken_f1", "eval_loss"),
+    # tenant_metric() registers the three tenant-labeled families with
+    # the name as a variable behind a ValueError guard that pins this
+    # exact closed set (serving/tenancy.py _TENANT_METRICS; the guard
+    # is itself asserted in tests/test_tenancy.py)
+    os.path.join("serving", "tenancy.py"): (
+        "serving_requests_total", "serving_requests_shed_total",
+        "serving_request_seconds"),
 }
 
 _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -52,6 +52,11 @@ PIPELINE_BUDGET=600
 # host-respawn (artifact, retrieval_index) reconciliation drill — each
 # a 2-router x 2-host fake-model fleet, so the budget covers hangs.
 EDGE_BUDGET=600
+# Tenant-fair serving: the hot-tenant-overload drill — one tenant at a
+# multiple of its share against a real server while in-share tenants
+# keep serving — plus the in-process fairness-law matrix (fake-model
+# servers, so the budget covers hangs, not work).
+TENANCY_BUDGET=600
 
 rc=0
 
@@ -79,6 +84,7 @@ run_suite "$RETRIEVAL_BUDGET" tests/test_retrieval.py "$@"
 run_suite "$FLEET_BUDGET" tests/test_fleet.py "$@"
 run_suite "$PIPELINE_BUDGET" tests/test_pipeline.py "$@"
 run_suite "$EDGE_BUDGET" tests/test_edge.py "$@"
+run_suite "$TENANCY_BUDGET" tests/test_tenancy.py "$@"
 
 if [ "$rc" -ne 0 ]; then
     echo "=== chaos run FAILED (rc=$rc): dumping diagnostics ==="

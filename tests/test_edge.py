@@ -414,6 +414,72 @@ def test_shared_fleet_view_derives_candidates_and_view():
         listener.shutdown()
 
 
+def test_router_forwards_x_tenant_to_backend():
+    """The tenant identity pin (serving/tenancy.py): X-Tenant rides
+    the shared forwarding contract router -> host, alongside X-Model
+    and X-Deadline-Ms — a header in REQUEST_FORWARD_HEADERS can never
+    silently stop at one hop."""
+    from code2vec_tpu.serving.fleet.router import FleetRouter
+    from code2vec_tpu.serving.forwarding import REQUEST_FORWARD_HEADERS
+    from test_fleet import _StubControl
+
+    assert "X-Tenant" in REQUEST_FORWARD_HEADERS
+
+    captured = []
+
+    class _Capture(http.server.ThreadingHTTPServer):
+        daemon_threads = True
+
+        def __init__(self):
+            class Handler(http.server.BaseHTTPRequestHandler):
+                protocol_version = "HTTP/1.1"
+
+                def log_message(self, *args):
+                    pass
+
+                def do_POST(self):  # noqa: N802 (stdlib API name)
+                    length = int(self.headers.get("Content-Length", 0))
+                    self.rfile.read(length)
+                    captured.append(dict(self.headers))
+                    body = b'{"ok": true}\n'
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            super().__init__(("127.0.0.1", 0), Handler)
+            threading.Thread(target=self.serve_forever,
+                             daemon=True).start()
+
+    backend = _Capture()
+    control = _StubControl({"default": [
+        (1.0, "h0", ("127.0.0.1", backend.server_address[1]))]})
+    router = FleetRouter(_router_test_config(), control,
+                         host="127.0.0.1", port=0, log=lambda m: None)
+    try:
+        status, _, _ = _post(router.port, "/predict",
+                             "class A { int f() { return 1; } }",
+                             headers={"X-Tenant": "acme",
+                                      "X-Deadline-Ms": "1500"})
+        assert status == 200
+        [headers] = captured
+        assert headers.get("X-Tenant") == "acme"
+        assert headers.get("X-Deadline-Ms") == "1500"
+        # absent header stays absent: the backend sees exactly what
+        # the client sent, never an injected default
+        captured.clear()
+        status, _, _ = _post(router.port, "/predict",
+                             "class A { int g() { return 2; } }")
+        assert status == 200
+        [headers] = captured
+        assert "X-Tenant" not in headers
+    finally:
+        router.close()
+        backend.shutdown()
+
+
 def test_shared_fleet_view_admin_relay_passes_status_through():
     from code2vec_tpu.serving.fleet.edge import SharedFleetView
 
